@@ -1,0 +1,76 @@
+"""Engine configuration.
+
+No analogue exists in the reference (its engine is the remote service,
+SURVEY §0); this is the "config file + kwargs override" layer SURVEY §5.6
+prescribes for the TPU build: mesh shape, dtype policy, KV paging, and
+batching budgets, resolved from defaults <- ~/.sutro/engine.json <- kwargs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from pathlib import Path
+from typing import Any, Dict, Optional, Tuple
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    # --- device mesh -------------------------------------------------------
+    # Axis sizes; 0/None => infer from available devices. Axes: ("data",
+    # "expert", "model") — DP over DCN/outer, EP and TP over ICI (SURVEY §5.8).
+    dp: int = 0
+    tp: int = 0
+    ep: int = 1
+    # --- dtype policy ------------------------------------------------------
+    activation_dtype: str = "bfloat16"
+    param_dtype: str = "bfloat16"
+    # --- KV cache / batching ----------------------------------------------
+    kv_page_size: int = 64          # tokens per KV page
+    max_pages_per_seq: int = 128    # => max context 8192 by default
+    decode_batch_size: int = 64     # fixed decode slot count (static shapes)
+    prefill_chunk: int = 512        # reserved: chunked prefill (not yet wired)
+    max_batch_tokens: int = 32768   # admission budget: sum of in-flight
+                                    # worst-case totals (scheduler._try_admit)
+    max_model_len: int = 8192
+    # --- generation defaults ----------------------------------------------
+    max_new_tokens: int = 1024
+    temperature: float = 0.7
+    top_p: float = 0.95
+    top_k: int = 0                  # 0 = disabled
+    # --- runtime -----------------------------------------------------------
+    use_pallas: Optional[bool] = None   # None => auto (TPU yes, CPU no)
+    weights_dir: Optional[str] = None   # local HF-style checkpoint root
+    seed: int = 0
+
+    def resolved_mesh(self, n_devices: int) -> Tuple[int, int, int]:
+        """Resolve (dp, ep, tp) against the actual device count: tp gets
+        what's specified (default: all devices not claimed by ep), remaining
+        devices fold into dp."""
+        ep = self.ep or 1
+        tp = self.tp or max(1, n_devices // ep)
+        dp = self.dp or max(1, n_devices // (tp * ep))
+        if dp * ep * tp > n_devices:
+            raise ValueError(
+                f"Mesh dp*ep*tp={dp * ep * tp} exceeds {n_devices} devices"
+            )
+        return dp, ep, tp
+
+    def max_context(self) -> int:
+        return min(self.max_model_len, self.kv_page_size * self.max_pages_per_seq)
+
+
+def load_engine_config(**overrides: Any) -> EngineConfig:
+    """defaults <- $SUTRO_HOME/engine.json <- explicit kwargs."""
+    cfg: Dict[str, Any] = {}
+    home = Path(os.environ.get("SUTRO_HOME", Path.home() / ".sutro"))
+    path = home / "engine.json"
+    if path.exists():
+        try:
+            cfg.update(json.loads(path.read_text()))
+        except Exception:
+            pass
+    cfg.update({k: v for k, v in overrides.items() if v is not None})
+    fields = {f.name for f in dataclasses.fields(EngineConfig)}
+    return EngineConfig(**{k: v for k, v in cfg.items() if k in fields})

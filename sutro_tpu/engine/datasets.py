@@ -1,0 +1,148 @@
+"""Local dataset store.
+
+TPU-native replacement for the reference's remote dataset CRUD
+(/root/reference/sutro/sdk.py:1289-1516; wire contract SURVEY §3.6):
+``dataset-<id>`` directories of parquet/csv/txt files under
+``$SUTRO_HOME/datasets``, with the same operations the SDK/CLI expose:
+create, upload, list datasets (with schema), list files, download.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import shutil
+import uuid
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Union
+
+import pandas as pd
+
+from ..validation import config_dir
+
+
+def _root() -> Path:
+    d = config_dir() / "datasets"
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+class DatasetStore:
+    def __init__(self, root: Optional[Path] = None):
+        self.root = Path(root) if root else _root()
+
+    def _dir(self, dataset_id: str) -> Path:
+        if not dataset_id.startswith("dataset-"):
+            raise ValueError(f"Invalid dataset id: {dataset_id!r}")
+        d = self.root / dataset_id
+        if not d.exists():
+            raise FileNotFoundError(f"Unknown dataset: {dataset_id}")
+        return d
+
+    def create(self) -> str:
+        dataset_id = f"dataset-{uuid.uuid4().hex[:12]}"
+        d = self.root / dataset_id
+        d.mkdir(parents=True)
+        meta = {
+            "dataset_id": dataset_id,
+            "datetime_added": datetime.datetime.now(
+                datetime.timezone.utc
+            ).isoformat(),
+            "updated_at": None,
+        }
+        (d / ".meta.json").write_text(json.dumps(meta, indent=2))
+        return dataset_id
+
+    def upload(
+        self, dataset_id: str, paths: List[Union[str, Path]]
+    ) -> List[str]:
+        d = self._dir(dataset_id)
+        names = []
+        for p in paths:
+            p = Path(p)
+            if p.is_dir():
+                for f in sorted(p.iterdir()):
+                    if f.is_file():
+                        shutil.copy2(f, d / f.name)
+                        names.append(f.name)
+            else:
+                shutil.copy2(p, d / p.name)
+                names.append(p.name)
+        meta = json.loads((d / ".meta.json").read_text())
+        meta["updated_at"] = datetime.datetime.now(
+            datetime.timezone.utc
+        ).isoformat()
+        (d / ".meta.json").write_text(json.dumps(meta, indent=2))
+        return names
+
+    def list_datasets(self) -> List[Dict[str, Any]]:
+        out = []
+        for d in sorted(self.root.iterdir()):
+            if not d.is_dir() or not d.name.startswith("dataset-"):
+                continue
+            try:
+                meta = json.loads((d / ".meta.json").read_text())
+            except Exception:
+                meta = {"dataset_id": d.name}
+            meta["schema"] = self._schema(d)
+            meta["num_files"] = len(self.list_files(d.name))
+            out.append(meta)
+        return out
+
+    def _schema(self, d: Path) -> Dict[str, str]:
+        for f in sorted(d.iterdir()):
+            if f.suffix == ".parquet":
+                try:
+                    import pyarrow.parquet as pq
+
+                    sch = pq.read_schema(f)
+                    return {n: str(t) for n, t in zip(sch.names, sch.types)}
+                except Exception:
+                    return {}
+            if f.suffix == ".csv":
+                try:
+                    head = pd.read_csv(f, nrows=10)
+                    return {c: str(t) for c, t in head.dtypes.items()}
+                except Exception:
+                    return {}
+        return {}
+
+    def list_files(self, dataset_id: str) -> List[str]:
+        d = self._dir(dataset_id)
+        return sorted(
+            f.name for f in d.iterdir() if f.is_file() and f.name != ".meta.json"
+        )
+
+    def file_path(self, dataset_id: str, file_name: str) -> Path:
+        d = self._dir(dataset_id)
+        p = d / file_name
+        if not p.exists():
+            raise FileNotFoundError(f"{dataset_id} has no file {file_name!r}")
+        return p
+
+    def download(
+        self, dataset_id: str, file_name: str, output_path: Union[str, Path]
+    ) -> Path:
+        src = self.file_path(dataset_id, file_name)
+        out_dir = Path(output_path)
+        out_dir.mkdir(parents=True, exist_ok=True)
+        dst = out_dir / file_name
+        shutil.copy2(src, dst)
+        return dst
+
+    def read_rows(
+        self, dataset_id: str, column: Optional[Union[str, List[Any]]] = None
+    ) -> List[str]:
+        """Materialize a dataset's rows for inference input (reference
+        behavior: a job may name `dataset-<id>` as its input,
+        common.py:111-162)."""
+        from ..common import prepare_input_data
+
+        rows: List[str] = []
+        for name in self.list_files(dataset_id):
+            p = self.file_path(dataset_id, name)
+            if p.suffix in (".parquet", ".csv", ".txt"):
+                got = prepare_input_data(str(p), column=column)
+                assert isinstance(got, list)
+                rows.extend(got)
+        return rows

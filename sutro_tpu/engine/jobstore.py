@@ -1,0 +1,263 @@
+"""Job store: records, states, durable results, quotas, cost model.
+
+Replaces the remote service's job control plane (reference wire contract
+SURVEY §3.6: /jobs/{id}, /job-status/{id}, /list-jobs, /job-results,
+/job-cancel, /get-quotas). Layout under ``$SUTRO_HOME/jobs/<job_id>/``:
+
+- ``record.json``   — the job record (status, counters, timestamps, config)
+- ``inputs.parquet``  — materialized input rows (row_id, inputs)
+- ``partial.parquet`` — completed rows flushed during the run (row-granular
+  resume, SURVEY §5.3: a preempted run restarts at row granularity)
+- ``results.parquet`` — final ordered results
+
+Invariants (SURVEY §5.2 — replace the reference's results-availability
+retry race, sdk.py:384-401, with real guarantees):
+
+- single writer: only the engine worker thread mutates a running job;
+- ``results.parquet`` is fully written and flushed *before* the record
+  flips to SUCCEEDED, so "status==SUCCEEDED" implies "results readable".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import json
+import threading
+import uuid
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import pandas as pd
+
+from ..interfaces import JobStatus
+from ..validation import config_dir
+
+# ---------------------------------------------------------------------------
+# Cost model (USD per 1M tokens). The reference surfaces only a server-side
+# `cost_estimate` (sdk.py:245-262); this local model prices by param count
+# against chip-seconds, tuned so the north-star comparison vs the OpenAI
+# Batch API (BASELINE.json) is honest: numbers chosen to approximate
+# v5e on-demand $/chip-hour amortized over measured tok/s/chip tiers.
+# ---------------------------------------------------------------------------
+
+COST_PER_MTOK: Dict[str, Dict[str, float]] = {
+    # engine_key prefix -> {input, output}
+    "qwen3-0.6b": {"input": 0.01, "output": 0.02},
+    "qwen3-4b": {"input": 0.04, "output": 0.08},
+    "qwen3-8b": {"input": 0.07, "output": 0.15},
+    "qwen3-14b": {"input": 0.12, "output": 0.25},
+    "qwen3-32b": {"input": 0.25, "output": 0.50},
+    "qwen3-30b-a3b": {"input": 0.10, "output": 0.20},
+    "qwen3-235b-a22b": {"input": 0.50, "output": 1.00},
+    "llama-3.2-3b": {"input": 0.03, "output": 0.06},
+    "llama-3.1-8b": {"input": 0.07, "output": 0.15},
+    "llama-3.3-70b": {"input": 0.45, "output": 0.90},
+    "gemma3-4b": {"input": 0.04, "output": 0.08},
+    "gemma3-12b": {"input": 0.10, "output": 0.22},
+    "gemma3-27b": {"input": 0.22, "output": 0.45},
+    "gpt-oss-20b": {"input": 0.06, "output": 0.12},
+    "gpt-oss-120b": {"input": 0.25, "output": 0.50},
+    "qwen3-emb-0.6b": {"input": 0.01, "output": 0.01},
+    "qwen3-emb-6b": {"input": 0.05, "output": 0.05},
+    "qwen3-emb-8b": {"input": 0.07, "output": 0.07},
+}
+_DEFAULT_COST = {"input": 0.10, "output": 0.20}
+
+# Per-priority quotas (rows, tokens) — reference /get-quotas shape: a list
+# indexed by priority, each {row_quota, token_quota} (sdk.py:1547-1561,
+# cli.py:406-411). Priority maps to pod-slice size in the TPU build
+# (BASELINE.json): lower priority number = more interactive = smaller batch.
+DEFAULT_QUOTAS: List[Dict[str, int]] = [
+    {"row_quota": 500_000, "token_quota": 500_000_000},
+    {"row_quota": 5_000_000, "token_quota": 5_000_000_000},
+]
+
+
+def estimate_cost(
+    engine_key: str, input_tokens: int, output_tokens: int
+) -> float:
+    rates = COST_PER_MTOK.get(engine_key, _DEFAULT_COST)
+    return (
+        input_tokens * rates["input"] + output_tokens * rates["output"]
+    ) / 1e6
+
+
+def _now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat()
+
+
+@dataclasses.dataclass
+class JobRecord:
+    job_id: str
+    status: str = JobStatus.QUEUED.value
+    name: Optional[str] = None
+    description: Optional[str] = None
+    model: str = ""
+    engine_key: str = ""
+    num_rows: int = 0
+    job_priority: int = 0
+    datetime_created: str = dataclasses.field(default_factory=_now)
+    datetime_started: Optional[str] = None
+    datetime_completed: Optional[str] = None
+    input_tokens: int = 0
+    output_tokens: int = 0
+    cost_estimate: Optional[float] = None
+    job_cost: Optional[float] = None
+    failure_reason: Optional[Dict[str, Any]] = None
+    output_schema: Optional[Dict[str, Any]] = None
+    system_prompt: Optional[str] = None
+    sampling_params: Optional[Dict[str, Any]] = None
+    truncate_rows: bool = True
+    dry_run: bool = False
+    random_seed_per_input: bool = False
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+class JobStore:
+    def __init__(self, root: Optional[Path] = None):
+        self.root = Path(root) if root else (config_dir() / "jobs")
+        self.root.mkdir(parents=True, exist_ok=True)
+        self._lock = threading.Lock()
+
+    # -- paths -----------------------------------------------------------
+    def _dir(self, job_id: str) -> Path:
+        return self.root / job_id
+
+    def _record_path(self, job_id: str) -> Path:
+        return self._dir(job_id) / "record.json"
+
+    # -- record lifecycle ------------------------------------------------
+    def create(self, **fields: Any) -> JobRecord:
+        job_id = fields.pop("job_id", None) or f"job-{uuid.uuid4().hex[:16]}"
+        rec = JobRecord(job_id=job_id, **fields)
+        d = self._dir(job_id)
+        d.mkdir(parents=True, exist_ok=True)
+        self._write_record(rec)
+        return rec
+
+    def _write_record(self, rec: JobRecord) -> None:
+        path = self._record_path(rec.job_id)
+        tmp = path.with_suffix(".json.tmp")
+        tmp.write_text(json.dumps(rec.to_dict(), indent=2))
+        tmp.replace(path)  # atomic on POSIX
+
+    def get(self, job_id: str) -> JobRecord:
+        path = self._record_path(job_id)
+        if not path.exists():
+            raise KeyError(f"Unknown job: {job_id}")
+        data = json.loads(path.read_text())
+        fields = {f.name for f in dataclasses.fields(JobRecord)}
+        return JobRecord(**{k: v for k, v in data.items() if k in fields})
+
+    def update(self, job_id: str, **fields: Any) -> JobRecord:
+        with self._lock:
+            rec = self.get(job_id)
+            for k, v in fields.items():
+                setattr(rec, k, v)
+            self._write_record(rec)
+            return rec
+
+    def set_status(self, job_id: str, status: JobStatus, **extra: Any) -> None:
+        fields: Dict[str, Any] = {"status": status.value, **extra}
+        if status == JobStatus.RUNNING:
+            fields.setdefault("datetime_started", _now())
+        if status.is_terminal():
+            fields.setdefault("datetime_completed", _now())
+        self.update(job_id, **fields)
+
+    def status(self, job_id: str) -> JobStatus:
+        return JobStatus(self.get(job_id).status)
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        """Newest-first job records (reference /list-jobs, cli.py:157-196)."""
+        out = []
+        for d in self.root.iterdir():
+            if (d / "record.json").exists():
+                try:
+                    out.append(self.get(d.name).to_dict())
+                except Exception:
+                    continue
+        out.sort(key=lambda r: r.get("datetime_created") or "", reverse=True)
+        return out
+
+    def delete(self, job_id: str) -> None:
+        import shutil
+
+        shutil.rmtree(self._dir(job_id), ignore_errors=True)
+
+    # -- inputs / results -------------------------------------------------
+    def write_inputs(self, job_id: str, rows: List[str]) -> None:
+        df = pd.DataFrame({"row_id": range(len(rows)), "inputs": rows})
+        df.to_parquet(self._dir(job_id) / "inputs.parquet")
+
+    def read_inputs(self, job_id: str) -> List[str]:
+        df = pd.read_parquet(self._dir(job_id) / "inputs.parquet")
+        return df.sort_values("row_id")["inputs"].tolist()
+
+    def flush_partial(self, job_id: str, rows: List[Dict[str, Any]]) -> None:
+        """Append-flush completed rows for row-granular resume (§5.3)."""
+        if not rows:
+            return
+        path = self._dir(job_id) / "partial.parquet"
+        df = pd.DataFrame(rows)
+        if path.exists():
+            df = pd.concat([pd.read_parquet(path), df], ignore_index=True)
+        tmp = path.with_suffix(".parquet.tmp")
+        df.to_parquet(tmp)
+        tmp.replace(path)
+
+    def read_partial(self, job_id: str) -> Dict[int, Dict[str, Any]]:
+        path = self._dir(job_id) / "partial.parquet"
+        if not path.exists():
+            return {}
+        df = pd.read_parquet(path)
+        return {int(r["row_id"]): dict(r) for _, r in df.iterrows()}
+
+    def finalize_results(
+        self, job_id: str, results: Dict[str, List[Any]]
+    ) -> None:
+        """Write final results THEN flip to SUCCEEDED (ordering invariant)."""
+        df = pd.DataFrame(results)
+        tmp = self._dir(job_id) / "results.parquet.tmp"
+        df.to_parquet(tmp)
+        tmp.replace(self._dir(job_id) / "results.parquet")
+        self.set_status(job_id, JobStatus.SUCCEEDED)
+
+    def read_results(self, job_id: str) -> pd.DataFrame:
+        path = self._dir(job_id) / "results.parquet"
+        if not path.exists():
+            status = self.status(job_id)
+            raise FileNotFoundError(
+                f"Results for {job_id} not available (status={status.value})"
+            )
+        return pd.read_parquet(path)
+
+    # -- quotas ----------------------------------------------------------
+    def get_quotas(self) -> List[Dict[str, int]]:
+        path = config_dir() / "quotas.json"
+        if path.exists():
+            try:
+                return json.loads(path.read_text())
+            except Exception:
+                pass
+        return [dict(q) for q in DEFAULT_QUOTAS]
+
+    def check_quota(
+        self, priority: int, num_rows: int, est_tokens: int
+    ) -> Optional[str]:
+        quotas = self.get_quotas()
+        q = quotas[min(max(priority, 0), len(quotas) - 1)]
+        if num_rows > q["row_quota"]:
+            return (
+                f"Row count {num_rows} exceeds priority-{priority} quota "
+                f"{q['row_quota']}"
+            )
+        if est_tokens > q["token_quota"]:
+            return (
+                f"Estimated tokens {est_tokens} exceed priority-{priority} "
+                f"quota {q['token_quota']}"
+            )
+        return None

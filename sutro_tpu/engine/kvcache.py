@@ -1,0 +1,140 @@
+"""Paged KV cache.
+
+TPU-native replacement for the server-side KV management the reference
+delegates to its remote fleet (SURVEY §2.3 row 1: "continuous-batching
+scheduler ... paged-KV decode attention"). Layout:
+
+- ``k_pages`` / ``v_pages``: ``[L, NP, PS, KVH, Dh]`` device arrays. Page 0
+  is a reserved garbage page — padding tokens scatter there, so the write
+  path needs no masks or dynamic shapes.
+- ``page_table``: host-side ``numpy`` ``[B, MP]`` int32, passed into each
+  jitted step as a device argument. Pages are allocated/freed by a
+  host-side free list (allocation is control-plane work; the device only
+  ever sees dense int32 tables).
+
+Gather (`gather_kv`) produces the fixed-size ``[L, B, CTX, KVH, Dh]`` view
+decode attention consumes; scatter (`write_kv`) lands a chunk's K/V into
+pages. Both are pure functions over pytrees, jitted as part of the runner's
+step functions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.configs import ModelConfig
+from .config import EngineConfig
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KVCache:
+    k_pages: jax.Array  # [L, NP, PS, KVH, Dh]
+    v_pages: jax.Array  # [L, NP, PS, KVH, Dh]
+
+    @property
+    def page_size(self) -> int:
+        return self.k_pages.shape[2]
+
+    @property
+    def num_pages(self) -> int:
+        return self.k_pages.shape[1]
+
+
+def alloc_cache(
+    mcfg: ModelConfig, ecfg: EngineConfig, num_pages: int,
+    dtype: jnp.dtype = jnp.bfloat16,
+) -> KVCache:
+    shape = (
+        mcfg.num_layers,
+        num_pages,
+        ecfg.kv_page_size,
+        mcfg.num_kv_heads,
+        mcfg.head_dim,
+    )
+    return KVCache(k_pages=jnp.zeros(shape, dtype), v_pages=jnp.zeros(shape, dtype))
+
+
+class PageAllocator:
+    """Host-side free list. Page 0 is reserved as the garbage page."""
+
+    def __init__(self, num_pages: int):
+        self.num_pages = num_pages
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+
+    def alloc(self, n: int = 1) -> List[int]:
+        if len(self._free) < n:
+            raise MemoryError(
+                f"KV cache out of pages (requested {n}, free {len(self._free)})"
+            )
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, pages: List[int]) -> None:
+        for p in pages:
+            if p != 0:
+                self._free.append(p)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+
+def pages_needed(length: int, page_size: int) -> int:
+    return (length + page_size - 1) // page_size
+
+
+def write_kv(
+    cache: KVCache,
+    k_chunk: jax.Array,        # [L, B, T, KVH, Dh]
+    v_chunk: jax.Array,
+    page_table: jax.Array,     # [B, MP] int32
+    start: jax.Array,          # [B] int32 — global position of chunk token 0
+    valid_len: jax.Array,      # [B] int32 — real tokens in chunk
+) -> KVCache:
+    """Scatter a chunk's K/V into pages. Padding positions are routed to
+    garbage page 0."""
+    L, B, T, KVH, Dh = k_chunk.shape
+    PS = cache.page_size
+    NP = cache.num_pages
+    pos = start[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]   # [B, T]
+    valid = jnp.arange(T, dtype=jnp.int32)[None, :] < valid_len[:, None]
+    page_idx = jnp.take_along_axis(page_table, pos // PS, axis=1)    # [B, T]
+    flat = jnp.where(valid, page_idx * PS + pos % PS, 0)             # [B, T]
+
+    k_flat = cache.k_pages.reshape(L, NP * PS, KVH, Dh)
+    v_flat = cache.v_pages.reshape(L, NP * PS, KVH, Dh)
+    # advanced indexing [L dim kept, flat [B,T]] -> [L, B, T, KVH, Dh]
+    k_flat = k_flat.at[:, flat].set(k_chunk.astype(k_flat.dtype))
+    v_flat = v_flat.at[:, flat].set(v_chunk.astype(v_flat.dtype))
+    return KVCache(
+        k_pages=k_flat.reshape(L, NP, PS, KVH, Dh),
+        v_pages=v_flat.reshape(L, NP, PS, KVH, Dh),
+    )
+
+
+def gather_kv(
+    cache: KVCache, page_table: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """[B, MP] page table -> contiguous ([L, B, CTX, KVH, Dh]) x2 view,
+    CTX = MP * PS. Invalid positions contain garbage; attention masks them
+    by ``past_len``."""
+    L, NP, PS, KVH, Dh = cache.k_pages.shape
+    B, MP = page_table.shape
+    k = jnp.take(cache.k_pages, page_table.reshape(-1), axis=1)
+    v = jnp.take(cache.v_pages, page_table.reshape(-1), axis=1)
+    k = k.reshape(L, B, MP * PS, KVH, Dh)
+    v = v.reshape(L, B, MP * PS, KVH, Dh)
+    return k, v
+
+
+def make_page_table(rows: List[List[int]], max_pages: int) -> np.ndarray:
+    """Pad per-slot page lists to a dense [B, MP] int32 table (garbage page 0)."""
+    out = np.zeros((len(rows), max_pages), np.int32)
+    for i, r in enumerate(rows):
+        out[i, : len(r)] = r
+    return out

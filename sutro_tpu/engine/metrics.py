@@ -1,0 +1,110 @@
+"""Progress/token metrics bus.
+
+Engine-side producer of the reference's NDJSON progress protocol
+(/root/reference/sutro/sdk.py:331-367): ``{"update_type": "progress",
+"result": <rows_done>}`` and ``{"update_type": "tokens", "result":
+{input_tokens, output_tokens, total_tokens_processed_per_second}}``.
+The reference consumes this over a long-lived HTTP stream; here the bus is
+an in-process, thread-safe pub/sub keyed by job id, with history retained
+so a late ``attach`` (reference sdk.py:800-911) sees current totals
+immediately. Token updates may be partial dicts — consumers must merge
+monotonically (sdk.py:354-363) — and the bus preserves that contract.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+
+class JobMetrics:
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.latest_tokens: Dict[str, Any] = {}
+        self.rows_completed = 0
+        self.done = False
+        self._subscribers: List[queue.Queue] = []
+
+    def _publish(self, update: Dict[str, Any]) -> None:
+        with self.lock:
+            subs = list(self._subscribers)
+        for q in subs:
+            q.put(update)
+
+    def progress(self, rows_completed: int) -> None:
+        with self.lock:
+            self.rows_completed = rows_completed
+        self._publish({"update_type": "progress", "result": rows_completed})
+
+    def tokens(self, result: Dict[str, Any]) -> None:
+        with self.lock:
+            self.latest_tokens.update(result)
+        self._publish({"update_type": "tokens", "result": dict(result)})
+
+    def finish(self) -> None:
+        with self.lock:
+            self.done = True
+            subs = list(self._subscribers)
+        for q in subs:
+            q.put(None)  # sentinel
+
+    def subscribe(self) -> Iterator[Dict[str, Any]]:
+        """Yields updates until the job finishes. Starts with a snapshot of
+        current totals so mid-run attach shows correct state."""
+        q: queue.Queue = queue.Queue()
+        with self.lock:
+            snapshot_rows = self.rows_completed
+            snapshot_tokens = dict(self.latest_tokens)
+            already_done = self.done
+            self._subscribers.append(q)
+        try:
+            yield {"update_type": "progress", "result": snapshot_rows}
+            if snapshot_tokens:
+                yield {"update_type": "tokens", "result": snapshot_tokens}
+            if already_done:
+                return
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                yield item
+        finally:
+            with self.lock:
+                if q in self._subscribers:
+                    self._subscribers.remove(q)
+
+
+class MetricsBus:
+    def __init__(self) -> None:
+        self._jobs: Dict[str, JobMetrics] = {}
+        self._lock = threading.Lock()
+
+    def job(self, job_id: str) -> JobMetrics:
+        with self._lock:
+            if job_id not in self._jobs:
+                self._jobs[job_id] = JobMetrics()
+            return self._jobs[job_id]
+
+    def drop(self, job_id: str) -> None:
+        with self._lock:
+            self._jobs.pop(job_id, None)
+
+
+class Throughput:
+    """Per-chip tokens/sec estimator (BASELINE.md tracked metric)."""
+
+    def __init__(self, n_chips: int = 1):
+        self.n_chips = max(n_chips, 1)
+        self.t0 = time.monotonic()
+        self.total = 0
+
+    def add(self, tokens: int) -> None:
+        self.total += tokens
+
+    def per_second(self) -> float:
+        return self.total / max(time.monotonic() - self.t0, 1e-9)
+
+    def per_chip_per_second(self) -> float:
+        return self.per_second() / self.n_chips

@@ -1,0 +1,208 @@
+"""Model runner: compiled prefill / decode / embed steps.
+
+The device-side half of the engine (SURVEY §7.1 ``runner.py``). The
+reference's equivalent is the remote fleet's decode loop, visible only
+through its progress stream (/root/reference/sutro/sdk.py:331-367); here it
+is three jitted functions over static shapes:
+
+- ``prefill(ids[1,T])``: full causal attention over one (bucketed) prompt,
+  K/V scattered into the paged cache, returns last-position logits.
+  Buckets are powers of two, so at most log2(max_ctx) compilations.
+- ``decode(ids[B,1])``: one token for every slot in the fixed-size decode
+  batch; past gathered from pages, new K/V scattered back, sampling fused
+  in (with optional constrained-decoding vocab masks).
+- ``embed(ids[B,T])``: trunk + mean-pool head for the embedding models.
+
+Host-side state (slots, page tables, FSM states) lives in
+engine/scheduler.py; this module is stateless apart from params + cache.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import transformer
+from ..models.configs import ModelConfig
+from .config import EngineConfig
+from .kvcache import KVCache, alloc_cache, gather_kv, write_kv
+from ..ops.sampling import sample, cumulative_logprob
+
+
+def next_bucket(n: int, lo: int = 16, hi: int = 1 << 20) -> int:
+    b = lo
+    while b < n and b < hi:
+        b *= 2
+    return b
+
+
+class ModelRunner:
+    def __init__(
+        self,
+        mcfg: ModelConfig,
+        ecfg: EngineConfig,
+        params: Optional[Any] = None,
+        *,
+        num_pages: Optional[int] = None,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        shardings: Optional[Any] = None,
+    ):
+        self.mcfg = mcfg
+        self.ecfg = ecfg
+        self.mesh = mesh
+        dtype = jnp.dtype(ecfg.param_dtype)
+        if params is None:
+            params = transformer.init_params(
+                mcfg, jax.random.PRNGKey(ecfg.seed), dtype
+            )
+        if shardings is not None and mesh is not None:
+            params = jax.device_put(params, shardings)
+        self.params = params
+        self.use_pallas = self._resolve_pallas(ecfg)
+        if num_pages is None:
+            num_pages = 1 + ecfg.decode_batch_size * ecfg.max_pages_per_seq
+        self.num_pages = num_pages
+        self.cache = alloc_cache(mcfg, ecfg, num_pages, dtype=dtype)
+        self._decode_fn = None
+        self._embed_cache: Dict[int, Any] = {}
+
+    @staticmethod
+    def _resolve_pallas(ecfg: EngineConfig) -> bool:
+        if ecfg.use_pallas is not None:
+            return ecfg.use_pallas
+        return jax.default_backend() not in ("cpu",)
+
+    # ------------------------------------------------------------------
+    # prefill
+    # ------------------------------------------------------------------
+
+    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+    def _prefill_jit(
+        self, params, cache: KVCache, ids, valid_len, page_table, start
+    ):
+        B, T = ids.shape
+        positions = start[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+        logits, hidden, (k, v) = transformer.forward(
+            self.mcfg, params, ids, positions, valid_len,
+            use_pallas=self.use_pallas,
+        )
+        cache = write_kv(cache, k, v, page_table, start, valid_len)
+        last = jnp.maximum(valid_len - 1, 0)
+        last_logits = jnp.take_along_axis(
+            logits, last[:, None, None], axis=1
+        )[:, 0]
+        return last_logits, cache
+
+    def prefill(
+        self, token_ids: np.ndarray, page_table: np.ndarray
+    ) -> np.ndarray:
+        """One prompt ([T] int32) -> last-position logits [V]. ``page_table``
+        is the slot's [MP] row."""
+        n = len(token_ids)
+        T = next_bucket(max(n, 1), lo=16, hi=self.ecfg.max_context())
+        ids = np.zeros((1, T), np.int32)
+        ids[0, :n] = token_ids
+        logits, self.cache = self._prefill_jit(
+            self.params,
+            self.cache,
+            jnp.asarray(ids),
+            jnp.asarray([n], jnp.int32),
+            jnp.asarray(page_table[None, :], jnp.int32),
+            jnp.asarray([0], jnp.int32),
+        )
+        return np.asarray(logits[0])
+
+    # ------------------------------------------------------------------
+    # decode
+    # ------------------------------------------------------------------
+
+    @functools.partial(
+        jax.jit, static_argnums=(0,), donate_argnums=(2,)
+    )
+    def _decode_jit(
+        self, params, cache: KVCache, ids, past_len, page_table,
+        rng, temperature, top_p, top_k, allowed,
+    ):
+        B = ids.shape[0]
+        positions = past_len[:, None]  # current token position == past length
+        pk, pv = gather_kv(cache, page_table)
+        logits, _, (k, v) = transformer.forward(
+            self.mcfg, params, ids, positions,
+            jnp.ones((B,), jnp.int32),
+            past_kv=(pk, pv), past_len=past_len,
+            use_pallas=self.use_pallas,
+        )
+        cache = write_kv(
+            cache, k, v, page_table, past_len, jnp.ones((B,), jnp.int32)
+        )
+        step_logits = logits[:, 0]  # [B, V]
+        tok = sample(
+            step_logits, rng,
+            temperature=temperature, top_p=top_p, top_k=top_k,
+            allowed=allowed,
+        )
+        logp = cumulative_logprob(step_logits, tok)
+        return tok, logp, cache
+
+    def decode_step(
+        self,
+        last_tokens: np.ndarray,     # [B] int32
+        past_len: np.ndarray,        # [B] int32
+        page_table: np.ndarray,      # [B, MP] int32
+        rng: jax.Array,
+        temperature: np.ndarray,     # [B]
+        top_p: np.ndarray,           # [B]
+        top_k: Optional[np.ndarray] = None,     # [B] int32; None => disabled
+        allowed: Optional[np.ndarray] = None,   # [B, V] bool
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        B = len(last_tokens)
+        if top_k is None:
+            top_k = np.zeros((B,), np.int32)
+        tok, logp, self.cache = self._decode_jit(
+            self.params,
+            self.cache,
+            jnp.asarray(last_tokens[:, None], jnp.int32),
+            jnp.asarray(past_len, jnp.int32),
+            jnp.asarray(page_table, jnp.int32),
+            rng,
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(top_p, jnp.float32),
+            jnp.asarray(top_k, jnp.int32),
+            None if allowed is None else jnp.asarray(allowed),
+        )
+        return np.asarray(tok), np.asarray(logp)
+
+    # ------------------------------------------------------------------
+    # embeddings
+    # ------------------------------------------------------------------
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _embed_jit(self, params, ids, valid_len):
+        B, T = ids.shape
+        positions = jnp.broadcast_to(
+            jnp.arange(T, dtype=jnp.int32)[None, :], (B, T)
+        )
+        emb, _, _ = transformer.forward(
+            self.mcfg, params, ids, positions, valid_len,
+            use_pallas=self.use_pallas,
+        )
+        return emb
+
+    def embed_batch(self, rows: list) -> np.ndarray:
+        """List of token-id arrays -> [N, H] float32 embeddings."""
+        n = len(rows)
+        maxlen = max((len(r) for r in rows), default=1)
+        T = next_bucket(max(maxlen, 1), lo=16, hi=self.ecfg.max_context())
+        ids = np.zeros((n, T), np.int32)
+        lens = np.zeros((n,), np.int32)
+        for i, r in enumerate(rows):
+            ids[i, : len(r)] = r
+            lens[i] = len(r)
+        emb = self._embed_jit(
+            self.params, jnp.asarray(ids), jnp.asarray(lens)
+        )
+        return np.asarray(emb, np.float32)

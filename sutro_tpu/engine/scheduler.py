@@ -1,0 +1,329 @@
+"""Continuous-batching scheduler.
+
+Host-side control plane of the engine — the component the reference's
+remote service keeps behind ``POST /batch-inference`` (SURVEY §2.3 row 1,
+§7.3 "continuous batching under XLA static shapes"). Design:
+
+- A fixed array of ``decode_batch_size`` slots; every decode step runs the
+  whole array through one compiled step regardless of occupancy (static
+  shapes — no recompiles as rows enter/leave).
+- Rows are admitted whenever a slot is free and the page allocator can
+  reserve the row's worst-case page count up front (prompt + max_new
+  capped to context) — reservation up front makes mid-flight OOM
+  impossible and keeps the loop deadlock-free.
+- Prefill is one row at a time into power-of-two buckets (compile-count
+  bounded); its last-position logits seed the slot's first sampled token.
+- Order-preserving results: completions are emitted keyed by ``row_id`` and
+  re-assembled in input order by the jobstore, while execution order is
+  whatever batching dictates (reference contract: README.md:221).
+- Constrained decoding: slots carrying a token-FSM contribute a per-slot
+  vocab mask assembled host-side each step (SURVEY §7.3 "vectorized
+  constrained decoding"); unconstrained slots get all-True rows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional, Protocol
+
+import jax
+import numpy as np
+
+from .kvcache import PageAllocator, pages_needed
+from .runner import ModelRunner
+from ..ops.sampling import cumulative_logprob, sample as device_sample
+
+
+class TokenConstraint(Protocol):
+    """Token-level FSM driving schema-constrained decoding
+    (engine/constrain/)."""
+
+    def allowed_tokens(self) -> np.ndarray:  # [V] bool
+        ...
+
+    def advance(self, token_id: int) -> None:
+        ...
+
+    def is_complete(self) -> bool:
+        ...
+
+
+@dataclasses.dataclass
+class GenRequest:
+    row_id: int
+    prompt_ids: np.ndarray
+    max_new_tokens: int = 256
+    temperature: float = 0.7
+    top_p: float = 0.95
+    top_k: int = 0
+    constraint: Optional[TokenConstraint] = None
+    # Reference `truncate_rows` semantics (sdk.py:457,480): True => over-long
+    # prompts are truncated to fit the context; False => the row fails.
+    allow_truncate: bool = True
+
+
+@dataclasses.dataclass
+class GenResult:
+    row_id: int
+    token_ids: List[int]
+    cumulative_logprob: float
+    # "stop" | "length" | "schema_complete" | "cancelled" | "error_too_long"
+    finish_reason: str
+    input_tokens: int
+
+
+@dataclasses.dataclass
+class _Slot:
+    req: GenRequest
+    pages: List[int]
+    pos: int                 # tokens currently in cache
+    last_token: int
+    out_ids: List[int] = dataclasses.field(default_factory=list)
+    logprob_sum: float = 0.0
+
+
+class ContinuousBatcher:
+    def __init__(
+        self,
+        runner: ModelRunner,
+        stop_ids: List[int],
+        *,
+        seed: int = 0,
+    ):
+        self.runner = runner
+        self.ecfg = runner.ecfg
+        self.vocab = runner.mcfg.vocab_size
+        self.stop_ids = set(int(s) for s in stop_ids)
+        self.allocator = PageAllocator(runner.num_pages)
+        self.B = self.ecfg.decode_batch_size
+        self.MP = self.ecfg.max_pages_per_seq
+        self.slots: List[Optional[_Slot]] = [None] * self.B
+        self._key = jax.random.PRNGKey(seed)
+        self._step = 0
+
+    # ------------------------------------------------------------------
+
+    def _max_total(self, req: GenRequest) -> int:
+        return min(
+            len(req.prompt_ids) + req.max_new_tokens,
+            self.ecfg.max_context(),
+        )
+
+    def _inflight_tokens(self) -> int:
+        return sum(
+            self._max_total(s.req) for s in self.slots if s is not None
+        )
+
+    def _try_admit(self, req: GenRequest) -> bool:
+        try:
+            free_idx = self.slots.index(None)
+        except ValueError:
+            return False
+        total = self._max_total(req)
+        need = pages_needed(total, self.ecfg.kv_page_size)
+        if need > self.MP or need > self.allocator.free_count:
+            return False
+        if (
+            self._inflight_tokens() > 0
+            and self._inflight_tokens() + total > self.ecfg.max_batch_tokens
+        ):
+            return False
+        pages = self.allocator.alloc(need)
+        table = np.zeros((self.MP,), np.int32)
+        table[: len(pages)] = pages
+
+        n = len(req.prompt_ids)
+        logits = self.runner.prefill(req.prompt_ids.astype(np.int32), table)
+        first, first_logp = self._sample_one(logits, req)
+        slot = _Slot(req=req, pages=pages, pos=n, last_token=first)
+        self.slots[free_idx] = slot
+        self._record_token(slot, first, first_logp)
+        return True
+
+    def _sample_one(self, logits: np.ndarray, req: GenRequest) -> tuple:
+        allowed = None
+        if req.constraint is not None:
+            allowed = req.constraint.allowed_tokens()[None, :]
+        self._key, sub = jax.random.split(self._key)
+        jl = jax.numpy.asarray(logits[None, :])
+        tok = device_sample(
+            jl,
+            sub,
+            temperature=np.float32(req.temperature),
+            top_p=np.float32(req.top_p),
+            top_k=np.int32(req.top_k),
+            allowed=None if allowed is None else jax.numpy.asarray(allowed),
+        )
+        logp = cumulative_logprob(jl, tok)
+        return int(np.asarray(tok)[0]), float(np.asarray(logp)[0])
+
+    def _record_token(self, slot: _Slot, tok: int, logp: float) -> None:
+        slot.out_ids.append(tok)
+        slot.logprob_sum += float(logp)
+        if slot.req.constraint is not None and tok not in self.stop_ids:
+            slot.req.constraint.advance(tok)
+
+    def _finish_reason(self, slot: _Slot, tok: int) -> Optional[str]:
+        c = slot.req.constraint
+        if tok in self.stop_ids:
+            return "stop"
+        if c is not None and c.is_complete():
+            return "schema_complete"
+        if len(slot.out_ids) >= slot.req.max_new_tokens:
+            return "length"
+        if slot.pos + 1 >= self.ecfg.max_context():
+            return "length"
+        return None
+
+    def _release(self, i: int) -> GenResult:
+        slot = self.slots[i]
+        assert slot is not None
+        self.allocator.free(slot.pages)
+        self.slots[i] = None
+        out = list(slot.out_ids)
+        reason = "stop"
+        if out and out[-1] in self.stop_ids:
+            out = out[:-1]
+            reason = "stop"
+        elif slot.req.constraint is not None and slot.req.constraint.is_complete():
+            reason = "schema_complete"
+        else:
+            reason = "length"
+        return GenResult(
+            row_id=slot.req.row_id,
+            token_ids=out,
+            cumulative_logprob=slot.logprob_sum,
+            finish_reason=reason,
+            input_tokens=len(slot.req.prompt_ids),
+        )
+
+    # ------------------------------------------------------------------
+
+    def run(
+        self,
+        requests: List[GenRequest],
+        *,
+        on_result: Callable[[GenResult], None],
+        on_progress: Optional[Callable[[Dict[str, Any]], None]] = None,
+        should_cancel: Optional[Callable[[], bool]] = None,
+        progress_every: float = 1.0,
+    ) -> None:
+        """Run all requests to completion, streaming results/progress."""
+        max_prompt = self.ecfg.max_context() - 1  # leave >=1 token of gen room
+        pending = []
+        for req in requests:
+            if len(req.prompt_ids) > max_prompt:
+                if req.allow_truncate:
+                    req = dataclasses.replace(
+                        req, prompt_ids=req.prompt_ids[:max_prompt]
+                    )
+                else:
+                    on_result(
+                        GenResult(
+                            row_id=req.row_id,
+                            token_ids=[],
+                            cumulative_logprob=0.0,
+                            finish_reason="error_too_long",
+                            input_tokens=len(req.prompt_ids),
+                        )
+                    )
+                    continue
+            pending.append(req)
+        pending = pending[::-1]  # pop() from the input-order front
+        input_tokens = 0
+        output_tokens = 0
+        rows_done = 0
+        t_start = time.monotonic()
+        t_last = t_start
+
+        def progress(force: bool = False) -> None:
+            nonlocal t_last
+            now = time.monotonic()
+            if on_progress and (force or now - t_last >= progress_every):
+                t_last = now
+                elapsed = max(now - t_start, 1e-9)
+                on_progress(
+                    {
+                        "rows_completed": rows_done,
+                        "input_tokens": input_tokens,
+                        "output_tokens": output_tokens,
+                        "total_tokens_processed_per_second": (
+                            (input_tokens + output_tokens) / elapsed
+                        ),
+                    }
+                )
+
+        while pending or any(s is not None for s in self.slots):
+            if should_cancel and should_cancel():
+                for i, s in enumerate(self.slots):
+                    if s is not None:
+                        res = self._release(i)
+                        res.finish_reason = "cancelled"
+                        on_result(res)
+                return
+            # Admit as many pending rows as slots/pages allow.
+            admitted = False
+            while pending and self._try_admit(pending[-1]):
+                req = pending.pop()
+                input_tokens += len(req.prompt_ids)
+                admitted = True
+            # Immediately-finished rows (e.g. first token was a stop).
+            for i, s in enumerate(self.slots):
+                if s is not None and self._finish_reason(s, s.last_token):
+                    on_result(self._release(i))
+                    rows_done += 1
+            active = [i for i, s in enumerate(self.slots) if s is not None]
+            if not active:
+                if not pending:
+                    break
+                if not admitted:
+                    raise MemoryError(
+                        "Row cannot be admitted: prompt+max_new exceeds KV capacity"
+                    )
+                continue
+
+            last = np.zeros((self.B,), np.int32)
+            past_len = np.zeros((self.B,), np.int32)
+            table = np.zeros((self.B, self.MP), np.int32)
+            temp = np.zeros((self.B,), np.float32)
+            top_p = np.ones((self.B,), np.float32)
+            top_k = np.zeros((self.B,), np.int32)
+            has_constraint = False
+            allowed = None
+            for i in active:
+                s = self.slots[i]
+                last[i] = s.last_token
+                past_len[i] = s.pos
+                table[i, : len(s.pages)] = s.pages
+                temp[i] = s.req.temperature
+                top_p[i] = s.req.top_p
+                top_k[i] = s.req.top_k
+                if s.req.constraint is not None:
+                    has_constraint = True
+            if has_constraint:
+                allowed = np.ones((self.B, self.vocab), bool)
+                for i in active:
+                    c = self.slots[i].req.constraint
+                    if c is not None:
+                        allowed[i] = c.allowed_tokens()
+
+            self._key, sub = jax.random.split(self._key)
+            toks, logps = self.runner.decode_step(
+                last, past_len, table, sub, temp, top_p,
+                top_k=top_k, allowed=allowed,
+            )
+            self._step += 1
+
+            for i in active:
+                s = self.slots[i]
+                s.pos += 1  # last_token's KV is now cached
+                tok = int(toks[i])
+                self._record_token(s, tok, float(logps[i]))
+                output_tokens += 1
+                s.last_token = tok
+                if self._finish_reason(s, tok):
+                    on_result(self._release(i))
+                    rows_done += 1
+            progress()
+        progress(force=True)

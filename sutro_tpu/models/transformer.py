@@ -1,0 +1,268 @@
+"""Config-driven decoder-only transformer in pure JAX.
+
+The compute core of the engine (no analogue in the reference, which runs
+models remotely — SURVEY §0). Design choices are TPU-first:
+
+- Parameters are plain pytrees (nested dicts of ``jnp`` arrays) with all
+  per-layer tensors **stacked on a leading layer axis**, so the layer loop
+  is a single ``lax.scan`` (one trace, fast compiles) and shardings can be
+  annotated per-leaf by path rules (parallel/sharding.py).
+- Static shapes everywhere: decode attends over a fixed ``CTX`` window
+  gathered from the paged KV cache and masks invalid positions; prefill is
+  bucketed by the runner. No data-dependent Python control flow.
+- All matmuls run in ``bfloat16`` on the MXU; softmax/norms accumulate in
+  ``float32``.
+- One code path covers Qwen3 (dense+MoE), Llama 3, Gemma 3, and gpt-oss via
+  ``ModelConfig`` flags (QK-norm, sliding windows, attention sinks, post
+  norms, MoE) — see models/configs.py.
+
+The forward returns the chunk's per-layer K/V; the *caller* (engine/runner)
+scatters them into the paged cache. That keeps this module purely
+functional and cache-layout-agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .configs import ModelConfig
+from ..ops.moe import moe_mlp
+from ..ops.attention import chunk_attention
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Initialization
+# ---------------------------------------------------------------------------
+
+
+def init_params(
+    cfg: ModelConfig, key: jax.Array, dtype: jnp.dtype = jnp.bfloat16
+) -> Params:
+    """Random init with per-layer stacking on axis 0 (scan layout)."""
+    H, L = cfg.hidden_size, cfg.num_layers
+    NHD, KVD = cfg.q_size, cfg.kv_size
+    F, Dh = cfg.intermediate_size, cfg.head_dim
+    keys = iter(jax.random.split(key, 64))
+
+    def dense(shape, scale_dim):
+        return (
+            jax.random.normal(next(keys), shape, jnp.float32)
+            * (scale_dim ** -0.5)
+        ).astype(dtype)
+
+    layers: Dict[str, Any] = {
+        "attn_norm": jnp.ones((L, H), dtype),
+        "wq": dense((L, H, NHD), H),
+        "wk": dense((L, H, KVD), H),
+        "wv": dense((L, H, KVD), H),
+        "wo": dense((L, NHD, H), NHD),
+        "mlp_norm": jnp.ones((L, H), dtype),
+    }
+    if cfg.norm_zero_centered:
+        layers["attn_norm"] = jnp.zeros((L, H), dtype)
+        layers["mlp_norm"] = jnp.zeros((L, H), dtype)
+    if cfg.attn_bias:
+        layers["bq"] = jnp.zeros((L, NHD), dtype)
+        layers["bk"] = jnp.zeros((L, KVD), dtype)
+        layers["bv"] = jnp.zeros((L, KVD), dtype)
+        layers["bo"] = jnp.zeros((L, H), dtype)
+    if cfg.qk_norm:
+        q_init = jnp.zeros if cfg.norm_zero_centered else jnp.ones
+        layers["q_norm"] = q_init((L, Dh), dtype)
+        layers["k_norm"] = q_init((L, Dh), dtype)
+    if cfg.attention_sink:
+        layers["sink"] = jnp.zeros((L, cfg.num_heads), dtype)
+    if cfg.post_norms:
+        init = jnp.zeros if cfg.norm_zero_centered else jnp.ones
+        layers["post_attn_norm"] = init((L, H), dtype)
+        layers["post_mlp_norm"] = init((L, H), dtype)
+    if cfg.moe_experts:
+        E, Fm = cfg.moe_experts, cfg.moe_intermediate_size
+        layers["router"] = dense((L, H, E), H)
+        layers["we_gate"] = dense((L, E, H, Fm), H)
+        layers["we_up"] = dense((L, E, H, Fm), H)
+        layers["we_down"] = dense((L, E, Fm, H), Fm)
+    else:
+        layers["w_gate"] = dense((L, H, F), H)
+        layers["w_up"] = dense((L, H, F), H)
+        layers["w_down"] = dense((L, F, H), F)
+
+    params: Params = {
+        "embed": dense((cfg.vocab_size, H), H),
+        "final_norm": (jnp.zeros if cfg.norm_zero_centered else jnp.ones)(
+            (H,), dtype
+        ),
+        "layers": layers,
+    }
+    if not cfg.tie_embeddings and cfg.head == "lm":
+        params["lm_head"] = dense((H, cfg.vocab_size), H)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float, zero_centered: bool) -> jax.Array:
+    dt = x.dtype
+    x32 = x.astype(jnp.float32)
+    x32 = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    scale = (1.0 + w.astype(jnp.float32)) if zero_centered else w.astype(jnp.float32)
+    return (x32 * scale).astype(dt)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: jax.Array) -> jax.Array:
+    """rotate-half RoPE. x: [B, T, N, Dh]; positions: [B, T]."""
+    dh = x.shape[-1]
+    half = dh // 2
+    freq = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[..., None] * freq  # [B, T, half]
+    cos = jnp.cos(ang)[:, :, None, :]
+    sin = jnp.sin(ang)[:, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
+def _mlp(cfg: ModelConfig, lp: Dict[str, Any], x: jax.Array) -> jax.Array:
+    if cfg.moe_experts:
+        return moe_mlp(
+            x,
+            lp["router"],
+            lp["we_gate"],
+            lp["we_up"],
+            lp["we_down"],
+            top_k=cfg.moe_top_k,
+            activation=cfg.activation,
+        )
+    gate = x @ lp["w_gate"]
+    up = x @ lp["w_up"]
+    if cfg.activation == "gelu":
+        act = jax.nn.gelu(gate.astype(jnp.float32), approximate=True).astype(x.dtype)
+    elif cfg.activation == "swiglu_oss":
+        g = jnp.clip(gate.astype(jnp.float32), max=7.0)
+        act = (g * jax.nn.sigmoid(1.702 * g)).astype(x.dtype)
+        up = jnp.clip(up.astype(jnp.float32), -7.0, 7.0).astype(x.dtype) + 1.0
+    else:
+        act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype)
+    return (act * up) @ lp["w_down"]
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def forward(
+    cfg: ModelConfig,
+    params: Params,
+    ids: jax.Array,                     # [B, T] int32
+    positions: jax.Array,               # [B, T] int32 (global positions)
+    valid_len: jax.Array,               # [B] int32 — tokens of chunk that are real
+    past_kv: Optional[Tuple[jax.Array, jax.Array]] = None,
+    # past_kv: (k, v) each [L, B, CTX, KVH, Dh] — pre-gathered from pages
+    past_len: Optional[jax.Array] = None,  # [B] int32 — valid past tokens
+    use_pallas: bool = False,
+) -> Tuple[jax.Array, jax.Array, Tuple[jax.Array, jax.Array]]:
+    """Run the trunk over a chunk.
+
+    Returns ``(logits_or_emb, final_hidden, (k_chunk, v_chunk))`` where the
+    chunk K/V are stacked ``[L, B, T, KVH, Dh]`` (post-RoPE, ready for cache
+    scatter by the runner).
+    """
+    B, T = ids.shape
+    L = cfg.num_layers
+    h = params["embed"][ids]  # [B, T, H] gather
+    if cfg.embed_scale:
+        h = (h.astype(jnp.float32) * (cfg.hidden_size ** 0.5)).astype(h.dtype)
+
+    windows = jnp.asarray(cfg.window_array(), jnp.int32)  # [L]
+    thetas = jnp.asarray(
+        [
+            (cfg.local_rope_theta if (w > 0 and cfg.local_rope_theta) else cfg.rope_theta)
+            for w in cfg.window_array()
+        ],
+        jnp.float32,
+    )
+
+    if past_kv is not None:
+        pk, pv = past_kv
+        xs = (params["layers"], windows, thetas, pk, pv)
+    else:
+        xs = (params["layers"], windows, thetas)
+
+    def layer_step(h, xs_l):
+        if past_kv is not None:
+            lp, window, theta, pk_l, pv_l = xs_l
+        else:
+            lp, window, theta = xs_l
+            pk_l = pv_l = None
+        resid = h
+        x = rms_norm(h, lp["attn_norm"], cfg.norm_eps, cfg.norm_zero_centered)
+        q = x @ lp["wq"]
+        k = x @ lp["wk"]
+        v = x @ lp["wv"]
+        if cfg.attn_bias:
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(B, T, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+        v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+        if cfg.qk_norm:
+            q = rms_norm(q, lp["q_norm"], cfg.norm_eps, cfg.norm_zero_centered)
+            k = rms_norm(k, lp["k_norm"], cfg.norm_eps, cfg.norm_zero_centered)
+        q = apply_rope(q, positions, theta)
+        k = apply_rope(k, positions, theta)
+        sink = lp.get("sink") if cfg.attention_sink else None
+        attn = chunk_attention(
+            q, k, v,
+            positions=positions,
+            valid_len=valid_len,
+            past_k=pk_l, past_v=pv_l, past_len=past_len,
+            window=window, sink=sink,
+            use_pallas=use_pallas,
+        )
+        attn = attn.reshape(B, T, cfg.q_size) @ lp["wo"]
+        if cfg.attn_bias:
+            attn = attn + lp["bo"]
+        if cfg.post_norms:
+            attn = rms_norm(attn, lp["post_attn_norm"], cfg.norm_eps, cfg.norm_zero_centered)
+        h = resid + attn
+        resid = h
+        x = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, cfg.norm_zero_centered)
+        x = _mlp(cfg, lp, x)
+        if cfg.post_norms:
+            x = rms_norm(x, lp["post_mlp_norm"], cfg.norm_eps, cfg.norm_zero_centered)
+        h = resid + x
+        return h, (k, v)
+
+    h, (k_all, v_all) = jax.lax.scan(layer_step, h, xs)
+
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps, cfg.norm_zero_centered)
+
+    if cfg.head == "embedding":
+        # Mean-pool over valid tokens, L2-normalize (BASELINE config #3).
+        mask = (jnp.arange(T)[None, :] < valid_len[:, None]).astype(jnp.float32)
+        pooled = jnp.sum(h.astype(jnp.float32) * mask[..., None], axis=1)
+        pooled = pooled / jnp.maximum(mask.sum(axis=1, keepdims=True), 1.0)
+        emb = pooled / jnp.maximum(
+            jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9
+        )
+        return emb, h, (k_all, v_all)
+
+    lm_head = params.get("lm_head")
+    if lm_head is None:
+        lm_head = params["embed"].T
+    logits = (h @ lm_head.astype(h.dtype)).astype(jnp.float32)
+    return logits, h, (k_all, v_all)
+
+
+def num_params(params: Params) -> int:
+    return int(sum(np.prod(x.shape) for x in jax.tree_util.tree_leaves(params)))

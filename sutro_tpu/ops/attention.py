@@ -1,0 +1,109 @@
+"""Attention ops.
+
+``chunk_attention`` is the single attention entry point for both prefill
+(T=chunk, no past) and decode (T=1, past gathered from the paged KV cache).
+The reference has no kernels at all (SURVEY §2.3); this is the TPU-native
+hot path. Two implementations sit behind one signature:
+
+- a pure-``jnp`` path (XLA fuses it well; used on CPU tests and as the
+  always-correct fallback), and
+- Pallas flash/paged kernels (ops/pallas_attention.py), dispatched with
+  ``use_pallas=True`` on TPU.
+
+Semantics handled here, uniformly: GQA head grouping, causal masking within
+the chunk, past-length masking, per-layer sliding windows (Gemma3 5:1
+local:global, gpt-oss alternating — SURVEY §5.7), and gpt-oss learnable
+attention sinks (an extra per-head softmax logit that absorbs probability
+mass).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def chunk_attention(
+    q: jax.Array,                       # [B, T, NH, Dh]
+    k: jax.Array,                       # [B, T, KVH, Dh] (chunk, post-RoPE)
+    v: jax.Array,                       # [B, T, KVH, Dh]
+    *,
+    positions: jax.Array,               # [B, T] global positions of queries
+    valid_len: jax.Array,               # [B] valid tokens in the chunk
+    past_k: Optional[jax.Array] = None, # [B, CTX, KVH, Dh]
+    past_v: Optional[jax.Array] = None,
+    past_len: Optional[jax.Array] = None,  # [B]
+    window: Optional[jax.Array] = None,    # scalar int32; 0 => full attention
+    sink: Optional[jax.Array] = None,      # [NH] attention-sink logits
+    use_pallas: bool = False,
+) -> jax.Array:
+    """Returns [B, T, NH, Dh]."""
+    if use_pallas:
+        from . import pallas_attention as pa
+
+        out = pa.try_chunk_attention(
+            q, k, v, positions=positions, valid_len=valid_len,
+            past_k=past_k, past_v=past_v, past_len=past_len,
+            window=window, sink=sink,
+        )
+        if out is not None:
+            return out
+
+    B, T, NH, Dh = q.shape
+    KVH = k.shape[2]
+    G = NH // KVH
+    scale = Dh ** -0.5
+
+    if past_k is not None:
+        keys = jnp.concatenate([past_k, k], axis=1)
+        vals = jnp.concatenate([past_v, v], axis=1)
+        ctx = past_k.shape[1]
+        key_pos = jnp.concatenate(
+            [
+                jnp.broadcast_to(jnp.arange(ctx, dtype=jnp.int32)[None], (B, ctx)),
+                positions,
+            ],
+            axis=1,
+        )
+        key_valid = jnp.concatenate(
+            [
+                jnp.arange(ctx, dtype=jnp.int32)[None] < past_len[:, None],
+                jnp.arange(T, dtype=jnp.int32)[None] < valid_len[:, None],
+            ],
+            axis=1,
+        )
+    else:
+        keys, vals = k, v
+        key_pos = positions
+        key_valid = jnp.arange(T, dtype=jnp.int32)[None] < valid_len[:, None]
+
+    S = keys.shape[1]
+    qg = q.reshape(B, T, KVH, G, Dh).astype(jnp.float32)
+    kf = keys.astype(jnp.float32)
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg, kf) * scale  # [B,KVH,G,T,S]
+
+    # Mask: causal (key_pos <= q_pos), key validity, sliding window.
+    qp = positions[:, :, None]                     # [B, T, 1]
+    kp = key_pos[:, None, :]                       # [B, 1, S]
+    allowed = (kp <= qp) & key_valid[:, None, :]
+    if window is not None:
+        win = jnp.asarray(window, jnp.int32)
+        in_window = (qp - kp) < jnp.where(win > 0, win, jnp.iinfo(jnp.int32).max)
+        allowed = allowed & in_window
+    # mask shape [B,1,1,T,S] broadcasts over (KVH, G)
+    scores = jnp.where(allowed[:, None, None, :, :], scores, NEG_INF)
+
+    if sink is not None:
+        sink_col = sink.astype(jnp.float32).reshape(1, KVH, G, 1, 1)
+        sink_col = jnp.broadcast_to(sink_col, (B, KVH, G, T, 1))
+        scores = jnp.concatenate([scores, sink_col], axis=-1)
+        weights = jax.nn.softmax(scores, axis=-1)[..., :S]
+    else:
+        weights = jax.nn.softmax(scores, axis=-1)
+
+    out = jnp.einsum("bkgts,bskd->btkgd", weights, vals.astype(jnp.float32))
+    return out.reshape(B, T, NH, Dh).astype(q.dtype)

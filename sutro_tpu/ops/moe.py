@@ -1,0 +1,94 @@
+"""Mixture-of-experts MLP.
+
+Covers Qwen3-MoE (30b-a3b, 235b-a22b) and gpt-oss (20b/120b) from the
+reference catalog (/root/reference/sutro/common.py:28-39). Two execution
+paths behind one call:
+
+- ``dense``: computes every expert for every token and combines with the
+  gate matrix. Correct and simple; the E/top_k FLOP overhead is fine for
+  tiny test models and small E.
+- ``ragged``: sorts the (token, expert) assignments by expert and runs two
+  grouped GEMMs via ``jax.lax.ragged_dot`` — the MXU-friendly path for
+  large E. Static shapes: the expanded token count is exactly ``N * top_k``.
+
+Router convention: softmax over the top-k logits (equivalent to
+renormalized top-k of the full softmax — matches Qwen3's
+``norm_topk_prob=True`` and gpt-oss).
+
+Expert parallelism shards the expert axis of ``we_*`` over the mesh
+"expert" axis; XLA turns the resulting gather/scatter into all-to-alls over
+ICI (see parallel/sharding.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _act(gate: jax.Array, up: jax.Array, activation: str):
+    if activation == "gelu":
+        a = jax.nn.gelu(gate.astype(jnp.float32), approximate=True)
+        return a.astype(gate.dtype), up
+    if activation == "swiglu_oss":
+        g = jnp.clip(gate.astype(jnp.float32), max=7.0)
+        a = (g * jax.nn.sigmoid(1.702 * g)).astype(gate.dtype)
+        u = jnp.clip(up.astype(jnp.float32), -7.0, 7.0).astype(up.dtype) + 1.0
+        return a, u
+    a = jax.nn.silu(gate.astype(jnp.float32)).astype(gate.dtype)
+    return a, up
+
+
+def moe_mlp(
+    x: jax.Array,          # [B, T, H]
+    router: jax.Array,     # [H, E]
+    we_gate: jax.Array,    # [E, H, F]
+    we_up: jax.Array,      # [E, H, F]
+    we_down: jax.Array,    # [E, F, H]
+    *,
+    top_k: int,
+    activation: str = "silu",
+    method: str = "auto",
+) -> jax.Array:
+    B, T, H = x.shape
+    E = router.shape[-1]
+    N = B * T
+    xt = x.reshape(N, H)
+
+    logits = xt.astype(jnp.float32) @ router.astype(jnp.float32)  # [N, E]
+    top_logits, top_idx = jax.lax.top_k(logits, top_k)            # [N, K]
+    probs = jax.nn.softmax(top_logits, axis=-1)                   # [N, K]
+
+    if method == "auto":
+        method = "dense" if E <= 8 else "ragged"
+
+    if method == "dense":
+        gates = jnp.zeros((N, E), jnp.float32)
+        gates = gates.at[jnp.arange(N)[:, None], top_idx].add(probs)
+        g = jnp.einsum("nh,ehf->nef", xt, we_gate)
+        u = jnp.einsum("nh,ehf->nef", xt, we_up)
+        a, u = _act(g, u, activation)
+        y = jnp.einsum("nef,efh->neh", a * u, we_down)
+        out = jnp.einsum("ne,neh->nh", gates.astype(y.dtype), y)
+        return out.reshape(B, T, H)
+
+    # ragged grouped-GEMM path
+    K = top_k
+    M = N * K
+    flat_expert = top_idx.reshape(M)                      # expert per expanded row
+    flat_token = jnp.repeat(jnp.arange(N), K)
+    flat_prob = probs.reshape(M)
+    order = jnp.argsort(flat_expert)                      # stable order by expert
+    sorted_expert = flat_expert[order]
+    sorted_token = flat_token[order]
+    sorted_prob = flat_prob[order]
+    group_sizes = jnp.bincount(sorted_expert, length=E).astype(jnp.int32)
+
+    lhs = xt[sorted_token]                                # [M, H]
+    g = jax.lax.ragged_dot(lhs, we_gate, group_sizes)     # [M, F]
+    u = jax.lax.ragged_dot(lhs, we_up, group_sizes)
+    a, u = _act(g, u, activation)
+    y = jax.lax.ragged_dot(a * u, we_down, group_sizes)   # [M, H]
+    y = y * sorted_prob[:, None].astype(y.dtype)
+    out = jnp.zeros((N, H), y.dtype).at[sorted_token].add(y)
+    return out.reshape(B, T, H)

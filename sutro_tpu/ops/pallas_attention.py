@@ -1,0 +1,36 @@
+"""Pallas TPU attention kernels (flash prefill / paged decode).
+
+Dispatched from ops/attention.py with ``use_pallas=True``. Each entry point
+returns ``None`` when it cannot handle the given shapes/flags, in which
+case the caller falls back to the fused-XLA path — so correctness never
+depends on kernel coverage.
+
+Kernels are implemented incrementally; see pallas kernels section of
+SURVEY §7.2 step 4.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def try_chunk_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    positions: jax.Array,
+    valid_len: jax.Array,
+    past_k: Optional[jax.Array],
+    past_v: Optional[jax.Array],
+    past_len: Optional[jax.Array],
+    window: Optional[jax.Array],
+    sink: Optional[jax.Array],
+) -> Optional[jax.Array]:
+    from .pallas_flash import flash_prefill_supported, flash_prefill
+
+    if past_k is None and flash_prefill_supported(q, k, window, sink):
+        return flash_prefill(q, k, v, positions=positions, valid_len=valid_len)
+    return None

@@ -1,0 +1,23 @@
+"""Flash (blockwise, online-softmax) causal prefill attention in Pallas.
+
+Placeholder gate for now: ``flash_prefill_supported`` returns False until
+the kernel lands (SURVEY §7.2 step 4); ops/attention.py then uses the XLA
+path. Kept as a separate module so the kernel can be developed and
+unit-tested against the reference jnp implementation in isolation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def flash_prefill_supported(
+    q: jax.Array, k: jax.Array, window, sink
+) -> bool:
+    return False
+
+
+def flash_prefill(q, k, v, *, positions, valid_len):  # pragma: no cover
+    raise NotImplementedError

@@ -1,0 +1,76 @@
+"""Token sampling with optional constrained-decoding masks.
+
+Implements the ``sampling_params`` surface the reference forwards to its
+service (temperature / top_p / top_k; /root/reference/sutro/sdk.py:202-216
+payload) plus the logit-mask hook used by schema-constrained decoding
+(engine/constrain/): a boolean ``allowed`` mask computed host-side from the
+token FSM is applied before sampling, guaranteeing schema-valid JSON.
+
+Everything is jit-safe and static-shape; greedy is the temperature==0.0
+special case folded into the same compiled fn (lax.cond-free: we use a
+where on the temperature scalar so one executable serves both).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def sample(
+    logits: jax.Array,                  # [B, V] float32
+    key: jax.Array,
+    *,
+    temperature: jax.Array,             # scalar or [B]
+    top_p: jax.Array,                   # scalar or [B]; 1.0 disables
+    top_k: jax.Array = 0,               # scalar or [B] int32; 0 disables
+    allowed: Optional[jax.Array] = None,  # [B, V] bool — constrained decoding
+) -> jax.Array:
+    """Returns sampled token ids [B]."""
+    B, V = logits.shape
+    if allowed is not None:
+        logits = jnp.where(allowed, logits, NEG_INF)
+
+    temperature = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (B,))
+    top_p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (B,))
+    top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (B,))
+
+    greedy_tok = jnp.argmax(logits, axis=-1)
+
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+
+    # one descending sort serves both top-k and top-p filtering
+    sort_idx = jnp.argsort(-scaled, axis=-1)
+    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+
+    # top-k (dynamic per row): keep ranks < k; k<=0 disables
+    ranks = jnp.arange(V, dtype=jnp.int32)[None, :]
+    k_eff = jnp.where(top_k > 0, top_k, V)[:, None]
+    keep_k = ranks < k_eff
+
+    # top-p (nucleus): drop tokens outside the smallest prob mass >= top_p
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    keep_p = (cum - sorted_probs) < top_p[:, None]  # always keeps rank-0
+
+    keep_sorted = keep_k & keep_p
+    keep = jnp.zeros_like(keep_sorted).at[
+        jnp.arange(B)[:, None], sort_idx
+    ].set(keep_sorted)
+    scaled = jnp.where(keep, scaled, NEG_INF)
+
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(temperature <= 0.0, greedy_tok, sampled).astype(jnp.int32)
+
+
+def cumulative_logprob(
+    logits: jax.Array, token: jax.Array
+) -> jax.Array:
+    """Per-step logprob of the chosen token (for ``include_cumulative_logprobs``,
+    reference sdk.py:1138-1151)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(logp, token[:, None], axis=-1)[:, 0]

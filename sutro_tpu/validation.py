@@ -1,0 +1,70 @@
+"""Config discovery and bootstrap checks.
+
+Re-design of the reference's ``sutro/validation.py``
+(/root/reference/sutro/validation.py:10-60). The TPU build is local-first:
+an API key is optional (only needed when a client points at a remote
+``base_url``), so discovery never errors — it returns ``None`` and the SDK
+runs against the in-process engine. The PyPI version check
+(validation.py:18-33) is kept but disabled by default because this
+environment has zero egress; set ``SUTRO_CHECK_VERSION=1`` to enable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+CONFIG_DIR = Path(os.environ.get("SUTRO_HOME", Path.home() / ".sutro"))
+CONFIG_PATH = CONFIG_DIR / "config.json"
+
+
+def config_dir() -> Path:
+    d = Path(os.environ.get("SUTRO_HOME", Path.home() / ".sutro"))
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def load_config() -> Dict[str, Any]:
+    """Load ``~/.sutro/config.json`` (reference cli.py:17-21), tolerating
+    absence and corruption."""
+    path = config_dir() / "config.json"
+    if not path.exists():
+        return {}
+    try:
+        return json.loads(path.read_text())
+    except Exception:
+        return {}
+
+
+def save_config(cfg: Dict[str, Any]) -> None:
+    path = config_dir() / "config.json"
+    path.write_text(json.dumps(cfg, indent=2))
+
+
+def check_for_api_key() -> Optional[str]:
+    """API-key discovery: env ``SUTRO_API_KEY`` first, then config file
+    (reference validation.py:36-60). Returns None when absent — the local
+    TPU backend needs no key."""
+    key = os.environ.get("SUTRO_API_KEY")
+    if key:
+        return key
+    return load_config().get("api_key")
+
+
+def check_version(timeout: float = 2.0) -> Optional[str]:
+    """Best-effort PyPI latest-version lookup; fail-silent (reference
+    validation.py:18-33). No-op unless SUTRO_CHECK_VERSION=1 (zero-egress
+    environments)."""
+    if os.environ.get("SUTRO_CHECK_VERSION") != "1":
+        return None
+    try:  # pragma: no cover - requires network
+        import requests
+
+        resp = requests.get(
+            "https://pypi.org/pypi/sutro/json", timeout=timeout
+        )
+        return resp.json()["info"]["version"]
+    except Exception:
+        return None

@@ -1,0 +1,55 @@
+"""Test bootstrap: force CPU with 8 virtual devices so TP/DP/EP sharding
+logic runs multi-device in CI without TPUs (SURVEY §4 'lesson for the
+build'). Must run before jax is imported anywhere."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from sutro_tpu.engine.config import EngineConfig  # noqa: E402
+from sutro_tpu.engine.tokenizer import ByteTokenizer  # noqa: E402
+from sutro_tpu.models.configs import MODEL_CONFIGS  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def tiny_ecfg() -> EngineConfig:
+    return EngineConfig(
+        kv_page_size=8,
+        max_pages_per_seq=16,
+        decode_batch_size=4,
+        max_model_len=128,
+        use_pallas=False,
+        param_dtype="float32",
+        activation_dtype="float32",
+    )
+
+
+@pytest.fixture(scope="session")
+def byte_tok() -> ByteTokenizer:
+    return ByteTokenizer(vocab_size=MODEL_CONFIGS["tiny-dense"].vocab_size)
+
+
+@pytest.fixture(scope="session")
+def tiny_runner(tiny_ecfg):
+    from sutro_tpu.engine.runner import ModelRunner
+
+    return ModelRunner(MODEL_CONFIGS["tiny-dense"], tiny_ecfg)
+
+
+def make_requests(tok, texts, **kw):
+    from sutro_tpu.engine.scheduler import GenRequest
+
+    return [
+        GenRequest(
+            row_id=i, prompt_ids=np.array(tok.encode(t), np.int32), **kw
+        )
+        for i, t in enumerate(texts)
+    ]

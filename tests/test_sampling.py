@@ -1,0 +1,81 @@
+"""Sampling op: greedy, top-k/top-p filters, constrained-vocabulary masks."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sutro_tpu.ops.sampling import cumulative_logprob, sample
+
+
+def _logits():
+    # row 0: peaked at 3; row 1: flat-ish with max at 0
+    return jnp.asarray(
+        [[0.0, 1.0, 2.0, 10.0, -1.0], [3.0, 2.9, 2.8, 2.7, 2.6]], jnp.float32
+    )
+
+
+def test_greedy():
+    toks = sample(
+        _logits(), jax.random.PRNGKey(0), temperature=0.0, top_p=1.0
+    )
+    assert list(np.asarray(toks)) == [3, 0]
+
+
+def test_top_k_one_is_greedy():
+    toks = sample(
+        _logits(),
+        jax.random.PRNGKey(7),
+        temperature=1.0,
+        top_p=1.0,
+        top_k=jnp.array([1, 1], jnp.int32),
+    )
+    assert list(np.asarray(toks)) == [3, 0]
+
+
+def test_top_p_tiny_is_greedy():
+    toks = sample(
+        _logits(), jax.random.PRNGKey(3), temperature=1.0, top_p=1e-6
+    )
+    assert list(np.asarray(toks)) == [3, 0]
+
+
+def test_per_row_top_k():
+    # row 0: k=1 (greedy); row 1: k=0 (disabled) — both valid samples
+    toks = sample(
+        _logits(),
+        jax.random.PRNGKey(5),
+        temperature=1.0,
+        top_p=1.0,
+        top_k=jnp.array([1, 0], jnp.int32),
+    )
+    t = np.asarray(toks)
+    assert t[0] == 3
+    assert 0 <= t[1] < 5
+
+
+def test_allowed_mask_constrains():
+    allowed = jnp.asarray(
+        [[False, True, False, False, False], [True, True, False, False, False]]
+    )
+    for seed in range(5):
+        toks = sample(
+            _logits(),
+            jax.random.PRNGKey(seed),
+            temperature=1.0,
+            top_p=1.0,
+            allowed=allowed,
+        )
+        t = np.asarray(toks)
+        assert t[0] == 1
+        assert t[1] in (0, 1)
+
+
+def test_cumulative_logprob_matches_softmax():
+    logits = _logits()
+    tok = jnp.array([3, 0], jnp.int32)
+    lp = np.asarray(cumulative_logprob(logits, tok))
+    ref = np.log(
+        np.exp(np.asarray(logits))
+        / np.exp(np.asarray(logits)).sum(-1, keepdims=True)
+    )
+    np.testing.assert_allclose(lp, ref[[0, 1], [3, 0]], rtol=1e-4, atol=1e-6)

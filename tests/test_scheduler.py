@@ -1,0 +1,98 @@
+"""Continuous-batching scheduler behaviors: ordering, cancellation,
+truncation, admission, determinism, sampling-param plumbing."""
+
+import numpy as np
+
+from sutro_tpu.engine.scheduler import ContinuousBatcher, GenRequest
+
+from .conftest import make_requests
+
+
+def run_all(batcher, reqs, **kw):
+    res = {}
+    batcher.run(reqs, on_result=lambda r: res.__setitem__(r.row_id, r), **kw)
+    return res
+
+
+def test_all_rows_complete_in_order_keyed(tiny_runner, byte_tok):
+    b = ContinuousBatcher(tiny_runner, stop_ids=byte_tok.stop_ids())
+    reqs = make_requests(
+        byte_tok,
+        [f"row number {i}" for i in range(9)],
+        max_new_tokens=6,
+        temperature=0.5,
+    )
+    res = run_all(b, reqs)
+    assert set(res) == set(range(9))
+    assert all(r.input_tokens > 0 for r in res.values())
+
+
+def test_greedy_determinism_across_batching(tiny_runner, byte_tok):
+    b = ContinuousBatcher(tiny_runner, stop_ids=byte_tok.stop_ids())
+    reqs = make_requests(
+        byte_tok, ["same prompt"] * 4, max_new_tokens=8, temperature=0.0
+    )
+    res = run_all(b, reqs)
+    seqs = [tuple(res[i].token_ids) for i in range(4)]
+    assert len(set(seqs)) == 1
+
+
+def test_truncation_and_too_long(tiny_runner, byte_tok):
+    b = ContinuousBatcher(tiny_runner, stop_ids=byte_tok.stop_ids())
+    long_ids = np.arange(500, dtype=np.int32) % 200
+    reqs = [
+        GenRequest(row_id=0, prompt_ids=long_ids, max_new_tokens=4),
+        GenRequest(
+            row_id=1, prompt_ids=long_ids, max_new_tokens=4,
+            allow_truncate=False,
+        ),
+    ]
+    res = run_all(b, reqs)
+    assert res[0].finish_reason in ("length", "stop")
+    assert res[1].finish_reason == "error_too_long"
+    assert res[1].token_ids == []
+
+
+def test_cancellation(tiny_runner, byte_tok):
+    b = ContinuousBatcher(tiny_runner, stop_ids=byte_tok.stop_ids())
+    calls = [0]
+
+    def cancel():
+        calls[0] += 1
+        return calls[0] > 2
+
+    res = run_all(
+        b,
+        make_requests(byte_tok, ["a", "b"], max_new_tokens=50),
+        should_cancel=cancel,
+    )
+    assert all(r.finish_reason == "cancelled" for r in res.values())
+
+
+def test_progress_stream_fields(tiny_runner, byte_tok):
+    """Progress updates carry the reference NDJSON token fields
+    (sdk.py:339-366)."""
+    b = ContinuousBatcher(tiny_runner, stop_ids=byte_tok.stop_ids())
+    updates = []
+    run_all(
+        b,
+        make_requests(byte_tok, ["x", "y"], max_new_tokens=4),
+        on_progress=updates.append,
+        progress_every=0.0,
+    )
+    assert updates, "no progress reported"
+    last = updates[-1]
+    assert {
+        "rows_completed",
+        "input_tokens",
+        "output_tokens",
+        "total_tokens_processed_per_second",
+    } <= set(last)
+    assert last["rows_completed"] == 2
+
+
+def test_pages_released(tiny_runner, byte_tok):
+    b = ContinuousBatcher(tiny_runner, stop_ids=byte_tok.stop_ids())
+    free0 = b.allocator.free_count
+    run_all(b, make_requests(byte_tok, ["p1", "p2", "p3"], max_new_tokens=5))
+    assert b.allocator.free_count == free0

@@ -1,0 +1,51 @@
+"""Tokenizer tests: byte-level round trips, specials, token_bytes contract
+(the constrained-decoding FSM depends on token_bytes — engine/constrain/)."""
+
+from sutro_tpu.engine.tokenizer import (
+    ByteTokenizer,
+    _GPT2_BYTE_DECODER,
+)
+
+
+def test_byte_roundtrip():
+    tok = ByteTokenizer()
+    text = "hello, TPU — ünïcødé!"
+    assert tok.decode(tok.encode(text)) == text
+
+
+def test_specials_atomic():
+    tok = ByteTokenizer()
+    ids = tok.encode("<|im_start|>user\nhi<|im_end|>")
+    assert ids[0] == tok._special_to_id["<|im_start|>"]
+    assert ids[-1] == tok.im_end_id
+    # specials carry no bytes
+    assert tok.token_bytes(tok.im_end_id) == b""
+    assert tok.token_bytes(ord("a")) == b"a"
+
+
+def test_render_chat_templates():
+    tok = ByteTokenizer()
+    chatml = tok.render_chat("hi", system="sys", template="chatml")
+    assert chatml.startswith("<|im_start|>system\nsys<|im_end|>")
+    assert chatml.endswith("<|im_start|>assistant\n")
+    plain = tok.render_chat("hi", system="sys", template="plain")
+    assert plain == "sys\n\nhi"
+    gemma = tok.render_chat("hi", template="gemma")
+    assert "<start_of_turn>model" in gemma
+    llama = tok.render_chat("hi", template="llama3")
+    assert llama.startswith("<|begin_of_text|>")
+
+
+def test_gpt2_byte_decoder_complete():
+    # bijective over all 256 byte values
+    assert len(_GPT2_BYTE_DECODER) == 256
+    assert sorted(_GPT2_BYTE_DECODER.values()) == list(range(256))
+    # the canonical examples: 'Ġ' is space, '!' is itself
+    assert _GPT2_BYTE_DECODER["Ġ"] == 0x20
+    assert _GPT2_BYTE_DECODER["!"] == ord("!")
+
+
+def test_stop_ids():
+    tok = ByteTokenizer()
+    assert tok.eos_id in tok.stop_ids()
+    assert tok.im_end_id in tok.stop_ids()
