@@ -1,0 +1,139 @@
+"""Benchmark: decode throughput of the engine on the available accelerator.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Workload: the BASELINE config-#1 model class (qwen3-0.6b, random bf16
+weights — throughput is weight-value independent) running the real engine
+decode path (paged KV gather, batched sampling) at full decode batch.
+``vs_baseline`` compares against ``BENCH_baseline.json`` (written on first
+run) so later rounds report their speedup over this round; the reference
+publishes no numbers to compare against (BASELINE.md).
+
+Env knobs: SUTRO_BENCH_MODEL, SUTRO_BENCH_BATCH, SUTRO_BENCH_STEPS,
+SUTRO_BENCH_PROMPT.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from sutro_tpu.engine.config import EngineConfig
+    from sutro_tpu.engine.runner import ModelRunner
+    from sutro_tpu.models.configs import MODEL_CONFIGS
+
+    model_key = os.environ.get("SUTRO_BENCH_MODEL", "qwen3-0.6b")
+    B = int(os.environ.get("SUTRO_BENCH_BATCH", "64"))
+    steps = int(os.environ.get("SUTRO_BENCH_STEPS", "128"))
+    prompt_len = int(os.environ.get("SUTRO_BENCH_PROMPT", "128"))
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if not on_tpu:  # keep CPU smoke runs fast
+        model_key = os.environ.get("SUTRO_BENCH_MODEL", "tiny-dense")
+        B, steps, prompt_len = 4, 16, 16
+
+    mcfg = MODEL_CONFIGS[model_key]
+    ecfg = EngineConfig(
+        kv_page_size=64 if on_tpu else 8,
+        max_pages_per_seq=(prompt_len + steps) // (64 if on_tpu else 8) + 2,
+        decode_batch_size=B,
+        max_model_len=prompt_len + steps + 64,
+        param_dtype="bfloat16" if on_tpu else "float32",
+        use_pallas=None,
+    )
+    runner = ModelRunner(mcfg, ecfg)
+    MP = ecfg.max_pages_per_seq
+    PS = ecfg.kv_page_size
+
+    # fill every slot with a prompt
+    rng = np.random.default_rng(0)
+    pages_per_seq = (prompt_len + steps) // PS + 1
+    tables = np.zeros((B, MP), np.int32)
+    next_page = 1
+    for b in range(B):
+        tables[b, :pages_per_seq] = np.arange(
+            next_page, next_page + pages_per_seq
+        )
+        next_page += pages_per_seq
+    prompt = rng.integers(0, min(mcfg.vocab_size, 50000), prompt_len).astype(
+        np.int32
+    )
+    t_prefill0 = time.monotonic()
+    for b in range(B):
+        runner.prefill(prompt, tables[b])
+    t_prefill = time.monotonic() - t_prefill0
+
+    last = rng.integers(0, 256, B).astype(np.int32)
+    past_len = np.full((B,), prompt_len, np.int32)
+    temp = np.full((B,), 0.7, np.float32)
+    top_p = np.full((B,), 0.95, np.float32)
+
+    # warmup (compile)
+    toks, _ = runner.decode_step(
+        last, past_len, tables, jax.random.PRNGKey(0), temp, top_p
+    )
+    past_len += 1
+    last = toks.astype(np.int32)
+
+    t0 = time.monotonic()
+    for i in range(steps):
+        toks, _ = runner.decode_step(
+            last, past_len, tables, jax.random.PRNGKey(i + 1), temp, top_p
+        )
+        past_len += 1
+        last = toks.astype(np.int32)
+    dt = time.monotonic() - t0
+
+    n_chips = max(jax.device_count(), 1)
+    decode_tok_s = B * steps / dt
+    value = decode_tok_s / n_chips
+
+    baseline_path = Path(__file__).parent / "BENCH_baseline.json"
+    vs = 1.0
+    record = {
+        "model": model_key,
+        "backend": jax.default_backend(),
+        "batch": B,
+        "steps": steps,
+        "prompt_len": prompt_len,
+        "decode_tok_s_per_chip": value,
+        "prefill_s_total": t_prefill,
+    }
+    if baseline_path.exists():
+        try:
+            base = json.loads(baseline_path.read_text())
+            if (
+                base.get("model") == model_key
+                and base.get("backend") == jax.default_backend()
+                and base.get("decode_tok_s_per_chip", 0) > 0
+            ):
+                vs = value / base["decode_tok_s_per_chip"]
+        except Exception:
+            pass
+    else:
+        baseline_path.write_text(json.dumps(record, indent=2))
+
+    print(
+        json.dumps(
+            {
+                "metric": f"decode tokens/sec/chip ({model_key}, bs{B}, "
+                f"{jax.default_backend()})",
+                "value": round(value, 2),
+                "unit": "tok/s/chip",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

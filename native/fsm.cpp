@@ -1,0 +1,142 @@
+// Native core for constrained-decoding token-mask computation.
+//
+// The pure-Python fallback (sutro_tpu/engine/constrain/fsm.py) simulates
+// every vocab token's bytes through the schema NFA each time a new FSM
+// state-set is reached; for 150k-token vocabs that inner loop is the
+// host-side hot spot (SURVEY §2.3: "C++ core (FSM compile/step)").
+// This translation unit implements exactly that loop over a flattened NFA.
+//
+// Layout (built once per schema by constrain/cpp.py):
+//   - edges in CSR form: edge_offsets[n_states+1]; per edge a 256-bit byte
+//     bitmap (8x uint32) and a target state id
+//   - epsilon closure is precomputed Python-side per reachable state and
+//     folded into a "closed step": step(states, byte) already includes
+//     closure, so here we only need byte transitions into closed sets.
+//     To keep C++ independent of closure logic, the Python side passes the
+//     NFA with epsilon edges ALREADY eliminated (each state's edges point
+//     at epsilon-closed successor sets is not representable; instead we
+//     eliminate epsilon by edge-lifting: for every state s and every state
+//     t in eps-closure(s), s inherits t's byte edges; acceptance likewise).
+//
+// State sets are bitsets of n_states bits (vector<uint64_t> words).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+struct FsmCore {
+    int32_t n_states;
+    int32_t n_words;  // (n_states + 63) / 64
+    // CSR edges (epsilon-eliminated)
+    std::vector<int32_t> edge_offsets;  // n_states + 1
+    std::vector<uint32_t> edge_bitmaps; // n_edges * 8
+    std::vector<int32_t> edge_targets;  // n_edges
+    std::vector<uint8_t> accepting;     // n_states
+    // token table
+    int32_t vocab;
+    std::vector<int32_t> tok_offsets;   // vocab + 1
+    std::vector<uint8_t> tok_bytes;     // concatenated
+};
+
+FsmCore* fsm_create(
+    int32_t n_states,
+    const int32_t* edge_offsets,
+    const uint32_t* edge_bitmaps,
+    const int32_t* edge_targets,
+    const uint8_t* accepting,
+    int32_t vocab,
+    const int32_t* tok_offsets,
+    const uint8_t* tok_bytes) {
+    FsmCore* f = new FsmCore();
+    f->n_states = n_states;
+    f->n_words = (n_states + 63) / 64;
+    f->edge_offsets.assign(edge_offsets, edge_offsets + n_states + 1);
+    int32_t n_edges = edge_offsets[n_states];
+    f->edge_bitmaps.assign(edge_bitmaps, edge_bitmaps + (size_t)n_edges * 8);
+    f->edge_targets.assign(edge_targets, edge_targets + n_edges);
+    f->accepting.assign(accepting, accepting + n_states);
+    f->vocab = vocab;
+    f->tok_offsets.assign(tok_offsets, tok_offsets + vocab + 1);
+    f->tok_bytes.assign(tok_bytes, tok_bytes + tok_offsets[vocab]);
+    return f;
+}
+
+void fsm_destroy(FsmCore* f) { delete f; }
+
+static inline bool bit_test(const uint64_t* words, int32_t i) {
+    return (words[i >> 6] >> (i & 63)) & 1ull;
+}
+static inline void bit_set(uint64_t* words, int32_t i) {
+    words[i >> 6] |= (1ull << (i & 63));
+}
+
+// Advance a state bitset by one byte. Returns true if any state survives.
+static bool step(const FsmCore* f, const uint64_t* in, uint64_t* out,
+                 uint8_t byte) {
+    std::memset(out, 0, sizeof(uint64_t) * f->n_words);
+    bool any = false;
+    for (int32_t s = 0; s < f->n_states; ++s) {
+        if (!bit_test(in, s)) continue;
+        for (int32_t e = f->edge_offsets[s]; e < f->edge_offsets[s + 1]; ++e) {
+            const uint32_t* bm = &f->edge_bitmaps[(size_t)e * 8];
+            if ((bm[byte >> 5] >> (byte & 31)) & 1u) {
+                bit_set(out, f->edge_targets[e]);
+                any = true;
+            }
+        }
+    }
+    return any;
+}
+
+// mask[v] = 1 iff token v's bytes can all be consumed from `states`.
+void fsm_mask(const FsmCore* f, const int32_t* states, int32_t n_active,
+              uint8_t* mask) {
+    std::vector<uint64_t> start(f->n_words, 0), cur(f->n_words), nxt(f->n_words);
+    for (int32_t i = 0; i < n_active; ++i) bit_set(start.data(), states[i]);
+
+    // byte feasibility from the start set (prefilter)
+    uint32_t first_ok[8] = {0};
+    for (int32_t s = 0; s < f->n_states; ++s) {
+        if (!bit_test(start.data(), s)) continue;
+        for (int32_t e = f->edge_offsets[s]; e < f->edge_offsets[s + 1]; ++e) {
+            const uint32_t* bm = &f->edge_bitmaps[(size_t)e * 8];
+            for (int k = 0; k < 8; ++k) first_ok[k] |= bm[k];
+        }
+    }
+    for (int32_t v = 0; v < f->vocab; ++v) {
+        int32_t lo = f->tok_offsets[v], hi = f->tok_offsets[v + 1];
+        if (lo == hi) { mask[v] = 0; continue; }
+        uint8_t b0 = f->tok_bytes[lo];
+        if (!((first_ok[b0 >> 5] >> (b0 & 31)) & 1u)) { mask[v] = 0; continue; }
+        std::memcpy(cur.data(), start.data(), sizeof(uint64_t) * f->n_words);
+        bool ok = true;
+        for (int32_t i = lo; i < hi; ++i) {
+            if (!step(f, cur.data(), nxt.data(), f->tok_bytes[i])) {
+                ok = false;
+                break;
+            }
+            cur.swap(nxt);
+        }
+        mask[v] = ok ? 1 : 0;
+    }
+}
+
+// Advance `states` by a token's bytes; writes surviving states to
+// out_states, returns count (0 => dead).
+int32_t fsm_advance(const FsmCore* f, const int32_t* states, int32_t n_active,
+                    int32_t token, int32_t* out_states) {
+    std::vector<uint64_t> cur(f->n_words, 0), nxt(f->n_words);
+    for (int32_t i = 0; i < n_active; ++i) bit_set(cur.data(), states[i]);
+    for (int32_t i = f->tok_offsets[token]; i < f->tok_offsets[token + 1]; ++i) {
+        if (!step(f, cur.data(), nxt.data(), f->tok_bytes[i])) return 0;
+        cur.swap(nxt);
+    }
+    int32_t n = 0;
+    for (int32_t s = 0; s < f->n_states; ++s)
+        if (bit_test(cur.data(), s)) out_states[n++] = s;
+    return n;
+}
+
+}  // extern "C"

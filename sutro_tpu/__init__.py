@@ -1,0 +1,28 @@
+"""sutro-tpu: TPU-native batch LLM inference with the Sutro SDK surface.
+
+Module façade matching the reference (/root/reference/sutro/__init__.py:1-22):
+a ``Sutro()`` singleton is instantiated at import time and every public bound
+method is hoisted to module scope, so ``import sutro_tpu as so; so.infer(...)``
+works exactly like the reference's ``import sutro as so``.
+
+Only *methods* are hoisted — properties (notably ``Sutro.engine``) are
+skipped so importing the package never constructs the engine singleton or
+touches ``~/.sutro``; the engine starts lazily on the first job.
+"""
+
+from .sdk import Sutro
+
+_instance = Sutro()
+
+__all__ = ["Sutro"]
+for _name in dir(_instance):
+    if _name.startswith("_"):
+        continue
+    if isinstance(getattr(type(_instance), _name, None), property):
+        continue
+    _attr = getattr(_instance, _name)
+    if callable(_attr):
+        globals()[_name] = _attr
+        __all__.append(_name)
+
+__version__ = "0.1.0"

@@ -1,0 +1,344 @@
+"""``sutro`` CLI.
+
+Command-for-command re-design of the reference CLI
+(/root/reference/sutro/cli.py:17-439): groups ``jobs``, ``datasets``,
+``cache``; commands ``login``, ``docs``, ``set-base-url``, ``quotas``.
+Differences: table rendering uses pandas+tabulate (the reference uses
+polars, optional here); auth is only enforced for the remote backend — the
+local TPU engine needs no key (``login`` still works and persists to
+``~/.sutro/config.json``, reference cli.py:88-134); a new ``engine`` group
+surfaces TPU engine/device info, which has no reference analogue.
+
+Run as ``python -m sutro_tpu.cli`` or the ``sutro`` entry point.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import sys
+from typing import Optional
+
+import click
+from tabulate import tabulate
+
+from .common import to_colored_text
+from .validation import load_config, save_config
+
+BANNER = r"""
+   ____  __  __ ______ ____   ____
+  / ___/ / / / //_  __// __ \ / __ \
+  \__ \ / /_/ /  / /  / /_/ // /_/ /
+ ___/ / \__,_/  /_/  /_/ \_\ \____/   tpu
+/____/
+"""
+
+
+def get_sdk():
+    from .sdk import Sutro
+
+    cfg = load_config()
+    sdk = Sutro(api_key=cfg.get("api_key"))
+    if cfg.get("base_url"):
+        sdk.set_base_url(cfg["base_url"])
+    if cfg.get("backend"):
+        sdk.set_backend(cfg["backend"])
+    return sdk
+
+
+@click.group()
+def cli() -> None:
+    """Sutro TPU — batch LLM inference on TPU."""
+
+
+@cli.command()
+def login() -> None:
+    """Store an API key (only needed for the remote backend)."""
+    click.echo(to_colored_text(BANNER))
+    key = click.prompt("API key", hide_input=True, default="", show_default=False)
+    cfg = load_config()
+    if key:
+        cfg["api_key"] = key
+        sdk = get_sdk()
+        sdk.set_api_key(key)
+        if sdk.backend == "remote":
+            try:
+                ok = sdk.try_authentication(key).get("authenticated", False)
+            except Exception:
+                ok = False
+            if not ok:
+                click.echo(to_colored_text("✗ Authentication failed", "fail"))
+                sys.exit(1)
+    save_config(cfg)
+    click.echo(to_colored_text("✔ Logged in", "success"))
+
+
+@cli.command()
+def docs() -> None:
+    """Open the documentation."""
+    click.echo("https://docs.sutro.sh/")
+
+
+@cli.command("set-base-url")
+@click.argument("url")
+def set_base_url(url: str) -> None:
+    cfg = load_config()
+    cfg["base_url"] = url
+    save_config(cfg)
+    click.echo(to_colored_text(f"✔ base_url set to {url}", "success"))
+
+
+@cli.command("set-backend")
+@click.argument("backend", type=click.Choice(["tpu", "remote"]))
+def set_backend(backend: str) -> None:
+    cfg = load_config()
+    cfg["backend"] = backend
+    save_config(cfg)
+    click.echo(to_colored_text(f"✔ backend set to {backend}", "success"))
+
+
+@cli.command()
+def quotas() -> None:
+    """Show per-priority row/token quotas (reference cli.py:398-416)."""
+    rows = get_sdk().get_quotas()
+    table = [
+        {"priority": i, **q} for i, q in enumerate(rows)
+    ]
+    click.echo(tabulate(table, headers="keys", tablefmt="rounded_outline"))
+
+
+# ---------------------------------------------------------------------------
+# jobs
+# ---------------------------------------------------------------------------
+
+
+@cli.group()
+def jobs() -> None:
+    """Job management."""
+
+
+def _fmt_dt(value: Optional[str]) -> str:
+    if not value:
+        return ""
+    try:
+        dt = datetime.datetime.fromisoformat(value)
+        return dt.astimezone().strftime("%Y-%m-%d %H:%M")
+    except Exception:
+        return str(value)
+
+
+@jobs.command("list")
+@click.option("--limit", default=25, show_default=True)
+def jobs_list(limit: int) -> None:
+    """List jobs, newest first (reference cli.py:143-201)."""
+    records = get_sdk().list_jobs()[:limit]
+    if not records:
+        click.echo(to_colored_text("No jobs found."))
+        return
+    rows = [
+        {
+            "job_id": r.get("job_id"),
+            "status": r.get("status"),
+            "name": r.get("name") or "",
+            "model": r.get("model") or "",
+            "rows": r.get("num_rows"),
+            "created": _fmt_dt(r.get("datetime_created")),
+            "completed": _fmt_dt(r.get("datetime_completed")),
+            "in_tok": r.get("input_tokens"),
+            "out_tok": r.get("output_tokens"),
+            "cost": (
+                f"${r['job_cost']:.4f}" if r.get("job_cost") is not None else ""
+            ),
+        }
+        for r in records
+    ]
+    click.echo(tabulate(rows, headers="keys", tablefmt="rounded_outline"))
+
+
+@jobs.command("status")
+@click.argument("job_id")
+def jobs_status(job_id: str) -> None:
+    click.echo(get_sdk().get_job_status(job_id))
+
+
+@jobs.command("results")
+@click.argument("job_id")
+@click.option("--output-path", default=None, help="Write parquet here")
+@click.option("--include-inputs", is_flag=True)
+def jobs_results(
+    job_id: str, output_path: Optional[str], include_inputs: bool
+) -> None:
+    df = get_sdk().get_job_results(job_id, include_inputs=include_inputs)
+    if df is None:
+        sys.exit(1)
+    if output_path:
+        df.to_parquet(output_path)
+        click.echo(to_colored_text(f"✔ Wrote {output_path}", "success"))
+    else:
+        click.echo(df.head(20).to_string())
+
+
+@jobs.command("cancel")
+@click.argument("job_id")
+def jobs_cancel(job_id: str) -> None:
+    out = get_sdk().cancel_job(job_id)
+    click.echo(to_colored_text(f"Status: {out.get('status')}", "callout"))
+
+
+@jobs.command("attach")
+@click.argument("job_id", required=False)
+@click.option("--latest", is_flag=True, help="Attach to the most recent job")
+def jobs_attach(job_id: Optional[str], latest: bool) -> None:
+    """Re-attach to a running job (reference cli.py:419-435)."""
+    sdk = get_sdk()
+    if latest or not job_id:
+        records = sdk.list_jobs()
+        if not records:
+            click.echo(to_colored_text("No jobs found.", "fail"))
+            sys.exit(1)
+        job_id = records[0]["job_id"]
+    sdk.attach(job_id)
+
+
+# ---------------------------------------------------------------------------
+# datasets
+# ---------------------------------------------------------------------------
+
+
+@cli.group()
+def datasets() -> None:
+    """Dataset management."""
+
+
+@datasets.command("create")
+def datasets_create() -> None:
+    click.echo(get_sdk().create_dataset())
+
+
+@datasets.command("list")
+def datasets_list() -> None:
+    ds = get_sdk().list_datasets()
+    if not ds:
+        click.echo(to_colored_text("No datasets found."))
+        return
+    rows = [
+        {
+            "dataset_id": d.get("dataset_id"),
+            "files": d.get("num_files"),
+            "added": _fmt_dt(d.get("datetime_added")),
+            "updated": _fmt_dt(d.get("updated_at")),
+            "schema": json.dumps(d.get("schema") or {})[:60],
+        }
+        for d in ds
+    ]
+    click.echo(tabulate(rows, headers="keys", tablefmt="rounded_outline"))
+
+
+@datasets.command("files")
+@click.argument("dataset_id")
+def datasets_files(dataset_id: str) -> None:
+    for name in get_sdk().list_dataset_files(dataset_id):
+        click.echo(name)
+
+
+@datasets.command("upload")
+@click.argument("dataset_id")
+@click.argument("paths", nargs=-1, required=True)
+def datasets_upload(dataset_id: str, paths: tuple) -> None:
+    names = get_sdk().upload_to_dataset(dataset_id, list(paths))
+    click.echo(
+        to_colored_text(f"✔ Uploaded {len(names)} file(s)", "success")
+    )
+
+
+@datasets.command("download")
+@click.argument("dataset_id")
+@click.option("--output-path", default=".", show_default=True)
+@click.option("--file-name", default=None, help="Single file (default: all)")
+def datasets_download(
+    dataset_id: str, output_path: str, file_name: Optional[str]
+) -> None:
+    written = get_sdk().download_from_dataset(
+        dataset_id, file_names=file_name, output_path=output_path
+    )
+    for w in written:
+        click.echo(w)
+
+
+# ---------------------------------------------------------------------------
+# cache
+# ---------------------------------------------------------------------------
+
+
+@cli.group()
+def cache() -> None:
+    """Local job-results cache (reference cli.py:363-381)."""
+
+
+@cache.command("show")
+def cache_show() -> None:
+    rows = get_sdk().show_job_results_cache()
+    if not rows:
+        click.echo(to_colored_text("Cache is empty."))
+        return
+    click.echo(tabulate(rows, headers="keys", tablefmt="rounded_outline"))
+
+
+@cache.command("clear")
+def cache_clear() -> None:
+    n = get_sdk().clear_job_results_cache()
+    click.echo(to_colored_text(f"✔ Cleared {n} cached result file(s)", "success"))
+
+
+# ---------------------------------------------------------------------------
+# engine (TPU-native addition)
+# ---------------------------------------------------------------------------
+
+
+@cli.group()
+def engine() -> None:
+    """Local TPU engine info."""
+
+
+@engine.command("info")
+def engine_info() -> None:
+    import jax
+
+    from .engine.config import load_engine_config
+
+    devices = jax.devices()
+    ecfg = load_engine_config()
+    click.echo(f"backend: {jax.default_backend()}")
+    click.echo(f"devices: {[str(d) for d in devices]}")
+    dp, ep, tp = ecfg.resolved_mesh(len(devices))
+    click.echo(f"mesh: dp={dp} ep={ep} tp={tp}")
+    click.echo(
+        f"kv: page_size={ecfg.kv_page_size} max_pages_per_seq="
+        f"{ecfg.max_pages_per_seq} decode_batch={ecfg.decode_batch_size}"
+    )
+
+
+@engine.command("models")
+def engine_models() -> None:
+    from .common import MODEL_CATALOG
+    from .models.configs import MODEL_CONFIGS
+
+    rows = []
+    for name, meta in MODEL_CATALOG.items():
+        cfg = MODEL_CONFIGS[meta["engine_key"]]
+        rows.append(
+            {
+                "model": name,
+                "layers": cfg.num_layers,
+                "hidden": cfg.hidden_size,
+                "experts": cfg.moe_experts or "",
+                "type": "embed" if meta["embedding"] else (
+                    "thinking" if meta["thinking"] else "lm"
+                ),
+            }
+        )
+    click.echo(tabulate(rows, headers="keys", tablefmt="rounded_outline"))
+
+
+if __name__ == "__main__":
+    cli()

@@ -1,0 +1,478 @@
+"""LocalEngine: the in-process replacement for api.sutro.sh.
+
+Implements the reference's wire contract (SURVEY §3.6) as direct calls — the
+service behind ``POST /batch-inference``, ``GET /stream-job-progress``,
+``POST /job-results``, etc. becomes an in-process object the SDK dispatches
+to when ``backend="tpu"`` (the default).
+
+Threading model: one worker thread drains a priority queue of jobs
+(priority, then submit order — reference ``job_priority`` semantics,
+interfaces.py:45 / README two-priority model). The worker is the single
+writer for running jobs (jobstore invariant). Cancellation is a flag the
+scheduler polls between decode steps. Detach/attach works because the job
+runs in this background thread while the SDK returns; progress replays
+through the metrics bus, and results/status are durable in the jobstore, so
+a *new* process can still see and resume finished/partial work
+(row-granular resume per SURVEY §5.3).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import traceback
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..common import MODEL_CATALOG
+from ..interfaces import JobStatus
+from ..models.configs import MODEL_CONFIGS, ModelConfig
+from .config import EngineConfig, load_engine_config
+from .datasets import DatasetStore
+from .jobstore import JobRecord, JobStore, estimate_cost
+from .metrics import MetricsBus, Throughput
+from .runner import ModelRunner
+from .scheduler import ContinuousBatcher, GenRequest, GenResult
+from .tokenizer import BaseTokenizer, load_tokenizer
+
+_PARTIAL_FLUSH_EVERY = 256
+
+
+def resolve_model(model: str) -> Tuple[str, ModelConfig, Dict[str, Any]]:
+    """Public model name (or raw engine key) -> (engine_key, config, meta)."""
+    meta = MODEL_CATALOG.get(model)
+    if meta is not None:
+        key = meta["engine_key"]
+    elif model in MODEL_CONFIGS:
+        key, meta = model, {"engine_key": model, "thinking": False,
+                            "embedding": MODEL_CONFIGS[model].head == "embedding"}
+    else:
+        raise ValueError(
+            f"Unknown model {model!r}. Catalog: {sorted(MODEL_CATALOG)} "
+            f"(or an engine key from models.configs.MODEL_CONFIGS)"
+        )
+    return key, MODEL_CONFIGS[key], meta
+
+
+class LocalEngine:
+    def __init__(self, ecfg: Optional[EngineConfig] = None):
+        self.ecfg = ecfg or load_engine_config()
+        self.jobs = JobStore()
+        self.metrics = MetricsBus()
+        self.datasets = DatasetStore()
+        self._queue: "queue.PriorityQueue" = queue.PriorityQueue()
+        self._seq = 0
+        self._cancel: set = set()
+        self._lock = threading.Lock()
+        self._runner_cache: Dict[str, Tuple[ModelRunner, BaseTokenizer]] = {}
+        self._worker = threading.Thread(
+            target=self._worker_loop, daemon=True, name="sutro-engine"
+        )
+        self._worker.start()
+
+    # ------------------------------------------------------------------
+    # Public API (the §3.6 endpoints, as methods)
+    # ------------------------------------------------------------------
+
+    def submit_batch_inference(self, payload: Dict[str, Any]) -> str:
+        """POST /batch-inference equivalent. Returns job_id (for dry runs the
+        job completes immediately with a cost_estimate in its record)."""
+        model = payload.get("model", "qwen-3-4b")
+        engine_key, mcfg, meta = resolve_model(model)
+        inputs = payload["inputs"]
+        if isinstance(inputs, str) and inputs.startswith("dataset-"):
+            inputs = self.datasets.read_rows(
+                inputs, column=payload.get("column")
+            )
+        if not isinstance(inputs, list):
+            raise ValueError("inputs must be a list of strings or dataset id")
+        inputs = [str(x) for x in inputs]
+
+        sampling = dict(payload.get("sampling_params") or {})
+        sampling.setdefault("max_new_tokens", self.ecfg.max_new_tokens)
+        rec = self.jobs.create(
+            name=payload.get("name"),
+            description=payload.get("description"),
+            model=model,
+            engine_key=engine_key,
+            num_rows=len(inputs),
+            job_priority=int(payload.get("job_priority", 0)),
+            output_schema=payload.get("output_schema"),
+            system_prompt=payload.get("system_prompt"),
+            sampling_params=sampling,
+            truncate_rows=bool(payload.get("truncate_rows", True)),
+            dry_run=bool(payload.get("dry_run", False)),
+            random_seed_per_input=bool(
+                payload.get("random_seed_per_input", False)
+            ),
+        )
+        self.jobs.write_inputs(rec.job_id, inputs)
+
+        # quota check (reference /get-quotas semantics)
+        est_tokens = sum(len(r) // 3 + 1 for r in inputs) + len(inputs) * int(
+            sampling["max_new_tokens"]
+        )
+        quota_err = self.jobs.check_quota(
+            rec.job_priority, len(inputs), est_tokens
+        )
+        if quota_err:
+            self.jobs.set_status(
+                rec.job_id,
+                JobStatus.FAILED,
+                failure_reason={"message": quota_err},
+            )
+            return rec.job_id
+
+        with self._lock:
+            self._seq += 1
+            self._queue.put((rec.job_priority, self._seq, rec.job_id))
+        return rec.job_id
+
+    def job_status(self, job_id: str) -> str:
+        return self.jobs.status(job_id).value
+
+    def get_job(self, job_id: str) -> Dict[str, Any]:
+        return self.jobs.get(job_id).to_dict()
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        return self.jobs.list_jobs()
+
+    def cancel_job(self, job_id: str) -> Dict[str, Any]:
+        status = self.jobs.status(job_id)
+        if status.is_terminal():
+            return {"status": status.value}
+        self._cancel.add(job_id)
+        if status == JobStatus.QUEUED:
+            self.jobs.set_status(job_id, JobStatus.CANCELLED)
+            return {"status": JobStatus.CANCELLED.value}
+        self.jobs.set_status(job_id, JobStatus.CANCELLING)
+        return {"status": JobStatus.CANCELLING.value}
+
+    def job_results(
+        self,
+        job_id: str,
+        include_inputs: bool = False,
+        include_cumulative_logprobs: bool = False,
+    ) -> Dict[str, Any]:
+        """POST /job-results equivalent: {outputs[, inputs,
+        cumulative_logprobs]} aligned 1:1 with inputs, order-preserving."""
+        df = self.jobs.read_results(job_id).sort_values("row_id")
+        out: Dict[str, Any] = {"outputs": df["outputs"].tolist()}
+        if include_inputs:
+            out["inputs"] = self.jobs.read_inputs(job_id)
+        if include_cumulative_logprobs and "cumulative_logprobs" in df:
+            out["cumulative_logprobs"] = df["cumulative_logprobs"].tolist()
+        return out
+
+    def stream_job_progress(self, job_id: str) -> Iterator[Dict[str, Any]]:
+        """GET /stream-job-progress equivalent (NDJSON update dicts)."""
+        status = self.jobs.status(job_id)
+        jm = self.metrics.job(job_id)
+        if status.is_terminal():
+            rec = self.jobs.get(job_id)
+            yield {"update_type": "progress", "result": rec.num_rows
+                   if status == JobStatus.SUCCEEDED else jm.rows_completed}
+            return
+        yield from jm.subscribe()
+
+    def get_quotas(self) -> List[Dict[str, int]]:
+        return self.jobs.get_quotas()
+
+    def try_authentication(self) -> Dict[str, Any]:
+        return {"authenticated": True}  # local engine needs no key
+
+    # ------------------------------------------------------------------
+    # Worker
+    # ------------------------------------------------------------------
+
+    def _get_runner(
+        self, engine_key: str, mcfg: ModelConfig
+    ) -> Tuple[ModelRunner, BaseTokenizer]:
+        cached = self._runner_cache.get(engine_key)
+        if cached is not None:
+            return cached
+        weights_dir = None
+        if self.ecfg.weights_dir:
+            import os
+
+            cand = os.path.join(self.ecfg.weights_dir, engine_key)
+            if os.path.isdir(cand):
+                weights_dir = cand
+        tok = load_tokenizer(weights_dir, vocab_size=mcfg.vocab_size)
+        params = None
+        if weights_dir:
+            from .weights import load_checkpoint
+
+            params = load_checkpoint(weights_dir, mcfg, self.ecfg)
+        runner = ModelRunner(mcfg, self.ecfg, params=params)
+        # keep at most two runners resident (HBM budget)
+        if len(self._runner_cache) >= 2:
+            self._runner_cache.pop(next(iter(self._runner_cache)))
+        self._runner_cache[engine_key] = (runner, tok)
+        return runner, tok
+
+    def _worker_loop(self) -> None:
+        while True:
+            _, _, job_id = self._queue.get()
+            try:
+                if job_id in self._cancel:
+                    self.jobs.set_status(job_id, JobStatus.CANCELLED)
+                    continue
+                self._run_job(job_id)
+            except Exception as e:  # noqa: BLE001 — job isolation boundary
+                traceback.print_exc()
+                try:
+                    self.jobs.set_status(
+                        job_id,
+                        JobStatus.FAILED,
+                        failure_reason={"message": f"{type(e).__name__}: {e}"},
+                    )
+                except Exception:
+                    pass
+            finally:
+                self.metrics.job(job_id).finish()
+
+    def _run_job(self, job_id: str) -> None:
+        rec = self.jobs.get(job_id)
+        self.jobs.set_status(job_id, JobStatus.STARTING)
+        engine_key, mcfg, meta = resolve_model(rec.model)
+        runner, tok = self._get_runner(engine_key, mcfg)
+        inputs = self.jobs.read_inputs(job_id)
+        sampling = rec.sampling_params or {}
+        max_new = int(sampling.get("max_new_tokens", self.ecfg.max_new_tokens))
+
+        # Prompt build: system prompt + chat template, then tokenize.
+        prompts = [
+            tok.render_chat(
+                row,
+                system=rec.system_prompt,
+                template=mcfg.chat_template,
+            )
+            for row in inputs
+        ]
+        token_rows = [np.array(tok.encode(p), np.int32) for p in prompts]
+        input_tokens = int(sum(len(r) for r in token_rows))
+
+        if rec.dry_run:
+            est_out = rec.num_rows * max_new
+            cost = estimate_cost(engine_key, input_tokens, est_out)
+            self.jobs.update(
+                job_id,
+                cost_estimate=cost,
+                input_tokens=input_tokens,
+            )
+            self.jobs.set_status(job_id, JobStatus.SUCCEEDED)
+            return
+
+        self.jobs.set_status(job_id, JobStatus.RUNNING)
+        jm = self.metrics.job(job_id)
+
+        if mcfg.head == "embedding":
+            self._run_embedding_job(job_id, rec, runner, tok, token_rows, jm)
+            return
+
+        # Constrained decoding
+        constraint_factory = None
+        if rec.output_schema:
+            from .constrain import schema_constraint_factory
+
+            constraint_factory = schema_constraint_factory(
+                rec.output_schema, tok
+            )
+
+        resume = self.jobs.read_partial(job_id)
+        results: Dict[int, Dict[str, Any]] = dict(resume)
+        pending_flush: List[Dict[str, Any]] = []
+        import jax
+
+        n_chips = max(jax.device_count(), 1)
+        tput = Throughput(n_chips)
+
+        requests = []
+        for i, ids in enumerate(token_rows):
+            if i in results:
+                continue
+            requests.append(
+                GenRequest(
+                    row_id=i,
+                    prompt_ids=ids,
+                    max_new_tokens=max_new,
+                    temperature=float(
+                        sampling.get("temperature", self.ecfg.temperature)
+                    ),
+                    top_p=float(sampling.get("top_p", self.ecfg.top_p)),
+                    top_k=int(sampling.get("top_k", self.ecfg.top_k)),
+                    constraint=(
+                        constraint_factory() if constraint_factory else None
+                    ),
+                    allow_truncate=rec.truncate_rows,
+                    row_seed=i if rec.random_seed_per_input else None,
+                )
+            )
+
+        batcher = ContinuousBatcher(
+            runner, stop_ids=getattr(tok, "stop_ids", lambda: [tok.eos_id])(),
+            seed=self.ecfg.seed,
+        )
+
+        thinking = bool(meta.get("thinking"))
+
+        def render_output(token_ids) -> str:
+            text = tok.decode(token_ids)
+            if thinking:
+                # thinking models emit {content, reasoning_content} JSON so
+                # the SDK's unpack contract applies (reference
+                # sdk.py:1225-1234)
+                reasoning, sep, content = text.partition("</think>")
+                if sep:
+                    reasoning = reasoning.replace("<think>", "").strip()
+                    content = content.strip()
+                else:
+                    content, reasoning = text, ""
+                import json as _json
+
+                return _json.dumps(
+                    {"content": content, "reasoning_content": reasoning}
+                )
+            return text
+
+        def on_result(res: GenResult) -> None:
+            row = {
+                "row_id": res.row_id,
+                "outputs": render_output(res.token_ids),
+                "cumulative_logprobs": res.cumulative_logprob,
+                "finish_reason": res.finish_reason,
+            }
+            results[res.row_id] = row
+            pending_flush.append(row)
+            if len(pending_flush) >= _PARTIAL_FLUSH_EVERY:
+                self.jobs.flush_partial(job_id, list(pending_flush))
+                pending_flush.clear()
+
+        def on_progress(p: Dict[str, Any]) -> None:
+            jm.progress(len(results))
+            tput.total = p["input_tokens"] + p["output_tokens"]
+            jm.tokens(
+                {
+                    "input_tokens": p["input_tokens"],
+                    "output_tokens": p["output_tokens"],
+                    "total_tokens_processed_per_second": p[
+                        "total_tokens_processed_per_second"
+                    ],
+                    "tokens_per_second_per_chip": p[
+                        "total_tokens_processed_per_second"
+                    ]
+                    / n_chips,
+                }
+            )
+
+        cancelled = {"flag": False}
+
+        def should_cancel() -> bool:
+            if job_id in self._cancel:
+                cancelled["flag"] = True
+                return True
+            return False
+
+        batcher.run(
+            requests,
+            on_result=on_result,
+            on_progress=on_progress,
+            should_cancel=should_cancel,
+        )
+        if pending_flush:
+            self.jobs.flush_partial(job_id, list(pending_flush))
+            pending_flush.clear()
+
+        if cancelled["flag"]:
+            self.jobs.set_status(job_id, JobStatus.CANCELLED)
+            return
+
+        out_tokens = 0
+        ordered = {
+            "row_id": [],
+            "outputs": [],
+            "cumulative_logprobs": [],
+            "finish_reason": [],
+        }
+        for i in range(rec.num_rows):
+            row = results.get(i)
+            if row is None:  # cancelled rows that never ran
+                row = {
+                    "row_id": i,
+                    "outputs": None,
+                    "cumulative_logprobs": 0.0,
+                    "finish_reason": "cancelled",
+                }
+            for k in ordered:
+                ordered[k].append(row[k])
+        output_tokens = int(
+            sum(
+                len(tok.encode(o)) if o else 0 for o in ordered["outputs"]
+            )
+        )
+        self.jobs.update(
+            job_id,
+            input_tokens=input_tokens,
+            output_tokens=output_tokens,
+            job_cost=estimate_cost(engine_key, input_tokens, output_tokens),
+        )
+        jm.progress(rec.num_rows)
+        self.jobs.finalize_results(job_id, ordered)
+
+    def _run_embedding_job(
+        self, job_id, rec, runner, tok, token_rows, jm
+    ) -> None:
+        """Embedding path: mean-pool head, batched (BASELINE config #3)."""
+        bs = max(self.ecfg.decode_batch_size, 8)
+        outputs: List[Any] = []
+        done = 0
+        for i in range(0, len(token_rows), bs):
+            if job_id in self._cancel:
+                self.jobs.set_status(job_id, JobStatus.CANCELLED)
+                return
+            chunk = token_rows[i : i + bs]
+            emb = runner.embed_batch([list(map(int, r)) for r in chunk])
+            outputs.extend(emb.tolist())
+            done += len(chunk)
+            jm.progress(done)
+        input_tokens = int(sum(len(r) for r in token_rows))
+        self.jobs.update(
+            job_id,
+            input_tokens=input_tokens,
+            output_tokens=0,
+            job_cost=estimate_cost(rec.engine_key, input_tokens, 0),
+        )
+        self.jobs.finalize_results(
+            job_id,
+            {
+                "row_id": list(range(len(outputs))),
+                "outputs": outputs,
+                "cumulative_logprobs": [0.0] * len(outputs),
+                "finish_reason": ["stop"] * len(outputs),
+            },
+        )
+
+
+# ---------------------------------------------------------------------------
+# Singleton
+# ---------------------------------------------------------------------------
+
+_engine: Optional[LocalEngine] = None
+_engine_lock = threading.Lock()
+
+
+def get_engine(ecfg: Optional[EngineConfig] = None) -> LocalEngine:
+    global _engine
+    with _engine_lock:
+        if _engine is None:
+            _engine = LocalEngine(ecfg)
+        return _engine
+
+
+def reset_engine() -> None:
+    """Test hook: drop the singleton (its worker thread is daemonic)."""
+    global _engine
+    with _engine_lock:
+        _engine = None

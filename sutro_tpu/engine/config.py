@@ -18,10 +18,14 @@ from typing import Any, Dict, Optional, Tuple
 @dataclasses.dataclass
 class EngineConfig:
     # --- device mesh -------------------------------------------------------
-    # Axis sizes; 0/None => infer from available devices. Axes: ("data",
-    # "expert", "model") — DP over DCN/outer, EP and TP over ICI (SURVEY §5.8).
-    dp: int = 0
-    tp: int = 0
+    # Axis sizes; 0 => infer from available devices (tp gets devices not
+    # claimed by ep, remainder folds into dp). Defaults are explicit
+    # single-device: TP/EP need model-divisibility knowledge, so spreading
+    # over all chips is an explicit choice (engine.json or kwargs), not a
+    # surprise. Axes: ("data", "expert", "model") — DP over DCN/outer, EP
+    # and TP over ICI (SURVEY §5.8).
+    dp: int = 1
+    tp: int = 1
     ep: int = 1
     # --- dtype policy ------------------------------------------------------
     activation_dtype: str = "bfloat16"

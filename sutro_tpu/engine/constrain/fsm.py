@@ -1,0 +1,149 @@
+"""Token-level FSM over the schema NFA + vocabulary masks.
+
+The per-sequence object the scheduler drives (scheduler.TokenConstraint
+protocol): ``allowed_tokens()`` yields a [V] bool mask for the sampling op
+(ops/sampling.py), ``advance(token)`` consumes the sampled token's bytes.
+
+Performance model (SURVEY §7.3 "vectorized constrained decoding"): masks
+are cached per NFA state-set in a job-wide ``MaskCache`` shared by every
+row, so the steady-state cost per decode step is one dict lookup — string
+content, for instance, is a single self-looping state. Computing a mask
+for a *new* state simulates every vocab token's bytes; the optional C++
+core (native/fsm.cpp, loaded via ctypes in cpp.py) accelerates exactly
+that inner loop, with this pure-Python path as the always-available
+fallback.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, List, Optional
+
+import numpy as np
+
+from .nfa import NFA
+
+
+class TokenTable:
+    """Per-tokenizer byte strings for every vocab id, plus stop ids."""
+
+    def __init__(self, tokenizer) -> None:
+        V = tokenizer.vocab_size
+        self.vocab_size = V
+        self.token_bytes: List[bytes] = [
+            tokenizer.token_bytes(i) for i in range(V)
+        ]
+        stop = getattr(tokenizer, "stop_ids", None)
+        self.stop_ids: List[int] = list(stop()) if stop else [tokenizer.eos_id]
+        # ids that contribute no bytes (specials) — never valid inside JSON,
+        # only as terminators
+        self.empty_ids = np.array(
+            [i for i, b in enumerate(self.token_bytes) if not b], np.int64
+        )
+
+
+class MaskCache:
+    """state-set -> vocab mask, shared across all rows of a job."""
+
+    def __init__(self, nfa: NFA, table: TokenTable):
+        self.nfa = nfa
+        self.table = table
+        self._cache: Dict[FrozenSet[int], np.ndarray] = {}
+        self._cpp = None
+        try:
+            from .cpp import CppMasker
+
+            self._cpp = CppMasker(nfa, table)
+        except Exception:
+            self._cpp = None
+
+    def mask(self, states: FrozenSet[int]) -> np.ndarray:
+        cached = self._cache.get(states)
+        if cached is not None:
+            return cached
+        if self._cpp is not None:
+            m = self._cpp.mask(states)
+        else:
+            m = self._compute(states)
+        # terminal: allow stop tokens so the model can end cleanly
+        if self.nfa.is_accepting(states):
+            for sid in self.table.stop_ids:
+                m[sid] = True
+        self._cache[states] = m
+        return m
+
+    def _compute(self, states: FrozenSet[int]) -> np.ndarray:
+        nfa = self.nfa
+        m = np.zeros(self.table.vocab_size, bool)
+        byte_ok = nfa.allowed_bytes(states)
+        for tid, tb in enumerate(self.table.token_bytes):
+            if not tb or not byte_ok[tb[0]]:
+                continue
+            cur = states
+            ok = True
+            for b in tb:
+                cur = nfa.step(cur, b)
+                if not cur:
+                    ok = False
+                    break
+            m[tid] = ok
+        return m
+
+
+class TokenFSM:
+    """One row's constraint state (scheduler.TokenConstraint)."""
+
+    def __init__(self, nfa: NFA, masks: MaskCache, table: TokenTable):
+        self.nfa = nfa
+        self.masks = masks
+        self.table = table
+        self.states = nfa.initial()
+        self._complete = False
+
+    def allowed_tokens(self) -> np.ndarray:
+        if self._complete:
+            m = np.zeros(self.table.vocab_size, bool)
+            for sid in self.table.stop_ids:
+                m[sid] = True
+            return m
+        return self.masks.mask(self.states)
+
+    def advance(self, token_id: int) -> None:
+        if self._complete:
+            return
+        tb = self.table.token_bytes[int(token_id)]
+        if not tb:
+            # special token (stop) — only legal at accept; mark complete
+            self._complete = self.nfa.is_accepting(self.states)
+            return
+        cur = self.states
+        for b in tb:
+            cur = self.nfa.step(cur, b)
+            if not cur:
+                # mask guarantees this can't happen; fail safe by completing
+                self._complete = True
+                return
+        self.states = cur
+        if self.nfa.is_accepting(cur) and not np.any(
+            self.nfa.allowed_bytes(cur)
+        ):
+            # accepting with no outgoing bytes => JSON fully emitted
+            self._complete = True
+
+    def is_complete(self) -> bool:
+        return self._complete
+
+
+class ConstraintFactory:
+    def __init__(self, schema: Dict, tokenizer):
+        from .schema import compile_schema
+
+        self.nfa = compile_schema(schema)
+        self.table = TokenTable(tokenizer)
+        self.masks = MaskCache(self.nfa, self.table)
+
+    def __call__(self) -> TokenFSM:
+        return TokenFSM(self.nfa, self.masks, self.table)
+
+
+def schema_constraint_factory(schema: Dict, tokenizer) -> ConstraintFactory:
+    return ConstraintFactory(schema, tokenizer)

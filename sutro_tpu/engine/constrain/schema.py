@@ -1,0 +1,246 @@
+"""JSON schema -> byte NFA compiler.
+
+Lowers the ``output_schema`` contract of the reference
+(/root/reference/sutro/sdk.py:451,490-493 — Pydantic model or JSON-schema
+dict; normalized by common.normalize_output_schema) into a byte-level NFA
+accepting exactly the canonical JSON serializations that validate.
+
+Canonicalization choices (standard for constrained decoding): object keys
+are emitted in schema ``properties`` order; no insignificant whitespace.
+Optional (non-required) properties are genuinely optional branches in the
+automaton. Supported schema features: object/properties/required (incl.
+nested), string (with enum/const), integer, number, boolean, null, array
+(items, minItems/maxItems small), anyOf/oneOf, $ref/$defs (one level of
+indirection, as produced by Pydantic), additionalProperties ignored.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+from .nfa import NFA, Builder, bitmap, bitmap_of
+
+Frag = Tuple[int, int]
+
+# JSON string content: any byte except '"' (0x22), '\' (0x5C), and control
+# bytes < 0x20. Escapes: \ followed by one of "\/bfnrt or uXXXX.
+_STR_PLAIN = bitmap((0x20, 0x21), (0x23, 0x5B), (0x5D, 0xFF))
+_ESC_SIMPLE = bitmap_of(b'"\\/bfnrt')
+_HEX = bitmap((0x30, 0x39), (0x41, 0x46), (0x61, 0x66))
+_DIGIT = bitmap((0x30, 0x39))
+_DIGIT19 = bitmap((0x31, 0x39))
+
+
+class SchemaCompiler:
+    def __init__(self, schema: Dict[str, Any]):
+        self.b = Builder()
+        self.defs: Dict[str, Any] = {}
+        for key in ("$defs", "definitions"):
+            self.defs.update(schema.get(key, {}))
+        self.schema = schema
+
+    # -- JSON primitives -------------------------------------------------
+    def _string_char(self) -> Frag:
+        b = self.b
+        esc = b.seq(
+            b.char(bitmap_of(b"\\")),
+            b.alt(
+                b.char(_ESC_SIMPLE),
+                b.seq(
+                    b.char(bitmap_of(b"u")),
+                    b.char(_HEX), b.char(_HEX), b.char(_HEX), b.char(_HEX),
+                ),
+            ),
+        )
+        return b.alt(b.char(_STR_PLAIN), esc)
+
+    def _string_frag(
+        self, min_len: int = 0, max_len: Optional[int] = None
+    ) -> Frag:
+        b = self.b
+        if max_len is None:
+            content = b.star(self._string_char())
+            if min_len:
+                required = [self._string_char() for _ in range(min_len)]
+                content = b.seq(*required, content)
+            return b.seq(b.lit(b'"'), content, b.lit(b'"'))
+        # bounded: minLength required chars then up to (max-min) optional.
+        # NOTE: counts *escaped chars*, a close proxy for codepoints.
+        parts: List[Frag] = [self._string_char() for _ in range(min_len)]
+        opt_tail = None
+        for _ in range(max(max_len - min_len, 0)):
+            piece = self._string_char()
+            opt_tail = (
+                b.opt(piece)
+                if opt_tail is None
+                else b.opt(b.seq(piece, opt_tail))
+            )
+        frags = [b.lit(b'"'), *parts]
+        if opt_tail is not None:
+            frags.append(opt_tail)
+        frags.append(b.lit(b'"'))
+        return b.seq(*frags)
+
+    def _integer_frag(self) -> Frag:
+        b = self.b
+        body = b.alt(
+            b.lit(b"0"),
+            b.seq(b.char(_DIGIT19), b.star(b.char(_DIGIT))),
+        )
+        return b.seq(b.opt(b.lit(b"-")), body)
+
+    def _number_frag(self) -> Frag:
+        b = self.b
+        frac = b.seq(b.lit(b"."), b.plus(b.char(_DIGIT)))
+        exp = b.seq(
+            b.char(bitmap_of(b"eE")),
+            b.opt(b.char(bitmap_of(b"+-"))),
+            b.plus(b.char(_DIGIT)),
+        )
+        return b.seq(self._integer_frag(), b.opt(frac), b.opt(exp))
+
+    # -- schema nodes ------------------------------------------------------
+    def _resolve(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        if "$ref" in schema:
+            name = schema["$ref"].split("/")[-1]
+            if name not in self.defs:
+                raise ValueError(f"Unresolvable $ref: {schema['$ref']}")
+            return self._resolve(self.defs[name])
+        if "allOf" in schema and len(schema["allOf"]) == 1:
+            # Pydantic emits single-element allOf around $refs with siblings
+            merged = dict(self._resolve(schema["allOf"][0]))
+            merged.update({k: v for k, v in schema.items() if k != "allOf"})
+            return self._resolve(merged) if "$ref" in merged else merged
+        return schema
+
+    def compile_node(self, schema: Dict[str, Any]) -> Frag:
+        b = self.b
+        schema = self._resolve(schema)
+
+        if "enum" in schema:
+            return b.alt(
+                *[b.lit(json.dumps(v).encode()) for v in schema["enum"]]
+            )
+        if "const" in schema:
+            return b.lit(json.dumps(schema["const"]).encode())
+        for comb in ("anyOf", "oneOf"):
+            if comb in schema:
+                return b.alt(
+                    *[self.compile_node(s) for s in schema[comb]]
+                )
+
+        t = schema.get("type")
+        if isinstance(t, list):
+            return b.alt(
+                *[self.compile_node({**schema, "type": tt}) for tt in t]
+            )
+        if t == "string":
+            return self._string_frag(
+                min_len=int(schema.get("minLength", 0)),
+                max_len=(
+                    int(schema["maxLength"]) if "maxLength" in schema else None
+                ),
+            )
+        if t == "integer":
+            return self._integer_frag()
+        if t == "number":
+            return self._number_frag()
+        if t == "boolean":
+            return b.alt(b.lit(b"true"), b.lit(b"false"))
+        if t == "null":
+            return b.lit(b"null")
+        if t == "array":
+            return self._array_frag(schema)
+        if t == "object" or "properties" in schema:
+            return self._object_frag(schema)
+        # untyped: any JSON scalar (string | number | boolean | null)
+        return b.alt(
+            self._string_frag(),
+            self._number_frag(),
+            b.lit(b"true"),
+            b.lit(b"false"),
+            b.lit(b"null"),
+        )
+
+    def _array_frag(self, schema: Dict[str, Any]) -> Frag:
+        b = self.b
+        item_schema = schema.get("items", {})
+        min_items = int(schema.get("minItems", 0))
+        max_items = schema.get("maxItems")
+
+        def item() -> Frag:
+            return self.compile_node(item_schema)
+
+        if max_items is not None and int(max_items) <= 16:
+            # bounded unrolling for small fixed sizes
+            alts = []
+            for n in range(min_items, int(max_items) + 1):
+                if n == 0:
+                    alts.append(b.lit(b"[]"))
+                else:
+                    parts: List[Frag] = [b.lit(b"[")]
+                    for i in range(n):
+                        if i:
+                            parts.append(b.lit(b","))
+                        parts.append(item())
+                    parts.append(b.lit(b"]"))
+                    alts.append(b.seq(*parts))
+            return b.alt(*alts)
+
+        rest = b.star(b.seq(b.lit(b","), item()))
+        required_head: List[Frag] = [item()]
+        for _ in range(max(min_items - 1, 0)):
+            required_head.append(b.seq(b.lit(b","), item()))
+        nonempty = b.seq(b.lit(b"["), *required_head, rest, b.lit(b"]"))
+        if min_items > 0:
+            return nonempty
+        return b.alt(b.lit(b"[]"), nonempty)
+
+    def _object_frag(self, schema: Dict[str, Any]) -> Frag:
+        b = self.b
+        props: Dict[str, Any] = schema.get("properties", {})
+        required = set(schema.get("required", list(props)))
+        if not props:
+            return b.lit(b"{}")
+
+        # Emit keys in properties order. Optional properties branch.
+        # Build right-to-left: frag(i) = rest of object from property i on,
+        # given whether any property has been emitted yet (comma handling).
+        names = list(props)
+        memo: Dict[Tuple[int, bool], Frag] = {}
+
+        def tail(i: int, emitted_before: bool) -> Frag:
+            # memoized: NFA fragments are graphs, so sharing a tail between
+            # the "with property" and "skip property" branches is free and
+            # keeps construction linear in #properties
+            cached = memo.get((i, emitted_before))
+            if cached is not None:
+                return cached
+            frag = _tail(i, emitted_before)
+            memo[(i, emitted_before)] = frag
+            return frag
+
+        def _tail(i: int, emitted_before: bool) -> Frag:
+            if i == len(names):
+                return b.lit(b"}")
+            name = names[i]
+            keylit = json.dumps(name).encode() + b":"  # noqa: E501 — canonical, no spaces
+            prefix = (b"," if emitted_before else b"") + keylit
+            with_prop = b.seq(
+                b.lit(prefix),
+                self.compile_node(props[name]),
+                tail(i + 1, True),
+            )
+            if name in required:
+                return with_prop
+            return b.alt(with_prop, tail(i + 1, emitted_before))
+
+        return b.seq(b.lit(b"{"), tail(0, False))
+
+    def compile(self) -> NFA:
+        return self.b.build(self.compile_node(self.schema))
+
+
+def compile_schema(schema: Dict[str, Any]) -> NFA:
+    return SchemaCompiler(schema).compile()

@@ -53,20 +53,39 @@ class ModelRunner:
     ):
         self.mcfg = mcfg
         self.ecfg = ecfg
-        self.mesh = mesh
         dtype = jnp.dtype(ecfg.param_dtype)
         if params is None:
             params = transformer.init_params(
                 mcfg, jax.random.PRNGKey(ecfg.seed), dtype
             )
-        if shardings is not None and mesh is not None:
+        # Mesh: explicit > engine-config-resolved > single-device (None).
+        if mesh is None:
+            from ..parallel.mesh import auto_mesh
+
+            dp, ep, tp = ecfg.resolved_mesh(jax.device_count())
+            if dp * ep * tp > 1:
+                mesh = auto_mesh(ecfg)
+        self.mesh = mesh
+        if mesh is not None:
+            from ..parallel.sharding import param_shardings, cache_shardings
+
+            if shardings is None:
+                shardings = param_shardings(params, mesh)
             params = jax.device_put(params, shardings)
+            self._cache_sharding = cache_shardings(mesh)
+        else:
+            self._cache_sharding = None
         self.params = params
         self.use_pallas = self._resolve_pallas(ecfg)
         if num_pages is None:
             num_pages = 1 + ecfg.decode_batch_size * ecfg.max_pages_per_seq
         self.num_pages = num_pages
         self.cache = alloc_cache(mcfg, ecfg, num_pages, dtype=dtype)
+        if self._cache_sharding is not None:
+            self.cache = KVCache(
+                k_pages=jax.device_put(self.cache.k_pages, self._cache_sharding),
+                v_pages=jax.device_put(self.cache.v_pages, self._cache_sharding),
+            )
         self._decode_fn = None
         self._embed_cache: Dict[int, Any] = {}
 
@@ -125,7 +144,7 @@ class ModelRunner:
     )
     def _decode_jit(
         self, params, cache: KVCache, ids, past_len, page_table,
-        rng, temperature, top_p, top_k, allowed,
+        rng, temperature, top_p, top_k, allowed, row_seeds,
     ):
         B = ids.shape[0]
         positions = past_len[:, None]  # current token position == past length
@@ -143,7 +162,7 @@ class ModelRunner:
         tok = sample(
             step_logits, rng,
             temperature=temperature, top_p=top_p, top_k=top_k,
-            allowed=allowed,
+            allowed=allowed, row_seeds=row_seeds,
         )
         logp = cumulative_logprob(step_logits, tok)
         return tok, logp, cache
@@ -158,6 +177,7 @@ class ModelRunner:
         top_p: np.ndarray,           # [B]
         top_k: Optional[np.ndarray] = None,     # [B] int32; None => disabled
         allowed: Optional[np.ndarray] = None,   # [B, V] bool
+        row_seeds: Optional[np.ndarray] = None,  # [B] int32
     ) -> Tuple[np.ndarray, np.ndarray]:
         B = len(last_tokens)
         if top_k is None:
@@ -173,6 +193,7 @@ class ModelRunner:
             jnp.asarray(top_p, jnp.float32),
             jnp.asarray(top_k, jnp.int32),
             None if allowed is None else jnp.asarray(allowed),
+            None if row_seeds is None else jnp.asarray(row_seeds, jnp.int32),
         )
         return np.asarray(tok), np.asarray(logp)
 

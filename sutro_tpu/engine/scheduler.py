@@ -35,6 +35,11 @@ from .runner import ModelRunner
 from ..ops.sampling import cumulative_logprob, sample as device_sample
 
 
+def _step_seed(row_seed: int, step: int) -> int:
+    """Deterministic (row, step) -> int32 seed mix."""
+    return ((row_seed * 1_000_003) ^ (step * 2_654_435_761)) & 0x7FFFFFFF
+
+
 class TokenConstraint(Protocol):
     """Token-level FSM driving schema-constrained decoding
     (engine/constrain/)."""
@@ -61,6 +66,10 @@ class GenRequest:
     # Reference `truncate_rows` semantics (sdk.py:457,480): True => over-long
     # prompts are truncated to fit the context; False => the row fails.
     allow_truncate: bool = True
+    # Per-row sampling seed (`random_seed_per_input`): when set, this row's
+    # tokens are drawn from keys folded from (row_seed, step) — reproducible
+    # regardless of batch composition.
+    row_seed: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -100,6 +109,7 @@ class ContinuousBatcher:
         self.MP = self.ecfg.max_pages_per_seq
         self.slots: List[Optional[_Slot]] = [None] * self.B
         self._key = jax.random.PRNGKey(seed)
+        self._fixed_key = jax.random.PRNGKey(seed)
         self._step = 0
 
     # ------------------------------------------------------------------
@@ -145,7 +155,12 @@ class ContinuousBatcher:
         allowed = None
         if req.constraint is not None:
             allowed = req.constraint.allowed_tokens()[None, :]
-        self._key, sub = jax.random.split(self._key)
+        if req.row_seed is not None:
+            sub = self._fixed_key  # per-row key derives from row_seed
+            row_seeds = jax.numpy.asarray([_step_seed(req.row_seed, 0)])
+        else:
+            self._key, sub = jax.random.split(self._key)
+            row_seeds = None
         jl = jax.numpy.asarray(logits[None, :])
         tok = device_sample(
             jl,
@@ -154,6 +169,7 @@ class ContinuousBatcher:
             top_p=np.float32(req.top_p),
             top_k=np.int32(req.top_k),
             allowed=None if allowed is None else jax.numpy.asarray(allowed),
+            row_seeds=row_seeds,
         )
         logp = cumulative_logprob(jl, tok)
         return int(np.asarray(tok)[0]), float(np.asarray(logp)[0])
@@ -290,6 +306,8 @@ class ContinuousBatcher:
             top_p = np.ones((self.B,), np.float32)
             top_k = np.zeros((self.B,), np.int32)
             has_constraint = False
+            has_row_seed = False
+            row_seeds = np.zeros((self.B,), np.int32)
             allowed = None
             for i in active:
                 s = self.slots[i]
@@ -299,6 +317,14 @@ class ContinuousBatcher:
                 temp[i] = s.req.temperature
                 top_p[i] = s.req.top_p
                 top_k[i] = s.req.top_k
+                if s.req.row_seed is not None:
+                    has_row_seed = True
+                    row_seeds[i] = _step_seed(s.req.row_seed, len(s.out_ids))
+                else:
+                    # mixed batch: unseeded rows still need fresh per-step
+                    # keys (the batch-wide rng is pinned to _fixed_key when
+                    # any row is seeded)
+                    row_seeds[i] = _step_seed(0x5EED0000 ^ (i + 1), self._step)
                 if s.req.constraint is not None:
                     has_constraint = True
             if has_constraint:
@@ -309,9 +335,13 @@ class ContinuousBatcher:
                         allowed[i] = c.allowed_tokens()
 
             self._key, sub = jax.random.split(self._key)
+            # row-seeded sampling needs a batch-independent base key so a
+            # row's stream reproduces regardless of batch composition
+            rng = self._fixed_key if has_row_seed else sub
             toks, logps = self.runner.decode_step(
-                last, past_len, table, sub, temp, top_p,
+                last, past_len, table, rng, temp, top_p,
                 top_k=top_k, allowed=allowed,
+                row_seeds=row_seeds if has_row_seed else None,
             )
             self._step += 1
 

@@ -1,0 +1,199 @@
+"""HF safetensors checkpoint -> engine parameter pytree.
+
+The reference never touches weights (models live server-side; SURVEY §2.3).
+Here local checkpoint dirs (``EngineConfig.weights_dir/<engine_key>/``)
+holding standard HuggingFace safetensors shards are mapped into the
+scan-stacked pytree layout of models/transformer.py:
+
+- per-layer tensors are stacked on a leading layer axis,
+- projection matrices are transposed to [in, out] (HF stores [out, in]) so
+  the forward is plain ``x @ w`` on the MXU,
+- dtype-cast to the engine param dtype (bfloat16 by default),
+- shapes validated against the ModelConfig before any device transfer.
+
+Loading is lazy per-tensor (safetensors mmap) so host RSS stays ~one
+tensor; sharded device placement happens in the runner via NamedSharding.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.configs import ModelConfig
+from .config import EngineConfig
+
+
+class _ShardIndex:
+    """name -> (file, loader) over one or many .safetensors shards."""
+
+    def __init__(self, ckpt_dir: str):
+        from safetensors import safe_open
+
+        self._open = safe_open
+        self.dir = ckpt_dir
+        self.files: Dict[str, str] = {}
+        index_path = os.path.join(ckpt_dir, "model.safetensors.index.json")
+        if os.path.exists(index_path):
+            index = json.loads(open(index_path).read())
+            for name, fname in index["weight_map"].items():
+                self.files[name] = os.path.join(ckpt_dir, fname)
+        else:
+            for fname in sorted(os.listdir(ckpt_dir)):
+                if fname.endswith(".safetensors"):
+                    path = os.path.join(ckpt_dir, fname)
+                    with safe_open(path, framework="np") as f:
+                        for name in f.keys():
+                            self.files[name] = path
+        self._handles: Dict[str, Any] = {}
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.files
+
+    def get(self, name: str) -> np.ndarray:
+        path = self.files[name]
+        if path not in self._handles:
+            self._handles[path] = self._open(path, framework="np")
+        return self._handles[path].get_tensor(name)
+
+    def names(self) -> List[str]:
+        return list(self.files)
+
+
+def _first(idx: _ShardIndex, *names: str) -> Optional[str]:
+    for n in names:
+        if n in idx:
+            return n
+    return None
+
+
+def load_checkpoint(
+    ckpt_dir: str, mcfg: ModelConfig, ecfg: EngineConfig
+) -> Dict[str, Any]:
+    """Load + remap an HF checkpoint for any supported family."""
+    idx = _ShardIndex(ckpt_dir)
+    dtype = jnp.dtype(ecfg.param_dtype)
+    L = mcfg.num_layers
+
+    def get(name: str, transpose: bool = False) -> np.ndarray:
+        arr = idx.get(name)
+        if transpose:
+            arr = np.ascontiguousarray(arr.T)
+        return arr
+
+    def stack(
+        fmt: str | Callable[[int], str], transpose: bool = False
+    ) -> jnp.ndarray:
+        outs = []
+        for i in range(L):
+            name = fmt(i) if callable(fmt) else fmt.format(i=i)
+            outs.append(get(name, transpose))
+        return jnp.asarray(np.stack(outs), dtype)
+
+    def maybe_stack(fmt: str, transpose: bool = False) -> Optional[jnp.ndarray]:
+        if fmt.format(i=0) in idx:
+            return stack(fmt, transpose)
+        return None
+
+    p = "model.layers.{i}."
+    layers: Dict[str, Any] = {
+        "attn_norm": stack(p + "input_layernorm.weight"),
+        "wq": stack(p + "self_attn.q_proj.weight", transpose=True),
+        "wk": stack(p + "self_attn.k_proj.weight", transpose=True),
+        "wv": stack(p + "self_attn.v_proj.weight", transpose=True),
+        "wo": stack(p + "self_attn.o_proj.weight", transpose=True),
+    }
+    if mcfg.attn_bias:
+        layers["bq"] = stack(p + "self_attn.q_proj.bias")
+        layers["bk"] = stack(p + "self_attn.k_proj.bias")
+        layers["bv"] = stack(p + "self_attn.v_proj.bias")
+        layers["bo"] = stack(p + "self_attn.o_proj.bias")
+    if mcfg.qk_norm:
+        layers["q_norm"] = stack(p + "self_attn.q_norm.weight")
+        layers["k_norm"] = stack(p + "self_attn.k_norm.weight")
+    if mcfg.attention_sink:
+        layers["sink"] = stack(p + "self_attn.sinks")
+
+    if mcfg.post_norms:
+        # Gemma3 norm quartet
+        layers["post_attn_norm"] = stack(p + "post_attention_layernorm.weight")
+        layers["mlp_norm"] = stack(p + "pre_feedforward_layernorm.weight")
+        layers["post_mlp_norm"] = stack(p + "post_feedforward_layernorm.weight")
+    else:
+        layers["mlp_norm"] = stack(p + "post_attention_layernorm.weight")
+
+    if mcfg.moe_experts:
+        E = mcfg.moe_experts
+        router = maybe_stack(p + "mlp.gate.weight", transpose=True)
+        if router is None:
+            router = maybe_stack(p + "mlp.router.weight", transpose=True)
+        if router is None:
+            raise KeyError("No MoE router weight found in checkpoint")
+        layers["router"] = router
+
+        def stack_experts(sub: str) -> jnp.ndarray:
+            outs = []
+            for i in range(L):
+                per = []
+                for e in range(E):
+                    name = f"model.layers.{i}.mlp.experts.{e}.{sub}.weight"
+                    per.append(np.ascontiguousarray(idx.get(name).T))
+                outs.append(np.stack(per))
+            return jnp.asarray(np.stack(outs), dtype)
+
+        probe = f"model.layers.0.mlp.experts.0.gate_proj.weight"
+        if probe in idx:
+            layers["we_gate"] = stack_experts("gate_proj")
+            layers["we_up"] = stack_experts("up_proj")
+            layers["we_down"] = stack_experts("down_proj")
+        else:
+            # gpt-oss fused layout: experts.gate_up_proj [E, H, 2F] (+bias),
+            # experts.down_proj [E, F, H]
+            gu, down, gub, db = [], [], [], []
+            for i in range(L):
+                gu.append(idx.get(f"model.layers.{i}.mlp.experts.gate_up_proj"))
+                down.append(idx.get(f"model.layers.{i}.mlp.experts.down_proj"))
+            gu_arr = np.stack(gu)  # [L, E, H, 2F]
+            layers["we_gate"] = jnp.asarray(gu_arr[..., 0::2], dtype)
+            layers["we_up"] = jnp.asarray(gu_arr[..., 1::2], dtype)
+            layers["we_down"] = jnp.asarray(np.stack(down), dtype)
+    else:
+        layers["w_gate"] = stack(p + "mlp.gate_proj.weight", transpose=True)
+        layers["w_up"] = stack(p + "mlp.up_proj.weight", transpose=True)
+        layers["w_down"] = stack(p + "mlp.down_proj.weight", transpose=True)
+
+    params: Dict[str, Any] = {
+        "embed": jnp.asarray(get("model.embed_tokens.weight"), dtype),
+        "final_norm": jnp.asarray(get("model.norm.weight"), dtype),
+        "layers": layers,
+    }
+    if not mcfg.tie_embeddings and mcfg.head == "lm":
+        name = _first(idx, "lm_head.weight")
+        if name:
+            params["lm_head"] = jnp.asarray(get(name, transpose=True), dtype)
+
+    _validate(params, mcfg)
+    return params
+
+
+def _validate(params: Dict[str, Any], mcfg: ModelConfig) -> None:
+    H, L = mcfg.hidden_size, mcfg.num_layers
+    checks = {
+        "embed": (mcfg.vocab_size, H),
+        "layers.wq": (L, H, mcfg.q_size),
+        "layers.wk": (L, H, mcfg.kv_size),
+        "layers.wo": (L, mcfg.q_size, H),
+    }
+    for path, want in checks.items():
+        node: Any = params
+        for part in path.split("."):
+            node = node[part]
+        if tuple(node.shape) != want:
+            raise ValueError(
+                f"Checkpoint shape mismatch at {path}: got {tuple(node.shape)}, "
+                f"want {want} for model {mcfg.name}"
+            )

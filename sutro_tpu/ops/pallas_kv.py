@@ -1,0 +1,86 @@
+"""Pallas TPU kernel: in-place KV page writes.
+
+The XLA path for landing a decode step's K/V into the paged cache is a
+scatter over a ~GB-scale buffer; under jit donation that costs several ms
+per step of pure buffer churn (measured ~8 ms/donated buffer through the
+axon PJRT path, ~57 ms for the full two-tensor scatter). This kernel makes
+the write a true in-place DMA: grid over (layer, token), each step copies
+one [KVH, D] tile into its (page, slot) destination, with
+``input_output_aliases`` pinning the output to the input buffer — no
+copies, no churn.
+
+Used by engine/runner for both decode (N = batch) and prefill (N = B*T
+chunk tokens); invalid/padding tokens are routed to flat index 0, the
+reserved garbage page (kvcache.py convention).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kv_write_kernel(
+    flat_idx_ref,  # scalar prefetch [N]
+    k_new_ref,     # [L, 1, KVH, D] block — all layers of one token
+    v_new_ref,
+    k_io_ref,      # aliased in/out blocks (unused as input)
+    v_io_ref,
+    k_out_ref,
+    v_out_ref,
+):
+    del flat_idx_ref, k_io_ref, v_io_ref
+    k_out_ref[...] = k_new_ref[...]
+    v_out_ref[...] = v_new_ref[...]
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def kv_write_pallas(
+    k_pages: jax.Array,   # [L, R, KVH, D]  (R = NP * PS, flat rows)
+    v_pages: jax.Array,
+    k_new: jax.Array,     # [L, N, KVH, D]
+    v_new: jax.Array,
+    flat_idx: jax.Array,  # [N] int32 row index into R (0 = garbage)
+) -> Tuple[jax.Array, jax.Array]:
+    L, R, KVH, D = k_pages.shape
+    N = k_new.shape[1]
+
+    # one grid step per token, whole layer stack in one block: N DMAs of
+    # L*KVH*D elements each, instead of L*N tiny tile copies
+    new_spec = pl.BlockSpec(
+        (L, 1, KVH, D), lambda n, idx: (0, n, 0, 0)
+    )
+    io_spec = pl.BlockSpec(
+        (L, 1, KVH, D), lambda n, idx: (0, idx[n], 0, 0)
+    )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(N,),
+        in_specs=[new_spec, new_spec, io_spec, io_spec],
+        out_specs=[io_spec, io_spec],
+    )
+    out_k, out_v = pl.pallas_call(
+        _kv_write_kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+        ],
+        # flattened operand order: flat_idx(0), k_new(1), v_new(2),
+        # k_pages(3), v_pages(4) -> outputs 0, 1
+        input_output_aliases={3: 0, 4: 1},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+    )(flat_idx, k_new, v_new, k_pages, v_pages)
+    return out_k, out_v
+
+
+def kv_write_supported() -> bool:
+    return jax.default_backend() == "tpu"

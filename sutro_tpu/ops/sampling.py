@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+# widest nucleus/top-k head considered for sampling (see sample())
+NUCLEUS_CAP = 256
 
 
 def sample(
@@ -29,8 +31,14 @@ def sample(
     top_p: jax.Array,                   # scalar or [B]; 1.0 disables
     top_k: jax.Array = 0,               # scalar or [B] int32; 0 disables
     allowed: Optional[jax.Array] = None,  # [B, V] bool — constrained decoding
+    row_seeds: Optional[jax.Array] = None,  # [B] int32 — per-row derived keys
 ) -> jax.Array:
-    """Returns sampled token ids [B]."""
+    """Returns sampled token ids [B].
+
+    ``row_seeds`` implements the reference's ``random_seed_per_input``
+    (sdk.py payload): each row samples with a key folded from its own seed
+    (gumbel-max, equivalent to categorical), so a row's output stream is
+    reproducible independent of batch composition."""
     B, V = logits.shape
     if allowed is not None:
         logits = jnp.where(allowed, logits, NEG_INF)
@@ -39,31 +47,52 @@ def sample(
     top_p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (B,))
     top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (B,))
 
-    greedy_tok = jnp.argmax(logits, axis=-1)
-
     scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
 
-    # one descending sort serves both top-k and top-p filtering
-    sort_idx = jnp.argsort(-scaled, axis=-1)
-    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+    # A full [B, V] argsort is pathologically slow on TPU (sorting networks
+    # over 150k lanes). Filtered rows instead use the top NUCLEUS_CAP
+    # logits — the nucleus/top-k filters only ever *keep* a head of the
+    # distribution — normalized against the exact full-vocab logsumexp, so
+    # probabilities are exact. Rows with filtering disabled (top_k<=0 or
+    # >cap, and top_p>=1) sample the FULL vocabulary via gumbel-argmax
+    # (== categorical, no sort), honoring the "0 disables" contract.
+    # Remaining approximation: a *nucleus* wider than NUCLEUS_CAP tokens
+    # (near-uniform distributions with top_p<1) truncates to the cap.
+    K = min(NUCLEUS_CAP, V)
+    top_vals, top_idx = jax.lax.top_k(scaled, K)      # [B, K], descending
+    greedy_tok = top_idx[:, 0]
 
-    # top-k (dynamic per row): keep ranks < k; k<=0 disables
-    ranks = jnp.arange(V, dtype=jnp.int32)[None, :]
-    k_eff = jnp.where(top_k > 0, top_k, V)[:, None]
+    lse = jax.scipy.special.logsumexp(scaled, axis=-1, keepdims=True)
+    probs = jnp.exp(top_vals - lse)                   # exact probabilities
+
+    ranks = jnp.arange(K, dtype=jnp.int32)[None, :]
+    k_active = (top_k > 0) & (top_k <= K)
+    k_eff = jnp.where(k_active, top_k, K)[:, None]
     keep_k = ranks < k_eff
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_p = (cum - probs) < top_p[:, None]           # always keeps rank-0
+    vals = jnp.where(keep_k & keep_p, top_vals, NEG_INF)
 
-    # top-p (nucleus): drop tokens outside the smallest prob mass >= top_p
-    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
-    cum = jnp.cumsum(sorted_probs, axis=-1)
-    keep_p = (cum - sorted_probs) < top_p[:, None]  # always keeps rank-0
-
-    keep_sorted = keep_k & keep_p
-    keep = jnp.zeros_like(keep_sorted).at[
-        jnp.arange(B)[:, None], sort_idx
-    ].set(keep_sorted)
-    scaled = jnp.where(keep, scaled, NEG_INF)
-
-    sampled = jax.random.categorical(key, scaled, axis=-1)
+    if row_seeds is not None:
+        keys = jax.vmap(lambda s: jax.random.fold_in(key, s))(row_seeds)
+        g_head = jax.vmap(
+            lambda k, lg: jax.random.gumbel(k, lg.shape, jnp.float32)
+        )(keys, vals)
+        choice = jnp.argmax(vals + g_head, axis=-1)
+        g_full = jax.vmap(
+            lambda k, lg: jax.random.gumbel(
+                jax.random.fold_in(k, 1), lg.shape, jnp.float32
+            )
+        )(keys, scaled)
+        full_tok = jnp.argmax(scaled + g_full, axis=-1)
+    else:
+        choice = jax.random.categorical(key, vals, axis=-1)
+        full_tok = jax.random.categorical(
+            jax.random.fold_in(key, 1), scaled, axis=-1
+        )
+    head_tok = jnp.take_along_axis(top_idx, choice[:, None], axis=1)[:, 0]
+    filtered = k_active | (top_p < 1.0)
+    sampled = jnp.where(filtered, head_tok, full_tok)
     return jnp.where(temperature <= 0.0, greedy_tok, sampled).astype(jnp.int32)
 
 

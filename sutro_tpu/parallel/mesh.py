@@ -1,0 +1,63 @@
+"""Device mesh construction and topology detection.
+
+The reference has no distributed layer at all (its transport is HTTPS,
+SURVEY §5.8); this is the TPU-native equivalent: a ``jax.sharding.Mesh``
+with axes ``("data", "expert", "model")``:
+
+- ``model`` (TP) — innermost, so tensor-parallel collectives (all-reduce /
+  all-gather of activations) ride the fastest ICI links;
+- ``expert`` (EP) — MoE all-to-all token routing;
+- ``data`` (DP) — outermost; across pod slices this maps to DCN, which only
+  ever carries embarrassingly-parallel row shards.
+
+Multi-host: call ``init_distributed()`` once per process
+(``jax.distributed.initialize``) and the same mesh spans all hosts'
+devices (SURVEY §5.8 "Inter-slice / multi-host").
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("data", "expert", "model")
+
+
+def init_distributed() -> None:
+    """Multi-host init (no-op when single-process or already initialized)."""
+    if os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        try:
+            jax.distributed.initialize()
+        except RuntimeError:
+            pass  # already initialized
+
+
+def make_mesh(
+    dp: int = 1,
+    ep: int = 1,
+    tp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    need = dp * ep * tp
+    if need > len(devices):
+        raise ValueError(
+            f"Mesh dp*ep*tp={need} exceeds available devices {len(devices)}"
+        )
+    grid = np.array(devices[:need]).reshape(dp, ep, tp)
+    return Mesh(grid, AXES)
+
+
+def auto_mesh(ecfg, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Resolve the engine config against the actual device count."""
+    devices = list(devices if devices is not None else jax.devices())
+    dp, ep, tp = ecfg.resolved_mesh(len(devices))
+    return make_mesh(dp, ep, tp, devices)
+
+
+def mesh_shape(mesh: Mesh) -> Tuple[int, int, int]:
+    return tuple(mesh.shape[a] for a in AXES)  # type: ignore[return-value]

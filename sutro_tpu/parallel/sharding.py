@@ -1,0 +1,96 @@
+"""Sharding rules: parameter/cache/data placement over the mesh.
+
+Megatron-style tensor parallelism expressed as ``NamedSharding`` per pytree
+leaf — XLA inserts the collectives (SURVEY §5.8: "pick a mesh, annotate
+shardings, let XLA insert collectives"):
+
+- attention: head dimension of wq/wk/wv sharded over ``model``; wo sharded
+  on its input (head) dimension → one all-reduce per attention block;
+- MLP: w_gate/w_up sharded on the FFN dim, w_down on its input → one
+  all-reduce per MLP block;
+- MoE: the *expert* axis of we_* shards over ``expert`` and the FFN dim
+  over ``model`` (EP×TP); router replicated;
+- embed replicated (token gather is cheap, avoids vocab-gather
+  collectives on every prefill chunk); lm_head sharded over vocab so the
+  logits matmul is parallel, with the all-gather deferred to sampling;
+- KV cache pages shard the KV-head axis over ``model``, matching the
+  attention-head sharding, so decode attention needs no KV collectives.
+
+All rules are path-based over the params pytree from
+models/transformer.init_params and engine/weights.load_checkpoint.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# leaf name (within params["layers"] or top level) -> PartitionSpec
+_LAYER_RULES: Dict[str, P] = {
+    "attn_norm": P(),
+    "mlp_norm": P(),
+    "post_attn_norm": P(),
+    "post_mlp_norm": P(),
+    "q_norm": P(),
+    "k_norm": P(),
+    "sink": P(None, "model"),            # [L, NH]
+    "wq": P(None, None, "model"),        # [L, H, NHD]
+    "wk": P(None, None, "model"),
+    "wv": P(None, None, "model"),
+    "bq": P(None, "model"),
+    "bk": P(None, "model"),
+    "bv": P(None, "model"),
+    "wo": P(None, "model", None),        # [L, NHD, H]
+    "bo": P(),
+    "w_gate": P(None, None, "model"),    # [L, H, F]
+    "w_up": P(None, None, "model"),
+    "w_down": P(None, "model", None),    # [L, F, H]
+    "router": P(),                       # [L, H, E]
+    "we_gate": P(None, "expert", None, "model"),  # [L, E, H, F]
+    "we_up": P(None, "expert", None, "model"),
+    "we_down": P(None, "expert", "model", None),  # [L, E, F, H]
+}
+
+_TOP_RULES: Dict[str, P] = {
+    "embed": P(),                        # replicated (see module docstring)
+    "final_norm": P(),
+    "lm_head": P(None, "model"),         # [H, V] — vocab-parallel logits
+}
+
+
+def param_shardings(params: Any, mesh: Mesh) -> Any:
+    """Pytree of NamedSharding matching ``params`` structure."""
+
+    def rule(path, leaf) -> NamedSharding:
+        names = [p.key for p in path if hasattr(p, "key")]
+        leaf_name = names[-1]
+        if "layers" in names:
+            spec = _LAYER_RULES.get(leaf_name, P())
+        else:
+            spec = _TOP_RULES.get(leaf_name, P())
+        if len(spec) > leaf.ndim:
+            spec = P(*spec[: leaf.ndim])
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(rule, params)
+
+
+def cache_shardings(mesh: Mesh) -> NamedSharding:
+    """[L, NP, PS, KVH, Dh]: KV heads follow the attention-head sharding."""
+    return NamedSharding(mesh, P(None, None, None, "model", None))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch rows shard over ``data`` (DP)."""
+    return NamedSharding(mesh, P("data"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_params(params: Any, mesh: Mesh) -> Any:
+    """device_put the whole pytree with its rules (host -> sharded HBM)."""
+    return jax.device_put(params, param_shardings(params, mesh))

@@ -1,0 +1,94 @@
+"""Classification template.
+
+Re-design of the reference mixin
+(/root/reference/sutro/templates/classification.py:11-117): builds an
+expert-classifier system prompt from a class list/dict, constrains output
+to a fixed ``{scratchpad, classification}`` schema, runs a detached job,
+awaits completion, and strips the scratchpad unless requested.
+"""
+
+from __future__ import annotations
+
+import json
+from enum import Enum
+from typing import Any, Dict, List, Optional, Union
+
+from pydantic import BaseModel, Field, create_model
+
+from ..interfaces import BaseSutroClient
+
+
+class ClassificationTemplates(BaseSutroClient):
+    def classify(
+        self,
+        data: Any,
+        classes: Union[List[str], Dict[str, str]],
+        column: Optional[Union[str, List[Any]]] = None,
+        model: str = "qwen-3-4b",
+        context: Optional[str] = None,
+        keep_scratchpad: bool = False,
+        job_priority: int = 0,
+        name: Optional[str] = None,
+        description: Optional[str] = None,
+        **kwargs: Any,
+    ) -> Any:
+        """Classify rows into one of ``classes``.
+
+        ``classes`` may be a list of labels or a dict label->description
+        (reference classification.py:51-83). Returns a DataFrame with a
+        ``classification`` column (plus ``scratchpad`` when
+        ``keep_scratchpad=True``)."""
+        if isinstance(classes, dict):
+            class_lines = "\n".join(
+                f"- {label}: {desc}" for label, desc in classes.items()
+            )
+            labels = list(classes)
+        else:
+            class_lines = "\n".join(f"- {label}" for label in classes)
+            labels = list(classes)
+        if not labels:
+            raise ValueError("classes must be non-empty")
+
+        system_prompt = (
+            "You are an expert classifier. Classify the user's input into "
+            "exactly one of the following classes:\n"
+            f"{class_lines}\n\n"
+            "First think briefly in the scratchpad, then answer with the "
+            "chosen class label, exactly as written above."
+        )
+        if context:
+            system_prompt += f"\n\nAdditional context:\n{context}"
+
+        label_enum = Enum(  # constrain classification to the label set
+            "ClassLabel", {f"c{i}": label for i, label in enumerate(labels)}
+        )
+        # maxLength bounds the scratchpad in the constrained-decoding FSM
+        # itself, so a runaway chain of thought can't eat the token budget
+        output_schema = create_model(
+            "ClassificationOutput",
+            scratchpad=(str, Field(max_length=400)),
+            classification=(label_enum, ...),
+        )
+
+        job_id = self.infer(
+            data,
+            model=model,
+            column=column,
+            output_schema=output_schema,
+            system_prompt=system_prompt,
+            job_priority=job_priority,
+            name=name,
+            description=description,
+            stay_attached=False,
+            **kwargs,
+        )
+        if job_id is None:
+            return None
+        results = self.await_job_completion(job_id, unpack_json=True)
+        if results is None:
+            return None
+        if not keep_scratchpad and "scratchpad" in getattr(
+            results, "columns", []
+        ):
+            results = results.drop(columns=["scratchpad"])
+        return results

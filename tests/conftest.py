@@ -11,12 +11,26 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The axon environment pins jax.config.jax_platforms programmatically in
+# sitecustomize (overriding the env var), so force CPU through the config
+# API too — before any backend is initialized.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 from sutro_tpu.engine.config import EngineConfig  # noqa: E402
 from sutro_tpu.engine.tokenizer import ByteTokenizer  # noqa: E402
 from sutro_tpu.models.configs import MODEL_CONFIGS  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def monkeypatch_module():
+    mp = pytest.MonkeyPatch()
+    yield mp
+    mp.undo()
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,68 @@
+"""CLI smoke tests via click's test runner (reference cli.py command set)."""
+
+import pytest
+from click.testing import CliRunner
+
+from sutro_tpu.cli import cli
+
+
+@pytest.fixture()
+def runner(tmp_path, monkeypatch):
+    monkeypatch.setenv("SUTRO_HOME", str(tmp_path))
+    return CliRunner()
+
+
+def test_quotas(runner):
+    res = runner.invoke(cli, ["quotas"])
+    assert res.exit_code == 0
+    assert "row_quota" in res.output
+
+
+def test_engine_models(runner):
+    res = runner.invoke(cli, ["engine", "models"])
+    assert res.exit_code == 0
+    assert "qwen-3-32b" in res.output
+    assert "gpt-oss-120b" in res.output
+
+
+def test_engine_info(runner):
+    res = runner.invoke(cli, ["engine", "info"])
+    assert res.exit_code == 0
+    assert "mesh:" in res.output
+
+
+def test_datasets_create_and_files(runner, tmp_path):
+    res = runner.invoke(cli, ["datasets", "create"])
+    assert res.exit_code == 0
+    ds = res.output.strip().splitlines()[-1]
+    assert ds.startswith("dataset-")
+    f = tmp_path / "a.txt"
+    f.write_text("row1\nrow2\n")
+    res = runner.invoke(cli, ["datasets", "upload", ds, str(f)])
+    assert res.exit_code == 0
+    res = runner.invoke(cli, ["datasets", "files", ds])
+    assert "a.txt" in res.output
+    res = runner.invoke(cli, ["datasets", "list"])
+    assert ds in res.output
+
+
+def test_cache_show_empty(runner):
+    res = runner.invoke(cli, ["cache", "show"])
+    assert res.exit_code == 0
+
+
+def test_set_base_url_and_backend(runner, tmp_path):
+    res = runner.invoke(cli, ["set-base-url", "https://example.test"])
+    assert res.exit_code == 0
+    res = runner.invoke(cli, ["set-backend", "tpu"])
+    assert res.exit_code == 0
+    from sutro_tpu.validation import load_config
+
+    cfg = load_config()
+    assert cfg["base_url"] == "https://example.test"
+    assert cfg["backend"] == "tpu"
+
+
+def test_jobs_list_empty(runner):
+    res = runner.invoke(cli, ["jobs", "list"])
+    assert res.exit_code == 0

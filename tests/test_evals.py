@@ -1,0 +1,54 @@
+"""Eval templates: Bradley–Terry/Elo math (pure client-side, reference
+evals.py:225-313) and input validation."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sutro_tpu.templates.evals import Rank
+
+
+def test_elo_orders_clear_winner():
+    rankings = [["a", "b", "c"]] * 10 + [["a", "c", "b"]] * 3
+    df = Rank.elo(rankings)
+    assert list(df["player"]) == ["a", "b", "c"]
+    assert df["elo"].iloc[0] > df["elo"].iloc[1] > df["elo"].iloc[2]
+
+
+def test_elo_symmetric_is_flat():
+    rankings = [["a", "b"], ["b", "a"]] * 5
+    df = Rank.elo(rankings)
+    assert abs(df["elo"].iloc[0] - df["elo"].iloc[1]) < 1.0
+
+
+def test_elo_tie_groups():
+    # a always wins; b and c always tie behind a
+    rankings = [["a", ["b", "c"]]] * 6
+    df = Rank.elo(rankings)
+    assert df["player"].iloc[0] == "a"
+    b = df[df["player"] == "b"]["elo"].iloc[0]
+    c = df[df["player"] == "c"]["elo"].iloc[0]
+    assert abs(b - c) < 1.0
+
+
+def test_elo_json_string_rankings():
+    df = Rank.elo(['["a","b"]', '["a","b"]', "not-json"])
+    assert df["player"].iloc[0] == "a"
+
+
+def test_elo_empty():
+    df = Rank.elo([])
+    assert len(df) == 0
+
+
+def test_rank_validates_options():
+    class Dummy(Rank):
+        pass
+
+    d = Dummy()
+    with pytest.raises(ValueError, match="DataFrame"):
+        d.rank(["not-a-df"], options=["a"], criteria="c")
+    with pytest.raises(ValueError, match="not in DataFrame"):
+        d.rank(
+            pd.DataFrame({"a": ["1"]}), options=["a", "missing"], criteria="c"
+        )
