@@ -91,8 +91,13 @@ static bool step(const FsmCore* f, const uint64_t* in, uint64_t* out,
 }
 
 // mask[v] = 1 iff token v's bytes can all be consumed from `states`.
+// out_dist[v] = min over surviving states of state_dist (byte distance to
+// accept; INT32_MAX = unreachable/disallowed) — consumed by budget-aware
+// constrained decoding (fsm.py: tokens are filtered each step so the
+// remaining budget always covers the shortest path to accept).
 void fsm_mask(const FsmCore* f, const int32_t* states, int32_t n_active,
-              uint8_t* mask) {
+              const int32_t* state_dist, uint8_t* mask, int32_t* out_dist) {
+    const int32_t INF = 0x7fffffff;
     std::vector<uint64_t> start(f->n_words, 0), cur(f->n_words), nxt(f->n_words);
     for (int32_t i = 0; i < n_active; ++i) bit_set(start.data(), states[i]);
 
@@ -107,9 +112,11 @@ void fsm_mask(const FsmCore* f, const int32_t* states, int32_t n_active,
     }
     for (int32_t v = 0; v < f->vocab; ++v) {
         int32_t lo = f->tok_offsets[v], hi = f->tok_offsets[v + 1];
-        if (lo == hi) { mask[v] = 0; continue; }
+        if (lo == hi) { mask[v] = 0; out_dist[v] = INF; continue; }
         uint8_t b0 = f->tok_bytes[lo];
-        if (!((first_ok[b0 >> 5] >> (b0 & 31)) & 1u)) { mask[v] = 0; continue; }
+        if (!((first_ok[b0 >> 5] >> (b0 & 31)) & 1u)) {
+            mask[v] = 0; out_dist[v] = INF; continue;
+        }
         std::memcpy(cur.data(), start.data(), sizeof(uint64_t) * f->n_words);
         bool ok = true;
         for (int32_t i = lo; i < hi; ++i) {
@@ -120,6 +127,13 @@ void fsm_mask(const FsmCore* f, const int32_t* states, int32_t n_active,
             cur.swap(nxt);
         }
         mask[v] = ok ? 1 : 0;
+        int32_t d = INF;
+        if (ok) {
+            for (int32_t s = 0; s < f->n_states; ++s)
+                if (bit_test(cur.data(), s) && state_dist[s] < d)
+                    d = state_dist[s];
+        }
+        out_dist[v] = d;
     }
 }
 

@@ -39,6 +39,32 @@ from .tokenizer import BaseTokenizer, load_tokenizer
 _PARTIAL_FLUSH_EVERY = 256
 
 
+def _read_url_rows(url: str, column: "str | None") -> list:
+    """Resolve an http(s) parquet/csv URL into a row list (the engine-side
+    half of prepare_input_data's URL pass-through, common.py)."""
+    import pandas as pd
+
+    try:
+        if url.split("?")[0].endswith((".csv", ".csv.gz")):
+            df = pd.read_csv(url)
+        else:
+            df = pd.read_parquet(url)
+    except Exception as e:
+        raise ValueError(f"Could not fetch input URL {url!r}: {e}") from e
+    if column is None:
+        if len(df.columns) != 1:
+            raise ValueError(
+                f"URL input has columns {list(df.columns)}; pass `column` "
+                "to select one"
+            )
+        column = df.columns[0]
+    if column not in df.columns:
+        raise ValueError(
+            f"URL input has no column {column!r} (has {list(df.columns)})"
+        )
+    return df[column].astype(str).tolist()
+
+
 def resolve_model(model: str) -> Tuple[str, ModelConfig, Dict[str, Any]]:
     """Public model name (or raw engine key) -> (engine_key, config, meta)."""
     meta = MODEL_CATALOG.get(model)
@@ -85,8 +111,17 @@ class LocalEngine:
             inputs = self.datasets.read_rows(
                 inputs, column=payload.get("column")
             )
+        elif isinstance(inputs, str) and inputs.startswith(
+            ("http://", "https://")
+        ):
+            # prepare_input_data passes URLs through for engine-side
+            # resolution (reference sdk accepts parquet/csv URLs)
+            inputs = _read_url_rows(inputs, payload.get("column"))
         if not isinstance(inputs, list):
-            raise ValueError("inputs must be a list of strings or dataset id")
+            raise ValueError(
+                "inputs must be a list of strings, a dataset-<id>, or an "
+                "http(s) URL to a parquet/csv file"
+            )
         inputs = [str(x) for x in inputs]
 
         sampling = dict(payload.get("sampling_params") or {})

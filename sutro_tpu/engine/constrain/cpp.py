@@ -66,7 +66,9 @@ def _load_lib():
         ctypes.c_void_p,
         np.ctypeslib.ndpointer(np.int32, flags="C"),
         ctypes.c_int32,
+        np.ctypeslib.ndpointer(np.int32, flags="C"),
         np.ctypeslib.ndpointer(np.uint8, flags="C"),
+        np.ctypeslib.ndpointer(np.int32, flags="C"),
     ]
     lib.fsm_advance.restype = ctypes.c_int32
     lib.fsm_advance.argtypes = [
@@ -121,6 +123,13 @@ class CppMasker:
             blobs.append(tb)
         tok_bytes = np.frombuffer(b"".join(blobs) or b"\x00", np.uint8).copy()
 
+        # per-state byte distance to accept (budget-aware decoding);
+        # inf -> INT32_MAX for the C side
+        dist = nfa.byte_distances()
+        self._state_dist = np.where(
+            np.isfinite(dist), dist, np.float64(0x7FFFFFFF)
+        ).astype(np.int32)
+
         self.vocab = table.vocab_size
         self._lib = lib
         self._handle = lib.fsm_create(
@@ -136,11 +145,17 @@ class CppMasker:
             np.ascontiguousarray(tok_bytes),
         )
 
-    def mask(self, states: FrozenSet[int]) -> np.ndarray:
+    def mask(self, states: FrozenSet[int]) -> "tuple[np.ndarray, np.ndarray]":
+        """Returns (allowed [V] bool, dist_after [V] int32) — dist_after is
+        the post-token byte distance to accept (INT32_MAX if disallowed)."""
         arr = np.array(sorted(states), np.int32)
         out = np.zeros(self.vocab, np.uint8)
-        self._lib.fsm_mask(self._handle, arr, np.int32(len(arr)), out)
-        return out.astype(bool)
+        out_dist = np.zeros(self.vocab, np.int32)
+        self._lib.fsm_mask(
+            self._handle, arr, np.int32(len(arr)), self._state_dist,
+            out, out_dist,
+        )
+        return out.astype(bool), out_dist
 
     def __del__(self) -> None:
         lib, handle = getattr(self, "_lib", None), getattr(self, "_handle", None)

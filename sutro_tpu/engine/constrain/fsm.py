@@ -41,13 +41,22 @@ class TokenTable:
         )
 
 
+INF_DIST = np.int32(0x7FFFFFFF)
+
+
 class MaskCache:
-    """state-set -> vocab mask, shared across all rows of a job."""
+    """state-set -> (vocab mask, per-token post-walk byte distance to
+    accept), shared across all rows of a job. The distance array is what
+    makes budget-aware decoding O(V) per step: the scheduler ANDs the
+    cached mask with ``dist_after <= remaining - 1`` instead of ever
+    re-walking tokens."""
 
     def __init__(self, nfa: NFA, table: TokenTable):
         self.nfa = nfa
         self.table = table
-        self._cache: Dict[FrozenSet[int], np.ndarray] = {}
+        self._cache: Dict[
+            FrozenSet[int], "tuple[np.ndarray, np.ndarray]"
+        ] = {}
         self._cpp = None
         try:
             from .cpp import CppMasker
@@ -57,23 +66,33 @@ class MaskCache:
             self._cpp = None
 
     def mask(self, states: FrozenSet[int]) -> np.ndarray:
+        return self.mask_and_dist(states)[0]
+
+    def mask_and_dist(
+        self, states: FrozenSet[int]
+    ) -> "tuple[np.ndarray, np.ndarray]":
         cached = self._cache.get(states)
         if cached is not None:
             return cached
         if self._cpp is not None:
-            m = self._cpp.mask(states)
+            m, dist = self._cpp.mask(states)
         else:
-            m = self._compute(states)
+            m, dist = self._compute(states)
         # terminal: allow stop tokens so the model can end cleanly
+        # (distance 0 — emitting stop costs no further closing bytes)
         if self.nfa.is_accepting(states):
             for sid in self.table.stop_ids:
                 m[sid] = True
-        self._cache[states] = m
-        return m
+                dist[sid] = 0
+        self._cache[states] = (m, dist)
+        return m, dist
 
-    def _compute(self, states: FrozenSet[int]) -> np.ndarray:
+    def _compute(
+        self, states: FrozenSet[int]
+    ) -> "tuple[np.ndarray, np.ndarray]":
         nfa = self.nfa
         m = np.zeros(self.table.vocab_size, bool)
+        dist = np.full(self.table.vocab_size, INF_DIST, np.int32)
         byte_ok = nfa.allowed_bytes(states)
         for tid, tb in enumerate(self.table.token_bytes):
             if not tb or not byte_ok[tb[0]]:
@@ -86,7 +105,10 @@ class MaskCache:
                     ok = False
                     break
             m[tid] = ok
-        return m
+            if ok:
+                d = nfa.dist_to_accept(cur)
+                dist[tid] = np.int32(d) if np.isfinite(d) else INF_DIST
+        return m, dist
 
 
 class TokenFSM:
@@ -99,13 +121,27 @@ class TokenFSM:
         self.states = nfa.initial()
         self._complete = False
 
-    def allowed_tokens(self) -> np.ndarray:
+    def allowed_tokens(self, remaining: Optional[int] = None) -> np.ndarray:
+        """Vocab mask; with ``remaining`` (token budget left for this row)
+        tokens whose post-walk shortest path to accept no longer fits the
+        budget are filtered out EVERY step. Invariant: if the budget covers
+        the distance at step 0, it covers it at every step (each kept
+        token satisfies dist_after <= remaining-1, and the next mask always
+        contains the shortest path's single-byte tokens) — so schema rows
+        always finish with complete JSON instead of a mid-string cut."""
         if self._complete:
             m = np.zeros(self.table.vocab_size, bool)
             for sid in self.table.stop_ids:
                 m[sid] = True
             return m
-        return self.masks.mask(self.states)
+        m, dist = self.masks.mask_and_dist(self.states)
+        if remaining is not None:
+            fits = m & (dist <= max(int(remaining) - 1, 0))
+            if fits.any():
+                return fits
+            # budget was infeasible from the start (or non-byte stop path):
+            # degrade to the unfiltered mask rather than dead-ending
+        return m
 
     def advance(self, token_id: int) -> None:
         if self._complete:

@@ -85,6 +85,52 @@ class NFA:
                 m |= bm
         return m
 
+    def byte_distances(self) -> np.ndarray:
+        """Per-state minimum bytes to reach accept (inf if unreachable).
+
+        0-1 BFS over the reversed graph (byte edges cost 1, epsilon cost
+        0). Powers budget-aware forced closure: when a row's remaining
+        token budget approaches this distance, the mask is narrowed to
+        distance-decreasing bytes so constrained rows always emit complete
+        JSON (the reference's "guaranteed JSON" contract even at the
+        length cap)."""
+        cached = getattr(self, "_byte_dist", None)
+        if cached is not None:
+            return cached
+        from collections import deque
+
+        INF = np.inf
+        rev_byte: Dict[int, List[int]] = {}
+        rev_eps: Dict[int, List[int]] = {}
+        for s, lst in self.edges.items():
+            for _, t in lst:
+                rev_byte.setdefault(t, []).append(s)
+        for s, lst in self.eps.items():
+            for t in lst:
+                rev_eps.setdefault(t, []).append(s)
+        dist = np.full(self.n_states, INF)
+        dist[self.accept] = 0.0
+        dq = deque([self.accept])
+        while dq:
+            u = dq.popleft()
+            d = dist[u]
+            for v in rev_eps.get(u, ()):      # eps edge v->u: cost 0
+                if d < dist[v]:
+                    dist[v] = d
+                    dq.appendleft(v)
+            for v in rev_byte.get(u, ()):     # byte edge v->u: cost 1
+                if d + 1 < dist[v]:
+                    dist[v] = d + 1
+                    dq.append(v)
+        self._byte_dist = dist
+        return dist
+
+    def dist_to_accept(self, states: FrozenSet[int]) -> float:
+        if not states:
+            return np.inf
+        d = self.byte_distances()
+        return min(d[s] for s in states)
+
 
 class Builder:
     """Mutable builder; combinator methods return (start, accept) fragments."""

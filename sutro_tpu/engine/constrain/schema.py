@@ -23,9 +23,12 @@ from .nfa import NFA, Builder, bitmap, bitmap_of
 
 Frag = Tuple[int, int]
 
-# JSON string content: any byte except '"' (0x22), '\' (0x5C), and control
-# bytes < 0x20. Escapes: \ followed by one of "\/bfnrt or uXXXX.
-_STR_PLAIN = bitmap((0x20, 0x21), (0x23, 0x5B), (0x5D, 0xFF))
+# JSON string content: ASCII except '"' (0x22), '\' (0x5C), and control
+# bytes < 0x20; non-ASCII must form exact UTF-8 sequences (modeled below —
+# a loose 0x80-0xFF class would let the FSM emit invalid UTF-8 under
+# forced closure or adversarial sampling). Escapes: \ + "\/bfnrt or uXXXX.
+_STR_PLAIN = bitmap((0x20, 0x21), (0x23, 0x5B), (0x5D, 0x7F))
+_CONT = (0x80, 0xBF)  # UTF-8 continuation byte
 _ESC_SIMPLE = bitmap_of(b'"\\/bfnrt')
 _HEX = bitmap((0x30, 0x39), (0x41, 0x46), (0x61, 0x66))
 _DIGIT = bitmap((0x30, 0x39))
@@ -53,7 +56,39 @@ class SchemaCompiler:
                 ),
             ),
         )
-        return b.alt(b.char(_STR_PLAIN), esc)
+        # exact UTF-8 multibyte sequences (RFC 3629 table: no overlongs,
+        # no surrogates, max U+10FFFF)
+        utf8 = b.alt(
+            b.seq(b.char(bitmap((0xC2, 0xDF))), b.char(bitmap(_CONT))),
+            b.seq(
+                b.char(bitmap((0xE0, 0xE0))),
+                b.char(bitmap((0xA0, 0xBF))), b.char(bitmap(_CONT)),
+            ),
+            b.seq(
+                b.char(bitmap((0xE1, 0xEC), (0xEE, 0xEF))),
+                b.char(bitmap(_CONT)), b.char(bitmap(_CONT)),
+            ),
+            b.seq(
+                b.char(bitmap((0xED, 0xED))),
+                b.char(bitmap((0x80, 0x9F))), b.char(bitmap(_CONT)),
+            ),
+            b.seq(
+                b.char(bitmap((0xF0, 0xF0))),
+                b.char(bitmap((0x90, 0xBF))),
+                b.char(bitmap(_CONT)), b.char(bitmap(_CONT)),
+            ),
+            b.seq(
+                b.char(bitmap((0xF1, 0xF3))),
+                b.char(bitmap(_CONT)), b.char(bitmap(_CONT)),
+                b.char(bitmap(_CONT)),
+            ),
+            b.seq(
+                b.char(bitmap((0xF4, 0xF4))),
+                b.char(bitmap((0x80, 0x8F))),
+                b.char(bitmap(_CONT)), b.char(bitmap(_CONT)),
+            ),
+        )
+        return b.alt(b.char(_STR_PLAIN), esc, utf8)
 
     def _string_frag(
         self, min_len: int = 0, max_len: Optional[int] = None

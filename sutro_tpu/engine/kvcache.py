@@ -95,9 +95,11 @@ def write_kv(
     page_table: jax.Array,     # [B, MP] int32
     start: jax.Array,          # [B] int32 — global position of chunk token 0
     valid_len: jax.Array,      # [B] int32 — real tokens in chunk
+    use_pallas: bool = False,
 ) -> KVCache:
     """Scatter a chunk's K/V into pages. Padding positions are routed to
-    garbage page 0."""
+    garbage page 0. With ``use_pallas`` the write is a true in-place DMA
+    (ops/pallas_kv.py) instead of an XLA scatter over the full pool."""
     L, B, T, KVH, Dh = k_chunk.shape
     PS = cache.page_size
     NP = cache.num_pages
@@ -108,9 +110,20 @@ def write_kv(
 
     k_flat = cache.k_pages.reshape(L, NP * PS, KVH, Dh)
     v_flat = cache.v_pages.reshape(L, NP * PS, KVH, Dh)
-    # advanced indexing [L dim kept, flat [B,T]] -> [L, B, T, KVH, Dh]
-    k_flat = k_flat.at[:, flat].set(k_chunk.astype(k_flat.dtype))
-    v_flat = v_flat.at[:, flat].set(v_chunk.astype(v_flat.dtype))
+    if use_pallas:
+        from ..ops.pallas_kv import kv_write_pallas
+
+        k_flat, v_flat = kv_write_pallas(
+            k_flat,
+            v_flat,
+            k_chunk.reshape(L, B * T, KVH, Dh).astype(k_flat.dtype),
+            v_chunk.reshape(L, B * T, KVH, Dh).astype(v_flat.dtype),
+            flat.reshape(-1).astype(jnp.int32),
+        )
+    else:
+        # advanced indexing [L dim kept, flat [B,T]] -> [L, B, T, KVH, Dh]
+        k_flat = k_flat.at[:, flat].set(k_chunk.astype(k_flat.dtype))
+        v_flat = v_flat.at[:, flat].set(v_chunk.astype(v_flat.dtype))
     return KVCache(
         k_pages=k_flat.reshape(L, NP, PS, KVH, Dh),
         v_pages=v_flat.reshape(L, NP, PS, KVH, Dh),
@@ -122,7 +135,13 @@ def gather_kv(
 ) -> Tuple[jax.Array, jax.Array]:
     """[B, MP] page table -> contiguous ([L, B, CTX, KVH, Dh]) x2 view,
     CTX = MP * PS. Invalid positions contain garbage; attention masks them
-    by ``past_len``."""
+    by ``past_len``.
+
+    NOTE: materializes the gathered view for ALL layers at once — decode
+    uses the per-layer path (``gather_kv_layer`` inside the layer scan)
+    instead, which keeps the transient at 1/L of this. Kept for tests and
+    small models.
+    """
     L, NP, PS, KVH, Dh = cache.k_pages.shape
     B, MP = page_table.shape
     k = jnp.take(cache.k_pages, page_table.reshape(-1), axis=1)
@@ -130,6 +149,25 @@ def gather_kv(
     k = k.reshape(L, B, MP * PS, KVH, Dh)
     v = v.reshape(L, B, MP * PS, KVH, Dh)
     return k, v
+
+
+def gather_kv_layer(
+    k_pages_l: jax.Array,  # [NP, PS, KVH, Dh] — one layer's pages
+    v_pages_l: jax.Array,
+    page_table: jax.Array,  # [B, MP] int32
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-layer page gather: [B, MP] table -> ([B, CTX, KVH, Dh]) x2,
+    CTX = MP * PS. Used inside the layer scan so only one layer's context
+    view is ever live (the XLA fallback when the Pallas paged kernel does
+    not run — the kernel reads pages in place and skips this copy)."""
+    NP, PS, KVH, Dh = k_pages_l.shape
+    B, MP = page_table.shape
+    k = jnp.take(k_pages_l, page_table.reshape(-1), axis=0)
+    v = jnp.take(v_pages_l, page_table.reshape(-1), axis=0)
+    return (
+        k.reshape(B, MP * PS, KVH, Dh),
+        v.reshape(B, MP * PS, KVH, Dh),
+    )
 
 
 def make_page_table(rows: List[List[int]], max_pages: int) -> np.ndarray:

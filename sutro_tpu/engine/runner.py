@@ -29,7 +29,7 @@ import numpy as np
 from ..models import transformer
 from ..models.configs import ModelConfig
 from .config import EngineConfig
-from .kvcache import KVCache, alloc_cache, gather_kv, write_kv
+from .kvcache import KVCache, alloc_cache, write_kv
 from ..ops.sampling import sample, cumulative_logprob
 
 
@@ -109,7 +109,10 @@ class ModelRunner:
             self.mcfg, params, ids, positions, valid_len,
             use_pallas=self.use_pallas,
         )
-        cache = write_kv(cache, k, v, page_table, start, valid_len)
+        cache = write_kv(
+            cache, k, v, page_table, start, valid_len,
+            use_pallas=self.use_pallas,
+        )
         last = jnp.maximum(valid_len - 1, 0)
         last_logits = jnp.take_along_axis(
             logits, last[:, None, None], axis=1
@@ -148,15 +151,16 @@ class ModelRunner:
     ):
         B = ids.shape[0]
         positions = past_len[:, None]  # current token position == past length
-        pk, pv = gather_kv(cache, page_table)
         logits, _, (k, v) = transformer.forward(
             self.mcfg, params, ids, positions,
             jnp.ones((B,), jnp.int32),
-            past_kv=(pk, pv), past_len=past_len,
+            paged_past=(cache.k_pages, cache.v_pages, page_table),
+            past_len=past_len,
             use_pallas=self.use_pallas,
         )
         cache = write_kv(
-            cache, k, v, page_table, past_len, jnp.ones((B,), jnp.int32)
+            cache, k, v, page_table, past_len, jnp.ones((B,), jnp.int32),
+            use_pallas=self.use_pallas,
         )
         step_logits = logits[:, 0]  # [B, V]
         tok = sample(

@@ -42,9 +42,13 @@ def _step_seed(row_seed: int, step: int) -> int:
 
 class TokenConstraint(Protocol):
     """Token-level FSM driving schema-constrained decoding
-    (engine/constrain/)."""
+    (engine/constrain/). ``remaining`` (tokens of budget left for the
+    row, when known) lets the FSM force closure so schema rows emit
+    complete JSON even at the length cap."""
 
-    def allowed_tokens(self) -> np.ndarray:  # [V] bool
+    def allowed_tokens(
+        self, remaining: "int | None" = None
+    ) -> np.ndarray:  # [V] bool
         ...
 
     def advance(self, token_id: int) -> None:
@@ -151,10 +155,38 @@ class ContinuousBatcher:
         self._record_token(slot, first, first_logp)
         return True
 
+    def _pad_mask(self, mask: np.ndarray) -> np.ndarray:
+        """Constraint masks are sized to the *tokenizer* vocab; pad to the
+        (possibly larger, padded) model vocab with False so padding token
+        ids are never sampled under a schema constraint."""
+        if len(mask) == self.vocab:
+            return mask
+        out = np.zeros((self.vocab,), bool)
+        out[: len(mask)] = mask[: self.vocab]
+        return out
+
+    def _constraint_mask(self, c: TokenConstraint, remaining: int) -> np.ndarray:
+        try:
+            m = c.allowed_tokens(remaining=remaining)
+        except TypeError:  # simple constraints without budget support
+            m = c.allowed_tokens()
+        return self._pad_mask(m)
+
+    def _remaining(self, req: GenRequest, emitted: int, pos: int) -> int:
+        """Tokens of generation budget left: request cap and context room."""
+        return max(
+            min(
+                req.max_new_tokens - emitted,
+                self.ecfg.max_context() - pos - 1,
+            ),
+            0,
+        )
+
     def _sample_one(self, logits: np.ndarray, req: GenRequest) -> tuple:
         allowed = None
         if req.constraint is not None:
-            allowed = req.constraint.allowed_tokens()[None, :]
+            rem = self._remaining(req, 0, len(req.prompt_ids))
+            allowed = self._constraint_mask(req.constraint, rem)[None, :]
         if req.row_seed is not None:
             sub = self._fixed_key  # per-row key derives from row_seed
             row_seeds = jax.numpy.asarray([_step_seed(req.row_seed, 0)])
@@ -330,9 +362,11 @@ class ContinuousBatcher:
             if has_constraint:
                 allowed = np.ones((self.B, self.vocab), bool)
                 for i in active:
-                    c = self.slots[i].req.constraint
+                    s = self.slots[i]
+                    c = s.req.constraint
                     if c is not None:
-                        allowed[i] = c.allowed_tokens()
+                        rem = self._remaining(s.req, len(s.out_ids), s.pos)
+                        allowed[i] = self._constraint_mask(c, rem)
 
             self._key, sub = jax.random.split(self._key)
             # row-seeded sampling needs a batch-independent base key so a

@@ -151,9 +151,10 @@ def load_checkpoint(
             layers["we_up"] = stack_experts("up_proj")
             layers["we_down"] = stack_experts("down_proj")
         else:
-            # gpt-oss fused layout: experts.gate_up_proj [E, H, 2F] (+bias),
-            # experts.down_proj [E, F, H]
-            gu, down, gub, db = [], [], [], []
+            # gpt-oss fused layout: experts.gate_up_proj [E, H, 2F] with
+            # gate/up interleaved on the last axis (+ biases [E, 2F]),
+            # experts.down_proj [E, F, H] (+ bias [E, H])
+            gu, down = [], []
             for i in range(L):
                 gu.append(idx.get(f"model.layers.{i}.mlp.experts.gate_up_proj"))
                 down.append(idx.get(f"model.layers.{i}.mlp.experts.down_proj"))
@@ -161,6 +162,27 @@ def load_checkpoint(
             layers["we_gate"] = jnp.asarray(gu_arr[..., 0::2], dtype)
             layers["we_up"] = jnp.asarray(gu_arr[..., 1::2], dtype)
             layers["we_down"] = jnp.asarray(np.stack(down), dtype)
+        if mcfg.moe_bias:
+            rb = maybe_stack(p + "mlp.router.bias")
+            if rb is None:
+                rb = maybe_stack(p + "mlp.gate.bias")
+            if rb is not None:
+                layers["router_b"] = rb
+            gub_probe = "model.layers.0.mlp.experts.gate_up_proj_bias"
+            if gub_probe in idx:
+                gub = np.stack(
+                    [
+                        idx.get(
+                            f"model.layers.{i}.mlp.experts.gate_up_proj_bias"
+                        )
+                        for i in range(L)
+                    ]
+                )  # [L, E, 2F]
+                layers["we_gate_b"] = jnp.asarray(gub[..., 0::2], dtype)
+                layers["we_up_b"] = jnp.asarray(gub[..., 1::2], dtype)
+                layers["we_down_b"] = stack(
+                    p + "mlp.experts.down_proj_bias"
+                )
     else:
         layers["w_gate"] = stack(p + "mlp.gate_proj.weight", transpose=True)
         layers["w_up"] = stack(p + "mlp.up_proj.weight", transpose=True)

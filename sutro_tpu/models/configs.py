@@ -41,6 +41,8 @@ class ModelConfig:
     moe_experts: int = 0
     moe_top_k: int = 0
     moe_intermediate_size: int = 0
+    # gpt-oss: router + per-expert projection biases
+    moe_bias: bool = False
     # Sliding window attention: 0 => full attention everywhere.
     sliding_window: int = 0
     # "none" | "alternate" (gpt-oss: even layers sliding) |
@@ -141,7 +143,8 @@ def _gpt_oss(name: str, h: int, l: int, nh: int, nkv: int,
         moe_experts=experts, moe_top_k=top_k,
         moe_intermediate_size=moe_inter, rope_theta=150_000.0,
         sliding_window=128, sliding_pattern="alternate",
-        attention_sink=True, attn_bias=True, activation="swiglu_oss",
+        attention_sink=True, attn_bias=True, moe_bias=True,
+        activation="swiglu_oss",
         chat_template="chatml",
     )
 
@@ -187,6 +190,7 @@ MODEL_CONFIGS: Dict[str, ModelConfig] = {
         name="tiny-oss", vocab_size=512, hidden_size=128, num_layers=2,
         num_heads=4, num_kv_heads=2, head_dim=32, intermediate_size=256,
         moe_experts=4, moe_top_k=2, moe_intermediate_size=128,
+        moe_bias=True,
         attention_sink=True, sliding_window=8, sliding_pattern="alternate",
         tie_embeddings=False, activation="swiglu_oss", chat_template="plain",
     ),

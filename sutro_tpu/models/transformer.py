@@ -88,6 +88,11 @@ def init_params(
         layers["we_gate"] = dense((L, E, H, Fm), H)
         layers["we_up"] = dense((L, E, H, Fm), H)
         layers["we_down"] = dense((L, E, Fm, H), Fm)
+        if cfg.moe_bias:
+            layers["router_b"] = jnp.zeros((L, E), dtype)
+            layers["we_gate_b"] = jnp.zeros((L, E, Fm), dtype)
+            layers["we_up_b"] = jnp.zeros((L, E, Fm), dtype)
+            layers["we_down_b"] = jnp.zeros((L, E, H), dtype)
     else:
         layers["w_gate"] = dense((L, H, F), H)
         layers["w_up"] = dense((L, H, F), H)
@@ -142,6 +147,10 @@ def _mlp(cfg: ModelConfig, lp: Dict[str, Any], x: jax.Array) -> jax.Array:
             lp["we_down"],
             top_k=cfg.moe_top_k,
             activation=cfg.activation,
+            router_b=lp.get("router_b"),
+            bias_gate=lp.get("we_gate_b"),
+            bias_up=lp.get("we_up_b"),
+            bias_down=lp.get("we_down_b"),
         )
     gate = x @ lp["w_gate"]
     up = x @ lp["w_up"]
@@ -167,8 +176,11 @@ def forward(
     ids: jax.Array,                     # [B, T] int32
     positions: jax.Array,               # [B, T] int32 (global positions)
     valid_len: jax.Array,               # [B] int32 — tokens of chunk that are real
-    past_kv: Optional[Tuple[jax.Array, jax.Array]] = None,
-    # past_kv: (k, v) each [L, B, CTX, KVH, Dh] — pre-gathered from pages
+    paged_past: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
+    # paged_past: (k_pages, v_pages, page_table) — pages [L, NP, PS, KVH,
+    # Dh] scanned per layer, table [B, MP]. Attention reads pages directly
+    # (Pallas) or gathers one layer's view at a time (XLA fallback) — the
+    # full [L, B, CTX, ...] gather is never materialized.
     past_len: Optional[jax.Array] = None,  # [B] int32 — valid past tokens
     use_pallas: bool = False,
 ) -> Tuple[jax.Array, jax.Array, Tuple[jax.Array, jax.Array]]:
@@ -193,18 +205,19 @@ def forward(
         jnp.float32,
     )
 
-    if past_kv is not None:
-        pk, pv = past_kv
-        xs = (params["layers"], windows, thetas, pk, pv)
+    if paged_past is not None:
+        k_pages, v_pages, page_table = paged_past
+        xs = (params["layers"], windows, thetas, k_pages, v_pages)
     else:
+        page_table = None
         xs = (params["layers"], windows, thetas)
 
     def layer_step(h, xs_l):
-        if past_kv is not None:
-            lp, window, theta, pk_l, pv_l = xs_l
+        if paged_past is not None:
+            lp, window, theta, kp_l, vp_l = xs_l
         else:
             lp, window, theta = xs_l
-            pk_l = pv_l = None
+            kp_l = vp_l = None
         resid = h
         x = rms_norm(h, lp["attn_norm"], cfg.norm_eps, cfg.norm_zero_centered)
         q = x @ lp["wq"]
@@ -225,7 +238,8 @@ def forward(
             q, k, v,
             positions=positions,
             valid_len=valid_len,
-            past_k=pk_l, past_v=pv_l, past_len=past_len,
+            past_k_pages=kp_l, past_v_pages=vp_l,
+            page_table=page_table, past_len=past_len,
             window=window, sink=sink,
             use_pallas=use_pallas,
         )

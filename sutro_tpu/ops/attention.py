@@ -37,11 +37,38 @@ def chunk_attention(
     past_k: Optional[jax.Array] = None, # [B, CTX, KVH, Dh]
     past_v: Optional[jax.Array] = None,
     past_len: Optional[jax.Array] = None,  # [B]
+    # paged past (decode): one layer's page pool + table; mutually
+    # exclusive with past_k/past_v. The Pallas paged kernel reads pages in
+    # place; the fallback gathers this layer's contiguous view.
+    past_k_pages: Optional[jax.Array] = None,  # [NP, PS, KVH, Dh]
+    past_v_pages: Optional[jax.Array] = None,
+    page_table: Optional[jax.Array] = None,    # [B, MP] int32
     window: Optional[jax.Array] = None,    # scalar int32; 0 => full attention
     sink: Optional[jax.Array] = None,      # [NH] attention-sink logits
     use_pallas: bool = False,
 ) -> jax.Array:
     """Returns [B, T, NH, Dh]."""
+    B, T = q.shape[:2]
+    if past_k_pages is not None:
+        if use_pallas and T == 1:
+            from .pallas_paged import paged_decode_attention, paged_decode_supported
+
+            if paged_decode_supported(q[:, 0], past_k_pages):
+                win = (
+                    jnp.asarray(0, jnp.int32) if window is None
+                    else jnp.asarray(window, jnp.int32)
+                )
+                out = paged_decode_attention(
+                    q[:, 0], past_k_pages, past_v_pages, page_table,
+                    past_len, k[:, 0], v[:, 0], win, sink,
+                )
+                return out[:, None]
+        from ..engine.kvcache import gather_kv_layer
+
+        past_k, past_v = gather_kv_layer(
+            past_k_pages, past_v_pages, page_table
+        )
+
     if use_pallas:
         from . import pallas_attention as pa
 

@@ -49,6 +49,10 @@ def moe_mlp(
     top_k: int,
     activation: str = "silu",
     method: str = "auto",
+    router_b: "jax.Array | None" = None,   # [E]
+    bias_gate: "jax.Array | None" = None,  # [E, F]  (gpt-oss)
+    bias_up: "jax.Array | None" = None,    # [E, F]
+    bias_down: "jax.Array | None" = None,  # [E, H]
 ) -> jax.Array:
     B, T, H = x.shape
     E = router.shape[-1]
@@ -56,6 +60,8 @@ def moe_mlp(
     xt = x.reshape(N, H)
 
     logits = xt.astype(jnp.float32) @ router.astype(jnp.float32)  # [N, E]
+    if router_b is not None:
+        logits = logits + router_b.astype(jnp.float32)
     top_logits, top_idx = jax.lax.top_k(logits, top_k)            # [N, K]
     probs = jax.nn.softmax(top_logits, axis=-1)                   # [N, K]
 
@@ -67,8 +73,13 @@ def moe_mlp(
         gates = gates.at[jnp.arange(N)[:, None], top_idx].add(probs)
         g = jnp.einsum("nh,ehf->nef", xt, we_gate)
         u = jnp.einsum("nh,ehf->nef", xt, we_up)
+        if bias_gate is not None:
+            g = g + bias_gate[None].astype(g.dtype)
+            u = u + bias_up[None].astype(u.dtype)
         a, u = _act(g, u, activation)
         y = jnp.einsum("nef,efh->neh", a * u, we_down)
+        if bias_down is not None:
+            y = y + bias_down[None].astype(y.dtype)
         out = jnp.einsum("ne,neh->nh", gates.astype(y.dtype), y)
         return out.reshape(B, T, H)
 
@@ -87,8 +98,13 @@ def moe_mlp(
     lhs = xt[sorted_token]                                # [M, H]
     g = jax.lax.ragged_dot(lhs, we_gate, group_sizes)     # [M, F]
     u = jax.lax.ragged_dot(lhs, we_up, group_sizes)
+    if bias_gate is not None:
+        g = g + bias_gate[sorted_expert].astype(g.dtype)
+        u = u + bias_up[sorted_expert].astype(u.dtype)
     a, u = _act(g, u, activation)
     y = jax.lax.ragged_dot(a * u, we_down, group_sizes)   # [M, H]
+    if bias_down is not None:
+        y = y + bias_down[sorted_expert].astype(y.dtype)
     y = y * sorted_prob[:, None].astype(y.dtype)
     out = jnp.zeros((N, H), y.dtype).at[sorted_token].add(y)
     return out.reshape(B, T, H)

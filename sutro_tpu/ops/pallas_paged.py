@@ -1,0 +1,223 @@
+"""Pallas TPU kernel: paged-KV decode attention.
+
+The decode hot loop (SURVEY §7.3 "Paged-KV attention in Pallas"). For each
+decode step the jnp fallback gathers a contiguous ``[B, CTX, KVH, Dh]``
+view of the page pool per layer — a pure HBM copy that dominates decode
+time at long context. This kernel instead reads K/V pages **in place**,
+walking the page table via scalar prefetch, with flash-style online
+softmax across pages:
+
+- grid ``(B, KVH, MP)``: batch and kv-head are parallel; the page axis is
+  sequential and carries running ``(m, l, acc)`` in VMEM scratch;
+- page blocks are addressed by ``page_table[b, ki]`` in the BlockSpec
+  index_map (scalar-prefetch — the DMA for page ``ki+1`` overlaps the
+  compute on page ``ki``);
+- pages at or beyond ``past_len[b]`` are skipped entirely (``pl.when``), so
+  work is proportional to actual context, not table capacity;
+- the current token's K/V (not yet in the page pool) and the optional
+  gpt-oss attention sink join the softmax in the finalization step;
+- per-layer sliding windows (Gemma3 / gpt-oss) are dynamic operands, so one
+  compiled kernel serves every layer of the ``lax.scan``.
+
+GQA is expressed by blocking q as ``[B, KVH, G, Dh]``; scores are
+``[G, PS]`` per grid step. All math is float32.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _paged_decode_kernel(
+    # scalar prefetch
+    page_table_ref,   # [B * MP] int32 (flattened)
+    past_len_ref,     # [B] int32
+    window_ref,       # [1] int32 (0 = full attention)
+    # operands
+    q_ref,            # [1, 1, G, Dh]
+    k_page_ref,       # [1, PS, 1, Dh]
+    v_page_ref,       # [1, PS, 1, Dh]
+    k_cur_ref,        # [1, 1, Dh]
+    v_cur_ref,        # [1, 1, Dh]
+    sink_ref,         # [1, G]
+    # output
+    out_ref,          # [1, 1, G, Dh]
+    # scratch
+    m_ref,            # [G, 128] f32
+    l_ref,            # [G, 128] f32
+    acc_ref,          # [G, Dh] f32
+    *,
+    num_pages_per_seq: int,
+    page_size: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    ki = pl.program_id(2)
+    PS = page_size
+    G, Dh = q_ref.shape[2], q_ref.shape[3]
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    past = past_len_ref[b]
+    pos = past  # current token's global position
+    win = window_ref[0]
+    page_start = ki * PS
+
+    @pl.when(page_start < past)
+    def _accumulate():
+        q = q_ref[0, 0].astype(jnp.float32)           # [G, Dh]
+        k = k_page_ref[0, :, 0].astype(jnp.float32)   # [PS, Dh]
+        v = v_page_ref[0, :, 0].astype(jnp.float32)   # [PS, Dh]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                     # [G, PS]
+        tok = page_start + jax.lax.broadcasted_iota(jnp.int32, (G, PS), 1)
+        ok = tok < past
+        ok = jnp.logical_and(
+            ok, jnp.where(win > 0, pos - tok < win, True)
+        )
+        s = jnp.where(ok, s, NEG_INF)
+
+        m_prev = m_ref[:, 0]                          # [G]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_prev - m_new)               # [G]
+        p = jnp.exp(s - m_new[:, None])               # [G, PS]
+        l_new = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)  # [G]
+        l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+
+    @pl.when(ki == num_pages_per_seq - 1)
+    def _finalize():
+        q = q_ref[0, 0].astype(jnp.float32)           # [G, Dh]
+        k_cur = k_cur_ref[0, 0].astype(jnp.float32)   # [Dh]
+        v_cur = v_cur_ref[0, 0].astype(jnp.float32)   # [Dh]
+        sink = sink_ref[0].astype(jnp.float32)        # [G]
+
+        s_self = jnp.sum(q * k_cur[None, :], axis=1) * scale  # [G]
+        m_prev = m_ref[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.maximum(s_self, sink))
+        alpha = jnp.exp(m_prev - m_new)
+        p_self = jnp.exp(s_self - m_new)
+        p_sink = jnp.exp(sink - m_new)
+        l = l_ref[:, 0] * alpha + p_self + p_sink
+        acc = acc_ref[...] * alpha[:, None] + p_self[:, None] * v_cur[None, :]
+        out = acc / jnp.maximum(l, 1e-30)[:, None]
+        out_ref[0, 0] = out.astype(out_ref.dtype)
+
+
+def paged_decode_supported(
+    q: jax.Array, k_pages: jax.Array
+) -> bool:
+    """Shape gate for the compiled TPU path (interpret mode has no such
+    constraints — tests call paged_decode_attention(interpret=True))."""
+    Dh = q.shape[-1]
+    PS = k_pages.shape[1]
+    return Dh % 128 == 0 and PS % 8 == 0
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("interpret",),
+)
+def paged_decode_attention(
+    q: jax.Array,          # [B, NH, Dh] — current-step queries
+    k_pages: jax.Array,    # [NP, PS, KVH, Dh] — one layer's page pool
+    v_pages: jax.Array,
+    page_table: jax.Array, # [B, MP] int32
+    past_len: jax.Array,   # [B] int32 — tokens already in the cache
+    k_cur: jax.Array,      # [B, KVH, Dh] — current token K (post-RoPE)
+    v_cur: jax.Array,
+    window: jax.Array,     # scalar int32; 0 => full attention
+    sink: Optional[jax.Array] = None,   # [NH] logits or None
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Returns [B, NH, Dh] attention outputs for one decode step."""
+    B, NH, Dh = q.shape
+    NP, PS, KVH, _ = k_pages.shape
+    MP = page_table.shape[1]
+    G = NH // KVH
+    scale = Dh ** -0.5
+
+    qg = q.reshape(B, KVH, G, Dh)
+    if sink is None:
+        sink_g = jnp.full((KVH, G), NEG_INF, jnp.float32)
+    else:
+        sink_g = sink.astype(jnp.float32).reshape(KVH, G)
+
+    kernel = functools.partial(
+        _paged_decode_kernel,
+        num_pages_per_seq=MP,
+        page_size=PS,
+        scale=scale,
+    )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, KVH, MP),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, G, Dh), lambda b, h, ki, pt, pls, win: (b, h, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, PS, 1, Dh),
+                lambda b, h, ki, pt, pls, win: (pt[b * MP + ki], 0, h, 0),
+            ),
+            pl.BlockSpec(
+                (1, PS, 1, Dh),
+                lambda b, h, ki, pt, pls, win: (pt[b * MP + ki], 0, h, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, Dh), lambda b, h, ki, pt, pls, win: (b, h, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, Dh), lambda b, h, ki, pt, pls, win: (b, h, 0)
+            ),
+            pl.BlockSpec((1, G), lambda b, h, ki, pt, pls, win: (h, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, G, Dh), lambda b, h, ki, pt, pls, win: (b, h, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((G, 128), jnp.float32),
+            pltpu.VMEM((G, 128), jnp.float32),
+            pltpu.VMEM((G, Dh), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KVH, G, Dh), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(
+        page_table.reshape(-1).astype(jnp.int32),
+        past_len.astype(jnp.int32),
+        jnp.asarray(window, jnp.int32).reshape(1),
+        qg,
+        k_pages,
+        v_pages,
+        k_cur,
+        v_cur,
+        sink_g,
+    )
+    return out.reshape(B, NH, Dh)

@@ -53,11 +53,12 @@ def sample(
     # over 150k lanes). Filtered rows instead use the top NUCLEUS_CAP
     # logits — the nucleus/top-k filters only ever *keep* a head of the
     # distribution — normalized against the exact full-vocab logsumexp, so
-    # probabilities are exact. Rows with filtering disabled (top_k<=0 or
-    # >cap, and top_p>=1) sample the FULL vocabulary via gumbel-argmax
+    # probabilities are exact. Rows with filtering disabled (top_k==0 and
+    # top_p>=1) sample the FULL vocabulary via gumbel-argmax
     # (== categorical, no sort), honoring the "0 disables" contract.
-    # Remaining approximation: a *nucleus* wider than NUCLEUS_CAP tokens
-    # (near-uniform distributions with top_p<1) truncates to the cap.
+    # Remaining approximations: top_k above the cap clamps to the cap-wide
+    # head; a *nucleus* wider than NUCLEUS_CAP tokens (near-uniform
+    # distributions with top_p<1) truncates to the cap.
     K = min(NUCLEUS_CAP, V)
     top_vals, top_idx = jax.lax.top_k(scaled, K)      # [B, K], descending
     greedy_tok = top_idx[:, 0]
@@ -66,8 +67,10 @@ def sample(
     probs = jnp.exp(top_vals - lse)                   # exact probabilities
 
     ranks = jnp.arange(K, dtype=jnp.int32)[None, :]
-    k_active = (top_k > 0) & (top_k <= K)
-    k_eff = jnp.where(k_active, top_k, K)[:, None]
+    k_active = top_k > 0
+    # top_k beyond the cap is clamped to the cap-wide head (closest
+    # realizable restriction), never silently disabled
+    k_eff = jnp.where(k_active, jnp.minimum(top_k, K), K)[:, None]
     keep_k = ranks < k_eff
     cum = jnp.cumsum(probs, axis=-1)
     keep_p = (cum - probs) < top_p[:, None]           # always keeps rank-0

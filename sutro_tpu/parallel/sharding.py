@@ -48,9 +48,13 @@ _LAYER_RULES: Dict[str, P] = {
     "w_up": P(None, None, "model"),
     "w_down": P(None, "model", None),    # [L, F, H]
     "router": P(),                       # [L, H, E]
+    "router_b": P(),                     # [L, E]
     "we_gate": P(None, "expert", None, "model"),  # [L, E, H, F]
     "we_up": P(None, "expert", None, "model"),
     "we_down": P(None, "expert", "model", None),  # [L, E, F, H]
+    "we_gate_b": P(None, "expert", "model"),      # [L, E, F]
+    "we_up_b": P(None, "expert", "model"),
+    "we_down_b": P(None, "expert", None),         # [L, E, H]
 }
 
 _TOP_RULES: Dict[str, P] = {

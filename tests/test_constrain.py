@@ -183,8 +183,62 @@ def test_cpp_python_mask_parity():
     py._cpp = None
     states = nfa.initial()
     for ch in '{"s":"x\\n","v":-1.5e2,"e":"ab"}'.encode():
-        pm = py._compute(states)
-        cm = cpp.mask(states)
+        pm, pd = py._compute(states)
+        cm, cd = cpp.mask(states)
         np.testing.assert_array_equal(pm, cm)
+        np.testing.assert_array_equal(pd, cd)
         states = nfa.step(states, ch)
         assert states
+
+
+def test_budget_aware_closure_always_completes():
+    """With a token budget too small for free-running string content, the
+    FSM must steer to closing bytes so the emitted JSON is complete
+    (verify-session regression: mid-string cuts at the length cap)."""
+    import json
+
+    from sutro_tpu.engine.constrain.fsm import schema_constraint_factory
+
+    tok = ByteTokenizer()
+    schema = {
+        "type": "object",
+        "properties": {"label": {"type": "string"}},
+        "required": ["label"],
+    }
+    nested = {
+        "type": "array",
+        "items": {
+            "type": "object",
+            "properties": {"label": {"type": "string"}},
+            "required": ["label"],
+        },
+    }
+    rng = np.random.default_rng(0)
+    for sch, check in (
+        (schema, lambda o: "label" in o),
+        (nested, lambda o: isinstance(o, list)),
+    ):
+        factory = schema_constraint_factory(sch, tok)
+        for budget in (14, 20, 40):
+            fsm = factory()
+            out = bytearray()
+            remaining = budget
+            while remaining > 0 and not fsm.is_complete():
+                mask = fsm.allowed_tokens(remaining=remaining)
+                ids = np.nonzero(mask)[0]
+                assert len(ids), "mask must never be empty"
+                # adversarial: pick a random allowed token (worst-case model)
+                tid = int(rng.choice(ids))
+                fsm.advance(tid)
+                out.extend(tok.token_bytes(tid))
+                remaining -= 1
+            obj = json.loads(out.decode("utf-8", errors="strict"))
+            assert check(obj), (sch, budget, out)
+
+
+def test_distance_to_accept():
+    from sutro_tpu.engine.constrain.schema import compile_schema as cs
+
+    nfa = cs({"enum": ["ab"]})  # JSON: "ab" -> 4 bytes: " a b "
+    d0 = nfa.dist_to_accept(nfa.initial())
+    assert d0 == 4

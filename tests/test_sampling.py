@@ -79,3 +79,29 @@ def test_cumulative_logprob_matches_softmax():
         / np.exp(np.asarray(logits)).sum(-1, keepdims=True)
     )
     np.testing.assert_allclose(lp, ref[[0, 1], [3, 0]], rtol=1e-4, atol=1e-6)
+
+
+def test_top_k_above_cap_clamps_not_disables():
+    """top_k > NUCLEUS_CAP must clamp to the cap-wide head, not fall back
+    to full-vocab sampling (code-review regression)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sutro_tpu.ops.sampling import NUCLEUS_CAP, sample
+
+    V = NUCLEUS_CAP * 4
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.standard_normal((1, V)), jnp.float32)
+    head = set(
+        np.asarray(jax.lax.top_k(logits, NUCLEUS_CAP)[1][0]).tolist()
+    )
+    for i in range(20):
+        tok = sample(
+            logits,
+            jax.random.PRNGKey(i),
+            temperature=jnp.float32(5.0),  # near-uniform: tail very likely
+            top_p=jnp.float32(1.0),
+            top_k=jnp.int32(V),  # "keep everything" — clamps to cap
+        )
+        assert int(tok[0]) in head

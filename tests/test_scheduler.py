@@ -96,3 +96,47 @@ def test_pages_released(tiny_runner, byte_tok):
     free0 = b.allocator.free_count
     run_all(b, make_requests(byte_tok, ["p1", "p2", "p3"], max_new_tokens=5))
     assert b.allocator.free_count == free0
+
+
+def test_constraint_mask_smaller_than_model_vocab(tiny_ecfg, byte_tok):
+    """Tokenizer vocab < padded model vocab: masks must pad with False
+    (code-review regression — real HF checkpoints pad the embedding)."""
+    import numpy as np
+
+    from sutro_tpu.engine.runner import ModelRunner
+    from sutro_tpu.engine.scheduler import ContinuousBatcher, GenRequest
+    from sutro_tpu.models.configs import MODEL_CONFIGS
+
+    cfg = MODEL_CONFIGS["tiny-dense"]
+    short = cfg.vocab_size - 100  # pretend tokenizer is 100 ids short
+
+    class HeadOnly:
+        """Allows only token id 7, mask sized to the short vocab."""
+
+        def allowed_tokens(self):
+            m = np.zeros((short,), bool)
+            m[7] = True
+            return m
+
+        def advance(self, tok):
+            pass
+
+        def is_complete(self):
+            return False
+
+    b = ContinuousBatcher(
+        ModelRunner(cfg, tiny_ecfg), stop_ids=byte_tok.stop_ids()
+    )
+    res = {}
+    b.run(
+        [
+            GenRequest(
+                row_id=0,
+                prompt_ids=np.array(byte_tok.encode("x"), np.int32),
+                max_new_tokens=4,
+                constraint=HeadOnly(),
+            )
+        ],
+        on_result=lambda r: res.__setitem__(r.row_id, r),
+    )
+    assert all(t == 7 for t in res[0].token_ids)
