@@ -22,11 +22,14 @@ class EngineConfig:
     # claimed by ep, remainder folds into dp). Defaults are explicit
     # single-device: TP/EP need model-divisibility knowledge, so spreading
     # over all chips is an explicit choice (engine.json or kwargs), not a
-    # surprise. Axes: ("data", "expert", "model") — DP over DCN/outer, EP
-    # and TP over ICI (SURVEY §5.8).
+    # surprise. Axes: ("data", "seq", "expert", "model") — DP over
+    # DCN/outer, SP/EP/TP over ICI (SURVEY §5.8). ``sp`` > 1 enables
+    # ring-attention sequence parallelism for long-prompt prefill
+    # (ops/ring_attention.py).
     dp: int = 1
     tp: int = 1
     ep: int = 1
+    sp: int = 1
     # --- dtype policy ------------------------------------------------------
     activation_dtype: str = "bfloat16"
     param_dtype: str = "bfloat16"
@@ -48,18 +51,20 @@ class EngineConfig:
     weights_dir: Optional[str] = None   # local HF-style checkpoint root
     seed: int = 0
 
-    def resolved_mesh(self, n_devices: int) -> Tuple[int, int, int]:
-        """Resolve (dp, ep, tp) against the actual device count: tp gets
-        what's specified (default: all devices not claimed by ep), remaining
-        devices fold into dp."""
+    def resolved_mesh(self, n_devices: int) -> Tuple[int, int, int, int]:
+        """Resolve (dp, sp, ep, tp) against the actual device count: tp
+        gets what's specified (default: all devices not claimed by ep/sp),
+        remaining devices fold into dp."""
+        sp = self.sp or 1
         ep = self.ep or 1
-        tp = self.tp or max(1, n_devices // ep)
-        dp = self.dp or max(1, n_devices // (tp * ep))
-        if dp * ep * tp > n_devices:
+        tp = self.tp or max(1, n_devices // (ep * sp))
+        dp = self.dp or max(1, n_devices // (tp * ep * sp))
+        if dp * sp * ep * tp > n_devices:
             raise ValueError(
-                f"Mesh dp*ep*tp={dp * ep * tp} exceeds {n_devices} devices"
+                f"Mesh dp*sp*ep*tp={dp * sp * ep * tp} exceeds "
+                f"{n_devices} devices"
             )
-        return dp, ep, tp
+        return dp, sp, ep, tp
 
     def max_context(self) -> int:
         return min(self.max_model_len, self.kv_page_size * self.max_pages_per_seq)

@@ -62,10 +62,13 @@ class ModelRunner:
         if mesh is None:
             from ..parallel.mesh import auto_mesh
 
-            dp, ep, tp = ecfg.resolved_mesh(jax.device_count())
-            if dp * ep * tp > 1:
+            dp, sp, ep, tp = ecfg.resolved_mesh(jax.device_count())
+            if dp * sp * ep * tp > 1:
                 mesh = auto_mesh(ecfg)
         self.mesh = mesh
+        # ring-attention sequence parallelism for prefill when the mesh
+        # carries a non-trivial "seq" axis (SURVEY §5.7 TPU plan)
+        self.sp = int(mesh.shape.get("seq", 1)) if mesh is not None else 1
         if mesh is not None:
             from ..parallel.sharding import param_shardings, cache_shardings
 
@@ -108,6 +111,7 @@ class ModelRunner:
         logits, hidden, (k, v) = transformer.forward(
             self.mcfg, params, ids, positions, valid_len,
             use_pallas=self.use_pallas,
+            ring_mesh=self.mesh if self.sp > 1 else None,
         )
         cache = write_kv(
             cache, k, v, page_table, start, valid_len,
@@ -126,6 +130,8 @@ class ModelRunner:
         is the slot's [MP] row."""
         n = len(token_ids)
         T = next_bucket(max(n, 1), lo=16, hi=self.ecfg.max_context())
+        if T % self.sp:  # ring prefill shards T over the seq axis
+            T = -(-T // self.sp) * self.sp
         ids = np.zeros((1, T), np.int32)
         ids[0, :n] = token_ids
         logits, self.cache = self._prefill_jit(

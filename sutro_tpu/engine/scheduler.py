@@ -24,6 +24,7 @@ remote service keeps behind ``POST /batch-inference`` (SURVEY §2.3 row 1,
 from __future__ import annotations
 
 import dataclasses
+import inspect
 import time
 from typing import Any, Callable, Dict, List, Optional, Protocol
 
@@ -56,6 +57,10 @@ class TokenConstraint(Protocol):
 
     def is_complete(self) -> bool:
         ...
+
+
+# per-constraint-class cache: does allowed_tokens accept ``remaining``?
+_TAKES_BUDGET: Dict[type, bool] = {}
 
 
 @dataclasses.dataclass
@@ -166,10 +171,33 @@ class ContinuousBatcher:
         return out
 
     def _constraint_mask(self, c: TokenConstraint, remaining: int) -> np.ndarray:
-        try:
-            m = c.allowed_tokens(remaining=remaining)
-        except TypeError:  # simple constraints without budget support
-            m = c.allowed_tokens()
+        # Probe the signature once per constraint type: a TypeError raised
+        # *inside* a budget-aware allowed_tokens must propagate, not
+        # silently disable budget enforcement.
+        cls = type(c)
+        takes_budget = _TAKES_BUDGET.get(cls)
+        if takes_budget is None:
+            try:
+                # bound attribute, so instance-attribute implementations
+                # of the protocol probe correctly too
+                sig = inspect.signature(c.allowed_tokens)
+                kw_ok = (
+                    inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                    inspect.Parameter.KEYWORD_ONLY,
+                )
+                takes_budget = any(
+                    (p.name == "remaining" and p.kind in kw_ok)
+                    or p.kind == inspect.Parameter.VAR_KEYWORD
+                    for p in sig.parameters.values()
+                )
+            except Exception:
+                takes_budget = False
+            _TAKES_BUDGET[cls] = takes_budget
+        m = (
+            c.allowed_tokens(remaining=remaining)
+            if takes_budget
+            else c.allowed_tokens()
+        )
         return self._pad_mask(m)
 
     def _remaining(self, req: GenRequest, emitted: int, pos: int) -> int:

@@ -183,6 +183,7 @@ def forward(
     # full [L, B, CTX, ...] gather is never materialized.
     past_len: Optional[jax.Array] = None,  # [B] int32 — valid past tokens
     use_pallas: bool = False,
+    ring_mesh=None,  # Mesh with "seq" axis > 1 => ring-attention prefill
 ) -> Tuple[jax.Array, jax.Array, Tuple[jax.Array, jax.Array]]:
     """Run the trunk over a chunk.
 
@@ -242,6 +243,7 @@ def forward(
             page_table=page_table, past_len=past_len,
             window=window, sink=sink,
             use_pallas=use_pallas,
+            ring_mesh=ring_mesh,
         )
         attn = attn.reshape(B, T, cfg.q_size) @ lp["wo"]
         if cfg.attn_bias:

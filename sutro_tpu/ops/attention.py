@@ -46,9 +46,24 @@ def chunk_attention(
     window: Optional[jax.Array] = None,    # scalar int32; 0 => full attention
     sink: Optional[jax.Array] = None,      # [NH] attention-sink logits
     use_pallas: bool = False,
+    ring_mesh=None,                        # Mesh with a >1 "seq" axis =>
+                                           # sequence-parallel ring prefill
 ) -> jax.Array:
     """Returns [B, T, NH, Dh]."""
     B, T = q.shape[:2]
+    if (
+        ring_mesh is not None
+        and past_k is None
+        and past_k_pages is None
+        and T > 1
+    ):
+        from .ring_attention import ring_self_attention
+
+        return ring_self_attention(
+            ring_mesh, q, k, v,
+            positions=positions, valid_len=valid_len,
+            window=window, sink=sink,
+        )
     if past_k_pages is not None:
         if use_pallas and T == 1:
             from .pallas_paged import paged_decode_attention, paged_decode_supported

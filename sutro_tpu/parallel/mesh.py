@@ -2,11 +2,14 @@
 
 The reference has no distributed layer at all (its transport is HTTPS,
 SURVEY §5.8); this is the TPU-native equivalent: a ``jax.sharding.Mesh``
-with axes ``("data", "expert", "model")``:
+with axes ``("data", "seq", "expert", "model")``:
 
 - ``model`` (TP) — innermost, so tensor-parallel collectives (all-reduce /
   all-gather of activations) ride the fastest ICI links;
 - ``expert`` (EP) — MoE all-to-all token routing;
+- ``seq`` (SP) — ring-attention sequence/context parallelism for long
+  prompts (ops/ring_attention.py): K/V chunks rotate around the ring via
+  ``ppermute`` while each device keeps its query chunk resident;
 - ``data`` (DP) — outermost; across pod slices this maps to DCN, which only
   ever carries embarrassingly-parallel row shards.
 
@@ -24,7 +27,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("data", "expert", "model")
+AXES = ("data", "seq", "expert", "model")
 
 
 def init_distributed() -> None:
@@ -41,23 +44,26 @@ def make_mesh(
     ep: int = 1,
     tp: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
+    *,
+    sp: int = 1,
 ) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
-    need = dp * ep * tp
+    need = dp * sp * ep * tp
     if need > len(devices):
         raise ValueError(
-            f"Mesh dp*ep*tp={need} exceeds available devices {len(devices)}"
+            f"Mesh dp*sp*ep*tp={need} exceeds available devices "
+            f"{len(devices)}"
         )
-    grid = np.array(devices[:need]).reshape(dp, ep, tp)
+    grid = np.array(devices[:need]).reshape(dp, sp, ep, tp)
     return Mesh(grid, AXES)
 
 
 def auto_mesh(ecfg, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Resolve the engine config against the actual device count."""
     devices = list(devices if devices is not None else jax.devices())
-    dp, ep, tp = ecfg.resolved_mesh(len(devices))
-    return make_mesh(dp, ep, tp, devices)
+    dp, sp, ep, tp = ecfg.resolved_mesh(len(devices))
+    return make_mesh(dp, ep, tp, devices, sp=sp)
 
 
-def mesh_shape(mesh: Mesh) -> Tuple[int, int, int]:
+def mesh_shape(mesh: Mesh) -> Tuple[int, int, int, int]:
     return tuple(mesh.shape[a] for a in AXES)  # type: ignore[return-value]

@@ -32,9 +32,11 @@ def _ecfg(**kw):
 
 def test_mesh_construction(eight_devices):
     mesh = make_mesh(2, 2, 2, eight_devices)
-    assert mesh_shape(mesh) == (2, 2, 2)
+    assert mesh_shape(mesh) == (2, 1, 2, 2)
     with pytest.raises(ValueError, match="exceeds"):
         make_mesh(4, 4, 4, eight_devices)
+    with pytest.raises(ValueError, match="exceeds"):
+        make_mesh(2, 2, 2, eight_devices, sp=2)
 
 
 def test_param_shardings_cover_all_leaves(eight_devices):
