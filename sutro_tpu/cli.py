@@ -310,8 +310,8 @@ def engine_info() -> None:
     ecfg = load_engine_config()
     click.echo(f"backend: {jax.default_backend()}")
     click.echo(f"devices: {[str(d) for d in devices]}")
-    dp, sp, ep, tp = ecfg.resolved_mesh(len(devices))
-    click.echo(f"mesh: dp={dp} sp={sp} ep={ep} tp={tp}")
+    dp, pp, sp, ep, tp = ecfg.resolved_mesh(len(devices))
+    click.echo(f"mesh: dp={dp} pp={pp} sp={sp} ep={ep} tp={tp}")
     click.echo(
         f"kv: page_size={ecfg.kv_page_size} max_pages_per_seq="
         f"{ecfg.max_pages_per_seq} decode_batch={ecfg.decode_batch_size}"

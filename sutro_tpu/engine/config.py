@@ -30,6 +30,8 @@ class EngineConfig:
     tp: int = 1
     ep: int = 1
     sp: int = 1
+    pp: int = 1                     # pipeline stages (parallel/pipeline.py)
+    pp_microbatches: int = 0        # 0 => min(pp, batch)
     # --- dtype policy ------------------------------------------------------
     activation_dtype: str = "bfloat16"
     param_dtype: str = "bfloat16"
@@ -51,20 +53,23 @@ class EngineConfig:
     weights_dir: Optional[str] = None   # local HF-style checkpoint root
     seed: int = 0
 
-    def resolved_mesh(self, n_devices: int) -> Tuple[int, int, int, int]:
-        """Resolve (dp, sp, ep, tp) against the actual device count: tp
-        gets what's specified (default: all devices not claimed by ep/sp),
-        remaining devices fold into dp."""
+    def resolved_mesh(
+        self, n_devices: int
+    ) -> Tuple[int, int, int, int, int]:
+        """Resolve (dp, pp, sp, ep, tp) against the actual device count:
+        tp gets what's specified (default: all devices not claimed by
+        ep/sp/pp), remaining devices fold into dp."""
+        pp = self.pp or 1
         sp = self.sp or 1
         ep = self.ep or 1
-        tp = self.tp or max(1, n_devices // (ep * sp))
-        dp = self.dp or max(1, n_devices // (tp * ep * sp))
-        if dp * sp * ep * tp > n_devices:
+        tp = self.tp or max(1, n_devices // (ep * sp * pp))
+        dp = self.dp or max(1, n_devices // (tp * ep * sp * pp))
+        if dp * pp * sp * ep * tp > n_devices:
             raise ValueError(
-                f"Mesh dp*sp*ep*tp={dp * sp * ep * tp} exceeds "
+                f"Mesh dp*pp*sp*ep*tp={dp * pp * sp * ep * tp} exceeds "
                 f"{n_devices} devices"
             )
-        return dp, sp, ep, tp
+        return dp, pp, sp, ep, tp
 
     def max_context(self) -> int:
         return min(self.max_model_len, self.kv_page_size * self.max_pages_per_seq)

@@ -62,20 +62,32 @@ class ModelRunner:
         if mesh is None:
             from ..parallel.mesh import auto_mesh
 
-            dp, sp, ep, tp = ecfg.resolved_mesh(jax.device_count())
-            if dp * sp * ep * tp > 1:
+            dp, pp, sp, ep, tp = ecfg.resolved_mesh(jax.device_count())
+            if dp * pp * sp * ep * tp > 1:
                 mesh = auto_mesh(ecfg)
         self.mesh = mesh
         # ring-attention sequence parallelism for prefill when the mesh
         # carries a non-trivial "seq" axis (SURVEY §5.7 TPU plan)
         self.sp = int(mesh.shape.get("seq", 1)) if mesh is not None else 1
+        # GPipe pipeline stages when the mesh carries a "pipe" axis
+        self.pp = int(mesh.shape.get("pipe", 1)) if mesh is not None else 1
         if mesh is not None:
             from ..parallel.sharding import param_shardings, cache_shardings
 
             if shardings is None:
-                shardings = param_shardings(params, mesh)
+                if self.pp > 1:
+                    from ..parallel.pipeline import pp_param_shardings
+
+                    shardings = pp_param_shardings(params, mesh)
+                else:
+                    shardings = param_shardings(params, mesh)
             params = jax.device_put(params, shardings)
-            self._cache_sharding = cache_shardings(mesh)
+            if self.pp > 1:
+                from ..parallel.pipeline import pp_cache_sharding
+
+                self._cache_sharding = pp_cache_sharding(mesh)
+            else:
+                self._cache_sharding = cache_shardings(mesh)
         else:
             self._cache_sharding = None
         self.params = params
@@ -108,11 +120,22 @@ class ModelRunner:
     ):
         B, T = ids.shape
         positions = start[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
-        logits, hidden, (k, v) = transformer.forward(
-            self.mcfg, params, ids, positions, valid_len,
-            use_pallas=self.use_pallas,
-            ring_mesh=self.mesh if self.sp > 1 else None,
-        )
+        if self.pp > 1:
+            from ..parallel.pipeline import pipeline_forward
+
+            logits, hidden, (k, v) = pipeline_forward(
+                self.mcfg, params, ids, positions, valid_len, self.mesh,
+                n_microbatches=min(
+                    self.ecfg.pp_microbatches or self.pp, B
+                ),
+                use_pallas=self.use_pallas,
+            )
+        else:
+            logits, hidden, (k, v) = transformer.forward(
+                self.mcfg, params, ids, positions, valid_len,
+                use_pallas=self.use_pallas,
+                ring_mesh=self.mesh if self.sp > 1 else None,
+            )
         cache = write_kv(
             cache, k, v, page_table, start, valid_len,
             use_pallas=self.use_pallas,

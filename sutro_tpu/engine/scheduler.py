@@ -26,7 +26,7 @@ from __future__ import annotations
 import dataclasses
 import inspect
 import time
-from typing import Any, Callable, Dict, List, Optional, Protocol
+from typing import Any, Callable, Dict, List, Optional, Protocol, Tuple
 
 import jax
 import numpy as np
@@ -59,8 +59,11 @@ class TokenConstraint(Protocol):
         ...
 
 
-# per-constraint-class cache: does allowed_tokens accept ``remaining``?
-_TAKES_BUDGET: Dict[type, bool] = {}
+# per-callable cache: does this allowed_tokens accept ``remaining``?
+# Keyed by the underlying function object (not the class) so
+# instance-attribute implementations of the protocol probe independently;
+# the value keeps a strong ref to the function so its id can't be reused.
+_TAKES_BUDGET: Dict[int, Tuple[Any, bool]] = {}
 
 
 @dataclasses.dataclass
@@ -171,16 +174,17 @@ class ContinuousBatcher:
         return out
 
     def _constraint_mask(self, c: TokenConstraint, remaining: int) -> np.ndarray:
-        # Probe the signature once per constraint type: a TypeError raised
+        # Probe the signature once per implementation: a TypeError raised
         # *inside* a budget-aware allowed_tokens must propagate, not
         # silently disable budget enforcement.
-        cls = type(c)
-        takes_budget = _TAKES_BUDGET.get(cls)
-        if takes_budget is None:
+        fn = getattr(c.allowed_tokens, "__func__", c.allowed_tokens)
+        key = id(fn)
+        cached = _TAKES_BUDGET.get(key)
+        if cached is not None:
+            takes_budget = cached[1]
+        else:
             try:
-                # bound attribute, so instance-attribute implementations
-                # of the protocol probe correctly too
-                sig = inspect.signature(c.allowed_tokens)
+                sig = inspect.signature(fn)
                 kw_ok = (
                     inspect.Parameter.POSITIONAL_OR_KEYWORD,
                     inspect.Parameter.KEYWORD_ONLY,
@@ -192,7 +196,7 @@ class ContinuousBatcher:
                 )
             except Exception:
                 takes_budget = False
-            _TAKES_BUDGET[cls] = takes_budget
+            _TAKES_BUDGET[key] = (fn, takes_budget)
         m = (
             c.allowed_tokens(remaining=remaining)
             if takes_budget

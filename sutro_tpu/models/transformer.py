@@ -165,6 +165,116 @@ def _mlp(cfg: ModelConfig, lp: Dict[str, Any], x: jax.Array) -> jax.Array:
     return (act * up) @ lp["w_down"]
 
 
+def layer_apply(
+    cfg: ModelConfig,
+    lp: Dict[str, Any],          # one layer's params (leaves without L axis)
+    h: jax.Array,                # [B, T, H]
+    *,
+    positions: jax.Array,        # [B, T]
+    valid_len: jax.Array,        # [B]
+    window: jax.Array,           # scalar int32
+    theta: jax.Array,            # scalar fp32 RoPE base
+    kp_l: Optional[jax.Array] = None,   # this layer's K page pool
+    vp_l: Optional[jax.Array] = None,
+    page_table: Optional[jax.Array] = None,
+    past_len: Optional[jax.Array] = None,
+    use_pallas: bool = False,
+    ring_mesh=None,
+) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
+    """One decoder block. Shared by the scanned ``forward`` and the
+    pipeline-parallel stage loop (parallel/pipeline.py). Returns
+    ``(h, (k_chunk, v_chunk))``."""
+    B, T = h.shape[:2]
+    resid = h
+    x = rms_norm(h, lp["attn_norm"], cfg.norm_eps, cfg.norm_zero_centered)
+    q = x @ lp["wq"]
+    k = x @ lp["wk"]
+    v = x @ lp["wv"]
+    if cfg.attn_bias:
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    q = q.reshape(B, T, cfg.num_heads, cfg.head_dim)
+    k = k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    if cfg.qk_norm:
+        q = rms_norm(q, lp["q_norm"], cfg.norm_eps, cfg.norm_zero_centered)
+        k = rms_norm(k, lp["k_norm"], cfg.norm_eps, cfg.norm_zero_centered)
+    q = apply_rope(q, positions, theta)
+    k = apply_rope(k, positions, theta)
+    sink = lp.get("sink") if cfg.attention_sink else None
+    attn = chunk_attention(
+        q, k, v,
+        positions=positions,
+        valid_len=valid_len,
+        past_k_pages=kp_l, past_v_pages=vp_l,
+        page_table=page_table, past_len=past_len,
+        window=window, sink=sink,
+        use_pallas=use_pallas,
+        ring_mesh=ring_mesh,
+    )
+    attn = attn.reshape(B, T, cfg.q_size) @ lp["wo"]
+    if cfg.attn_bias:
+        attn = attn + lp["bo"]
+    if cfg.post_norms:
+        attn = rms_norm(
+            attn, lp["post_attn_norm"], cfg.norm_eps, cfg.norm_zero_centered
+        )
+    h = resid + attn
+    resid = h
+    x = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, cfg.norm_zero_centered)
+    x = _mlp(cfg, lp, x)
+    if cfg.post_norms:
+        x = rms_norm(
+            x, lp["post_mlp_norm"], cfg.norm_eps, cfg.norm_zero_centered
+        )
+    h = resid + x
+    return h, (k, v)
+
+
+def rope_thetas(cfg: ModelConfig) -> jax.Array:
+    """Per-layer RoPE base frequencies [L] (local layers may differ)."""
+    return jnp.asarray(
+        [
+            (
+                cfg.local_rope_theta
+                if (w > 0 and cfg.local_rope_theta)
+                else cfg.rope_theta
+            )
+            for w in cfg.window_array()
+        ],
+        jnp.float32,
+    )
+
+
+def embed_tokens(cfg: ModelConfig, params: Params, ids: jax.Array) -> jax.Array:
+    h = params["embed"][ids]  # [B, T, H] gather
+    if cfg.embed_scale:
+        h = (h.astype(jnp.float32) * (cfg.hidden_size ** 0.5)).astype(h.dtype)
+    return h
+
+
+def head_apply(
+    cfg: ModelConfig, params: Params, h: jax.Array, valid_len: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """final norm + lm/embedding head. h: [B, T, H].
+
+    Returns ``(out, h_normed)`` — the head output plus the post-final-norm
+    hidden states (the ``hidden`` of the forward contract)."""
+    T = h.shape[1]
+    h = rms_norm(h, params["final_norm"], cfg.norm_eps, cfg.norm_zero_centered)
+    if cfg.head == "embedding":
+        mask = (jnp.arange(T)[None, :] < valid_len[:, None]).astype(jnp.float32)
+        pooled = jnp.sum(h.astype(jnp.float32) * mask[..., None], axis=1)
+        pooled = pooled / jnp.maximum(mask.sum(axis=1, keepdims=True), 1.0)
+        emb = pooled / jnp.maximum(
+            jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9
+        )
+        return emb, h
+    lm_head = params.get("lm_head")
+    if lm_head is None:
+        lm_head = params["embed"].T
+    return (h @ lm_head.astype(h.dtype)).astype(jnp.float32), h
+
+
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
@@ -191,20 +301,10 @@ def forward(
     chunk K/V are stacked ``[L, B, T, KVH, Dh]`` (post-RoPE, ready for cache
     scatter by the runner).
     """
-    B, T = ids.shape
-    L = cfg.num_layers
-    h = params["embed"][ids]  # [B, T, H] gather
-    if cfg.embed_scale:
-        h = (h.astype(jnp.float32) * (cfg.hidden_size ** 0.5)).astype(h.dtype)
+    h = embed_tokens(cfg, params, ids)
 
     windows = jnp.asarray(cfg.window_array(), jnp.int32)  # [L]
-    thetas = jnp.asarray(
-        [
-            (cfg.local_rope_theta if (w > 0 and cfg.local_rope_theta) else cfg.rope_theta)
-            for w in cfg.window_array()
-        ],
-        jnp.float32,
-    )
+    thetas = rope_thetas(cfg)
 
     if paged_past is not None:
         k_pages, v_pages, page_table = paged_past
@@ -219,65 +319,19 @@ def forward(
         else:
             lp, window, theta = xs_l
             kp_l = vp_l = None
-        resid = h
-        x = rms_norm(h, lp["attn_norm"], cfg.norm_eps, cfg.norm_zero_centered)
-        q = x @ lp["wq"]
-        k = x @ lp["wk"]
-        v = x @ lp["wv"]
-        if cfg.attn_bias:
-            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
-        q = q.reshape(B, T, cfg.num_heads, cfg.head_dim)
-        k = k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
-        v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
-        if cfg.qk_norm:
-            q = rms_norm(q, lp["q_norm"], cfg.norm_eps, cfg.norm_zero_centered)
-            k = rms_norm(k, lp["k_norm"], cfg.norm_eps, cfg.norm_zero_centered)
-        q = apply_rope(q, positions, theta)
-        k = apply_rope(k, positions, theta)
-        sink = lp.get("sink") if cfg.attention_sink else None
-        attn = chunk_attention(
-            q, k, v,
-            positions=positions,
-            valid_len=valid_len,
-            past_k_pages=kp_l, past_v_pages=vp_l,
+        return layer_apply(
+            cfg, lp, h,
+            positions=positions, valid_len=valid_len,
+            window=window, theta=theta,
+            kp_l=kp_l, vp_l=vp_l,
             page_table=page_table, past_len=past_len,
-            window=window, sink=sink,
-            use_pallas=use_pallas,
-            ring_mesh=ring_mesh,
+            use_pallas=use_pallas, ring_mesh=ring_mesh,
         )
-        attn = attn.reshape(B, T, cfg.q_size) @ lp["wo"]
-        if cfg.attn_bias:
-            attn = attn + lp["bo"]
-        if cfg.post_norms:
-            attn = rms_norm(attn, lp["post_attn_norm"], cfg.norm_eps, cfg.norm_zero_centered)
-        h = resid + attn
-        resid = h
-        x = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, cfg.norm_zero_centered)
-        x = _mlp(cfg, lp, x)
-        if cfg.post_norms:
-            x = rms_norm(x, lp["post_mlp_norm"], cfg.norm_eps, cfg.norm_zero_centered)
-        h = resid + x
-        return h, (k, v)
 
     h, (k_all, v_all) = jax.lax.scan(layer_step, h, xs)
 
-    h = rms_norm(h, params["final_norm"], cfg.norm_eps, cfg.norm_zero_centered)
-
-    if cfg.head == "embedding":
-        # Mean-pool over valid tokens, L2-normalize (BASELINE config #3).
-        mask = (jnp.arange(T)[None, :] < valid_len[:, None]).astype(jnp.float32)
-        pooled = jnp.sum(h.astype(jnp.float32) * mask[..., None], axis=1)
-        pooled = pooled / jnp.maximum(mask.sum(axis=1, keepdims=True), 1.0)
-        emb = pooled / jnp.maximum(
-            jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9
-        )
-        return emb, h, (k_all, v_all)
-
-    lm_head = params.get("lm_head")
-    if lm_head is None:
-        lm_head = params["embed"].T
-    logits = (h @ lm_head.astype(h.dtype)).astype(jnp.float32)
-    return logits, h, (k_all, v_all)
+    out, h = head_apply(cfg, params, h, valid_len)
+    return out, h, (k_all, v_all)
 
 
 def num_params(params: Params) -> int:

@@ -2,7 +2,7 @@
 
 The reference has no distributed layer at all (its transport is HTTPS,
 SURVEY §5.8); this is the TPU-native equivalent: a ``jax.sharding.Mesh``
-with axes ``("data", "seq", "expert", "model")``:
+with axes ``("data", "pipe", "seq", "expert", "model")``:
 
 - ``model`` (TP) — innermost, so tensor-parallel collectives (all-reduce /
   all-gather of activations) ride the fastest ICI links;
@@ -10,6 +10,8 @@ with axes ``("data", "seq", "expert", "model")``:
 - ``seq`` (SP) — ring-attention sequence/context parallelism for long
   prompts (ops/ring_attention.py): K/V chunks rotate around the ring via
   ``ppermute`` while each device keeps its query chunk resident;
+- ``pipe`` (PP) — GPipe stage-sharded layers with microbatch ppermute
+  hops (parallel/pipeline.py), for stacks beyond TP+EP memory;
 - ``data`` (DP) — outermost; across pod slices this maps to DCN, which only
   ever carries embarrassingly-parallel row shards.
 
@@ -27,7 +29,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("data", "seq", "expert", "model")
+AXES = ("data", "pipe", "seq", "expert", "model")
 
 
 def init_distributed() -> None:
@@ -46,24 +48,25 @@ def make_mesh(
     devices: Optional[Sequence[jax.Device]] = None,
     *,
     sp: int = 1,
+    pp: int = 1,
 ) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
-    need = dp * sp * ep * tp
+    need = dp * pp * sp * ep * tp
     if need > len(devices):
         raise ValueError(
-            f"Mesh dp*sp*ep*tp={need} exceeds available devices "
+            f"Mesh dp*pp*sp*ep*tp={need} exceeds available devices "
             f"{len(devices)}"
         )
-    grid = np.array(devices[:need]).reshape(dp, sp, ep, tp)
+    grid = np.array(devices[:need]).reshape(dp, pp, sp, ep, tp)
     return Mesh(grid, AXES)
 
 
 def auto_mesh(ecfg, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Resolve the engine config against the actual device count."""
     devices = list(devices if devices is not None else jax.devices())
-    dp, sp, ep, tp = ecfg.resolved_mesh(len(devices))
-    return make_mesh(dp, ep, tp, devices, sp=sp)
+    dp, pp, sp, ep, tp = ecfg.resolved_mesh(len(devices))
+    return make_mesh(dp, ep, tp, devices, sp=sp, pp=pp)
 
 
-def mesh_shape(mesh: Mesh) -> Tuple[int, int, int, int]:
+def mesh_shape(mesh: Mesh) -> Tuple[int, int, int, int, int]:
     return tuple(mesh.shape[a] for a in AXES)  # type: ignore[return-value]

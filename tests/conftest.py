@@ -26,6 +26,22 @@ from sutro_tpu.engine.tokenizer import ByteTokenizer  # noqa: E402
 from sutro_tpu.models.configs import MODEL_CONFIGS  # noqa: E402
 
 
+@pytest.fixture(scope="session")
+def eight_devices():
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 virtual devices")
+    return jax.devices()[:8]
+
+
+@pytest.fixture(scope="session")
+def mesh_ecfg():
+    """Tiny engine config for multi-device sharding tests."""
+    return EngineConfig(
+        kv_page_size=8, max_pages_per_seq=8, decode_batch_size=4,
+        max_model_len=64, use_pallas=False, param_dtype="float32",
+    )
+
+
 @pytest.fixture(scope="module")
 def monkeypatch_module():
     mp = pytest.MonkeyPatch()

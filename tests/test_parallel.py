@@ -14,13 +14,6 @@ from sutro_tpu.parallel.mesh import make_mesh, mesh_shape
 from sutro_tpu.parallel.sharding import param_shardings, shard_params
 
 
-@pytest.fixture(scope="module")
-def eight_devices():
-    if jax.device_count() < 8:
-        pytest.skip("needs 8 virtual devices")
-    return jax.devices()[:8]
-
-
 def _ecfg(**kw):
     base = dict(
         kv_page_size=8, max_pages_per_seq=8, decode_batch_size=4,
@@ -32,11 +25,13 @@ def _ecfg(**kw):
 
 def test_mesh_construction(eight_devices):
     mesh = make_mesh(2, 2, 2, eight_devices)
-    assert mesh_shape(mesh) == (2, 1, 2, 2)
+    assert mesh_shape(mesh) == (2, 1, 1, 2, 2)
     with pytest.raises(ValueError, match="exceeds"):
         make_mesh(4, 4, 4, eight_devices)
     with pytest.raises(ValueError, match="exceeds"):
         make_mesh(2, 2, 2, eight_devices, sp=2)
+    with pytest.raises(ValueError, match="exceeds"):
+        make_mesh(2, 2, 2, eight_devices, pp=2)
 
 
 def test_param_shardings_cover_all_leaves(eight_devices):

@@ -16,13 +16,6 @@ from sutro_tpu.parallel.mesh import make_mesh
 
 
 @pytest.fixture(scope="module")
-def eight_devices():
-    if jax.device_count() < 8:
-        pytest.skip("needs 8 virtual devices")
-    return jax.devices()[:8]
-
-
-@pytest.fixture(scope="module")
 def qkv():
     rng = np.random.default_rng(0)
     B, T, NH, KVH, Dh = 2, 32, 4, 2, 8
