@@ -1,0 +1,178 @@
+// Native host-side runtime core for the continuous-batching scheduler.
+//
+// The reference ships no native code at all (SURVEY §2.3) — its scheduler
+// lives in the remote fleet. This is the TPU build's equivalent of that
+// fleet's host runtime: KV page allocation, admission control (token-budget
+// bin-packing), and the per-decode-step dense batch state (last tokens,
+// past lengths, page tables, sampling params) that the device step
+// consumes. Python holds zero-copy numpy views over the dense arrays, so
+// the per-step slot-assembly loop disappears from the interpreter
+// (sutro_tpu/engine/scheduler.py run loop; binding in
+// sutro_tpu/engine/native_runtime.py, pure-Python fallback retained).
+//
+// Invariants (mirror engine/kvcache.py PageAllocator + scheduler._try_admit):
+//   - page 0 is the reserved garbage page, never allocated or freed
+//   - a row's worst-case total (prompt + max_new, clamped to max_context)
+//     is reserved at admission; admission fails if slots, pages, or the
+//     max_batch_tokens budget would be exceeded
+//   - release returns all pages and zeroes the slot's dense row
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+struct Runtime {
+    int32_t num_pages;
+    int32_t num_slots;
+    int32_t max_pages_per_seq;
+    int32_t page_size;
+    int64_t max_batch_tokens;
+    int32_t max_context;
+
+    std::vector<int32_t> free_pages;          // LIFO free list
+    std::vector<std::vector<int32_t>> slot_pages;
+    std::vector<int64_t> slot_total;          // reserved worst-case tokens
+    std::vector<uint8_t> active;
+
+    // dense per-step state, shared with Python as zero-copy views
+    std::vector<int32_t> last;                // [B]
+    std::vector<int32_t> past_len;            // [B]
+    std::vector<int32_t> table;               // [B * MP]
+    std::vector<float> temp;                  // [B]
+    std::vector<float> top_p;                 // [B]
+    std::vector<int32_t> top_k;               // [B]
+    std::vector<int32_t> emitted;             // [B] tokens generated so far
+};
+
+Runtime* rt_create(
+    int32_t num_pages,
+    int32_t num_slots,
+    int32_t max_pages_per_seq,
+    int32_t page_size,
+    int64_t max_batch_tokens,
+    int32_t max_context) {
+    Runtime* rt = new Runtime();
+    rt->num_pages = num_pages;
+    rt->num_slots = num_slots;
+    rt->max_pages_per_seq = max_pages_per_seq;
+    rt->page_size = page_size;
+    rt->max_batch_tokens = max_batch_tokens;
+    rt->max_context = max_context;
+    rt->free_pages.reserve(num_pages > 0 ? num_pages - 1 : 0);
+    for (int32_t p = num_pages - 1; p >= 1; --p) rt->free_pages.push_back(p);
+    rt->slot_pages.resize(num_slots);
+    rt->slot_total.assign(num_slots, 0);
+    rt->active.assign(num_slots, 0);
+    rt->last.assign(num_slots, 0);
+    rt->past_len.assign(num_slots, 0);
+    rt->table.assign((size_t)num_slots * max_pages_per_seq, 0);
+    rt->temp.assign(num_slots, 0.0f);
+    rt->top_p.assign(num_slots, 1.0f);
+    rt->top_k.assign(num_slots, 0);
+    rt->emitted.assign(num_slots, 0);
+    return rt;
+}
+
+void rt_destroy(Runtime* rt) { delete rt; }
+
+int32_t rt_free_page_count(Runtime* rt) {
+    return (int32_t)rt->free_pages.size();
+}
+
+int64_t rt_inflight_tokens(Runtime* rt) {
+    int64_t total = 0;
+    for (int32_t i = 0; i < rt->num_slots; ++i)
+        if (rt->active[i]) total += rt->slot_total[i];
+    return total;
+}
+
+int32_t rt_active_count(Runtime* rt) {
+    int32_t n = 0;
+    for (int32_t i = 0; i < rt->num_slots; ++i) n += rt->active[i] ? 1 : 0;
+    return n;
+}
+
+// Admission: returns the slot index, or -1 if the row cannot be admitted
+// now. On success the slot's page-table row is populated and reserved.
+int32_t rt_try_admit(Runtime* rt, int32_t prompt_len, int32_t max_new) {
+    int32_t slot = -1;
+    for (int32_t i = 0; i < rt->num_slots; ++i) {
+        if (!rt->active[i]) { slot = i; break; }
+    }
+    if (slot < 0) return -1;
+    int64_t total = (int64_t)prompt_len + max_new;
+    if (total > rt->max_context) total = rt->max_context;
+    int32_t need =
+        (int32_t)((total + rt->page_size - 1) / rt->page_size);
+    if (need > rt->max_pages_per_seq) return -1;
+    if (need > (int32_t)rt->free_pages.size()) return -1;
+    int64_t inflight = rt_inflight_tokens(rt);
+    if (inflight > 0 && inflight + total > rt->max_batch_tokens) return -1;
+
+    std::vector<int32_t>& pages = rt->slot_pages[slot];
+    pages.clear();
+    for (int32_t k = 0; k < need; ++k) {
+        pages.push_back(rt->free_pages.back());
+        rt->free_pages.pop_back();
+    }
+    int32_t* row = rt->table.data() + (size_t)slot * rt->max_pages_per_seq;
+    std::memset(row, 0, sizeof(int32_t) * rt->max_pages_per_seq);
+    for (size_t k = 0; k < pages.size(); ++k) row[k] = pages[k];
+    rt->slot_total[slot] = total;
+    rt->active[slot] = 1;
+    rt->emitted[slot] = 0;
+    return slot;
+}
+
+// Post-prefill slot arming: position after the prompt, the first sampled
+// token, and the row's sampling params.
+void rt_arm_slot(
+    Runtime* rt, int32_t slot, int32_t pos, int32_t first_token,
+    float temperature, float top_p, int32_t top_k) {
+    rt->past_len[slot] = pos;
+    rt->last[slot] = first_token;
+    rt->temp[slot] = temperature;
+    rt->top_p[slot] = top_p;
+    rt->top_k[slot] = top_k;
+    rt->emitted[slot] = 1;  // the first token was sampled at prefill
+}
+
+// After a decode step accepted token `tok` for this slot.
+void rt_note_token(Runtime* rt, int32_t slot, int32_t tok) {
+    rt->past_len[slot] += 1;
+    rt->last[slot] = tok;
+    rt->emitted[slot] += 1;
+}
+
+void rt_release(Runtime* rt, int32_t slot) {
+    if (!rt->active[slot]) return;
+    for (int32_t p : rt->slot_pages[slot])
+        if (p != 0) rt->free_pages.push_back(p);
+    rt->slot_pages[slot].clear();
+    rt->slot_total[slot] = 0;
+    rt->active[slot] = 0;
+    rt->last[slot] = 0;
+    rt->past_len[slot] = 0;
+    rt->temp[slot] = 0.0f;
+    rt->top_p[slot] = 1.0f;
+    rt->top_k[slot] = 0;
+    rt->emitted[slot] = 0;
+    int32_t* row = rt->table.data() + (size_t)slot * rt->max_pages_per_seq;
+    std::memset(row, 0, sizeof(int32_t) * rt->max_pages_per_seq);
+}
+
+int32_t rt_emitted(Runtime* rt, int32_t slot) { return rt->emitted[slot]; }
+int32_t rt_pos(Runtime* rt, int32_t slot) { return rt->past_len[slot]; }
+int32_t rt_is_active(Runtime* rt, int32_t slot) { return rt->active[slot]; }
+
+// zero-copy views for numpy (stable for the Runtime's lifetime)
+int32_t* rt_view_last(Runtime* rt) { return rt->last.data(); }
+int32_t* rt_view_past_len(Runtime* rt) { return rt->past_len.data(); }
+int32_t* rt_view_table(Runtime* rt) { return rt->table.data(); }
+float* rt_view_temp(Runtime* rt) { return rt->temp.data(); }
+float* rt_view_top_p(Runtime* rt) { return rt->top_p.data(); }
+int32_t* rt_view_top_k(Runtime* rt) { return rt->top_k.data(); }
+
+}  // extern "C"

@@ -98,6 +98,18 @@ def set_backend(backend: str) -> None:
 
 
 @cli.command()
+@click.option("--host", default="127.0.0.1", show_default=True)
+@click.option("--port", default=8642, show_default=True)
+@click.option("--quiet", is_flag=True, help="Suppress per-request logging")
+def serve(host: str, port: int, quiet: bool) -> None:
+    """Run the engine as a long-lived HTTP daemon (detach/attach across
+    processes; clients use `sutro set-backend remote` + `set-base-url`)."""
+    from .server import serve as _serve
+
+    _serve(host=host, port=port, verbose=not quiet)
+
+
+@cli.command()
 def quotas() -> None:
     """Show per-priority row/token quotas (reference cli.py:398-416)."""
     rows = get_sdk().get_quotas()

@@ -75,6 +75,23 @@ class DatasetStore:
         (d / ".meta.json").write_text(json.dumps(meta, indent=2))
         return names
 
+    def upload_bytes(
+        self, dataset_id: str, file_name: str, data: bytes
+    ) -> str:
+        """Write one uploaded file body (the daemon's multipart endpoint,
+        server.py)."""
+        d = self._dir(dataset_id)
+        name = Path(file_name).name  # strip any client-supplied directories
+        if not name or name == ".meta.json":
+            raise ValueError(f"Invalid upload file name: {file_name!r}")
+        (d / name).write_bytes(data)
+        meta = json.loads((d / ".meta.json").read_text())
+        meta["updated_at"] = datetime.datetime.now(
+            datetime.timezone.utc
+        ).isoformat()
+        (d / ".meta.json").write_text(json.dumps(meta, indent=2))
+        return name
+
     def list_datasets(self) -> List[Dict[str, Any]]:
         out = []
         for d in sorted(self.root.iterdir()):
@@ -115,7 +132,13 @@ class DatasetStore:
 
     def file_path(self, dataset_id: str, file_name: str) -> Path:
         d = self._dir(dataset_id)
-        p = d / file_name
+        p = (d / file_name).resolve()
+        # reject traversal: the resolved path must stay inside the dataset
+        # dir (file_name is client-controlled via the daemon, server.py)
+        if p.parent != d.resolve() or p.name == ".meta.json":
+            raise FileNotFoundError(
+                f"{dataset_id} has no file {file_name!r}"
+            )
         if not p.exists():
             raise FileNotFoundError(f"{dataset_id} has no file {file_name!r}")
         return p

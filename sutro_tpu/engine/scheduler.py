@@ -59,11 +59,29 @@ class TokenConstraint(Protocol):
         ...
 
 
-# per-callable cache: does this allowed_tokens accept ``remaining``?
-# Keyed by the underlying function object (not the class) so
-# instance-attribute implementations of the protocol probe independently;
-# the value keeps a strong ref to the function so its id can't be reused.
+# per-method cache: does this allowed_tokens accept ``remaining``? Keyed
+# by the unbound class function (bounded: one entry per implementing
+# class); the value keeps a strong ref so the id can't be reused.
+# Instance-attribute callables (no __func__) are probed per object and
+# memoized on the instance itself, so the cache cannot grow unboundedly
+# in a long-lived daemon.
 _TAKES_BUDGET: Dict[int, Tuple[Any, bool]] = {}
+
+
+def _probe_takes_budget(fn: Any) -> bool:
+    try:
+        sig = inspect.signature(fn)
+    except Exception:
+        return False
+    kw_ok = (
+        inspect.Parameter.POSITIONAL_OR_KEYWORD,
+        inspect.Parameter.KEYWORD_ONLY,
+    )
+    return any(
+        (p.name == "remaining" and p.kind in kw_ok)
+        or p.kind == inspect.Parameter.VAR_KEYWORD
+        for p in sig.parameters.values()
+    )
 
 
 @dataclasses.dataclass
@@ -116,15 +134,34 @@ class ContinuousBatcher:
         self.ecfg = runner.ecfg
         self.vocab = runner.mcfg.vocab_size
         self.stop_ids = set(int(s) for s in stop_ids)
-        self.allocator = PageAllocator(runner.num_pages)
         self.B = self.ecfg.decode_batch_size
         self.MP = self.ecfg.max_pages_per_seq
+        # Native host runtime (native/runtime.cpp): page allocator +
+        # admission + dense step-state arrays as zero-copy views. Falls
+        # back to the pure-Python allocator when the toolchain is absent
+        # or SUTRO_NATIVE_RUNTIME=0.
+        from .native_runtime import maybe_native_runtime
+
+        self.native = maybe_native_runtime(
+            runner.num_pages, self.B, self.MP, self.ecfg.kv_page_size,
+            self.ecfg.max_batch_tokens, self.ecfg.max_context(),
+        )
+        self.allocator = (
+            None if self.native is not None
+            else PageAllocator(runner.num_pages)
+        )
         self.slots: List[Optional[_Slot]] = [None] * self.B
         self._key = jax.random.PRNGKey(seed)
         self._fixed_key = jax.random.PRNGKey(seed)
         self._step = 0
 
     # ------------------------------------------------------------------
+
+    @property
+    def free_page_count(self) -> int:
+        if self.native is not None:
+            return self.native.free_count
+        return self.allocator.free_count
 
     def _max_total(self, req: GenRequest) -> int:
         return min(
@@ -138,28 +175,41 @@ class ContinuousBatcher:
         )
 
     def _try_admit(self, req: GenRequest) -> bool:
-        try:
-            free_idx = self.slots.index(None)
-        except ValueError:
-            return False
-        total = self._max_total(req)
-        need = pages_needed(total, self.ecfg.kv_page_size)
-        if need > self.MP or need > self.allocator.free_count:
-            return False
-        if (
-            self._inflight_tokens() > 0
-            and self._inflight_tokens() + total > self.ecfg.max_batch_tokens
-        ):
-            return False
-        pages = self.allocator.alloc(need)
-        table = np.zeros((self.MP,), np.int32)
-        table[: len(pages)] = pages
-
         n = len(req.prompt_ids)
+        if self.native is not None:
+            free_idx = self.native.try_admit(n, req.max_new_tokens)
+            if free_idx < 0:
+                return False
+            assert self.slots[free_idx] is None
+            pages = self.native.slot_pages(free_idx)
+            table = self.native.table[free_idx]
+        else:
+            try:
+                free_idx = self.slots.index(None)
+            except ValueError:
+                return False
+            total = self._max_total(req)
+            need = pages_needed(total, self.ecfg.kv_page_size)
+            if need > self.MP or need > self.allocator.free_count:
+                return False
+            if (
+                self._inflight_tokens() > 0
+                and self._inflight_tokens() + total
+                > self.ecfg.max_batch_tokens
+            ):
+                return False
+            pages = self.allocator.alloc(need)
+            table = np.zeros((self.MP,), np.int32)
+            table[: len(pages)] = pages
+
         logits = self.runner.prefill(req.prompt_ids.astype(np.int32), table)
         first, first_logp = self._sample_one(logits, req)
         slot = _Slot(req=req, pages=pages, pos=n, last_token=first)
         self.slots[free_idx] = slot
+        if self.native is not None:
+            self.native.arm_slot(
+                free_idx, n, first, req.temperature, req.top_p, req.top_k
+            )
         self._record_token(slot, first, first_logp)
         return True
 
@@ -177,31 +227,25 @@ class ContinuousBatcher:
         # Probe the signature once per implementation: a TypeError raised
         # *inside* a budget-aware allowed_tokens must propagate, not
         # silently disable budget enforcement.
-        fn = getattr(c.allowed_tokens, "__func__", c.allowed_tokens)
-        key = id(fn)
-        cached = _TAKES_BUDGET.get(key)
-        if cached is not None:
-            takes_budget = cached[1]
-        else:
-            try:
-                sig = inspect.signature(fn)
-                kw_ok = (
-                    inspect.Parameter.POSITIONAL_OR_KEYWORD,
-                    inspect.Parameter.KEYWORD_ONLY,
-                )
-                takes_budget = any(
-                    (p.name == "remaining" and p.kind in kw_ok)
-                    or p.kind == inspect.Parameter.VAR_KEYWORD
-                    for p in sig.parameters.values()
-                )
-            except Exception:
-                takes_budget = False
-            _TAKES_BUDGET[key] = (fn, takes_budget)
-        m = (
-            c.allowed_tokens(remaining=remaining)
-            if takes_budget
-            else c.allowed_tokens()
-        )
+        bound = c.allowed_tokens
+        fn = getattr(bound, "__func__", None)
+        if fn is not None:  # normal bound method: class-level cache
+            key = id(fn)
+            cached = _TAKES_BUDGET.get(key)
+            if cached is not None:
+                takes_budget = cached[1]
+            else:
+                takes_budget = _probe_takes_budget(fn)
+                _TAKES_BUDGET[key] = (fn, takes_budget)
+        else:  # instance-attribute callable: memoize on the instance
+            takes_budget = getattr(c, "_sutro_takes_budget", None)
+            if takes_budget is None:
+                takes_budget = _probe_takes_budget(bound)
+                try:
+                    c._sutro_takes_budget = takes_budget
+                except Exception:
+                    pass  # __slots__ etc.: re-probe next step
+        m = bound(remaining=remaining) if takes_budget else bound()
         return self._pad_mask(m)
 
     def _remaining(self, req: GenRequest, emitted: int, pos: int) -> int:
@@ -259,7 +303,10 @@ class ContinuousBatcher:
     def _release(self, i: int) -> GenResult:
         slot = self.slots[i]
         assert slot is not None
-        self.allocator.free(slot.pages)
+        if self.native is not None:
+            self.native.release(i)
+        else:
+            self.allocator.free(slot.pages)
         self.slots[i] = None
         out = list(slot.out_ids)
         reason = "stop"
@@ -363,24 +410,31 @@ class ContinuousBatcher:
                     )
                 continue
 
-            last = np.zeros((self.B,), np.int32)
-            past_len = np.zeros((self.B,), np.int32)
-            table = np.zeros((self.B, self.MP), np.int32)
-            temp = np.zeros((self.B,), np.float32)
-            top_p = np.ones((self.B,), np.float32)
-            top_k = np.zeros((self.B,), np.int32)
+            if self.native is not None:
+                # dense arrays live in the C++ core, always current
+                nat = self.native
+                last, past_len, table = nat.last, nat.past_len, nat.table
+                temp, top_p, top_k = nat.temp, nat.top_p, nat.top_k
+            else:
+                last = np.zeros((self.B,), np.int32)
+                past_len = np.zeros((self.B,), np.int32)
+                table = np.zeros((self.B, self.MP), np.int32)
+                temp = np.zeros((self.B,), np.float32)
+                top_p = np.ones((self.B,), np.float32)
+                top_k = np.zeros((self.B,), np.int32)
             has_constraint = False
             has_row_seed = False
             row_seeds = np.zeros((self.B,), np.int32)
             allowed = None
             for i in active:
                 s = self.slots[i]
-                last[i] = s.last_token
-                past_len[i] = s.pos
-                table[i, : len(s.pages)] = s.pages
-                temp[i] = s.req.temperature
-                top_p[i] = s.req.top_p
-                top_k[i] = s.req.top_k
+                if self.native is None:
+                    last[i] = s.last_token
+                    past_len[i] = s.pos
+                    table[i, : len(s.pages)] = s.pages
+                    temp[i] = s.req.temperature
+                    top_p[i] = s.req.top_p
+                    top_k[i] = s.req.top_k
                 if s.req.row_seed is not None:
                     has_row_seed = True
                     row_seeds[i] = _step_seed(s.req.row_seed, len(s.out_ids))
@@ -415,6 +469,8 @@ class ContinuousBatcher:
                 s = self.slots[i]
                 s.pos += 1  # last_token's KV is now cached
                 tok = int(toks[i])
+                if self.native is not None:
+                    self.native.note_token(i, tok)
                 self._record_token(s, tok, float(logps[i]))
                 output_tokens += 1
                 s.last_token = tok

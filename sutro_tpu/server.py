@@ -1,0 +1,324 @@
+"""Local engine daemon: the reference wire contract over HTTP.
+
+The reference SDK talks to a remote fleet at api.sutro.sh
+(/root/reference/sutro/sdk.py:56, endpoints catalogued in SURVEY §3.6).
+This module serves the *same* contract from the in-process TPU engine, so:
+
+- detach/attach works across processes: start ``sutro serve`` once, point
+  any number of shells/notebooks at it (``backend="remote"``,
+  ``set-base-url http://localhost:8642``) and jobs survive client exits;
+- the CLI's jobs/datasets/quotas commands work unchanged against a
+  long-running engine that keeps compiled runners and HBM-resident
+  weights warm between jobs (SURVEY §5.8 "client⇄engine" shim).
+
+Stdlib-only (ThreadingHTTPServer): one engine worker thread executes jobs
+(LocalEngine's queue discipline is unchanged); HTTP threads only enqueue,
+poll the jobstore, or tail the metrics bus — all thread-safe surfaces.
+
+Endpoints (SURVEY §3.6 table): POST /batch-inference, GET
+/stream-job-progress/{id} (NDJSON), POST /job-results, GET /jobs/{id},
+GET /job-status/{id}, GET /job-cancel/{id}, GET /list-jobs, GET
+/create-dataset, POST /upload-to-dataset (multipart), POST
+/list-datasets, POST /list-dataset-files, POST /download-from-dataset,
+GET /try-authentication, GET /get-quotas, POST /functions/run.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from email.message import Message
+from email.parser import BytesParser
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+from .engine.api import LocalEngine
+from .interfaces import JobStatus
+
+DEFAULT_PORT = 8642
+
+
+class _BadRequest(Exception):
+    """Malformed request body (400) — distinct from unknown resources
+    (KeyError -> 404)."""
+
+
+def _require(req: Dict[str, Any], field: str) -> Any:
+    try:
+        return req[field]
+    except KeyError:
+        raise _BadRequest(f"missing required field {field!r}") from None
+
+
+def _parse_multipart(content_type: str, body: bytes) -> Dict[str, Any]:
+    """Parse a multipart/form-data body into {field: value} where file
+    fields become (filename, bytes)."""
+    parser = BytesParser()
+    msg = parser.parsebytes(
+        b"Content-Type: " + content_type.encode() + b"\r\n\r\n" + body
+    )
+    out: Dict[str, Any] = {}
+    if not msg.is_multipart():
+        return out
+    for part in msg.get_payload():
+        assert isinstance(part, Message)
+        name = part.get_param("name", header="content-disposition")
+        if name is None:
+            continue
+        filename = part.get_filename()
+        payload = part.get_payload(decode=True)
+        if filename is not None:
+            out[name] = (filename, payload or b"")
+        else:
+            out[name] = (payload or b"").decode("utf-8", "replace")
+    return out
+
+
+class EngineHTTPHandler(BaseHTTPRequestHandler):
+    engine: LocalEngine  # set by make_server
+    protocol_version = "HTTP/1.1"
+    server_version = "sutro-tpu-engine"
+
+    # -- plumbing ------------------------------------------------------
+
+    def log_message(self, fmt: str, *args: Any) -> None:  # quiet by default
+        if getattr(self.server, "verbose", False):
+            super().log_message(fmt, *args)
+
+    def _json(self, obj: Any, status: int = 200) -> None:
+        data = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _bytes(self, data: bytes, status: int = 200) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _error(self, status: int, message: str) -> None:
+        self._json({"detail": message}, status=status)
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length else b""
+
+    def _read_json(self) -> Dict[str, Any]:
+        body = self._read_body()
+        return json.loads(body) if body else {}
+
+    def _route(self) -> Tuple[str, Optional[str]]:
+        path = self.path.split("?")[0].strip("/")
+        head, _, rest = path.partition("/")
+        return head, (rest or None)
+
+    # -- verbs ---------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib naming)
+        try:
+            head, rest = self._route()
+            eng = self.engine
+            if head == "stream-job-progress" and rest:
+                self._stream_progress(rest)
+            elif head == "jobs" and rest:
+                self._json({"job": eng.get_job(rest)})
+            elif head == "job-status" and rest:
+                self._json({"job_status": {rest: eng.job_status(rest)}})
+            elif head == "job-cancel" and rest:
+                self._json(eng.cancel_job(rest))
+            elif head == "list-jobs":
+                self._json({"jobs": eng.list_jobs()})
+            elif head == "create-dataset":
+                self._json({"dataset_id": eng.datasets.create()})
+            elif head == "try-authentication":
+                self._json(eng.try_authentication())
+            elif head == "get-quotas":
+                self._json({"quotas": eng.get_quotas()})
+            elif head == "healthz":
+                self._json({"ok": True})
+            else:
+                self._error(404, f"Unknown endpoint GET /{head}")
+        except (KeyError, FileNotFoundError) as e:
+            self._error(404, f"Not found: {e}")
+        except Exception as e:  # noqa: BLE001 — request isolation boundary
+            self._error(500, f"{type(e).__name__}: {e}")
+
+    def do_POST(self) -> None:  # noqa: N802
+        try:
+            head, _ = self._route()
+            eng = self.engine
+            if head == "batch-inference":
+                payload = self._read_json()
+                self._json({"results": eng.submit_batch_inference(payload)})
+            elif head == "job-results":
+                req = self._read_json()
+                res = eng.job_results(
+                    _require(req, "job_id"),
+                    include_inputs=bool(req.get("include_inputs")),
+                    include_cumulative_logprobs=bool(
+                        req.get("include_cumulative_logprobs")
+                    ),
+                )
+                self._json({"results": res})
+            elif head == "upload-to-dataset":
+                form = _parse_multipart(
+                    self.headers.get("Content-Type", ""), self._read_body()
+                )
+                dataset_id = form.get("dataset_id")
+                file_field = form.get("file")
+                if not dataset_id or not isinstance(file_field, tuple):
+                    self._error(400, "need multipart fields file+dataset_id")
+                    return
+                fname, data = file_field
+                eng.datasets.upload_bytes(dataset_id, fname, data)
+                self._json({"uploaded": fname})
+            elif head == "list-datasets":
+                self._json({"datasets": eng.datasets.list_datasets()})
+            elif head == "list-dataset-files":
+                req = self._read_json()
+                self._json(
+                    {
+                        "files": eng.datasets.list_files(
+                            _require(req, "dataset_id")
+                        )
+                    }
+                )
+            elif head == "download-from-dataset":
+                req = self._read_json()
+                path = eng.datasets.file_path(
+                    _require(req, "dataset_id"), _require(req, "file_name")
+                )
+                self._bytes(path.read_bytes())
+            elif head == "functions" and self.path.rstrip("/").endswith(
+                "run"
+            ):
+                self._functions_run()
+            else:
+                self._error(404, f"Unknown endpoint POST /{head}")
+        except _BadRequest as e:
+            self._error(400, str(e))
+        except (KeyError, FileNotFoundError) as e:
+            self._error(404, f"Not found: {e}")
+        except json.JSONDecodeError as e:
+            self._error(400, f"Invalid JSON body: {e}")
+        except Exception as e:  # noqa: BLE001
+            self._error(500, f"{type(e).__name__}: {e}")
+
+    # -- endpoint bodies ----------------------------------------------
+
+    def _stream_progress(self, job_id: str) -> None:
+        """NDJSON progress stream (chunked) — reference sdk.py:311-367."""
+        self.engine.job_status(job_id)  # 404 before headers if unknown
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def send_chunk(obj: Dict[str, Any]) -> None:
+            line = (json.dumps(obj) + "\n").encode()
+            self.wfile.write(f"{len(line):X}\r\n".encode() + line + b"\r\n")
+            self.wfile.flush()
+
+        try:
+            for update in self.engine.stream_job_progress(job_id):
+                send_chunk(update)
+        except (BrokenPipeError, ConnectionResetError):
+            return  # client detached — job keeps running
+        self.wfile.write(b"0\r\n\r\n")
+
+    def _functions_run(self) -> None:
+        """Synchronous single-input serving call (reference sdk.py:512-588
+        contract: {response, confidence, predictions, run_id, usage})."""
+        req = self._read_json()
+        name = _require(req, "name")
+        input_data = req.get("input_data")
+        text = (
+            json.dumps(input_data)
+            if isinstance(input_data, dict)
+            else str(input_data)
+        )
+        eng = self.engine
+        job_id = eng.submit_batch_inference(
+            {
+                "model": name,
+                "inputs": [text],
+                "job_priority": 0,
+                "truncate_rows": False,
+            }
+        )
+        import time
+
+        deadline = time.monotonic() + 600
+        while time.monotonic() < deadline:
+            if JobStatus(eng.job_status(job_id)).is_terminal():
+                break
+            time.sleep(0.05)
+        if eng.job_status(job_id) != JobStatus.SUCCEEDED.value:
+            self._error(500, f"function job {eng.job_status(job_id)}")
+            return
+        res = eng.job_results(job_id)
+        rec = eng.get_job(job_id)
+        self._json(
+            {
+                "response": res["outputs"][0],
+                "confidence": None,
+                "predictions": [],
+                "run_id": job_id,
+                "usage": {
+                    "input_tokens": rec.get("input_tokens"),
+                    "output_tokens": rec.get("output_tokens"),
+                },
+            }
+        )
+
+
+def make_server(
+    engine: LocalEngine,
+    host: str = "127.0.0.1",
+    port: int = DEFAULT_PORT,
+    verbose: bool = False,
+) -> ThreadingHTTPServer:
+    handler = type(
+        "BoundEngineHandler", (EngineHTTPHandler,), {"engine": engine}
+    )
+    server = ThreadingHTTPServer((host, port), handler)
+    server.verbose = verbose  # type: ignore[attr-defined]
+    server.daemon_threads = True
+    return server
+
+
+def start_server_thread(
+    engine: LocalEngine, host: str = "127.0.0.1", port: int = 0
+) -> Tuple[ThreadingHTTPServer, threading.Thread, str]:
+    """Start a daemon server thread; returns (server, thread, base_url).
+    port=0 picks a free port (tests)."""
+    server = make_server(engine, host, port)
+    thread = threading.Thread(
+        target=server.serve_forever, daemon=True, name="sutro-http"
+    )
+    thread.start()
+    return server, thread, f"http://{host}:{server.server_address[1]}"
+
+
+def serve(
+    host: str = "127.0.0.1",
+    port: int = DEFAULT_PORT,
+    ecfg: Optional[Any] = None,
+    verbose: bool = True,
+) -> None:
+    """Blocking entry point (``sutro serve``)."""
+    from .engine.api import get_engine
+
+    engine = get_engine(ecfg)
+    server = make_server(engine, host, port, verbose=verbose)
+    print(f"sutro-tpu engine daemon listening on http://{host}:{port}")
+    print("point clients at it with: sutro set-base-url "
+          f"http://{host}:{port} && sutro set-backend remote")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        server.shutdown()

@@ -7,7 +7,6 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from sutro_tpu.engine.config import EngineConfig
 from sutro_tpu.engine.runner import ModelRunner
 from sutro_tpu.models import transformer
 from sutro_tpu.models.configs import MODEL_CONFIGS
@@ -51,18 +50,16 @@ def test_pipeline_validates_divisibility(eight_devices):
         pipeline_forward(cfg, params, ids, pos, vl, mesh, n_microbatches=2)
 
 
-def test_pp_runner_generation_matches_single_device(eight_devices):
+def test_pp_runner_generation_matches_single_device(
+    eight_devices, mesh_ecfg
+):
     """Greedy prefill+decode through the engine runner must be identical
     with the layer stack pipeline-sharded (pp=2) and pp x tp (2x2)."""
     cfg = MODEL_CONFIGS["tiny-dense"]
-    ecfg = EngineConfig(
-        kv_page_size=8, max_pages_per_seq=8, decode_batch_size=4,
-        max_model_len=64, use_pallas=False, param_dtype="float32",
-    )
     prompt = (np.arange(17, dtype=np.int32) * 5) % 199
 
     def run(mesh):
-        runner = ModelRunner(cfg, ecfg, mesh=mesh)
+        runner = ModelRunner(cfg, mesh_ecfg, mesh=mesh)
         table = np.zeros((8,), np.int32)
         table[:4] = [1, 2, 3, 4]
         logits = runner.prefill(prompt, table)

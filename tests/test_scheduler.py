@@ -93,9 +93,9 @@ def test_progress_stream_fields(tiny_runner, byte_tok):
 
 def test_pages_released(tiny_runner, byte_tok):
     b = ContinuousBatcher(tiny_runner, stop_ids=byte_tok.stop_ids())
-    free0 = b.allocator.free_count
+    free0 = b.free_page_count
     run_all(b, make_requests(byte_tok, ["p1", "p2", "p3"], max_new_tokens=5))
-    assert b.allocator.free_count == free0
+    assert b.free_page_count == free0
 
 
 def test_constraint_mask_smaller_than_model_vocab(tiny_ecfg, byte_tok):

@@ -1,0 +1,121 @@
+"""Engine HTTP daemon (server.py): the SDK's remote backend against a live
+in-process server — detach/attach across "processes", results, datasets,
+cancellation, functions (wire contract SURVEY §3.6)."""
+
+import numpy as np
+import pytest
+
+from sutro_tpu.engine.api import LocalEngine
+from sutro_tpu.engine.config import EngineConfig
+from sutro_tpu.interfaces import JobStatus
+from sutro_tpu.server import start_server_thread
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory, monkeypatch_module):
+    """A live daemon over a tiny CPU engine + an SDK bound to it."""
+    home = tmp_path_factory.mktemp("serve-home")
+    monkeypatch_module.setenv("SUTRO_HOME", str(home))
+    ecfg = EngineConfig(
+        kv_page_size=8, max_pages_per_seq=16, decode_batch_size=4,
+        max_model_len=128, use_pallas=False, param_dtype="float32",
+        activation_dtype="float32", max_new_tokens=8,
+    )
+    engine = LocalEngine(ecfg)
+    server, thread, url = start_server_thread(engine)
+    from sutro_tpu.sdk import Sutro
+
+    sdk = Sutro(api_key="test-key", base_url=url, backend="remote")
+    sdk.set_serving_base_url(url)  # functions/run rides the serving host
+    yield sdk, engine, url
+    server.shutdown()
+
+
+def test_auth_and_quotas(served):
+    sdk, _, _ = served
+    assert sdk.try_authentication()["authenticated"] is True
+    quotas = sdk.get_quotas()
+    assert quotas and all("row_quota" in q for q in quotas)
+
+
+def test_infer_detach_results_roundtrip(served):
+    sdk, engine, _ = served
+    job_id = sdk.infer(
+        ["hello", "world", "again"], model="tiny-dense", stay_attached=False
+    )
+    assert isinstance(job_id, str) and job_id.startswith("job-")
+    df = sdk.await_job_completion(job_id, timeout=300)
+    assert df is not None and len(df) == 3
+    # a *different* client (fresh SDK) attaches to the same job
+    from sutro_tpu.sdk import Sutro
+
+    other = Sutro(api_key="k2", base_url=served[2], backend="remote")
+    assert other.get_job_status(job_id) == JobStatus.SUCCEEDED.value
+    df2 = other.get_job_results(job_id, disable_cache=True)
+    assert list(df2["inference_result"]) == list(df["inference_result"])
+
+
+def test_stream_progress_over_http(served):
+    sdk, _, _ = served
+    job_id = sdk.infer(["stream me"], model="tiny-dense", stay_attached=False)
+    updates = list(sdk._iter_progress(job_id))
+    assert any(u.get("update_type") == "progress" for u in updates)
+    sdk.await_job_completion(job_id, timeout=300, obtain_results=False)
+
+
+def test_job_record_and_list(served):
+    sdk, _, _ = served
+    jobs = sdk.list_jobs()
+    assert jobs
+    rec = sdk._fetch_job(jobs[0]["job_id"])
+    assert "status" in rec and "num_rows" in rec
+
+
+def test_cancel_queued_job(served):
+    sdk, engine, _ = served
+    # pile up work so the next job sits in the queue long enough to cancel
+    blocker = sdk.infer(
+        ["b"] * 4, model="tiny-dense", stay_attached=False
+    )
+    victim = sdk.infer(["v"] * 4, model="tiny-dense", stay_attached=False)
+    out = sdk.cancel_job(victim)
+    assert out["status"] in (
+        JobStatus.CANCELLED.value, JobStatus.CANCELLING.value,
+        JobStatus.SUCCEEDED.value,  # raced to completion: acceptable
+    )
+    sdk.await_job_completion(blocker, timeout=300, obtain_results=False)
+
+
+def test_datasets_over_http(served, tmp_path):
+    sdk, _, _ = served
+    dataset_id = sdk.create_dataset()
+    assert dataset_id.startswith("dataset-")
+    src = tmp_path / "rows.csv"
+    src.write_text("text\nalpha\nbeta\n")
+    sdk.upload_to_dataset(dataset_id, [str(src)])
+    assert sdk.list_dataset_files(dataset_id) == ["rows.csv"]
+    listed = sdk.list_datasets()
+    assert any(d["dataset_id"] == dataset_id for d in listed)
+    out = sdk.download_from_dataset(
+        dataset_id, output_path=str(tmp_path / "dl")
+    )
+    assert (tmp_path / "dl" / "rows.csv").read_text() == src.read_text()
+    assert out and out[0].endswith("rows.csv")
+    # dataset as inference input through the daemon
+    job_id = sdk.infer(dataset_id, model="tiny-dense", column="text",
+                       stay_attached=False)
+    df = sdk.await_job_completion(job_id, timeout=300)
+    assert len(df) == 2
+
+
+def test_functions_run_over_http(served):
+    sdk, _, _ = served
+    out = sdk.run_function(name="tiny-dense", input_data={"q": "hi"})
+    assert "response" in out and out["run_id"].startswith("job-")
+    assert out["usage"]["input_tokens"] > 0
+
+
+def test_unknown_endpoint_404(served):
+    sdk, _, _ = served
+    resp = sdk.do_request("get", "no-such-endpoint")
+    assert resp.status_code == 404
