@@ -410,12 +410,15 @@ class LocalEngine:
                 return True
             return False
 
-        batcher.run(
-            requests,
-            on_result=on_result,
-            on_progress=on_progress,
-            should_cancel=should_cancel,
-        )
+        from .profiling import job_trace
+
+        with job_trace(self.ecfg.profile_dir, job_id):
+            batcher.run(
+                requests,
+                on_result=on_result,
+                on_progress=on_progress,
+                should_cancel=should_cancel,
+            )
         if pending_flush:
             self.jobs.flush_partial(job_id, list(pending_flush))
             pending_flush.clear()
@@ -452,6 +455,7 @@ class LocalEngine:
             input_tokens=input_tokens,
             output_tokens=output_tokens,
             job_cost=estimate_cost(engine_key, input_tokens, output_tokens),
+            perf=batcher.timer.summary(),
         )
         jm.progress(rec.num_rows)
         self.jobs.finalize_results(job_id, ordered)

@@ -35,11 +35,15 @@ class EngineConfig:
     # --- dtype policy ------------------------------------------------------
     activation_dtype: str = "bfloat16"
     param_dtype: str = "bfloat16"
+    quantize: Optional[str] = None  # "int8" => weight-only per-channel
+                                    # quantization of the projection
+                                    # matrices (ops/quant.py)
     # --- KV cache / batching ----------------------------------------------
     kv_page_size: int = 64          # tokens per KV page
     max_pages_per_seq: int = 128    # => max context 8192 by default
     decode_batch_size: int = 64     # fixed decode slot count (static shapes)
-    prefill_chunk: int = 512        # reserved: chunked prefill (not yet wired)
+    prefill_chunk: int = 512        # prompts longer than this prefill in
+                                    # fixed-size chunks (runner.prefill)
     max_batch_tokens: int = 32768   # admission budget: sum of in-flight
                                     # worst-case totals (scheduler._try_admit)
     max_model_len: int = 8192
@@ -52,6 +56,8 @@ class EngineConfig:
     use_pallas: Optional[bool] = None   # None => auto (TPU yes, CPU no)
     weights_dir: Optional[str] = None   # local HF-style checkpoint root
     seed: int = 0
+    profile_dir: Optional[str] = None   # capture per-job jax.profiler
+                                        # traces here (engine/profiling.py)
 
     def resolved_mesh(
         self, n_devices: int

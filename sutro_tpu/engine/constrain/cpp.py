@@ -40,15 +40,16 @@ def _load_lib():
     global _lib
     if _lib is not None:
         return _lib
-    if not os.path.exists(_LIB_PATH):
-        if not os.path.exists(os.path.join(_NATIVE_DIR, "fsm.cpp")):
-            raise FileNotFoundError("native/fsm.cpp not present")
-        subprocess.run(
-            ["make", "-C", _NATIVE_DIR],
-            check=True,
-            capture_output=True,
-            timeout=120,
-        )
+    if not os.path.exists(os.path.join(_NATIVE_DIR, "fsm.cpp")):
+        raise FileNotFoundError("native/fsm.cpp not present")
+    # always run make: a no-op when the .so is fresh, a rebuild when
+    # fsm.cpp changed (the artifact is not checked in)
+    subprocess.run(
+        ["make", "-C", _NATIVE_DIR],
+        check=True,
+        capture_output=True,
+        timeout=120,
+    )
     lib = ctypes.CDLL(_LIB_PATH)
     lib.fsm_create.restype = ctypes.c_void_p
     lib.fsm_create.argtypes = [

@@ -11,7 +11,9 @@ from __future__ import annotations
 
 import datetime
 import json
+import os
 import shutil
+import threading
 import uuid
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Union
@@ -30,6 +32,24 @@ def _root() -> Path:
 class DatasetStore:
     def __init__(self, root: Optional[Path] = None):
         self.root = Path(root) if root else _root()
+        # serializes .meta.json read-modify-writes (the daemon's HTTP
+        # threads hit the store concurrently, server.py)
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _write_meta(d: Path, meta: Dict[str, Any]) -> None:
+        """Atomic replace so concurrent readers never see torn JSON."""
+        tmp = d / ".meta.json.tmp"
+        tmp.write_text(json.dumps(meta, indent=2))
+        os.replace(tmp, d / ".meta.json")
+
+    def _touch_meta(self, d: Path) -> None:
+        with self._lock:
+            meta = json.loads((d / ".meta.json").read_text())
+            meta["updated_at"] = datetime.datetime.now(
+                datetime.timezone.utc
+            ).isoformat()
+            self._write_meta(d, meta)
 
     def _dir(self, dataset_id: str) -> Path:
         if not dataset_id.startswith("dataset-"):
@@ -50,7 +70,7 @@ class DatasetStore:
             ).isoformat(),
             "updated_at": None,
         }
-        (d / ".meta.json").write_text(json.dumps(meta, indent=2))
+        self._write_meta(d, meta)
         return dataset_id
 
     def upload(
@@ -68,11 +88,7 @@ class DatasetStore:
             else:
                 shutil.copy2(p, d / p.name)
                 names.append(p.name)
-        meta = json.loads((d / ".meta.json").read_text())
-        meta["updated_at"] = datetime.datetime.now(
-            datetime.timezone.utc
-        ).isoformat()
-        (d / ".meta.json").write_text(json.dumps(meta, indent=2))
+        self._touch_meta(d)
         return names
 
     def upload_bytes(
@@ -85,11 +101,7 @@ class DatasetStore:
         if not name or name == ".meta.json":
             raise ValueError(f"Invalid upload file name: {file_name!r}")
         (d / name).write_bytes(data)
-        meta = json.loads((d / ".meta.json").read_text())
-        meta["updated_at"] = datetime.datetime.now(
-            datetime.timezone.utc
-        ).isoformat()
-        (d / ".meta.json").write_text(json.dumps(meta, indent=2))
+        self._touch_meta(d)
         return name
 
     def list_datasets(self) -> List[Dict[str, Any]]:
@@ -127,7 +139,10 @@ class DatasetStore:
     def list_files(self, dataset_id: str) -> List[str]:
         d = self._dir(dataset_id)
         return sorted(
-            f.name for f in d.iterdir() if f.is_file() and f.name != ".meta.json"
+            f.name
+            for f in d.iterdir()
+            # dotfiles excluded: .meta.json and its atomic-replace temp
+            if f.is_file() and not f.name.startswith(".")
         )
 
     def file_path(self, dataset_id: str, file_name: str) -> Path:

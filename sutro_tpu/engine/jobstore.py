@@ -111,6 +111,8 @@ class JobRecord:
     truncate_rows: bool = True
     dry_run: bool = False
     random_seed_per_input: bool = False
+    # per-job latency profile (engine/profiling.py StepTimer.summary())
+    perf: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

@@ -40,15 +40,16 @@ def _load_lib():
         _lib_failed = True
         return None
     try:
-        if not os.path.exists(_LIB_PATH):
-            if not os.path.exists(os.path.join(_NATIVE_DIR, "runtime.cpp")):
-                raise FileNotFoundError("native/runtime.cpp not present")
-            subprocess.run(
-                ["make", "-C", _NATIVE_DIR],
-                check=True,
-                capture_output=True,
-                timeout=120,
-            )
+        if not os.path.exists(os.path.join(_NATIVE_DIR, "runtime.cpp")):
+            raise FileNotFoundError("native/runtime.cpp not present")
+        # always run make: a no-op when the .so is fresh, a rebuild when
+        # runtime.cpp changed (the artifact is not checked in)
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
         lib = ctypes.CDLL(_LIB_PATH)
     except Exception:
         _lib_failed = True

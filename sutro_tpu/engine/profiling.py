@@ -1,0 +1,80 @@
+"""Engine-level profiling.
+
+The reference has no profiling at all (SURVEY §5.1: "No performance
+profiling exists"); this is the TPU build's addition: device traces via
+``jax.profiler`` (viewable in TensorBoard/XProf) plus host-side step
+timing that lands in the job record, so every job reports its own
+latency profile without external tooling.
+
+- ``job_trace(profile_dir, job_id)``: context manager capturing an XLA
+  device trace for the whole job into ``{profile_dir}/{job_id}`` when
+  ``EngineConfig.profile_dir`` is set (off by default — tracing costs
+  memory and time).
+- ``StepTimer``: cheap wall-clock histogram of prefill/decode steps;
+  summarized as count/mean/p50/p90/p99 milliseconds.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+
+@contextlib.contextmanager
+def job_trace(profile_dir: Optional[str], job_id: str) -> Iterator[None]:
+    if not profile_dir:
+        yield
+        return
+    import os
+
+    import jax
+
+    path = os.path.join(profile_dir, job_id)
+    os.makedirs(path, exist_ok=True)
+    jax.profiler.start_trace(path)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Wall-clock step latencies by phase ("prefill" / "decode")."""
+
+    def __init__(self) -> None:
+        self._samples: Dict[str, List[float]] = {}
+
+    @contextlib.contextmanager
+    def time(self, phase: str) -> Iterator[None]:
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self._samples.setdefault(phase, []).append(
+                time.monotonic() - t0
+            )
+
+    def add(self, phase: str, seconds: float) -> None:
+        self._samples.setdefault(phase, []).append(seconds)
+
+    def summary(self) -> Dict[str, Dict[str, Any]]:
+        out: Dict[str, Dict[str, Any]] = {}
+        for phase, xs in self._samples.items():
+            if not xs:
+                continue
+            s = sorted(xs)
+            n = len(s)
+
+            def pct(p: float) -> float:
+                return s[min(int(p * n), n - 1)]
+
+            out[phase] = {
+                "count": n,
+                "total_s": round(sum(s), 4),
+                "mean_ms": round(1e3 * sum(s) / n, 3),
+                "p50_ms": round(1e3 * pct(0.50), 3),
+                "p90_ms": round(1e3 * pct(0.90), 3),
+                "p99_ms": round(1e3 * pct(0.99), 3),
+            }
+        return out

@@ -58,6 +58,20 @@ class ModelRunner:
             params = transformer.init_params(
                 mcfg, jax.random.PRNGKey(ecfg.seed), dtype
             )
+        if ecfg.quantize == "int8":
+            from ..ops.quant import is_quantized, quantize_params
+
+            if not any(
+                is_quantized(x)
+                for x in jax.tree_util.tree_leaves(
+                    params, is_leaf=is_quantized
+                )
+            ):
+                params = quantize_params(params)
+        elif ecfg.quantize:
+            raise ValueError(
+                f"Unknown quantize mode {ecfg.quantize!r} (only 'int8')"
+            )
         # Mesh: explicit > engine-config-resolved > single-device (None).
         if mesh is None:
             from ..parallel.mesh import auto_mesh
@@ -146,12 +160,59 @@ class ModelRunner:
         )[:, 0]
         return last_logits, cache
 
+    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+    def _prefill_chunk_jit(
+        self, params, cache: KVCache, ids, valid_len, page_table, start
+    ):
+        """One fixed-size chunk of a long prompt: attends over the pages
+        written by earlier chunks (past_len = start), scatters its own K/V.
+        A single compile serves every chunk of every long prompt."""
+        B, C = ids.shape
+        positions = start[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
+        logits, _, (k, v) = transformer.forward(
+            self.mcfg, params, ids, positions, valid_len,
+            paged_past=(cache.k_pages, cache.v_pages, page_table),
+            past_len=start,
+            use_pallas=self.use_pallas,
+        )
+        cache = write_kv(
+            cache, k, v, page_table, start, valid_len,
+            use_pallas=self.use_pallas,
+        )
+        last = jnp.maximum(valid_len - 1, 0)
+        last_logits = jnp.take_along_axis(
+            logits, last[:, None, None], axis=1
+        )[:, 0]
+        return last_logits, cache
+
     def prefill(
         self, token_ids: np.ndarray, page_table: np.ndarray
     ) -> np.ndarray:
         """One prompt ([T] int32) -> last-position logits [V]. ``page_table``
-        is the slot's [MP] row."""
+        is the slot's [MP] row.
+
+        Long prompts (> ``prefill_chunk``) are processed in fixed-size
+        chunks so attention transients stay O(chunk x ctx) instead of
+        O(T^2) and one compile covers all lengths — except under
+        sequence parallelism (sp > 1), where the ring path wants the full
+        sequence resident and sharded (ops/ring_attention.py)."""
         n = len(token_ids)
+        C = self.ecfg.prefill_chunk
+        if n > C and self.sp == 1 and self.pp == 1:
+            table_dev = jnp.asarray(page_table[None, :], jnp.int32)
+            for off in range(0, n, C):
+                seg = token_ids[off : off + C]
+                ids = np.zeros((1, C), np.int32)
+                ids[0, : len(seg)] = seg
+                logits, self.cache = self._prefill_chunk_jit(
+                    self.params,
+                    self.cache,
+                    jnp.asarray(ids),
+                    jnp.asarray([len(seg)], jnp.int32),
+                    table_dev,
+                    jnp.asarray([off], jnp.int32),
+                )
+            return np.asarray(logits[0])
         T = next_bucket(max(n, 1), lo=16, hi=self.ecfg.max_context())
         if T % self.sp:  # ring prefill shards T over the seq axis
             T = -(-T // self.sp) * self.sp

@@ -154,6 +154,9 @@ class ContinuousBatcher:
         self._key = jax.random.PRNGKey(seed)
         self._fixed_key = jax.random.PRNGKey(seed)
         self._step = 0
+        from .profiling import StepTimer
+
+        self.timer = StepTimer()
 
     # ------------------------------------------------------------------
 
@@ -202,7 +205,10 @@ class ContinuousBatcher:
             table = np.zeros((self.MP,), np.int32)
             table[: len(pages)] = pages
 
-        logits = self.runner.prefill(req.prompt_ids.astype(np.int32), table)
+        with self.timer.time("prefill"):
+            logits = self.runner.prefill(
+                req.prompt_ids.astype(np.int32), table
+            )
         first, first_logp = self._sample_one(logits, req)
         slot = _Slot(req=req, pages=pages, pos=n, last_token=first)
         self.slots[free_idx] = slot
@@ -458,11 +464,12 @@ class ContinuousBatcher:
             # row-seeded sampling needs a batch-independent base key so a
             # row's stream reproduces regardless of batch composition
             rng = self._fixed_key if has_row_seed else sub
-            toks, logps = self.runner.decode_step(
-                last, past_len, table, rng, temp, top_p,
-                top_k=top_k, allowed=allowed,
-                row_seeds=row_seeds if has_row_seed else None,
-            )
+            with self.timer.time("decode"):
+                toks, logps = self.runner.decode_step(
+                    last, past_len, table, rng, temp, top_p,
+                    top_k=top_k, allowed=allowed,
+                    row_seeds=row_seeds if has_row_seed else None,
+                )
             self._step += 1
 
             for i in active:

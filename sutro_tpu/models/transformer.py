@@ -32,8 +32,14 @@ import numpy as np
 from .configs import ModelConfig
 from ..ops.moe import moe_mlp
 from ..ops.attention import chunk_attention
+from ..ops.quant import materialize
 
 Params = Dict[str, Any]
+
+
+def _w(lp: Dict[str, Any], name: str, dtype) -> jax.Array:
+    """Possibly-int8 weight leaf -> matmul-ready array (ops/quant.py)."""
+    return materialize(lp[name], dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -142,9 +148,9 @@ def _mlp(cfg: ModelConfig, lp: Dict[str, Any], x: jax.Array) -> jax.Array:
         return moe_mlp(
             x,
             lp["router"],
-            lp["we_gate"],
-            lp["we_up"],
-            lp["we_down"],
+            _w(lp, "we_gate", x.dtype),
+            _w(lp, "we_up", x.dtype),
+            _w(lp, "we_down", x.dtype),
             top_k=cfg.moe_top_k,
             activation=cfg.activation,
             router_b=lp.get("router_b"),
@@ -152,8 +158,8 @@ def _mlp(cfg: ModelConfig, lp: Dict[str, Any], x: jax.Array) -> jax.Array:
             bias_up=lp.get("we_up_b"),
             bias_down=lp.get("we_down_b"),
         )
-    gate = x @ lp["w_gate"]
-    up = x @ lp["w_up"]
+    gate = x @ _w(lp, "w_gate", x.dtype)
+    up = x @ _w(lp, "w_up", x.dtype)
     if cfg.activation == "gelu":
         act = jax.nn.gelu(gate.astype(jnp.float32), approximate=True).astype(x.dtype)
     elif cfg.activation == "swiglu_oss":
@@ -162,7 +168,7 @@ def _mlp(cfg: ModelConfig, lp: Dict[str, Any], x: jax.Array) -> jax.Array:
         up = jnp.clip(up.astype(jnp.float32), -7.0, 7.0).astype(x.dtype) + 1.0
     else:
         act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype)
-    return (act * up) @ lp["w_down"]
+    return (act * up) @ _w(lp, "w_down", x.dtype)
 
 
 def layer_apply(
@@ -187,9 +193,9 @@ def layer_apply(
     B, T = h.shape[:2]
     resid = h
     x = rms_norm(h, lp["attn_norm"], cfg.norm_eps, cfg.norm_zero_centered)
-    q = x @ lp["wq"]
-    k = x @ lp["wk"]
-    v = x @ lp["wv"]
+    q = x @ _w(lp, "wq", x.dtype)
+    k = x @ _w(lp, "wk", x.dtype)
+    v = x @ _w(lp, "wv", x.dtype)
     if cfg.attn_bias:
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
     q = q.reshape(B, T, cfg.num_heads, cfg.head_dim)
@@ -211,7 +217,7 @@ def layer_apply(
         use_pallas=use_pallas,
         ring_mesh=ring_mesh,
     )
-    attn = attn.reshape(B, T, cfg.q_size) @ lp["wo"]
+    attn = attn.reshape(B, T, cfg.q_size) @ _w(lp, "wo", h.dtype)
     if cfg.attn_bias:
         attn = attn + lp["bo"]
     if cfg.post_norms:
@@ -272,6 +278,8 @@ def head_apply(
     lm_head = params.get("lm_head")
     if lm_head is None:
         lm_head = params["embed"].T
+    else:
+        lm_head = materialize(lm_head, h.dtype)
     return (h @ lm_head.astype(h.dtype)).astype(jnp.float32), h
 
 

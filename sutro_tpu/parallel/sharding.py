@@ -65,17 +65,30 @@ _TOP_RULES: Dict[str, P] = {
 
 
 def param_shardings(params: Any, mesh: Mesh) -> Any:
-    """Pytree of NamedSharding matching ``params`` structure."""
+    """Pytree of NamedSharding matching ``params`` structure.
+
+    Quantized leaves (ops/quant.py: ``{"qw", "scale"}`` under the weight
+    name) inherit the weight's rule; ``scale``'s collapsed reduction axis
+    (size 1) drops its mesh axis so size-1 dims are never sharded."""
 
     def rule(path, leaf) -> NamedSharding:
         names = [p.key for p in path if hasattr(p, "key")]
         leaf_name = names[-1]
+        if leaf_name in ("qw", "scale") and len(names) >= 2:
+            leaf_name = names[-2]
         if "layers" in names:
             spec = _LAYER_RULES.get(leaf_name, P())
         else:
             spec = _TOP_RULES.get(leaf_name, P())
         if len(spec) > leaf.ndim:
             spec = P(*spec[: leaf.ndim])
+        if any(d == 1 for d in leaf.shape) and len(spec):
+            spec = P(
+                *(
+                    None if leaf.shape[i] == 1 else ax
+                    for i, ax in enumerate(spec)
+                )
+            )
         return NamedSharding(mesh, spec)
 
     return jax.tree_util.tree_map_with_path(rule, params)

@@ -228,7 +228,14 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
                 send_chunk(update)
         except (BrokenPipeError, ConnectionResetError):
             return  # client detached — job keeps running
-        self.wfile.write(b"0\r\n\r\n")
+        except Exception:  # noqa: BLE001 — headers already sent: a second
+            # response would corrupt the chunked body; terminate cleanly
+            # and let the client treat the early end-of-stream as done.
+            pass
+        try:
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass
 
     def _functions_run(self) -> None:
         """Synchronous single-input serving call (reference sdk.py:512-588

@@ -140,3 +140,31 @@ def test_constraint_mask_smaller_than_model_vocab(tiny_ecfg, byte_tok):
         on_result=lambda r: res.__setitem__(r.row_id, r),
     )
     assert all(t == 7 for t in res[0].token_ids)
+
+
+def test_job_perf_profile_recorded(tiny_ecfg, byte_tok, tmp_path, monkeypatch):
+    """Completed jobs carry a StepTimer latency summary in their record
+    (engine/profiling.py; SURVEY §5.1 engine-level profiling)."""
+    monkeypatch.setenv("SUTRO_HOME", str(tmp_path))
+    from sutro_tpu.engine.api import LocalEngine
+
+    eng = LocalEngine(tiny_ecfg)
+    job_id = eng.submit_batch_inference(
+        {"model": "tiny-dense", "inputs": ["a", "bb"],
+         "sampling_params": {"max_new_tokens": 5}}
+    )
+    import time
+
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        from sutro_tpu.interfaces import JobStatus
+
+        if JobStatus(eng.job_status(job_id)).is_terminal():
+            break
+        time.sleep(0.2)
+    rec = eng.get_job(job_id)
+    assert rec["status"] == "SUCCEEDED", rec.get("failure_reason")
+    perf = rec["perf"]
+    assert perf and "decode" in perf and "prefill" in perf
+    assert perf["prefill"]["count"] == 2
+    assert perf["decode"]["p50_ms"] > 0
