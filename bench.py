@@ -11,7 +11,8 @@ run) so later rounds report their speedup over this round; the reference
 publishes no numbers to compare against (BASELINE.md).
 
 Env knobs: SUTRO_BENCH_MODEL, SUTRO_BENCH_BATCH, SUTRO_BENCH_STEPS,
-SUTRO_BENCH_PROMPT.
+SUTRO_BENCH_PROMPT, SUTRO_BENCH_MULTI (decode steps fused per device
+program; 1 = legacy per-token dispatch).
 """
 
 from __future__ import annotations
@@ -35,11 +36,14 @@ def main() -> None:
     B = int(os.environ.get("SUTRO_BENCH_BATCH", "64"))
     steps = int(os.environ.get("SUTRO_BENCH_STEPS", "128"))
     prompt_len = int(os.environ.get("SUTRO_BENCH_PROMPT", "128"))
+    multi = int(os.environ.get("SUTRO_BENCH_MULTI", "16"))
 
     on_tpu = jax.default_backend() not in ("cpu",)
     if not on_tpu:  # keep CPU smoke runs fast
         model_key = os.environ.get("SUTRO_BENCH_MODEL", "tiny-dense")
         B, steps, prompt_len = 4, 16, 16
+        multi = min(multi, 4)
+    steps = -(-steps // multi) * multi  # whole windows
 
     mcfg = MODEL_CONFIGS[model_key]
     ecfg = EngineConfig(
@@ -78,19 +82,37 @@ def main() -> None:
     top_p = np.full((B,), 0.95, np.float32)
 
     # warmup (compile)
-    toks, _ = runner.decode_step(
-        last, past_len, tables, jax.random.PRNGKey(0), temp, top_p
-    )
-    past_len += 1
-    last = toks.astype(np.int32)
-
-    t0 = time.monotonic()
-    for i in range(steps):
+    if multi > 1:
+        toks_w, _ = runner.decode_multi(
+            last, past_len, tables, jax.random.PRNGKey(0), temp, top_p,
+            multi,
+        )
+        past_len += multi
+        last = toks_w[-1].astype(np.int32)
+    else:
         toks, _ = runner.decode_step(
-            last, past_len, tables, jax.random.PRNGKey(i + 1), temp, top_p
+            last, past_len, tables, jax.random.PRNGKey(0), temp, top_p
         )
         past_len += 1
         last = toks.astype(np.int32)
+
+    t0 = time.monotonic()
+    if multi > 1:
+        for i in range(steps // multi):
+            toks_w, _ = runner.decode_multi(
+                last, past_len, tables, jax.random.PRNGKey(i + 1),
+                temp, top_p, multi,
+            )
+            past_len += multi
+            last = toks_w[-1].astype(np.int32)
+    else:
+        for i in range(steps):
+            toks, _ = runner.decode_step(
+                last, past_len, tables, jax.random.PRNGKey(i + 1), temp,
+                top_p,
+            )
+            past_len += 1
+            last = toks.astype(np.int32)
     dt = time.monotonic() - t0
 
     n_chips = max(jax.device_count(), 1)

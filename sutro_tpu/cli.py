@@ -197,6 +197,29 @@ def jobs_cancel(job_id: str) -> None:
     click.echo(to_colored_text(f"Status: {out.get('status')}", "callout"))
 
 
+@jobs.command("resume")
+@click.argument("job_id")
+def jobs_resume(job_id: str) -> None:
+    """Re-queue a failed/cancelled job; completed rows are kept."""
+    out = get_sdk().resume_job(job_id)
+    if out.get("resumed"):
+        click.echo(
+            to_colored_text(
+                f"✔ Resumed ({out.get('rows_already_done', 0)} rows "
+                "already done)",
+                "success",
+            )
+        )
+    else:
+        click.echo(
+            to_colored_text(
+                f"Not resumed: {out.get('detail')} "
+                f"(status: {out.get('status')})",
+                "callout",
+            )
+        )
+
+
 @jobs.command("attach")
 @click.argument("job_id", required=False)
 @click.option("--latest", is_flag=True, help="Attach to the most recent job")

@@ -90,6 +90,8 @@ class LocalEngine:
         self._queue: "queue.PriorityQueue" = queue.PriorityQueue()
         self._seq = 0
         self._cancel: set = set()
+        self._queued: set = set()
+        self._current_job: Optional[str] = None
         self._lock = threading.Lock()
         self._runner_cache: Dict[str, Tuple[ModelRunner, BaseTokenizer]] = {}
         self._worker = threading.Thread(
@@ -159,10 +161,14 @@ class LocalEngine:
             )
             return rec.job_id
 
+        self._enqueue(rec.job_priority, rec.job_id)
+        return rec.job_id
+
+    def _enqueue(self, priority: int, job_id: str) -> None:
         with self._lock:
             self._seq += 1
-            self._queue.put((rec.job_priority, self._seq, rec.job_id))
-        return rec.job_id
+            self._queued.add(job_id)
+            self._queue.put((priority, self._seq, job_id))
 
     def job_status(self, job_id: str) -> str:
         return self.jobs.status(job_id).value
@@ -211,6 +217,51 @@ class LocalEngine:
             return
         yield from jm.subscribe()
 
+    def resume_job(self, job_id: str) -> Dict[str, Any]:
+        """Row-granular resume (SURVEY §5.3): re-queue a FAILED/CANCELLED
+        job — or one left RUNNING/STARTING by a dead engine process. Rows
+        already flushed to the partial store are not recomputed
+        (_run_job reads them back and skips)."""
+        import time as _time
+
+        status = self.jobs.status(job_id)
+        deadline = _time.monotonic() + 5.0
+        while True:
+            with self._lock:
+                busy = (
+                    job_id in self._queued or job_id == self._current_job
+                )
+            if not busy:
+                break
+            # terminal status + still "current": the worker is in its
+            # epilogue (flush/metrics) — wait for it to let go rather
+            # than refusing a resume the caller can see is legitimate
+            if not status.is_terminal() or _time.monotonic() > deadline:
+                return {"status": status.value, "resumed": False,
+                        "detail": "job is already queued or running"}
+            _time.sleep(0.02)
+            status = self.jobs.status(job_id)
+        if status == JobStatus.SUCCEEDED:
+            return {"status": status.value, "resumed": False,
+                    "detail": "job already succeeded"}
+        rec = self.jobs.get(job_id)
+        self._cancel.discard(job_id)
+        self.metrics.drop(job_id)  # fresh progress stream for the re-run
+        self.jobs.set_status(job_id, JobStatus.QUEUED, failure_reason=None)
+        self._enqueue(rec.job_priority, job_id)
+        # mirror _run_job's resume filter: cancelled-truncated rows are
+        # regenerated, so they don't count as already done
+        done = sum(
+            1
+            for r in self.jobs.read_partial(job_id).values()
+            if r.get("finish_reason") != "cancelled"
+        )
+        return {
+            "status": JobStatus.QUEUED.value,
+            "resumed": True,
+            "rows_already_done": done,
+        }
+
     def get_quotas(self) -> List[Dict[str, int]]:
         return self.jobs.get_quotas()
 
@@ -250,6 +301,9 @@ class LocalEngine:
     def _worker_loop(self) -> None:
         while True:
             _, _, job_id = self._queue.get()
+            with self._lock:
+                self._queued.discard(job_id)
+                self._current_job = job_id
             try:
                 if job_id in self._cancel:
                     self.jobs.set_status(job_id, JobStatus.CANCELLED)
@@ -266,7 +320,12 @@ class LocalEngine:
                 except Exception:
                     pass
             finally:
+                # finish metrics BEFORE releasing _current_job: resume_job
+                # waits on _current_job, and must not race this epilogue
+                # into finishing the resumed run's fresh metrics stream
                 self.metrics.job(job_id).finish()
+                with self._lock:
+                    self._current_job = None
 
     def _run_job(self, job_id: str) -> None:
         rec = self.jobs.get(job_id)
@@ -316,7 +375,12 @@ class LocalEngine:
                 rec.output_schema, tok
             )
 
-        resume = self.jobs.read_partial(job_id)
+        # cancelled rows carry truncated output — regenerate them on resume
+        resume = {
+            i: r
+            for i, r in self.jobs.read_partial(job_id).items()
+            if r.get("finish_reason") != "cancelled"
+        }
         results: Dict[int, Dict[str, Any]] = dict(resume)
         pending_flush: List[Dict[str, Any]] = []
         import jax

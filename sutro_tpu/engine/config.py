@@ -47,6 +47,10 @@ class EngineConfig:
     max_batch_tokens: int = 32768   # admission budget: sum of in-flight
                                     # worst-case totals (scheduler._try_admit)
     max_model_len: int = 8192
+    decode_multi_step: int = 8      # decode steps fused into one device
+                                    # program when no row needs host-side
+                                    # FSM masks/seeds (runner.decode_multi);
+                                    # amortizes dispatch+fetch latency
     # --- generation defaults ----------------------------------------------
     max_new_tokens: int = 1024
     temperature: float = 0.7

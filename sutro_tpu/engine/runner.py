@@ -292,6 +292,83 @@ class ModelRunner:
         return np.asarray(tok), np.asarray(logp)
 
     # ------------------------------------------------------------------
+    # multi-step decode
+    # ------------------------------------------------------------------
+
+    @functools.partial(
+        jax.jit, static_argnums=(0, 9), donate_argnums=(2,)
+    )
+    def _decode_multi_jit(
+        self, params, cache: KVCache, last, past_len, page_table,
+        rng, temperature, top_p, steps: int, top_k,
+    ):
+        """``steps`` decode iterations in ONE device program: the sampled
+        token feeds the next step on-device, so the host pays one dispatch
+        + one fetch per window instead of per token. This is the
+        throughput path for unconstrained generation — constrained rows
+        need the host FSM between steps (scheduler falls back to
+        single-step)."""
+        B = last.shape[0]
+        ones = jnp.ones((B,), jnp.int32)
+
+        def body(carry, step_idx):
+            cache, last, pl_ = carry
+            logits, _, (k, v) = transformer.forward(
+                self.mcfg, params, last[:, None], pl_[:, None], ones,
+                paged_past=(cache.k_pages, cache.v_pages, page_table),
+                past_len=pl_,
+                use_pallas=self.use_pallas,
+            )
+            cache = write_kv(
+                cache, k, v, page_table, pl_, ones,
+                use_pallas=self.use_pallas,
+            )
+            step_logits = logits[:, 0]
+            key = jax.random.fold_in(rng, step_idx)
+            tok = sample(
+                step_logits, key,
+                temperature=temperature, top_p=top_p, top_k=top_k,
+            )
+            logp = cumulative_logprob(step_logits, tok)
+            return (cache, tok, pl_ + 1), (tok, logp)
+
+        (cache, _, _), (toks, logps) = jax.lax.scan(
+            body,
+            (cache, last, past_len),
+            jnp.arange(steps, dtype=jnp.int32),
+        )
+        return toks, logps, cache
+
+    def decode_multi(
+        self,
+        last_tokens: np.ndarray,     # [B] int32
+        past_len: np.ndarray,        # [B] int32
+        page_table: np.ndarray,      # [B, MP] int32
+        rng: jax.Array,
+        temperature: np.ndarray,     # [B]
+        top_p: np.ndarray,           # [B]
+        steps: int,
+        top_k: Optional[np.ndarray] = None,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Returns (tokens [steps, B], logprobs [steps, B])."""
+        B = len(last_tokens)
+        if top_k is None:
+            top_k = np.zeros((B,), np.int32)
+        toks, logps, self.cache = self._decode_multi_jit(
+            self.params,
+            self.cache,
+            jnp.asarray(last_tokens, jnp.int32),
+            jnp.asarray(past_len, jnp.int32),
+            jnp.asarray(page_table, jnp.int32),
+            rng,
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(top_p, jnp.float32),
+            steps,
+            jnp.asarray(top_k, jnp.int32),
+        )
+        return np.asarray(toks), np.asarray(logps)
+
+    # ------------------------------------------------------------------
     # embeddings
     # ------------------------------------------------------------------
 

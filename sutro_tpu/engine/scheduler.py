@@ -306,6 +306,20 @@ class ContinuousBatcher:
             return "length"
         return None
 
+    def _accept_token(self, i: int, tok: int, logp: float, on_result) -> int:
+        """Record one sampled token for slot ``i``; release on finish.
+        Returns 1 if the row completed, else 0."""
+        s = self.slots[i]
+        s.pos += 1  # last_token's KV is now cached
+        if self.native is not None:
+            self.native.note_token(i, tok)
+        self._record_token(s, tok, logp)
+        s.last_token = tok
+        if self._finish_reason(s, tok):
+            on_result(self._release(i))
+            return 1
+        return 0
+
     def _release(self, i: int) -> GenResult:
         slot = self.slots[i]
         assert slot is not None
@@ -460,29 +474,64 @@ class ContinuousBatcher:
                         rem = self._remaining(s.req, len(s.out_ids), s.pos)
                         allowed[i] = self._constraint_mask(c, rem)
 
+            # Fuse K decode steps into one device program when no row
+            # needs host work between steps (FSM masks / per-row seeds):
+            # one dispatch + one fetch per window instead of per token.
+            K = 1
+            if (
+                self.ecfg.decode_multi_step > 1
+                and not has_constraint
+                and not has_row_seed
+            ):
+                cap = min(
+                    len(self.slots[i].pages) * self.ecfg.kv_page_size
+                    - self.slots[i].pos
+                    for i in active
+                )
+                # all-or-nothing: every distinct K is a separate XLA
+                # compilation of the fused window (steps is static), so
+                # near-capacity tails run single-step instead of walking
+                # through K-1 recompiles
+                if cap >= self.ecfg.decode_multi_step:
+                    K = self.ecfg.decode_multi_step
+
             self._key, sub = jax.random.split(self._key)
             # row-seeded sampling needs a batch-independent base key so a
             # row's stream reproduces regardless of batch composition
             rng = self._fixed_key if has_row_seed else sub
-            with self.timer.time("decode"):
-                toks, logps = self.runner.decode_step(
-                    last, past_len, table, rng, temp, top_p,
-                    top_k=top_k, allowed=allowed,
-                    row_seeds=row_seeds if has_row_seed else None,
-                )
-            self._step += 1
-
-            for i in active:
-                s = self.slots[i]
-                s.pos += 1  # last_token's KV is now cached
-                tok = int(toks[i])
-                if self.native is not None:
-                    self.native.note_token(i, tok)
-                self._record_token(s, tok, float(logps[i]))
-                output_tokens += 1
-                s.last_token = tok
-                if self._finish_reason(s, tok):
-                    on_result(self._release(i))
-                    rows_done += 1
+            if K > 1:
+                with self.timer.time("decode"):
+                    toks_w, logps_w = self.runner.decode_multi(
+                        last, past_len, table, sub, temp, top_p, K,
+                        top_k=top_k,
+                    )
+                self._step += K
+                for j in range(K):
+                    for i in active:
+                        if self.slots[i] is None:
+                            continue  # finished earlier in this window
+                        output_tokens += 1
+                        rows_done += self._accept_token(
+                            i, int(toks_w[j][i]), float(logps_w[j][i]),
+                            on_result,
+                        )
+                    active = [
+                        i for i in active if self.slots[i] is not None
+                    ]
+                    if not active:
+                        break
+            else:
+                with self.timer.time("decode"):
+                    toks, logps = self.runner.decode_step(
+                        last, past_len, table, rng, temp, top_p,
+                        top_k=top_k, allowed=allowed,
+                        row_seeds=row_seeds if has_row_seed else None,
+                    )
+                self._step += 1
+                for i in active:
+                    output_tokens += 1
+                    rows_done += self._accept_token(
+                        i, int(toks[i]), float(logps[i]), on_result
+                    )
             progress()
         progress(force=True)

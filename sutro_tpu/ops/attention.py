@@ -68,7 +68,7 @@ def chunk_attention(
         if use_pallas and T == 1:
             from .pallas_paged import paged_decode_attention, paged_decode_supported
 
-            if paged_decode_supported(q[:, 0], past_k_pages):
+            if paged_decode_supported(q[:, 0], past_k_pages, page_table):
                 win = (
                     jnp.asarray(0, jnp.int32) if window is None
                     else jnp.asarray(window, jnp.int32)

@@ -7,11 +7,16 @@ time at long context. This kernel instead reads K/V pages **in place**,
 walking the page table via scalar prefetch, with flash-style online
 softmax across pages:
 
-- grid ``(B, KVH, MP)``: batch and kv-head are parallel; the page axis is
-  sequential and carries running ``(m, l, acc)`` in VMEM scratch;
+- grid ``(B, MP)``: batch is parallel; the page axis is sequential and
+  carries running ``(m, l, acc)`` per KV head in VMEM scratch;
 - page blocks are addressed by ``page_table[b, ki]`` in the BlockSpec
   index_map (scalar-prefetch — the DMA for page ``ki+1`` overlaps the
   compute on page ``ki``);
+- each block carries the page's full ``[PS, KVH, Dh]`` tile (Mosaic
+  requires the trailing two block dims to be full or (8,128)-aligned;
+  blocking a single KV head would put a size-1 block on the KVH axis,
+  which the TPU lowering rejects). KV heads are processed by a static
+  in-kernel loop, one ``[G, PS]`` score tile per head;
 - pages at or beyond ``past_len[b]`` are skipped entirely (``pl.when``), so
   work is proportional to actual context, not table capacity;
 - the current token's K/V (not yet in the page pool) and the optional
@@ -19,8 +24,7 @@ softmax across pages:
 - per-layer sliding windows (Gemma3 / gpt-oss) are dynamic operands, so one
   compiled kernel serves every layer of the ``lax.scan``.
 
-GQA is expressed by blocking q as ``[B, KVH, G, Dh]``; scores are
-``[G, PS]`` per grid step. All math is float32.
+All math is float32.
 """
 
 from __future__ import annotations
@@ -42,27 +46,28 @@ def _paged_decode_kernel(
     past_len_ref,     # [B] int32
     window_ref,       # [1] int32 (0 = full attention)
     # operands
-    q_ref,            # [1, 1, G, Dh]
-    k_page_ref,       # [1, PS, 1, Dh]
-    v_page_ref,       # [1, PS, 1, Dh]
-    k_cur_ref,        # [1, 1, Dh]
-    v_cur_ref,        # [1, 1, Dh]
-    sink_ref,         # [1, G]
+    q_ref,            # [1, KVH, G, Dh]
+    k_page_ref,       # [1, PS, KVH, Dh]
+    v_page_ref,       # [1, PS, KVH, Dh]
+    k_cur_ref,        # [1, KVH, Dh]
+    v_cur_ref,        # [1, KVH, Dh]
+    sink_ref,         # [KVH, G]
     # output
-    out_ref,          # [1, 1, G, Dh]
+    out_ref,          # [1, KVH, G, Dh]
     # scratch
-    m_ref,            # [G, 128] f32
-    l_ref,            # [G, 128] f32
-    acc_ref,          # [G, Dh] f32
+    m_ref,            # [KVH, G, 128] f32
+    l_ref,            # [KVH, G, 128] f32
+    acc_ref,          # [KVH, G, Dh] f32
     *,
     num_pages_per_seq: int,
     page_size: int,
     scale: float,
+    kvh: int,
 ):
     b = pl.program_id(0)
-    ki = pl.program_id(2)
+    ki = pl.program_id(1)
     PS = page_size
-    G, Dh = q_ref.shape[2], q_ref.shape[3]
+    G = q_ref.shape[2]
 
     @pl.when(ki == 0)
     def _init():
@@ -77,59 +82,83 @@ def _paged_decode_kernel(
 
     @pl.when(page_start < past)
     def _accumulate():
-        q = q_ref[0, 0].astype(jnp.float32)           # [G, Dh]
-        k = k_page_ref[0, :, 0].astype(jnp.float32)   # [PS, Dh]
-        v = v_page_ref[0, :, 0].astype(jnp.float32)   # [PS, Dh]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale                                     # [G, PS]
         tok = page_start + jax.lax.broadcasted_iota(jnp.int32, (G, PS), 1)
         ok = tok < past
+        # windowless (win <= 0) ORed in instead of a boolean select —
+        # Mosaic cannot legalize arith.select on i1 vectors
         ok = jnp.logical_and(
-            ok, jnp.where(win > 0, pos - tok < win, True)
+            ok, jnp.logical_or(pos - tok < win, win <= 0)
         )
-        s = jnp.where(ok, s, NEG_INF)
+        for h in range(kvh):  # static unroll over KV heads
+            q = q_ref[0, h].astype(jnp.float32)            # [G, Dh]
+            k = k_page_ref[0, :, h, :].astype(jnp.float32)  # [PS, Dh]
+            v = v_page_ref[0, :, h, :].astype(jnp.float32)  # [PS, Dh]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                                      # [G, PS]
+            s = jnp.where(ok, s, NEG_INF)
 
-        m_prev = m_ref[:, 0]                          # [G]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
-        alpha = jnp.exp(m_prev - m_new)               # [G]
-        p = jnp.exp(s - m_new[:, None])               # [G, PS]
-        l_new = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)  # [G]
-        l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
-        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+            m_prev = m_ref[h, :, 0]                        # [G]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+            alpha = jnp.exp(m_prev - m_new)                # [G]
+            p = jnp.exp(s - m_new[:, None])                # [G, PS]
+            l_new = l_ref[h, :, 0] * alpha + jnp.sum(p, axis=1)
+            l_ref[h] = jnp.broadcast_to(
+                l_new[:, None], l_ref.shape[1:]
+            )
+            acc_ref[h] = acc_ref[h] * alpha[:, None] + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_ref[h] = jnp.broadcast_to(
+                m_new[:, None], m_ref.shape[1:]
+            )
 
     @pl.when(ki == num_pages_per_seq - 1)
     def _finalize():
-        q = q_ref[0, 0].astype(jnp.float32)           # [G, Dh]
-        k_cur = k_cur_ref[0, 0].astype(jnp.float32)   # [Dh]
-        v_cur = v_cur_ref[0, 0].astype(jnp.float32)   # [Dh]
-        sink = sink_ref[0].astype(jnp.float32)        # [G]
+        for h in range(kvh):
+            q = q_ref[0, h].astype(jnp.float32)            # [G, Dh]
+            k_cur = k_cur_ref[0, h].astype(jnp.float32)    # [Dh]
+            v_cur = v_cur_ref[0, h].astype(jnp.float32)    # [Dh]
+            sink = sink_ref[h].astype(jnp.float32)         # [G]
 
-        s_self = jnp.sum(q * k_cur[None, :], axis=1) * scale  # [G]
-        m_prev = m_ref[:, 0]
-        m_new = jnp.maximum(m_prev, jnp.maximum(s_self, sink))
-        alpha = jnp.exp(m_prev - m_new)
-        p_self = jnp.exp(s_self - m_new)
-        p_sink = jnp.exp(sink - m_new)
-        l = l_ref[:, 0] * alpha + p_self + p_sink
-        acc = acc_ref[...] * alpha[:, None] + p_self[:, None] * v_cur[None, :]
-        out = acc / jnp.maximum(l, 1e-30)[:, None]
-        out_ref[0, 0] = out.astype(out_ref.dtype)
+            s_self = jnp.sum(q * k_cur[None, :], axis=1) * scale  # [G]
+            m_prev = m_ref[h, :, 0]
+            m_new = jnp.maximum(m_prev, jnp.maximum(s_self, sink))
+            alpha = jnp.exp(m_prev - m_new)
+            p_self = jnp.exp(s_self - m_new)
+            p_sink = jnp.exp(sink - m_new)
+            l = l_ref[h, :, 0] * alpha + p_self + p_sink
+            acc = (
+                acc_ref[h] * alpha[:, None]
+                + p_self[:, None] * v_cur[None, :]
+            )
+            out = acc / jnp.maximum(l, 1e-30)[:, None]
+            out_ref[0, h] = out.astype(out_ref.dtype)
+
+
+# Below this table capacity (tokens) the XLA gather fallback wins: the
+# gathered view is small, while the kernel pays per-grid-step overhead on
+# B x MP tiny blocks per layer. Above it, gather traffic grows with
+# capacity but the kernel's work stays proportional to *actual* context.
+# Crossover measured on v5e (qwen3-0.6b, B=64): gather 4.5 ms vs kernel
+# 12.9 ms at 384-token tables; gather scales ~linearly past that.
+PALLAS_PAGED_MIN_CTX = 1024
 
 
 def paged_decode_supported(
-    q: jax.Array, k_pages: jax.Array
+    q: jax.Array, k_pages: jax.Array, page_table: jax.Array
 ) -> bool:
-    """Shape gate for the compiled TPU path (interpret mode has no such
-    constraints — tests call paged_decode_attention(interpret=True))."""
+    """Shape/size gate for the compiled TPU path (interpret mode has no
+    such constraints — tests call paged_decode_attention(interpret=True))."""
     Dh = q.shape[-1]
     PS = k_pages.shape[1]
-    return Dh % 128 == 0 and PS % 8 == 0
+    ctx_capacity = page_table.shape[1] * PS
+    return (
+        Dh % 128 == 0 and PS % 8 == 0
+        and ctx_capacity >= PALLAS_PAGED_MIN_CTX
+    )
 
 
 @functools.partial(
@@ -167,38 +196,41 @@ def paged_decode_attention(
         num_pages_per_seq=MP,
         page_size=PS,
         scale=scale,
+        kvh=KVH,
     )
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(B, KVH, MP),
+        grid=(B, MP),
         in_specs=[
             pl.BlockSpec(
-                (1, 1, G, Dh), lambda b, h, ki, pt, pls, win: (b, h, 0, 0)
+                (1, KVH, G, Dh), lambda b, ki, pt, pls, win: (b, 0, 0, 0)
             ),
             pl.BlockSpec(
-                (1, PS, 1, Dh),
-                lambda b, h, ki, pt, pls, win: (pt[b * MP + ki], 0, h, 0),
+                (1, PS, KVH, Dh),
+                lambda b, ki, pt, pls, win: (pt[b * MP + ki], 0, 0, 0),
             ),
             pl.BlockSpec(
-                (1, PS, 1, Dh),
-                lambda b, h, ki, pt, pls, win: (pt[b * MP + ki], 0, h, 0),
+                (1, PS, KVH, Dh),
+                lambda b, ki, pt, pls, win: (pt[b * MP + ki], 0, 0, 0),
             ),
             pl.BlockSpec(
-                (1, 1, Dh), lambda b, h, ki, pt, pls, win: (b, h, 0)
+                (1, KVH, Dh), lambda b, ki, pt, pls, win: (b, 0, 0)
             ),
             pl.BlockSpec(
-                (1, 1, Dh), lambda b, h, ki, pt, pls, win: (b, h, 0)
+                (1, KVH, Dh), lambda b, ki, pt, pls, win: (b, 0, 0)
             ),
-            pl.BlockSpec((1, G), lambda b, h, ki, pt, pls, win: (h, 0)),
+            pl.BlockSpec(
+                (KVH, G), lambda b, ki, pt, pls, win: (0, 0)
+            ),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, G, Dh), lambda b, h, ki, pt, pls, win: (b, h, 0, 0)
+            (1, KVH, G, Dh), lambda b, ki, pt, pls, win: (b, 0, 0, 0)
         ),
         scratch_shapes=[
-            pltpu.VMEM((G, 128), jnp.float32),
-            pltpu.VMEM((G, 128), jnp.float32),
-            pltpu.VMEM((G, Dh), jnp.float32),
+            pltpu.VMEM((KVH, G, 128), jnp.float32),
+            pltpu.VMEM((KVH, G, 128), jnp.float32),
+            pltpu.VMEM((KVH, G, Dh), jnp.float32),
         ],
     )
     out = pl.pallas_call(
@@ -206,7 +238,7 @@ def paged_decode_attention(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, KVH, G, Dh), q.dtype),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(

@@ -60,8 +60,14 @@ def sample(
     # head; a *nucleus* wider than NUCLEUS_CAP tokens (near-uniform
     # distributions with top_p<1) truncates to the cap.
     K = min(NUCLEUS_CAP, V)
-    top_vals, top_idx = jax.lax.top_k(scaled, K)      # [B, K], descending
-    greedy_tok = top_idx[:, 0]
+    # approx_max_k is ~3x faster than exact top_k on TPU for 150k vocabs;
+    # the head feeds *stochastic* nucleus sampling, where a ~2% recall
+    # miss in the tail of the head is statistically invisible. Greedy
+    # stays exact via a separate argmax (determinism contract).
+    top_vals, top_idx = jax.lax.approx_max_k(
+        scaled, K, recall_target=0.95, aggregate_to_topk=True
+    )
+    greedy_tok = jnp.argmax(scaled, axis=-1).astype(jnp.int32)
 
     lse = jax.scipy.special.logsumexp(scaled, axis=-1, keepdims=True)
     probs = jnp.exp(top_vals - lse)                   # exact probabilities
@@ -75,6 +81,8 @@ def sample(
     cum = jnp.cumsum(probs, axis=-1)
     keep_p = (cum - probs) < top_p[:, None]           # always keeps rank-0
     vals = jnp.where(keep_k & keep_p, top_vals, NEG_INF)
+
+    filtered = k_active | (top_p < 1.0)
 
     if row_seeds is not None:
         keys = jax.vmap(lambda s: jax.random.fold_in(key, s))(row_seeds)
@@ -90,11 +98,16 @@ def sample(
         full_tok = jnp.argmax(scaled + g_full, axis=-1)
     else:
         choice = jax.random.categorical(key, vals, axis=-1)
-        full_tok = jax.random.categorical(
-            jax.random.fold_in(key, 1), scaled, axis=-1
+        # the full-vocab draw only matters for rows with filtering
+        # disabled — skip the [B, V] gumbel pass when every row filters
+        full_tok = jax.lax.cond(
+            jnp.all(filtered | (temperature <= 0.0)),
+            lambda: jnp.zeros((B,), jnp.int32),
+            lambda: jax.random.categorical(
+                jax.random.fold_in(key, 1), scaled, axis=-1
+            ).astype(jnp.int32),
         )
     head_tok = jnp.take_along_axis(top_idx, choice[:, None], axis=1)[:, 0]
-    filtered = k_active | (top_p < 1.0)
     sampled = jnp.where(filtered, head_tok, full_tok)
     return jnp.where(temperature <= 0.0, greedy_tok, sampled).astype(jnp.int32)
 
@@ -103,6 +116,7 @@ def cumulative_logprob(
     logits: jax.Array, token: jax.Array
 ) -> jax.Array:
     """Per-step logprob of the chosen token (for ``include_cumulative_logprobs``,
-    reference sdk.py:1138-1151)."""
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    return jnp.take_along_axis(logp, token[:, None], axis=-1)[:, 0]
+    reference sdk.py:1138-1151). Gather-then-logsumexp so the full [B, V]
+    log_softmax is never materialized."""
+    chosen = jnp.take_along_axis(logits, token[:, None], axis=-1)[:, 0]
+    return chosen - jax.scipy.special.logsumexp(logits, axis=-1)

@@ -476,6 +476,14 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
             return self._remote_json("get", f"job-cancel/{job_id}")
         return self.engine.cancel_job(job_id)
 
+    def resume_job(self, job_id: str) -> Dict[str, Any]:
+        """Re-queue a FAILED/CANCELLED (or orphaned) job; rows already in
+        the partial store are not recomputed (engine row-granular resume,
+        SURVEY §5.3 — an extension over the reference API)."""
+        if self.backend == "remote":
+            return self._remote_json("get", f"job-resume/{job_id}")
+        return self.engine.resume_job(job_id)
+
     def _await_job_start(self, job_id: str, timeout: int = 3600) -> bool:
         """Poll until RUNNING/STARTING (True) or FAILED/CANCELLED (False)
         (reference sdk.py:1677-1715)."""

@@ -130,6 +130,8 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
                 self._json({"job_status": {rest: eng.job_status(rest)}})
             elif head == "job-cancel" and rest:
                 self._json(eng.cancel_job(rest))
+            elif head == "job-resume" and rest:
+                self._json(eng.resume_job(rest))
             elif head == "list-jobs":
                 self._json({"jobs": eng.list_jobs()})
             elif head == "create-dataset":

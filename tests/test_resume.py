@@ -1,0 +1,125 @@
+"""Row-granular resume (SURVEY §5.3): cancelled/failed jobs re-queue and
+skip rows already flushed to the partial store; a fresh engine process
+resumes a job orphaned by a dead predecessor."""
+
+import time
+
+import pytest
+
+from sutro_tpu.engine.api import LocalEngine
+from sutro_tpu.interfaces import JobStatus
+
+
+def _wait_terminal(eng, job_id, timeout=180):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if JobStatus(eng.job_status(job_id)).is_terminal():
+            return JobStatus(eng.job_status(job_id))
+        time.sleep(0.1)
+    raise TimeoutError(job_id)
+
+
+@pytest.fixture()
+def eng(tiny_ecfg, tmp_path, monkeypatch):
+    monkeypatch.setenv("SUTRO_HOME", str(tmp_path))
+    return LocalEngine(tiny_ecfg)
+
+
+def test_resume_cancelled_job_skips_done_rows(eng):
+    job_id = eng.submit_batch_inference(
+        {"model": "tiny-dense", "inputs": [f"row {i}" for i in range(12)],
+         "sampling_params": {"max_new_tokens": 100}}
+    )
+    # let at least one row finish, then cancel mid-run
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if eng.metrics.job(job_id).rows_completed >= 1:
+            break
+        time.sleep(0.05)
+    eng.cancel_job(job_id)
+    status = _wait_terminal(eng, job_id)
+    if status == JobStatus.SUCCEEDED:
+        pytest.skip("job raced to completion before cancel")
+    # CANCELLING is terminal (reference semantics); the worker flips it
+    # to CANCELLED once the batcher drains
+    deadline = time.monotonic() + 60
+    while (
+        eng.job_status(job_id) == JobStatus.CANCELLING.value
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.1)
+    assert eng.job_status(job_id) == JobStatus.CANCELLED.value
+
+    out = eng.resume_job(job_id)
+    assert out["resumed"] is True
+    assert _wait_terminal(eng, job_id) == JobStatus.SUCCEEDED
+    res = eng.job_results(job_id)
+    assert len(res["outputs"]) == 6
+    assert all(o is not None for o in res["outputs"])
+
+
+def test_resume_refuses_succeeded_and_active(eng):
+    job_id = eng.submit_batch_inference(
+        {"model": "tiny-dense", "inputs": ["a"],
+         "sampling_params": {"max_new_tokens": 3}}
+    )
+    _wait_terminal(eng, job_id)
+    out = eng.resume_job(job_id)
+    assert out["resumed"] is False and "succeeded" in out["detail"]
+
+
+def test_orphaned_running_job_resumes_in_fresh_engine(
+    tiny_ecfg, tmp_path, monkeypatch
+):
+    """Simulate a daemon crash: job record says RUNNING, no worker owns
+    it. A new engine process must be able to resume it."""
+    monkeypatch.setenv("SUTRO_HOME", str(tmp_path))
+    eng1 = LocalEngine(tiny_ecfg)
+    job_id = eng1.submit_batch_inference(
+        {"model": "tiny-dense", "inputs": ["x", "y"],
+         "sampling_params": {"max_new_tokens": 3}}
+    )
+    _wait_terminal(eng1, job_id)
+    # forge the crash: flip the durable record back to RUNNING and delete
+    # the results file, as if the process died mid-job
+    eng1.jobs.set_status(job_id, JobStatus.RUNNING)
+    (eng1.jobs._dir(job_id) / "results.parquet").unlink()
+
+    eng2 = LocalEngine(tiny_ecfg)  # fresh "process" over the same store
+    out = eng2.resume_job(job_id)
+    assert out["resumed"] is True
+    assert _wait_terminal(eng2, job_id) == JobStatus.SUCCEEDED
+    assert len(eng2.job_results(job_id)["outputs"]) == 2
+
+
+def test_resume_skips_partial_rows_deterministically(
+    tiny_ecfg, tmp_path, monkeypatch
+):
+    """Forge a FAILED job with one row already in the partial store: the
+    resumed run must keep that row's output verbatim (it is skipped, not
+    recomputed) and generate the rest."""
+    monkeypatch.setenv("SUTRO_HOME", str(tmp_path))
+    eng = LocalEngine(tiny_ecfg)
+    job_id = eng.jobs.create(
+        model="tiny-dense", engine_key="tiny-dense", num_rows=3,
+        job_priority=0,
+        sampling_params={"max_new_tokens": 4},
+    ).job_id
+    eng.jobs.write_inputs(job_id, ["a", "b", "c"])
+    sentinel = "PRECOMPUTED-ROW-1"
+    eng.jobs.flush_partial(
+        job_id,
+        [{"row_id": 1, "outputs": sentinel, "cumulative_logprobs": -1.0,
+          "finish_reason": "stop"}],
+    )
+    eng.jobs.set_status(
+        job_id, JobStatus.FAILED,
+        failure_reason={"message": "simulated preemption"},
+    )
+
+    out = eng.resume_job(job_id)
+    assert out["resumed"] is True and out["rows_already_done"] == 1
+    assert _wait_terminal(eng, job_id) == JobStatus.SUCCEEDED
+    res = eng.job_results(job_id)
+    assert res["outputs"][1] == sentinel
+    assert res["outputs"][0] is not None and res["outputs"][2] is not None

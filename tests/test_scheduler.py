@@ -168,3 +168,30 @@ def test_job_perf_profile_recorded(tiny_ecfg, byte_tok, tmp_path, monkeypatch):
     assert perf and "decode" in perf and "prefill" in perf
     assert perf["prefill"]["count"] == 2
     assert perf["decode"]["p50_ms"] > 0
+
+
+def test_multi_step_matches_single_step_greedy(tiny_ecfg, byte_tok):
+    """Fused multi-step decode windows (decode_multi_step) must produce
+    exactly the single-step greedy outputs (greedy is rng-independent)."""
+    import dataclasses
+
+    from sutro_tpu.engine.runner import ModelRunner
+    from sutro_tpu.models.configs import MODEL_CONFIGS
+
+    texts = ["alpha", "beta gamma", "", "longer prompt here"]
+
+    def run(multi):
+        ecfg = dataclasses.replace(tiny_ecfg, decode_multi_step=multi)
+        b = ContinuousBatcher(
+            ModelRunner(MODEL_CONFIGS["tiny-dense"], ecfg),
+            stop_ids=byte_tok.stop_ids(),
+        )
+        res = run_all(
+            b,
+            make_requests(byte_tok, texts, max_new_tokens=11,
+                          temperature=0.0),
+        )
+        return {i: (tuple(r.token_ids), r.finish_reason)
+                for i, r in res.items()}
+
+    assert run(1) == run(8)
