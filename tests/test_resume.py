@@ -52,9 +52,13 @@ def test_resume_cancelled_job_skips_done_rows(eng):
 
     out = eng.resume_job(job_id)
     assert out["resumed"] is True
+    # resume must SKIP the rows that already flushed before the cancel,
+    # not regenerate them — prove it, don't assume it
+    assert out["rows_already_done"] >= 1
     assert _wait_terminal(eng, job_id) == JobStatus.SUCCEEDED
     res = eng.job_results(job_id)
-    assert len(res["outputs"]) == 6
+    # 12 rows in -> 12 ordered outputs (reference 1:1 contract)
+    assert len(res["outputs"]) == 12
     assert all(o is not None for o in res["outputs"])
 
 
