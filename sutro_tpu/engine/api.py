@@ -164,11 +164,20 @@ class LocalEngine:
         self._enqueue(rec.job_priority, rec.job_id)
         return rec.job_id
 
+    def _reserve_queue_entry(self, job_id: str) -> int:
+        """Caller must hold ``self._lock``. Registers the job as queued
+        and returns its FIFO sequence number; the caller must follow up
+        with ``self._queue.put((priority, seq, job_id))`` (possibly
+        after releasing the lock) or roll back by discarding the id from
+        ``self._queued``."""
+        self._seq += 1
+        self._queued.add(job_id)
+        return self._seq
+
     def _enqueue(self, priority: int, job_id: str) -> None:
         with self._lock:
-            self._seq += 1
-            self._queued.add(job_id)
-            self._queue.put((priority, self._seq, job_id))
+            seq = self._reserve_queue_entry(job_id)
+            self._queue.put((priority, seq, job_id))
 
     def job_status(self, job_id: str) -> str:
         return self.jobs.status(job_id).value
@@ -227,12 +236,22 @@ class LocalEngine:
         status = self.jobs.status(job_id)
         deadline = _time.monotonic() + 5.0
         while True:
+            # Atomic not-busy check AND re-queue under ONE lock hold:
+            # two concurrent resume calls must not both observe not-busy
+            # and double-enqueue the job (it would run twice).
             with self._lock:
                 busy = (
                     job_id in self._queued or job_id == self._current_job
                 )
-            if not busy:
-                break
+                if not busy:
+                    if status == JobStatus.SUCCEEDED:
+                        return {"status": status.value, "resumed": False,
+                                "detail": "job already succeeded"}
+                    # fetch BEFORE registering as queued: a raise here
+                    # must not leave the id poisoning _queued
+                    rec = self.jobs.get(job_id)
+                    seq = self._reserve_queue_entry(job_id)
+                    break
             # terminal status + still "current": the worker is in its
             # epilogue (flush/metrics) — wait for it to let go rather
             # than refusing a resume the caller can see is legitimate
@@ -241,14 +260,17 @@ class LocalEngine:
                         "detail": "job is already queued or running"}
             _time.sleep(0.02)
             status = self.jobs.status(job_id)
-        if status == JobStatus.SUCCEEDED:
-            return {"status": status.value, "resumed": False,
-                    "detail": "job already succeeded"}
-        rec = self.jobs.get(job_id)
-        self._cancel.discard(job_id)
-        self.metrics.drop(job_id)  # fresh progress stream for the re-run
-        self.jobs.set_status(job_id, JobStatus.QUEUED, failure_reason=None)
-        self._enqueue(rec.job_priority, job_id)
+        try:
+            self._cancel.discard(job_id)
+            self.metrics.drop(job_id)  # fresh stream for the re-run
+            self.jobs.set_status(
+                job_id, JobStatus.QUEUED, failure_reason=None
+            )
+            self._queue.put((rec.job_priority, seq, job_id))
+        except Exception:
+            with self._lock:
+                self._queued.discard(job_id)
+            raise
         # mirror _run_job's resume filter: cancelled-truncated rows are
         # regenerated, so they don't count as already done
         done = sum(

@@ -44,8 +44,10 @@ class EngineConfig:
     decode_batch_size: int = 64     # fixed decode slot count (static shapes)
     prefill_chunk: int = 512        # prompts longer than this prefill in
                                     # fixed-size chunks (runner.prefill)
+    prefill_batch_size: int = 8     # short rows prefilled per device
+                                    # dispatch (runner.prefill_batch)
     max_batch_tokens: int = 32768   # admission budget: sum of in-flight
-                                    # worst-case totals (scheduler._try_admit)
+                                    # worst-case totals (scheduler._reserve)
     max_model_len: int = 8192
     decode_multi_step: int = 8      # decode steps fused into one device
                                     # program when no row needs host-side

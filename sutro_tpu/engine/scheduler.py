@@ -107,7 +107,8 @@ class GenResult:
     row_id: int
     token_ids: List[int]
     cumulative_logprob: float
-    # "stop" | "length" | "schema_complete" | "cancelled" | "error_too_long"
+    # "stop" | "length" | "schema_complete" | "cancelled" |
+    # "error_too_long" | "error_capacity"
     finish_reason: str
     input_tokens: int
 
@@ -177,47 +178,96 @@ class ContinuousBatcher:
             self._max_total(s.req) for s in self.slots if s is not None
         )
 
-    def _try_admit(self, req: GenRequest) -> bool:
+    def _reserve(
+        self, req: GenRequest, reserved: int = 0, exclude=frozenset()
+    ):
+        """Reserve a slot + worst-case pages for ``req``. Returns
+        ``(slot_idx, pages, table)`` or None. No device work happens
+        here — prefill/sampling run in ``_admit_batch`` so several
+        reserved rows can share one dispatch. Slots are only *armed*
+        there, so same-batch state lives in the arguments: ``reserved``
+        carries the worst-case tokens of rows reserved but not yet
+        armed, ``exclude`` their slot indices (the native runtime tracks
+        both internally — its slots go active at try_admit)."""
         n = len(req.prompt_ids)
         if self.native is not None:
             free_idx = self.native.try_admit(n, req.max_new_tokens)
             if free_idx < 0:
-                return False
+                return None
             assert self.slots[free_idx] is None
             pages = self.native.slot_pages(free_idx)
             table = self.native.table[free_idx]
         else:
-            try:
-                free_idx = self.slots.index(None)
-            except ValueError:
-                return False
+            free_idx = next(
+                (
+                    i
+                    for i, s in enumerate(self.slots)
+                    if s is None and i not in exclude
+                ),
+                None,
+            )
+            if free_idx is None:
+                return None
             total = self._max_total(req)
             need = pages_needed(total, self.ecfg.kv_page_size)
             if need > self.MP or need > self.allocator.free_count:
-                return False
+                return None
+            inflight = self._inflight_tokens() + reserved
             if (
-                self._inflight_tokens() > 0
-                and self._inflight_tokens() + total
-                > self.ecfg.max_batch_tokens
+                inflight > 0
+                and inflight + total > self.ecfg.max_batch_tokens
             ):
-                return False
+                return None
             pages = self.allocator.alloc(need)
             table = np.zeros((self.MP,), np.int32)
             table[: len(pages)] = pages
+        return free_idx, pages, table
 
-        with self.timer.time("prefill"):
-            logits = self.runner.prefill(
-                req.prompt_ids.astype(np.int32), table
-            )
-        first, first_logp = self._sample_one(logits, req)
-        slot = _Slot(req=req, pages=pages, pos=n, last_token=first)
-        self.slots[free_idx] = slot
+    def _unreserve(self, slot_idx: int, pages) -> None:
+        """Roll back a reservation whose prefill never armed a slot (a
+        raised prefill would otherwise leak the slot's pages forever in a
+        long-lived daemon)."""
         if self.native is not None:
-            self.native.arm_slot(
-                free_idx, n, first, req.temperature, req.top_p, req.top_k
+            self.native.release(slot_idx)
+        else:
+            self.allocator.free(pages)
+
+    def _admit_batch(self, batch) -> None:
+        """``batch`` is a list of ``(req, slot_idx, pages, table)``
+        reservations. Runs ONE batched prefill dispatch + ONE batched
+        first-token sample for all of them, then arms the slots."""
+        reqs = [b[0] for b in batch]
+        try:
+            with self.timer.time("prefill"):
+                if len(batch) == 1:
+                    logits = self.runner.prefill(
+                        reqs[0].prompt_ids.astype(np.int32), batch[0][3]
+                    )[None]
+                else:
+                    logits = self.runner.prefill_batch(
+                        [r.prompt_ids.astype(np.int32) for r in reqs],
+                        np.stack([b[3] for b in batch]),
+                    )
+            toks, logps = self._sample_batch(
+                logits, reqs, [b[1] for b in batch]
             )
-        self._record_token(slot, first, first_logp)
-        return True
+        except Exception:
+            for _, slot_idx, pages, _ in batch:
+                self._unreserve(slot_idx, pages)
+            raise
+        for (req, slot_idx, pages, _), tok, logp in zip(batch, toks, logps):
+            first = int(tok)
+            slot = _Slot(
+                req=req, pages=pages, pos=len(req.prompt_ids),
+                last_token=first,
+            )
+            self.slots[slot_idx] = slot
+            if self.native is not None:
+                self.native.arm_slot(
+                    slot_idx, len(req.prompt_ids), first,
+                    req.temperature, req.top_p, req.top_k,
+                )
+            self._record_token(slot, first, float(logp))
 
     def _pad_mask(self, mask: np.ndarray) -> np.ndarray:
         """Constraint masks are sized to the *tokenizer* vocab; pad to the
@@ -264,29 +314,55 @@ class ContinuousBatcher:
             0,
         )
 
-    def _sample_one(self, logits: np.ndarray, req: GenRequest) -> tuple:
+    def _sample_batch(
+        self,
+        logits: np.ndarray,
+        reqs: List[GenRequest],
+        slot_idxs: List[int],
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """First-token sampling for ``len(reqs)`` fresh rows in one
+        device call. ``logits`` is [n, V]."""
+        n = len(reqs)
+        temps = np.array([r.temperature for r in reqs], np.float32)
+        top_p = np.array([r.top_p for r in reqs], np.float32)
+        top_k = np.array([r.top_k for r in reqs], np.int32)
         allowed = None
-        if req.constraint is not None:
-            rem = self._remaining(req, 0, len(req.prompt_ids))
-            allowed = self._constraint_mask(req.constraint, rem)[None, :]
-        if req.row_seed is not None:
-            sub = self._fixed_key  # per-row key derives from row_seed
-            row_seeds = jax.numpy.asarray([_step_seed(req.row_seed, 0)])
+        if any(r.constraint is not None for r in reqs):
+            allowed = np.ones((n, self.vocab), bool)
+            for i, r in enumerate(reqs):
+                if r.constraint is not None:
+                    rem = self._remaining(r, 0, len(r.prompt_ids))
+                    allowed[i] = self._constraint_mask(r.constraint, rem)
+        row_seeds = None
+        if any(r.row_seed is not None for r in reqs):
+            sub = self._fixed_key  # per-row keys derive from row_seed
+            # unseeded rows in a mixed batch key off their SLOT index
+            # (unique across same-step admit batches) under a salt
+            # distinct from the decode loop's, so no two draws alias
+            row_seeds = jax.numpy.asarray(
+                [
+                    _step_seed(r.row_seed, 0)
+                    if r.row_seed is not None
+                    else _step_seed(0x0F1E57 ^ (slot_idxs[i] + 1),
+                                    self._step)
+                    for i, r in enumerate(reqs)
+                ],
+                dtype=jax.numpy.int32,
+            )
         else:
             self._key, sub = jax.random.split(self._key)
-            row_seeds = None
-        jl = jax.numpy.asarray(logits[None, :])
+        jl = jax.numpy.asarray(logits)
         tok = device_sample(
             jl,
             sub,
-            temperature=np.float32(req.temperature),
-            top_p=np.float32(req.top_p),
-            top_k=np.int32(req.top_k),
+            temperature=temps,
+            top_p=top_p,
+            top_k=top_k,
             allowed=None if allowed is None else jax.numpy.asarray(allowed),
             row_seeds=row_seeds,
         )
         logp = cumulative_logprob(jl, tok)
-        return int(np.asarray(tok)[0]), float(np.asarray(logp)[0])
+        return np.asarray(tok), np.asarray(logp)
 
     def _record_token(self, slot: _Slot, tok: int, logp: float) -> None:
         slot.out_ids.append(tok)
@@ -409,12 +485,43 @@ class ContinuousBatcher:
                         res.finish_reason = "cancelled"
                         on_result(res)
                 return
-            # Admit as many pending rows as slots/pages allow.
+            # Admit as many pending rows as slots/pages allow, prefilling
+            # them in batches of up to ``prefill_batch_size`` per device
+            # dispatch (long rows chunk one at a time — see
+            # runner.prefill).
             admitted = False
-            while pending and self._try_admit(pending[-1]):
-                req = pending.pop()
-                input_tokens += len(req.prompt_ids)
+            while pending:
+                batch = []
+                reserved_tokens = 0
+                reserved_idxs = set()
+                while (
+                    pending and len(batch) < self.ecfg.prefill_batch_size
+                ):
+                    req = pending[-1]
+                    is_long = (
+                        len(req.prompt_ids) > self.ecfg.prefill_chunk
+                    )
+                    if is_long and batch:
+                        break  # flush the short-row batch first
+                    r = self._reserve(
+                        req, reserved=reserved_tokens,
+                        exclude=reserved_idxs,
+                    )
+                    if r is None:
+                        break
+                    pending.pop()
+                    batch.append((req,) + r)
+                    reserved_tokens += self._max_total(req)
+                    reserved_idxs.add(r[0])
+                    if is_long:
+                        break  # long rows prefill alone (chunked path)
+                if not batch:
+                    break
+                self._admit_batch(batch)
                 admitted = True
+                input_tokens += sum(
+                    len(b[0].prompt_ids) for b in batch
+                )
             # Immediately-finished rows (e.g. first token was a stop).
             for i, s in enumerate(self.slots):
                 if s is not None and self._finish_reason(s, s.last_token):
@@ -425,9 +532,21 @@ class ContinuousBatcher:
                 if not pending:
                     break
                 if not admitted:
-                    raise MemoryError(
-                        "Row cannot be admitted: prompt+max_new exceeds KV capacity"
+                    # The head row can never fit an EMPTY machine
+                    # (prompt+max_new exceeds total KV capacity). Fail
+                    # that one row and keep the job going — one bad row
+                    # must not fail its whole job.
+                    req = pending.pop()
+                    on_result(
+                        GenResult(
+                            row_id=req.row_id,
+                            token_ids=[],
+                            cumulative_logprob=0.0,
+                            finish_reason="error_capacity",
+                            input_tokens=len(req.prompt_ids),
+                        )
                     )
+                    rows_done += 1
                 continue
 
             if self.native is not None:

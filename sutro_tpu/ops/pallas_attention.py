@@ -32,5 +32,5 @@ def try_chunk_attention(
     from .pallas_flash import flash_prefill_supported, flash_prefill
 
     if past_k is None and flash_prefill_supported(q, k, window, sink):
-        return flash_prefill(q, k, v, positions=positions, valid_len=valid_len)
+        return flash_prefill(q, k, v, window=window, sink=sink)
     return None

@@ -1,23 +1,236 @@
 """Flash (blockwise, online-softmax) causal prefill attention in Pallas.
 
-Placeholder gate for now: ``flash_prefill_supported`` returns False until
-the kernel lands (SURVEY §7.2 step 4); ops/attention.py then uses the XLA
-path. Kept as a separate module so the kernel can be developed and
-unit-tested against the reference jnp implementation in isolation.
+The prefill hot path (SURVEY §2.3 row 1, §7.2 step 4). The reference's
+whole value proposition is batch throughput (/root/reference/README.md:36-38)
+and classify-style jobs are prefill-dominated, so prefill must not
+materialize the O(T^2) score matrix the fused-XLA fallback builds.
+
+Design (TPU-first):
+
+- Layout is head-major: q ``[B, KVH, G, T, Dh]``, k/v ``[B, KVH, T, Dh]``
+  so one grid step owns one (batch row, KV head) pair and the MXU sees
+  ``[BQ, Dh] x [BK, Dh]^T`` tiles per query-head-in-group.
+- Grid ``(B, KVH, nQ, nK)``; the key-block axis is innermost and
+  sequential ("arbitrary"), carrying running ``(m, l, acc)`` per grouped
+  query head in VMEM scratch — classic flash online softmax.
+- Causality is exploited at block granularity: key blocks strictly above
+  the diagonal are skipped (``pl.when``), so work is ~half of the full
+  rectangle; the output is finalized and written at the diagonal block,
+  which under causal masking is always the last contributing key block.
+- Per-layer sliding windows (Gemma3 / gpt-oss alternating) arrive as a
+  *dynamic* scalar-prefetch operand so one compiled kernel serves every
+  layer of the model's ``lax.scan``: fully-out-of-window key blocks are
+  skipped dynamically, the diagonal block is never skippable, and partial
+  blocks are masked elementwise.
+- gpt-oss attention sinks join the softmax denominator at finalization
+  (a per-head logit with no value row — same semantics as
+  ops/attention.py's jnp path).
+
+Contract: self-attention over a chunk with NO past — query/key positions
+are ``[0, T)`` (the runner's bucketed prefill and the embed path both
+guarantee this; chunked long-prompt prefill carries paged past and takes
+the paged/XLA path instead). Padding rows/tails (``t >= valid_len``) are
+computed-and-discarded by the caller exactly as in the jnp path: a padded
+query only ever attends causally, so every *used* output position
+(t < valid_len) sees only real keys.
+
+All math float32; outputs cast back to the query dtype.
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+BLOCK_Q = 128
+BLOCK_K = 128
+MAX_GROUP = 8  # scratch is [G, BQ, *]; cap G so VMEM stays bounded
+
+
+def _flash_kernel(
+    # scalar prefetch
+    window_ref,       # [1] int32 (0 = full attention)
+    # operands
+    q_ref,            # [1, 1, G, BQ, Dh]
+    k_ref,            # [1, 1, BK, Dh]
+    v_ref,            # [1, 1, BK, Dh]
+    sink_ref,         # [1, G, 128] f32 (NEG_INF rows when no sink)
+    # output
+    out_ref,          # [1, 1, G, BQ, Dh]
+    # scratch
+    m_ref,            # [G, BQ, 128] f32
+    l_ref,            # [G, BQ, 128] f32
+    acc_ref,          # [G, BQ, Dh] f32
+    *,
+    groups: int,
+    scale: float,
+):
+    qb = pl.program_id(2)
+    kb = pl.program_id(3)
+    BQ = q_ref.shape[3]
+    BK = k_ref.shape[2]
+    q0 = qb * BQ
+    k0 = kb * BK
+    win = window_ref[0]
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # Block-level skip: strictly-above-diagonal (causal) or fully below
+    # the sliding window. The diagonal block (k0 == q0) satisfies neither
+    # condition, so every query row always executes at least one block.
+    causal_skip = k0 > q0 + BQ - 1
+    window_skip = jnp.logical_and(win > 0, k0 + BK - 1 <= q0 - win)
+
+    @pl.when(jnp.logical_not(jnp.logical_or(causal_skip, window_skip)))
+    def _accumulate():
+        qpos = q0 + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 0)
+        kpos = k0 + jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 1)
+        ok = kpos <= qpos
+        # windowless (win <= 0) ORed in — Mosaic cannot legalize
+        # arith.select on i1 vectors (same workaround as pallas_paged)
+        ok = jnp.logical_and(
+            ok, jnp.logical_or(qpos - kpos < win, win <= 0)
+        )
+        k = k_ref[0, 0].astype(jnp.float32)            # [BK, Dh]
+        v = v_ref[0, 0].astype(jnp.float32)            # [BK, Dh]
+        for g in range(groups):  # static unroll over heads in the group
+            q = q_ref[0, 0, g].astype(jnp.float32)     # [BQ, Dh]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                                  # [BQ, BK]
+            s = jnp.where(ok, s, NEG_INF)
+
+            m_prev = m_ref[g, :, 0]                    # [BQ]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+            alpha = jnp.exp(m_prev - m_new)            # [BQ]
+            p = jnp.exp(s - m_new[:, None])            # [BQ, BK]
+            l_new = l_ref[g, :, 0] * alpha + jnp.sum(p, axis=1)
+            acc_ref[g] = acc_ref[g] * alpha[:, None] + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_ref[g] = jnp.broadcast_to(m_new[:, None], m_ref.shape[1:])
+            l_ref[g] = jnp.broadcast_to(l_new[:, None], l_ref.shape[1:])
+
+    # The diagonal block is the last contributing key block for this query
+    # block (everything past it is causally skipped) — finalize here.
+    @pl.when(k0 == q0)
+    def _finalize():
+        for g in range(groups):
+            sink = sink_ref[0, g, 0]                   # scalar f32
+            m_prev = m_ref[g, :, 0]
+            m_new = jnp.maximum(m_prev, sink)
+            alpha = jnp.exp(m_prev - m_new)
+            # the sink contributes a probability-mass column only
+            l = l_ref[g, :, 0] * alpha + jnp.exp(sink - m_new)
+            out = acc_ref[g] * alpha[:, None] / jnp.maximum(l, 1e-30)[:, None]
+            out_ref[0, 0, g] = out.astype(out_ref.dtype)
 
 
 def flash_prefill_supported(
     q: jax.Array, k: jax.Array, window, sink
 ) -> bool:
-    return False
+    """Static shape gate for the compiled TPU path. window/sink are
+    dynamic operands of the kernel, so they never gate."""
+    B, T, NH, Dh = q.shape
+    KVH = k.shape[2]
+    if NH % KVH:
+        return False
+    G = NH // KVH
+    return (
+        T >= BLOCK_Q
+        and T % BLOCK_Q == 0
+        and Dh % 128 == 0
+        and G <= MAX_GROUP
+    )
 
 
-def flash_prefill(q, k, v, *, positions, valid_len):  # pragma: no cover
-    raise NotImplementedError
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def flash_prefill(
+    q: jax.Array,                    # [B, T, NH, Dh]
+    k: jax.Array,                    # [B, T, KVH, Dh] (post-RoPE)
+    v: jax.Array,                    # [B, T, KVH, Dh]
+    *,
+    window: Optional[jax.Array] = None,   # scalar int32; 0/None => full
+    sink: Optional[jax.Array] = None,     # [NH] logits or None
+    interpret: bool = False,
+) -> jax.Array:
+    """Returns [B, T, NH, Dh] causal self-attention over the chunk."""
+    B, T, NH, Dh = q.shape
+    KVH = k.shape[2]
+    G = NH // KVH
+    scale = Dh ** -0.5
+    nQ = T // BLOCK_Q
+    nK = T // BLOCK_K
+
+    # head-major layout: [B, KVH, G, T, Dh] / [B, KVH, T, Dh]
+    qh = q.reshape(B, T, KVH, G, Dh).transpose(0, 2, 3, 1, 4)
+    kh = k.transpose(0, 2, 1, 3)
+    vh = v.transpose(0, 2, 1, 3)
+    if sink is None:
+        sink_g = jnp.full((KVH, G, 128), NEG_INF, jnp.float32)
+    else:
+        sink_g = jnp.broadcast_to(
+            sink.astype(jnp.float32).reshape(KVH, G, 1), (KVH, G, 128)
+        )
+    win = (
+        jnp.zeros((1,), jnp.int32)
+        if window is None
+        else jnp.asarray(window, jnp.int32).reshape(1)
+    )
+
+    kernel = functools.partial(_flash_kernel, groups=G, scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, KVH, nQ, nK),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, G, BLOCK_Q, Dh),
+                lambda b, h, qb, kb, win: (b, h, 0, qb, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, BLOCK_K, Dh),
+                lambda b, h, qb, kb, win: (b, h, kb, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, BLOCK_K, Dh),
+                lambda b, h, qb, kb, win: (b, h, kb, 0),
+            ),
+            pl.BlockSpec(
+                (1, G, 128), lambda b, h, qb, kb, win: (h, 0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, G, BLOCK_Q, Dh),
+            lambda b, h, qb, kb, win: (b, h, 0, qb, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((G, BLOCK_Q, 128), jnp.float32),
+            pltpu.VMEM((G, BLOCK_Q, 128), jnp.float32),
+            pltpu.VMEM((G, BLOCK_Q, Dh), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KVH, G, T, Dh), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=(
+                "parallel", "parallel", "parallel", "arbitrary"
+            ),
+        ),
+        interpret=interpret,
+    )(win, qh, kh, vh, sink_g)
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, T, NH, Dh)

@@ -22,6 +22,7 @@ from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 # leaves (by name) that get int8 treatment — the big matmul operands
 QUANT_LEAVES = frozenset(
@@ -38,14 +39,20 @@ def is_quantized(leaf: Any) -> bool:
     return isinstance(leaf, dict) and "qw" in leaf and "scale" in leaf
 
 
-def quantize_weight(w: jax.Array) -> Dict[str, jax.Array]:
+def quantize_weight(w) -> Dict[str, np.ndarray]:
     """Symmetric per-output-channel int8 over the reduction (second to
-    last) axis. ``w[..., in, out] -> qw int8 + scale[..., 1, out]``."""
-    w32 = w.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(w32), axis=-2, keepdims=True)
-    scale = jnp.maximum(amax, 1e-8) / 127.0
-    qw = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
-    return {"qw": qw, "scale": scale.astype(jnp.float32)}
+    last) axis. ``w[..., in, out] -> qw int8 + scale[..., 1, out]``.
+
+    Runs on HOST numpy deliberately: quantization happens before the
+    params are device_put with their shardings (engine/runner.py), and a
+    jnp implementation would materialize every f32 temporary of a 32B+
+    stack on the single default device — an OOM before sharding ever
+    happens. Host peak is one leaf at a time instead."""
+    w32 = np.asarray(w, dtype=np.float32)
+    amax = np.max(np.abs(w32), axis=-2, keepdims=True)
+    scale = np.maximum(amax, 1e-8) / 127.0
+    qw = np.clip(np.round(w32 / scale), -127, 127).astype(np.int8)
+    return {"qw": qw, "scale": scale.astype(np.float32)}
 
 
 def materialize(leaf: Any, dtype: Any) -> jax.Array:

@@ -63,10 +63,26 @@ def sample(
     # approx_max_k is ~3x faster than exact top_k on TPU for 150k vocabs;
     # the head feeds *stochastic* nucleus sampling, where a ~2% recall
     # miss in the tail of the head is statistically invisible. Greedy
-    # stays exact via a separate argmax (determinism contract).
-    top_vals, top_idx = jax.lax.approx_max_k(
-        scaled, K, recall_target=0.95, aggregate_to_topk=True
-    )
+    # stays exact via a separate argmax (determinism contract). Two cases
+    # need the EXACT head: (a) FSM-constrained rows, whose allowed set
+    # may be smaller than the approx recall can resolve, and (b) small
+    # top_k (a ~5%/element miss inside a 2-wide head is a visible
+    # distribution change). (a) is static; (b) is a runtime cond so the
+    # common unconstrained/top_p path keeps the fast kernel.
+    def _exact():
+        return jax.lax.top_k(scaled, K)
+
+    def _approx():
+        return jax.lax.approx_max_k(
+            scaled, K, recall_target=0.95, aggregate_to_topk=True
+        )
+
+    if allowed is not None:
+        top_vals, top_idx = _exact()
+    else:
+        top_vals, top_idx = jax.lax.cond(
+            jnp.any((top_k > 0) & (top_k <= 32)), _exact, _approx
+        )
     greedy_tok = jnp.argmax(scaled, axis=-1).astype(jnp.int32)
 
     lse = jax.scipy.special.logsumexp(scaled, axis=-1, keepdims=True)

@@ -126,3 +126,84 @@ def test_decode_step_via_runner_matches_dense(tiny_ecfg):
     )
     ref_tok = int(np.argmax(np.asarray(ref_logits[0, -1])))
     assert int(toks[0]) == ref_tok
+
+
+# ---------------------------------------------------------------------------
+# flash prefill kernel
+# ---------------------------------------------------------------------------
+
+from sutro_tpu.ops.pallas_flash import (  # noqa: E402
+    flash_prefill,
+    flash_prefill_supported,
+)
+
+
+def _make_prefill_case(rng, *, B=2, T=128, NH=4, KVH=2, Dh=128):
+    q = jnp.asarray(rng.standard_normal((B, T, NH, Dh)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, T, KVH, Dh)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, T, KVH, Dh)), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("window", [0, 5, 200])
+@pytest.mark.parametrize("with_sink", [False, True])
+def test_flash_prefill_matches_reference(window, with_sink):
+    rng = np.random.default_rng(7)
+    B, T, NH = 2, 256, 4
+    q, k, v = _make_prefill_case(rng, B=B, T=T, NH=NH)
+    sink = (
+        jnp.asarray(rng.standard_normal(NH), jnp.float32)
+        if with_sink
+        else None
+    )
+    win = jnp.asarray(window, jnp.int32)
+    positions = jnp.broadcast_to(
+        jnp.arange(T, dtype=jnp.int32)[None], (B, T)
+    )
+    valid_len = jnp.full((B,), T, jnp.int32)
+
+    ref = chunk_attention(
+        q, k, v, positions=positions, valid_len=valid_len,
+        window=win, sink=sink, use_pallas=False,
+    )
+    got = flash_prefill(q, k, v, window=win, sink=sink, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_flash_prefill_ragged_valid_len():
+    """Padded rows: every used position (t < valid_len) must match the
+    jnp path, which additionally masks padded keys — causality makes the
+    two equivalent on used rows."""
+    rng = np.random.default_rng(11)
+    B, T = 3, 128
+    q, k, v = _make_prefill_case(rng, B=B, T=T)
+    valid = jnp.asarray([128, 57, 1], jnp.int32)
+    positions = jnp.broadcast_to(
+        jnp.arange(T, dtype=jnp.int32)[None], (B, T)
+    )
+    ref = chunk_attention(
+        q, k, v, positions=positions, valid_len=valid,
+        window=None, sink=None, use_pallas=False,
+    )
+    got = flash_prefill(q, k, v, interpret=True)
+    for b in range(B):
+        n = int(valid[b])
+        np.testing.assert_allclose(
+            np.asarray(got)[b, :n],
+            np.asarray(ref)[b, :n],
+            atol=2e-5,
+            rtol=2e-5,
+        )
+
+
+def test_flash_prefill_gate():
+    rng = np.random.default_rng(0)
+    q, k, v = _make_prefill_case(rng, B=1, T=128)
+    assert flash_prefill_supported(q, k, None, None)
+    q2, k2, _ = _make_prefill_case(rng, B=1, T=64)  # sub-block chunk
+    assert not flash_prefill_supported(q2, k2, None, None)
+    q3 = jnp.zeros((1, 128, 4, 64), jnp.float32)  # Dh % 128 != 0
+    k3 = jnp.zeros((1, 128, 2, 64), jnp.float32)
+    assert not flash_prefill_supported(q3, k3, None, None)

@@ -166,7 +166,8 @@ def test_job_perf_profile_recorded(tiny_ecfg, byte_tok, tmp_path, monkeypatch):
     assert rec["status"] == "SUCCEEDED", rec.get("failure_reason")
     perf = rec["perf"]
     assert perf and "decode" in perf and "prefill" in perf
-    assert perf["prefill"]["count"] == 2
+    # both rows ride ONE batched prefill dispatch (runner.prefill_batch)
+    assert perf["prefill"]["count"] == 1
     assert perf["decode"]["p50_ms"] > 0
 
 
@@ -195,3 +196,74 @@ def test_multi_step_matches_single_step_greedy(tiny_ecfg, byte_tok):
                 for i, r in res.items()}
 
     assert run(1) == run(8)
+
+
+def test_batched_prefill_matches_single(tiny_ecfg, byte_tok):
+    """Greedy outputs must be identical whether rows prefill one per
+    dispatch (prefill_batch_size=1) or batched — batching is purely an
+    execution-shape change."""
+    import dataclasses
+
+    from sutro_tpu.engine.runner import ModelRunner
+    from sutro_tpu.models.configs import MODEL_CONFIGS
+
+    texts = ["alpha beta", "gamma", "delta epsilon zeta", "eta", "theta!"]
+    outs = []
+    for pbs in (1, 4):
+        ecfg = dataclasses.replace(tiny_ecfg, prefill_batch_size=pbs)
+        runner = ModelRunner(MODEL_CONFIGS["tiny-dense"], ecfg)
+        b = ContinuousBatcher(runner, stop_ids=byte_tok.stop_ids())
+        reqs = make_requests(
+            byte_tok, texts, max_new_tokens=6, temperature=0.0
+        )
+        res = run_all(b, reqs)
+        outs.append([tuple(res[i].token_ids) for i in range(len(texts))])
+    assert outs[0] == outs[1]
+
+
+def test_inadmissible_row_fails_alone(tiny_ecfg, byte_tok):
+    """A row whose prompt+max_new exceeds total KV capacity fails with a
+    per-row error result; every other row still succeeds and the job
+    completes (no whole-job MemoryError)."""
+    from sutro_tpu.engine.runner import ModelRunner
+    from sutro_tpu.models.configs import MODEL_CONFIGS
+
+    # cache holds only 7 usable pages (56 tokens) < the 16 pages the bad
+    # row's worst case needs — it can never fit even an empty machine
+    runner = ModelRunner(
+        MODEL_CONFIGS["tiny-dense"], tiny_ecfg, num_pages=8
+    )
+    b = ContinuousBatcher(runner, stop_ids=byte_tok.stop_ids())
+    ok1 = make_requests(byte_tok, ["good row"], max_new_tokens=4)[0]
+    bad = GenRequest(
+        row_id=1,
+        prompt_ids=(np.arange(40) % 200).astype(np.int32),
+        max_new_tokens=tiny_ecfg.max_context(),
+    )
+    ok2 = make_requests(byte_tok, ["another good row"], max_new_tokens=4)[0]
+    ok2 = GenRequest(
+        row_id=2, prompt_ids=ok2.prompt_ids, max_new_tokens=4
+    )
+    res = run_all(b, [ok1, bad, ok2])
+    assert set(res) == {0, 1, 2}
+    assert res[1].finish_reason == "error_capacity"
+    assert res[1].token_ids == []
+    assert res[0].finish_reason in ("stop", "length")
+    assert res[2].finish_reason in ("stop", "length")
+
+
+def test_python_fallback_batched_admission(tiny_runner, byte_tok, monkeypatch):
+    """The pure-Python allocator path (no native runtime) must admit a
+    multi-row batch into DISTINCT slots — regression for a reservation
+    collision where every same-batch row got slots.index(None)."""
+    import sutro_tpu.engine.native_runtime as nr
+
+    monkeypatch.setattr(nr, "maybe_native_runtime", lambda *a, **k: None)
+    b = ContinuousBatcher(tiny_runner, stop_ids=byte_tok.stop_ids())
+    assert b.native is None and b.allocator is not None
+    texts = ["one", "two", "three", "four"]
+    res = run_all(
+        b, make_requests(byte_tok, texts, max_new_tokens=5)
+    )
+    assert set(res) == set(range(len(texts)))
+    assert b.free_page_count == b.allocator.num_pages - 1  # all released
