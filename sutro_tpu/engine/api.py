@@ -91,9 +91,11 @@ class LocalEngine:
         self._seq = 0
         self._cancel: set = set()
         self._queued: set = set()
+        self._queued_prio: Dict[str, int] = {}  # queued job -> priority
         self._current_job: Optional[str] = None
         self._lock = threading.Lock()
         self._runner_cache: Dict[str, Tuple[ModelRunner, BaseTokenizer]] = {}
+        self._tok_cache: Dict[str, BaseTokenizer] = {}
         self._worker = threading.Thread(
             target=self._worker_loop, daemon=True, name="sutro-engine"
         )
@@ -146,13 +148,46 @@ class LocalEngine:
         )
         self.jobs.write_inputs(rec.job_id, inputs)
 
-        # quota check (reference /get-quotas semantics)
-        est_tokens = sum(len(r) // 3 + 1 for r in inputs) + len(inputs) * int(
-            sampling["max_new_tokens"]
+        # Quota gate (reference /get-quotas semantics). Token honesty
+        # without tokenizing every submit: a BPE token consumes >= 1
+        # UTF-8 byte, so byte length is a sound upper bound — jobs whose
+        # bound fits the quota pass immediately; only jobs near the
+        # quota pay exact tokenize-and-count (SURVEY §7.3 cost-model
+        # honesty; the old chars//3 heuristic undercounted CJK ~3x).
+        max_new_total = len(inputs) * int(sampling["max_new_tokens"])
+        overhead = len(
+            (rec.system_prompt or "").encode("utf-8")
+        ) + 64  # per-row chat-template + system-prompt bound
+        bound = (
+            sum(len(r.encode("utf-8")) for r in inputs)
+            + len(inputs) * overhead
+            + max_new_total
         )
-        quota_err = self.jobs.check_quota(
-            rec.job_priority, len(inputs), est_tokens
-        )
+        # row quota first on its own: tokenizing cannot change a
+        # row-count failure, so never pay the exact pass for one
+        quota_err = self.jobs.check_quota(rec.job_priority, len(inputs), 0)
+        if quota_err is None:
+            quota_err = self.jobs.check_quota(rec.job_priority, 0, bound)
+            if quota_err:
+                tok = self._get_tokenizer(engine_key, mcfg)
+                exact = (
+                    sum(
+                        len(
+                            tok.encode(
+                                tok.render_chat(
+                                    r,
+                                    system=rec.system_prompt,
+                                    template=mcfg.chat_template,
+                                )
+                            )
+                        )
+                        for r in inputs
+                    )
+                    + max_new_total
+                )
+                quota_err = self.jobs.check_quota(
+                    rec.job_priority, 0, exact
+                )
         if quota_err:
             self.jobs.set_status(
                 rec.job_id,
@@ -164,19 +199,35 @@ class LocalEngine:
         self._enqueue(rec.job_priority, rec.job_id)
         return rec.job_id
 
-    def _reserve_queue_entry(self, job_id: str) -> int:
+    def _higher_priority_waiting(self, my_priority: int) -> bool:
+        """True when a strictly-higher-priority (lower number) job sits
+        in the queue — the preemption predicate. Interactive jobs
+        preempt the running batch at decode-step granularity (reference
+        two-priority model, README.md:168-171): the running batcher
+        yields, requeues itself at its original priority, and resumes
+        row-granularly after the higher-priority job drains. Reading the
+        queued-priority map under the lock (rather than flagging the
+        current job at submit time) makes preemption race-free against
+        the worker's pop/requeue windows."""
+        with self._lock:
+            return any(
+                p < my_priority for p in self._queued_prio.values()
+            )
+
+    def _reserve_queue_entry(self, priority: int, job_id: str) -> int:
         """Caller must hold ``self._lock``. Registers the job as queued
         and returns its FIFO sequence number; the caller must follow up
         with ``self._queue.put((priority, seq, job_id))`` (possibly
         after releasing the lock) or roll back by discarding the id from
-        ``self._queued``."""
+        ``self._queued`` and ``self._queued_prio``."""
         self._seq += 1
         self._queued.add(job_id)
+        self._queued_prio[job_id] = priority
         return self._seq
 
     def _enqueue(self, priority: int, job_id: str) -> None:
         with self._lock:
-            seq = self._reserve_queue_entry(job_id)
+            seq = self._reserve_queue_entry(priority, job_id)
             self._queue.put((priority, seq, job_id))
 
     def job_status(self, job_id: str) -> str:
@@ -244,13 +295,19 @@ class LocalEngine:
                     job_id in self._queued or job_id == self._current_job
                 )
                 if not busy:
+                    # re-read status under the lock: a stale pre-lock
+                    # read could race job completion and re-run a
+                    # SUCCEEDED job
+                    status = self.jobs.status(job_id)
                     if status == JobStatus.SUCCEEDED:
                         return {"status": status.value, "resumed": False,
                                 "detail": "job already succeeded"}
                     # fetch BEFORE registering as queued: a raise here
                     # must not leave the id poisoning _queued
                     rec = self.jobs.get(job_id)
-                    seq = self._reserve_queue_entry(job_id)
+                    seq = self._reserve_queue_entry(
+                        rec.job_priority, job_id
+                    )
                     break
             # terminal status + still "current": the worker is in its
             # epilogue (flush/metrics) — wait for it to let go rather
@@ -270,6 +327,7 @@ class LocalEngine:
         except Exception:
             with self._lock:
                 self._queued.discard(job_id)
+                self._queued_prio.pop(job_id, None)
             raise
         # mirror _run_job's resume filter: cancelled-truncated rows are
         # regenerated, so they don't count as already done
@@ -294,20 +352,40 @@ class LocalEngine:
     # Worker
     # ------------------------------------------------------------------
 
+    def _weights_dir_for(self, engine_key: str) -> Optional[str]:
+        if self.ecfg.weights_dir:
+            import os
+
+            cand = os.path.join(self.ecfg.weights_dir, engine_key)
+            if os.path.isdir(cand):
+                return cand
+        return None
+
+    def _get_tokenizer(
+        self, engine_key: str, mcfg: ModelConfig
+    ) -> BaseTokenizer:
+        """Tokenizer WITHOUT building the runner (quota gate / dry runs
+        must not pay model init)."""
+        cached = self._runner_cache.get(engine_key)
+        if cached is not None:
+            return cached[1]
+        tok = self._tok_cache.get(engine_key)
+        if tok is None:
+            tok = load_tokenizer(
+                self._weights_dir_for(engine_key),
+                vocab_size=mcfg.vocab_size,
+            )
+            self._tok_cache[engine_key] = tok
+        return tok
+
     def _get_runner(
         self, engine_key: str, mcfg: ModelConfig
     ) -> Tuple[ModelRunner, BaseTokenizer]:
         cached = self._runner_cache.get(engine_key)
         if cached is not None:
             return cached
-        weights_dir = None
-        if self.ecfg.weights_dir:
-            import os
-
-            cand = os.path.join(self.ecfg.weights_dir, engine_key)
-            if os.path.isdir(cand):
-                weights_dir = cand
-        tok = load_tokenizer(weights_dir, vocab_size=mcfg.vocab_size)
+        weights_dir = self._weights_dir_for(engine_key)
+        tok = self._get_tokenizer(engine_key, mcfg)
         params = None
         if weights_dir:
             from .weights import load_checkpoint
@@ -325,12 +403,14 @@ class LocalEngine:
             _, _, job_id = self._queue.get()
             with self._lock:
                 self._queued.discard(job_id)
+                self._queued_prio.pop(job_id, None)
                 self._current_job = job_id
+            requeue_priority = None
             try:
                 if job_id in self._cancel:
                     self.jobs.set_status(job_id, JobStatus.CANCELLED)
                     continue
-                self._run_job(job_id)
+                requeue_priority = self._run_job(job_id)
             except Exception as e:  # noqa: BLE001 — job isolation boundary
                 traceback.print_exc()
                 try:
@@ -342,14 +422,27 @@ class LocalEngine:
                 except Exception:
                     pass
             finally:
-                # finish metrics BEFORE releasing _current_job: resume_job
-                # waits on _current_job, and must not race this epilogue
-                # into finishing the resumed run's fresh metrics stream
-                self.metrics.job(job_id).finish()
+                if requeue_priority is None:
+                    # finish metrics BEFORE releasing _current_job:
+                    # resume_job waits on _current_job, and must not race
+                    # this epilogue into finishing the resumed run's
+                    # fresh metrics stream
+                    self.metrics.job(job_id).finish()
+                else:
+                    # preempted: keep the metrics stream alive (attached
+                    # clients see progress stall, then resume) and
+                    # requeue BEFORE releasing _current_job so a
+                    # concurrent resume_job can never observe not-busy
+                    # and double-enqueue
+                    self.jobs.set_status(job_id, JobStatus.QUEUED)
+                    self._enqueue(requeue_priority, job_id)
                 with self._lock:
                     self._current_job = None
 
-    def _run_job(self, job_id: str) -> None:
+    def _run_job(self, job_id: str) -> Optional[int]:
+        """Run one job to a terminal state. Returns None normally, or
+        the job's priority when it yielded to a higher-priority job (the
+        worker loop requeues it)."""
         rec = self.jobs.get(job_id)
         self.jobs.set_status(job_id, JobStatus.STARTING)
         engine_key, mcfg, meta = resolve_model(rec.model)
@@ -385,8 +478,9 @@ class LocalEngine:
         jm = self.metrics.job(job_id)
 
         if mcfg.head == "embedding":
-            self._run_embedding_job(job_id, rec, runner, tok, token_rows, jm)
-            return
+            return self._run_embedding_job(
+                job_id, rec, runner, tok, token_rows, jm
+            )
 
         # Constrained decoding
         constraint_factory = None
@@ -499,11 +593,14 @@ class LocalEngine:
         from .profiling import job_trace
 
         with job_trace(self.ecfg.profile_dir, job_id):
-            batcher.run(
+            outcome = batcher.run(
                 requests,
                 on_result=on_result,
                 on_progress=on_progress,
                 should_cancel=should_cancel,
+                should_yield=lambda: self._higher_priority_waiting(
+                    rec.job_priority
+                ),
             )
         if pending_flush:
             self.jobs.flush_partial(job_id, list(pending_flush))
@@ -512,6 +609,12 @@ class LocalEngine:
         if cancelled["flag"]:
             self.jobs.set_status(job_id, JobStatus.CANCELLED)
             return
+
+        if outcome == "yielded":
+            # preempted by a higher-priority submit: completed rows are
+            # in the partial store; the worker requeues us and the
+            # re-run resumes row-granularly
+            return rec.job_priority
 
         out_tokens = 0
         ordered = {
@@ -548,20 +651,56 @@ class LocalEngine:
 
     def _run_embedding_job(
         self, job_id, rec, runner, tok, token_rows, jm
-    ) -> None:
-        """Embedding path: mean-pool head, batched (BASELINE config #3)."""
+    ) -> Optional[int]:
+        """Embedding path: mean-pool head, batched (BASELINE config #3).
+
+        Row-granular durability like the generation path (SURVEY §5.3):
+        embeddings flush to the partial store every few batches, so a
+        1M-row job that dies at row 999k resumes from the flush point
+        instead of row 0 — and the same mechanism serves preemption
+        (returns the job priority when yielding to a higher-priority
+        job) and cancel/resume."""
         bs = max(self.ecfg.decode_batch_size, 8)
-        outputs: List[Any] = []
-        done = 0
-        for i in range(0, len(token_rows), bs):
+        done_rows = self.jobs.read_partial(job_id)
+        results: Dict[int, Any] = {
+            i: (
+                r["outputs"].tolist()
+                if hasattr(r["outputs"], "tolist")
+                else r["outputs"]
+            )
+            for i, r in done_rows.items()
+        }
+        pending_flush: List[Dict[str, Any]] = []
+
+        def flush() -> None:
+            if pending_flush:
+                self.jobs.flush_partial(job_id, list(pending_flush))
+                pending_flush.clear()
+
+        todo = [i for i in range(len(token_rows)) if i not in results]
+        jm.progress(len(results))
+        for off in range(0, len(todo), bs):
             if job_id in self._cancel:
+                flush()
                 self.jobs.set_status(job_id, JobStatus.CANCELLED)
-                return
-            chunk = token_rows[i : i + bs]
-            emb = runner.embed_batch([list(map(int, r)) for r in chunk])
-            outputs.extend(emb.tolist())
-            done += len(chunk)
-            jm.progress(done)
+                return None
+            if self._higher_priority_waiting(rec.job_priority):
+                flush()
+                return rec.job_priority
+            idxs = todo[off : off + bs]
+            emb = runner.embed_batch(
+                [list(map(int, token_rows[i])) for i in idxs]
+            )
+            for i, vec in zip(idxs, emb.tolist()):
+                results[i] = vec
+                pending_flush.append(
+                    {"row_id": i, "outputs": vec,
+                     "cumulative_logprobs": 0.0, "finish_reason": "stop"}
+                )
+            if len(pending_flush) >= _PARTIAL_FLUSH_EVERY:
+                flush()
+            jm.progress(len(results))
+        flush()
         input_tokens = int(sum(len(r) for r in token_rows))
         self.jobs.update(
             job_id,
@@ -569,15 +708,17 @@ class LocalEngine:
             output_tokens=0,
             job_cost=estimate_cost(rec.engine_key, input_tokens, 0),
         )
+        n = len(token_rows)
         self.jobs.finalize_results(
             job_id,
             {
-                "row_id": list(range(len(outputs))),
-                "outputs": outputs,
-                "cumulative_logprobs": [0.0] * len(outputs),
-                "finish_reason": ["stop"] * len(outputs),
+                "row_id": list(range(n)),
+                "outputs": [results[i] for i in range(n)],
+                "cumulative_logprobs": [0.0] * n,
+                "finish_reason": ["stop"] * n,
             },
         )
+        return None
 
 
 # ---------------------------------------------------------------------------

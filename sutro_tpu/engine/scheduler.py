@@ -430,9 +430,18 @@ class ContinuousBatcher:
         on_result: Callable[[GenResult], None],
         on_progress: Optional[Callable[[Dict[str, Any]], None]] = None,
         should_cancel: Optional[Callable[[], bool]] = None,
+        should_yield: Optional[Callable[[], bool]] = None,
         progress_every: float = 1.0,
-    ) -> None:
-        """Run all requests to completion, streaming results/progress."""
+    ) -> str:
+        """Run all requests to completion, streaming results/progress.
+
+        Returns ``"completed"``, ``"cancelled"``, or ``"yielded"``.
+        ``should_yield`` is polled between decode steps (same cadence as
+        ``should_cancel``): on True the batcher drops its in-flight
+        slots WITHOUT emitting results (those rows regenerate when the
+        caller re-runs the job; completed rows were already emitted) and
+        returns immediately — the preemption primitive behind priority
+        scheduling (reference two-priority semantics, README.md:168-171)."""
         max_prompt = self.ecfg.max_context() - 1  # leave >=1 token of gen room
         pending = []
         for req in requests:
@@ -484,7 +493,13 @@ class ContinuousBatcher:
                         res = self._release(i)
                         res.finish_reason = "cancelled"
                         on_result(res)
-                return
+                return "cancelled"
+            if should_yield and should_yield():
+                for i, s in enumerate(self.slots):
+                    if s is not None:
+                        self._unreserve(i, s.pages)
+                        self.slots[i] = None
+                return "yielded"
             # Admit as many pending rows as slots/pages allow, prefilling
             # them in batches of up to ``prefill_batch_size`` per device
             # dispatch (long rows chunk one at a time — see

@@ -1,0 +1,105 @@
+"""Tokenizer-honest quota enforcement and embedding-job durability
+(partial flush + row-granular resume), per SURVEY §5.3/§7.3."""
+
+import json
+import time
+
+import numpy as np
+
+from sutro_tpu.interfaces import JobStatus
+
+
+def _wait_terminal(eng, job_id, timeout=180):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        s = eng.job_status(job_id)
+        if JobStatus(s).is_terminal():
+            return s
+        time.sleep(0.05)
+    raise TimeoutError(eng.job_status(job_id))
+
+
+def test_quota_exact_tokens_reject(tiny_ecfg, tmp_path, monkeypatch):
+    """A job whose exact token count exceeds the quota is rejected even
+    when a crude chars-based heuristic would have passed it. ByteTokenizer
+    is 1 token/byte, so multibyte text makes chars//3 undercount ~3x —
+    the old heuristic's failure mode."""
+    monkeypatch.setenv("SUTRO_HOME", str(tmp_path))
+    (tmp_path / "quotas.json").write_text(
+        json.dumps([{"row_quota": 10, "token_quota": 400}])
+    )
+    from sutro_tpu.engine.api import LocalEngine
+
+    eng = LocalEngine(tiny_ecfg)
+    # 3 rows x ~40 CJK chars = ~120 "chars//3 + 1" tokens (old heuristic:
+    # passes 400) but ~360 real byte-tokens + 3*64 max_new = >400
+    rows = ["漢字" * 20] * 3
+    jid = eng.submit_batch_inference(
+        {
+            "model": "tiny-dense",
+            "inputs": rows,
+            "sampling_params": {"max_new_tokens": 64},
+        }
+    )
+    assert _wait_terminal(eng, jid) == "FAILED"
+    reason = eng.get_job(jid)["failure_reason"]["message"]
+    assert "quota" in reason.lower()
+
+
+def test_quota_small_job_passes_without_exact_count(
+    tiny_ecfg, tmp_path, monkeypatch
+):
+    monkeypatch.setenv("SUTRO_HOME", str(tmp_path))
+    from sutro_tpu.engine.api import LocalEngine
+
+    eng = LocalEngine(tiny_ecfg)
+    jid = eng.submit_batch_inference(
+        {
+            "model": "tiny-dense",
+            "inputs": ["ok", "fine"],
+            "sampling_params": {"max_new_tokens": 4},
+        }
+    )
+    assert _wait_terminal(eng, jid) == "SUCCEEDED"
+
+
+def test_embedding_job_resumes_from_partial(
+    tiny_ecfg, tmp_path, monkeypatch
+):
+    """Cancel an embedding job mid-run, then resume: completed rows are
+    not recomputed (rows_already_done > 0) and the final result carries
+    every row."""
+    monkeypatch.setenv("SUTRO_HOME", str(tmp_path))
+    from sutro_tpu.engine.api import LocalEngine
+
+    eng = LocalEngine(tiny_ecfg)
+    n = 64
+    jid = eng.submit_batch_inference(
+        {"model": "tiny-emb", "inputs": [f"text {i}" for i in range(n)]}
+    )
+    # wait for some batches to complete, then cancel mid-flight
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if eng.metrics.job(jid).rows_completed > 0:
+            break
+        time.sleep(0.02)
+    eng.cancel_job(jid)
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        status = eng.job_status(jid)
+        if status in ("CANCELLED", "SUCCEEDED", "FAILED"):
+            break
+        time.sleep(0.05)
+    if status == "SUCCEEDED":  # raced to completion: nothing to resume
+        return
+    assert status == "CANCELLED"
+
+    out = eng.resume_job(jid)
+    assert out["resumed"] is True
+    assert out["rows_already_done"] > 0
+    assert _wait_terminal(eng, jid) == "SUCCEEDED"
+    res = eng.job_results(jid)
+    assert len(res["outputs"]) == n
+    # embeddings are unit-norm vectors
+    for v in res["outputs"]:
+        assert abs(float(np.linalg.norm(np.asarray(v))) - 1.0) < 1e-3
