@@ -1,0 +1,51 @@
+import time, json, os
+import numpy as np
+import jax
+
+from sutro_tpu.engine.config import EngineConfig
+from sutro_tpu.engine.runner import ModelRunner
+from sutro_tpu.models.configs import MODEL_CONFIGS
+
+def run(B, multi, prompt_len=128, steps=256, ps=64, extra_ctx=0, use_pallas=None):
+    mcfg = MODEL_CONFIGS["qwen3-0.6b"]
+    ecfg = EngineConfig(
+        kv_page_size=ps,
+        max_pages_per_seq=(prompt_len + steps + extra_ctx) // ps + 2,
+        decode_batch_size=B,
+        max_model_len=prompt_len + steps + extra_ctx + 64,
+        param_dtype="bfloat16",
+        use_pallas=use_pallas,
+    )
+    runner = ModelRunner(mcfg, ecfg)
+    MP = ecfg.max_pages_per_seq
+    rng = np.random.default_rng(0)
+    pages_per_seq = (prompt_len + steps) // ps + 1
+    tables = np.zeros((B, MP), np.int32); n = 1
+    for b in range(B):
+        tables[b, :pages_per_seq] = np.arange(n, n + pages_per_seq); n += pages_per_seq
+    prompt = rng.integers(0, 50000, prompt_len).astype(np.int32)
+    rows = [prompt] * min(B, 8)
+    t0 = time.monotonic()
+    runner.prefill_batch(rows, tables[:len(rows)])
+    t_pf = time.monotonic() - t0
+    last = rng.integers(0, 256, B).astype(np.int32)
+    past = np.full((B,), prompt_len, np.int32)
+    temp = np.full((B,), 0.7, np.float32); top_p = np.full((B,), 0.95, np.float32)
+    # warmup
+    toks, _ = runner.decode_multi(last, past, tables, jax.random.PRNGKey(0), temp, top_p, multi)
+    past += multi; last = toks[-1].astype(np.int32)
+    t0 = time.monotonic()
+    nwin = steps // multi
+    for i in range(nwin):
+        toks, _ = runner.decode_multi(last, past, tables, jax.random.PRNGKey(i+1), temp, top_p, multi)
+        past += multi; last = toks[-1].astype(np.int32)
+    dt = time.monotonic() - t0
+    print(json.dumps({"B": B, "multi": multi, "ps": ps, "ctx_cap": MP*ps,
+        "pallas": runner.use_pallas, "decode_tok_s": round(B*nwin*multi/dt, 1),
+        "ms_per_step": round(1000*dt/(nwin*multi), 2),
+        "prefill_batch8_s": round(t_pf, 2)}), flush=True)
+
+import sys
+for spec in sys.argv[1:]:
+    kw = json.loads(spec)
+    run(**kw)

@@ -1,0 +1,170 @@
+"""End-to-end BASELINE benchmarks through the full engine stack.
+
+Unlike bench.py (raw runner decode loop), this drives jobs through
+``LocalEngine`` — scheduler admission, batched prefill, FSM-constrained
+decoding, tokenizer, jobstore, metrics — matching the reference's
+headline workflows (/root/reference/README.md:173-192):
+
+- **classify**: BASELINE config #4 analog — short product reviews through
+  the classification template (system prompt + JSON output_schema with
+  scratchpad/classification, schema-constrained decoding).
+- **generate**: the same rows without a schema (unconstrained decode
+  path with fused multi-step windows).
+- **embed**: BASELINE config #3 analog — rows through the embedding
+  model (mean-pool head, batched).
+
+Row counts are time-boxed defaults; raise with SUTRO_E2E_ROWS /
+SUTRO_E2E_EMBED_ROWS for full-dataset runs (20k / 1M). Weights are
+random — throughput is weight-value independent — so rows/hour and
+tok/s/chip are real; classification *quality* is not measured here (see
+tests/test_golden.py for decode correctness on real checkpoints).
+
+Writes BENCH_E2E.json and prints one JSON line per workload.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+
+
+REVIEW_SNIPPETS = [
+    "battery life is incredible and it charges fast",
+    "stopped working after two weeks, very disappointed",
+    "decent value for the price but the build feels cheap",
+    "exactly as described, shipping was quick",
+    "the screen scratches way too easily",
+    "customer support resolved my issue in minutes",
+    "way too loud under load, returned it",
+    "my kids love it, survived several drops already",
+]
+
+
+def make_reviews(n: int) -> list:
+    return [
+        f"Review {i}: {REVIEW_SNIPPETS[i % len(REVIEW_SNIPPETS)]} "
+        f"(order #{1000 + i})"
+        for i in range(n)
+    ]
+
+
+def main() -> None:
+    import jax
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    n_chips = max(jax.device_count(), 1)
+
+    if on_tpu:
+        model = os.environ.get("SUTRO_E2E_MODEL", "qwen-3-0.6b")
+        emb_model = "qwen-3-embedding-0.6b"
+        rows = int(os.environ.get("SUTRO_E2E_ROWS", "1024"))
+        emb_rows = int(os.environ.get("SUTRO_E2E_EMBED_ROWS", "20000"))
+        ecfg = dict(
+            decode_batch_size=64,
+            kv_page_size=64,
+            max_pages_per_seq=8,
+            max_model_len=512,
+            max_new_tokens=48,
+        )
+    else:  # CPU smoke
+        model = emb_model = "tiny-dense"
+        emb_model = "tiny-emb"
+        rows = int(os.environ.get("SUTRO_E2E_ROWS", "16"))
+        emb_rows = int(os.environ.get("SUTRO_E2E_EMBED_ROWS", "64"))
+        ecfg = dict(
+            decode_batch_size=4, kv_page_size=8, max_pages_per_seq=16,
+            max_model_len=128, max_new_tokens=16, use_pallas=False,
+            param_dtype="float32",
+        )
+
+    os.environ.setdefault("SUTRO_HOME", "/tmp/sutro-bench-e2e")
+    from sutro_tpu.sdk import Sutro
+
+    so = Sutro(engine_config=ecfg)
+    eng = so.engine
+    results = {}
+
+    def record(name, job_id, n_rows, elapsed):
+        rec = eng.get_job(job_id)
+        in_tok = rec.get("input_tokens") or 0
+        out_tok = rec.get("output_tokens") or 0
+        total = in_tok + out_tok
+        cost = rec.get("job_cost") or 0.0
+        entry = {
+            "model": rec["model"],
+            "rows": n_rows,
+            "elapsed_s": round(elapsed, 2),
+            "rows_per_hour": round(n_rows / elapsed * 3600, 1),
+            "input_tokens": in_tok,
+            "output_tokens": out_tok,
+            "tok_s_per_chip": round(total / elapsed / n_chips, 1),
+            "usd_per_1m_tokens": (
+                round(cost / total * 1e6, 4) if total else None
+            ),
+            "status": rec["status"],
+        }
+        results[name] = entry
+        print(json.dumps({name: entry}), flush=True)
+
+    reviews = make_reviews(rows)
+
+    # -- classify (schema-constrained; reference README.md:124-160) ----
+    t0 = time.monotonic()
+    jid = so.infer(
+        reviews,
+        model=model,
+        system_prompt=(
+            "You are an expert classifier. Classify the sentiment of "
+            "the review as positive, negative, or neutral."
+        ),
+        output_schema={
+            "type": "object",
+            "properties": {
+                "classification": {
+                    "type": "string",
+                    "enum": ["positive", "negative", "neutral"],
+                },
+            },
+            "required": ["classification"],
+        },
+        stay_attached=False,
+    )
+    df = so.await_job_completion(jid, timeout=24 * 3600)
+    assert df is not None and len(df) == rows
+    record("classify", jid, rows, time.monotonic() - t0)
+
+    # -- generate (unconstrained, fused multi-step decode) --------------
+    t0 = time.monotonic()
+    jid = so.infer(
+        reviews,
+        model=model,
+        system_prompt="Summarize the review in one short sentence.",
+        stay_attached=False,
+    )
+    df = so.await_job_completion(jid, timeout=24 * 3600)
+    assert df is not None and len(df) == rows
+    record("generate", jid, rows, time.monotonic() - t0)
+
+    # -- embed (BASELINE config #3) --------------------------------------
+    emb_reviews = make_reviews(emb_rows)
+    t0 = time.monotonic()
+    jid = so.infer(emb_reviews, model=emb_model, stay_attached=False)
+    df = so.await_job_completion(jid, timeout=24 * 3600)
+    assert df is not None and len(df) == emb_rows
+    record("embed", jid, emb_rows, time.monotonic() - t0)
+
+    out = {
+        "backend": jax.default_backend(),
+        "n_chips": n_chips,
+        "workloads": results,
+    }
+    Path(__file__).parent.joinpath("BENCH_E2E.json").write_text(
+        json.dumps(out, indent=2)
+    )
+    print(json.dumps({"bench_e2e": "written"}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Bisect the window-buffered decode body."""
+import time, json
+import numpy as np
+import jax, jax.numpy as jnp
+
+from sutro_tpu.engine.config import EngineConfig
+from sutro_tpu.engine.runner import ModelRunner
+from sutro_tpu.models.configs import MODEL_CONFIGS
+from sutro_tpu.models import transformer
+from sutro_tpu.engine.kvcache import write_kv
+from sutro_tpu.ops.sampling import sample, cumulative_logprob
+
+mcfg = MODEL_CONFIGS["qwen3-0.6b"]
+B, MP, ps = 64, 8, 64
+ecfg = EngineConfig(kv_page_size=ps, max_pages_per_seq=MP, decode_batch_size=B,
+                    max_model_len=MP*ps, param_dtype="bfloat16")
+runner = ModelRunner(mcfg, ecfg, num_pages=1 + B*MP)
+params, cache = runner.params, runner.cache
+rng = np.random.default_rng(0)
+last0 = jnp.asarray(rng.integers(0, 50000, B), jnp.int32)
+past = jnp.full((B,), 200, jnp.int32)
+tables = np.zeros((B, MP), np.int32); n=1
+for b in range(B): tables[b,:MP-1]=np.arange(n,n+MP-1); n+=MP-1
+tables = jnp.asarray(tables)
+ones = jnp.ones((B,), jnp.int32)
+temp = jnp.full((B,), 0.7, jnp.float32); top_p = jnp.full((B,), 0.95, jnp.float32)
+top_k = jnp.zeros((B,), jnp.int32)
+K = 16
+L, KVH, Dh = mcfg.num_layers, mcfg.num_kv_heads, mcfg.head_dim
+dtype = cache.k_pages.dtype
+
+def make(do_sample, do_write):
+    @jax.jit
+    def f(params, cache, last, past, key):
+        wk0 = jnp.zeros((L, B, K, KVH, Dh), dtype)
+        wv0 = jnp.zeros((L, B, K, KVH, Dh), dtype)
+        def body(carry, step_idx):
+            wk, wv, last = carry
+            logits, _, (k, v) = transformer.forward(
+                mcfg, params, last[:, None], (past + step_idx)[:, None], ones,
+                paged_past=(cache.k_pages, cache.v_pages, tables),
+                past_len=past, window_past=(wk, wv, step_idx),
+                use_pallas=True)
+            wk = jax.lax.dynamic_update_slice(wk, k.astype(dtype), (0,0,step_idx,0,0))
+            wv = jax.lax.dynamic_update_slice(wv, v.astype(dtype), (0,0,step_idx,0,0))
+            sl = logits[:, 0]
+            if do_sample:
+                kk = jax.random.fold_in(key, step_idx)
+                tok = sample(sl, kk, temperature=temp, top_p=top_p, top_k=top_k)
+                lp = cumulative_logprob(sl, tok)
+            else:
+                tok = jnp.argmax(sl[:, :1024], axis=-1).astype(jnp.int32); lp = tok
+            return (wk, wv, tok), (tok, lp)
+        (wk, wv, _), (toks, lps) = jax.lax.scan(body, (wk0, wv0, last), jnp.arange(K, dtype=jnp.int32))
+        if do_write:
+            c2 = write_kv(cache, wk, wv, tables, past, jnp.full((B,), K, jnp.int32), use_pallas=True)
+            return toks, c2.k_pages[0,0,0,0,0]
+        return toks, wk[0,0,0,0,0]
+    return f
+
+def timeit(name, fn):
+    out = fn(params, cache, last0, past, jax.random.PRNGKey(0)); jax.block_until_ready(out)
+    t0 = time.monotonic()
+    out = fn(params, cache, last0, past, jax.random.PRNGKey(1)); jax.block_until_ready(out)
+    dt = time.monotonic() - t0
+    print(json.dumps({"variant": name, "ms_per_step": round(1000*dt/K, 2)}), flush=True)
+
+timeit("trunk+winbuf (greedy, no write)", make(False, False))
+timeit("trunk+winbuf+sample", make(True, False))
+timeit("trunk+winbuf+sample+write", make(True, True))

@@ -1,0 +1,42 @@
+"""Decode kernel-vs-gather sweep at fixed batch, varying context."""
+import time, json, sys
+import numpy as np
+import jax
+
+from sutro_tpu.engine.config import EngineConfig
+from sutro_tpu.engine.runner import ModelRunner
+from sutro_tpu.models.configs import MODEL_CONFIGS
+
+def run(B=64, multi=16, prompt_len=128, steps=256, ps=64, label=""):
+    mcfg = MODEL_CONFIGS["qwen3-0.6b"]
+    MP = (prompt_len + steps) // ps + 2
+    ecfg = EngineConfig(
+        kv_page_size=ps, max_pages_per_seq=MP, decode_batch_size=B,
+        max_model_len=MP * ps, param_dtype="bfloat16",
+    )
+    runner = ModelRunner(mcfg, ecfg, num_pages=1 + B * MP)
+    rng = np.random.default_rng(0)
+    pages_per_seq = MP - 1
+    tables = np.zeros((B, MP), np.int32); n = 1
+    for b in range(B):
+        tables[b, :pages_per_seq] = np.arange(n, n + pages_per_seq); n += pages_per_seq
+    last = rng.integers(0, 256, B).astype(np.int32)
+    past = np.full((B,), prompt_len, np.int32)
+    temp = np.full((B,), 0.7, np.float32); top_p = np.full((B,), 0.95, np.float32)
+    toks, _ = runner.decode_multi(last, past, tables, jax.random.PRNGKey(0), temp, top_p, multi)
+    past += multi; last = toks[-1].astype(np.int32)
+    t0 = time.monotonic()
+    nwin = steps // multi
+    for i in range(nwin - 1):
+        toks, _ = runner.decode_multi(last, past, tables, jax.random.PRNGKey(i+1), temp, top_p, multi)
+        past += multi; last = toks[-1].astype(np.int32)
+    dt = time.monotonic() - t0
+    nsteps = (nwin - 1) * multi
+    import sutro_tpu.ops.pallas_paged as pp
+    print(json.dumps({"label": label, "B": B, "multi": multi, "ctx_cap": MP*ps,
+        "min_ctx": pp.PALLAS_PAGED_MIN_CTX, "pallas": runner.use_pallas,
+        "decode_tok_s": round(B*nsteps/dt, 1),
+        "ms_per_step": round(1000*dt/nsteps, 2)}), flush=True)
+
+for spec in sys.argv[1:]:
+    run(**json.loads(spec))

@@ -1,0 +1,38 @@
+"""Isolate decode cost components: past-length sensitivity + window size."""
+import time, json, sys
+import numpy as np
+import jax
+
+from sutro_tpu.engine.config import EngineConfig
+from sutro_tpu.engine.runner import ModelRunner
+from sutro_tpu.models.configs import MODEL_CONFIGS
+
+def run(B=64, multi=16, past0=128, MP=8, ps=64, nwin=8, label=""):
+    mcfg = MODEL_CONFIGS["qwen3-0.6b"]
+    ecfg = EngineConfig(
+        kv_page_size=ps, max_pages_per_seq=MP, decode_batch_size=B,
+        max_model_len=MP * ps, param_dtype="bfloat16",
+    )
+    runner = ModelRunner(mcfg, ecfg, num_pages=1 + B * MP)
+    rng = np.random.default_rng(0)
+    tables = np.zeros((B, MP), np.int32); n = 1
+    for b in range(B):
+        tables[b, :MP-1] = np.arange(n, n + MP-1); n += MP-1
+    last = rng.integers(0, 256, B).astype(np.int32)
+    past = np.full((B,), past0, np.int32)
+    temp = np.full((B,), 0.7, np.float32); top_p = np.full((B,), 0.95, np.float32)
+    toks, _ = runner.decode_multi(last, past, tables, jax.random.PRNGKey(0), temp, top_p, multi)
+    last = toks[-1].astype(np.int32)
+    t0 = time.monotonic()
+    for i in range(nwin):
+        toks, _ = runner.decode_multi(last, past, tables, jax.random.PRNGKey(i+1), temp, top_p, multi)
+        last = toks[-1].astype(np.int32)  # past pinned: isolate ctx-len effect
+    dt = time.monotonic() - t0
+    nsteps = nwin * multi
+    print(json.dumps({"label": label, "B": B, "multi": multi, "past": past0,
+        "ctx_cap": MP*ps, "pallas": runner.use_pallas,
+        "tok_s": round(B*nsteps/dt, 1),
+        "ms_per_step": round(1000*dt/nsteps, 2)}), flush=True)
+
+for spec in sys.argv[1:]:
+    run(**json.loads(spec))

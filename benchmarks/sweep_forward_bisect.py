@@ -1,0 +1,73 @@
+"""Bisect the decode forward: attention vs MLP vs head vs scan."""
+import time, json
+import numpy as np
+import jax, jax.numpy as jnp
+
+from sutro_tpu.engine.config import EngineConfig
+from sutro_tpu.engine.runner import ModelRunner
+from sutro_tpu.models.configs import MODEL_CONFIGS
+from sutro_tpu.models import transformer as T
+import sutro_tpu.models.transformer as tmod
+
+mcfg = MODEL_CONFIGS["qwen3-0.6b"]
+B, MP, ps = 64, 8, 64
+ecfg = EngineConfig(kv_page_size=ps, max_pages_per_seq=MP, decode_batch_size=B,
+                    max_model_len=MP*ps, param_dtype="bfloat16")
+runner = ModelRunner(mcfg, ecfg, num_pages=1 + B*MP)
+params, cache = runner.params, runner.cache
+rng = np.random.default_rng(0)
+last0 = jnp.asarray(rng.integers(0, 50000, B), jnp.int32)
+past = jnp.full((B,), 200, jnp.int32)
+tables = np.zeros((B, MP), np.int32); n=1
+for b in range(B): tables[b,:MP-1]=np.arange(n,n+MP-1); n+=MP-1
+tables = jnp.asarray(tables)
+ones = jnp.ones((B,), jnp.int32)
+K = 16
+
+orig_attn = tmod.chunk_attention
+orig_mlp = tmod._mlp
+orig_head = tmod.head_apply
+
+def fake_attn(q, k, v, **kw):
+    B_, T_, NH, Dh = q.shape
+    return q * 0.5
+def fake_mlp(cfg, lp, x):
+    return x * 0.5
+def fake_head(cfg, params, h, valid_len):
+    return h[..., :128].astype(jnp.float32), h
+
+def make():
+    @jax.jit
+    def f(params, last, past):
+        def body(carry, step_idx):
+            last = carry
+            out, _, (k, v) = T.forward(
+                mcfg, params, last[:, None], (past + step_idx)[:, None], ones,
+                paged_past=(cache.k_pages, cache.v_pages, tables),
+                past_len=past, use_pallas=True)
+            tok = jnp.argmax(out[:, 0, :512], axis=-1).astype(jnp.int32)
+            return tok, tok
+        toks, _ = jax.lax.scan(body, last0, jnp.arange(K, dtype=jnp.int32))
+        return toks
+    return f
+
+def timeit(name, patches):
+    for mod, attr, val in patches:
+        setattr(mod, attr, val)
+    try:
+        fn = make()
+        out = fn(params, last0, past); jax.block_until_ready(out)
+        t0 = time.monotonic()
+        out = fn(params, last0, past); jax.block_until_ready(out)
+        dt = time.monotonic() - t0
+        print(json.dumps({"variant": name, "ms_per_step": round(1000*dt/K, 2)}), flush=True)
+    finally:
+        tmod.chunk_attention = orig_attn
+        tmod._mlp = orig_mlp
+        tmod.head_apply = orig_head
+
+timeit("full", [])
+timeit("no-attention", [(tmod, "chunk_attention", fake_attn)])
+timeit("no-mlp", [(tmod, "_mlp", fake_mlp)])
+timeit("no-head", [(tmod, "head_apply", fake_head)])
+timeit("no-attn-no-mlp-no-head", [(tmod, "chunk_attention", fake_attn), (tmod, "_mlp", fake_mlp), (tmod, "head_apply", fake_head)])

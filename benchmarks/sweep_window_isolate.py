@@ -1,0 +1,71 @@
+"""Isolate window-machinery cost: kernel window operands vs buffer threading."""
+import time, json, functools
+import numpy as np
+import jax, jax.numpy as jnp
+
+import sutro_tpu.ops.pallas_paged as pp
+from sutro_tpu.engine.config import EngineConfig
+from sutro_tpu.engine.runner import ModelRunner
+from sutro_tpu.models.configs import MODEL_CONFIGS
+from sutro_tpu.models import transformer
+
+mcfg = MODEL_CONFIGS["qwen3-0.6b"]
+B, MP, ps = 64, 8, 64
+ecfg = EngineConfig(kv_page_size=ps, max_pages_per_seq=MP, decode_batch_size=B,
+                    max_model_len=MP*ps, param_dtype="bfloat16")
+runner = ModelRunner(mcfg, ecfg, num_pages=1 + B*MP)
+params, cache = runner.params, runner.cache
+rng = np.random.default_rng(0)
+last0 = jnp.asarray(rng.integers(0, 50000, B), jnp.int32)
+past = jnp.full((B,), 200, jnp.int32)
+tables = np.zeros((B, MP), np.int32); n=1
+for b in range(B): tables[b,:MP-1]=np.arange(n,n+MP-1); n+=MP-1
+tables = jnp.asarray(tables)
+ones = jnp.ones((B,), jnp.int32)
+K = 16
+L, KVH, Dh = mcfg.num_layers, mcfg.num_kv_heads, mcfg.head_dim
+dtype = cache.k_pages.dtype
+
+orig_paged = pp.paged_decode_attention
+
+def no_win_paged(q, kp, vp, pt, pl_, kc, vc, win, sink, win_k=None, win_v=None, win_len=None, **kw):
+    return orig_paged(q, kp, vp, pt, pl_, kc, vc, win, sink, **kw)
+
+def make(mode):
+    # mode: "full" (window kernel), "nowin-kernel" (thread buffers, kernel ignores),
+    #       "nodus" (never update buffer), "nothread" (no window at all)
+    @jax.jit
+    def f(params, cache, last, past):
+        wk0 = jnp.zeros((L, B, K, KVH, Dh), dtype)
+        wv0 = jnp.zeros((L, B, K, KVH, Dh), dtype)
+        def body(carry, step_idx):
+            wk, wv, last = carry
+            wp = None if mode == "nothread" else (wk, wv, step_idx)
+            logits, _, (k, v) = transformer.forward(
+                mcfg, params, last[:, None], (past + step_idx)[:, None], ones,
+                paged_past=(cache.k_pages, cache.v_pages, tables),
+                past_len=past, window_past=wp, use_pallas=True)
+            if mode not in ("nodus",):
+                wk = jax.lax.dynamic_update_slice(wk, k.astype(dtype), (0,0,step_idx,0,0))
+                wv = jax.lax.dynamic_update_slice(wv, v.astype(dtype), (0,0,step_idx,0,0))
+            tok = jnp.argmax(logits[:, 0, :1024], axis=-1).astype(jnp.int32)
+            return (wk, wv, tok), tok
+        (wk, wv, _), toks = jax.lax.scan(body, (wk0, wv0, last0), jnp.arange(K, dtype=jnp.int32))
+        return toks, wk[0,0,0,0,0]
+    return f
+
+def timeit(name, fn, patch):
+    pp.paged_decode_attention = patch
+    try:
+        out = fn(params, cache, last0, past); jax.block_until_ready(out)
+        t0 = time.monotonic()
+        out = fn(params, cache, last0, past); jax.block_until_ready(out)
+        dt = time.monotonic() - t0
+        print(json.dumps({"variant": name, "ms_per_step": round(1000*dt/K, 2)}), flush=True)
+    finally:
+        pp.paged_decode_attention = orig_paged
+
+timeit("full-window-kernel", make("full"), orig_paged)
+timeit("thread-buffers, kernel-ignores-window", make("full"), no_win_paged)
+timeit("no-dus (buffer never written)", make("nodus"), orig_paged)
+timeit("no-window-at-all", make("nothread"), orig_paged)

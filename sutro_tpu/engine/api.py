@@ -130,6 +130,24 @@ class LocalEngine:
 
         sampling = dict(payload.get("sampling_params") or {})
         sampling.setdefault("max_new_tokens", self.ecfg.max_new_tokens)
+        if payload.get("output_schema"):
+            # The schema guarantee ("output_schema => complete JSON")
+            # must stay feasible: raise the row cap to the schema's
+            # shortest accepting output BEFORE quota/cost accounting, so
+            # the effective cap is what gets admitted, estimated, and
+            # persisted. Schema compile errors surface when the job runs.
+            try:
+                from .constrain import schema_constraint_factory
+
+                probe = schema_constraint_factory(
+                    payload["output_schema"],
+                    self._get_tokenizer(engine_key, mcfg),
+                )()
+                need = probe.min_tokens()
+                if need and int(sampling["max_new_tokens"]) < need + 1:
+                    sampling["max_new_tokens"] = need + 1
+            except Exception:
+                pass
         rec = self.jobs.create(
             name=payload.get("name"),
             description=payload.get("description"),
@@ -490,6 +508,8 @@ class LocalEngine:
             constraint_factory = schema_constraint_factory(
                 rec.output_schema, tok
             )
+            # (the schema-feasibility cap raise happens at submit time so
+            # quota and dry-run cost account for the effective cap)
 
         # cancelled rows carry truncated output — regenerate them on resume
         resume = {

@@ -121,6 +121,14 @@ class TokenFSM:
         self.states = nfa.initial()
         self._complete = False
 
+    def min_tokens(self) -> int:
+        """Shortest possible accepting output in tokens (upper-bounded by
+        bytes: every kept token advances >= 1 byte). The engine raises a
+        row's generation cap to at least this, so a small user
+        ``max_new_tokens`` cannot make the schema guarantee infeasible."""
+        d = self.nfa.dist_to_accept(self.nfa.initial())
+        return int(d) if np.isfinite(d) else 0
+
     def allowed_tokens(self, remaining: Optional[int] = None) -> np.ndarray:
         """Vocab mask; with ``remaining`` (token budget left for this row)
         tokens whose post-walk shortest path to accept no longer fits the

@@ -186,6 +186,9 @@ def layer_apply(
     past_len: Optional[jax.Array] = None,
     use_pallas: bool = False,
     ring_mesh=None,
+    wk_l: Optional[jax.Array] = None,   # this layer's fused-decode
+    wv_l: Optional[jax.Array] = None,   # window buffer [B, W, KVH, Dh]
+    win_len: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     """One decoder block. Shared by the scanned ``forward`` and the
     pipeline-parallel stage loop (parallel/pipeline.py). Returns
@@ -216,6 +219,7 @@ def layer_apply(
         window=window, sink=sink,
         use_pallas=use_pallas,
         ring_mesh=ring_mesh,
+        win_k=wk_l, win_v=wv_l, win_len=win_len,
     )
     attn = attn.reshape(B, T, cfg.q_size) @ _w(lp, "wo", h.dtype)
     if cfg.attn_bias:
@@ -302,6 +306,10 @@ def forward(
     past_len: Optional[jax.Array] = None,  # [B] int32 — valid past tokens
     use_pallas: bool = False,
     ring_mesh=None,  # Mesh with "seq" axis > 1 => ring-attention prefill
+    # fused-decode window buffer: (win_k [L, B, W, KVH, Dh], win_v,
+    # win_len scalar) — K/V of window tokens not yet in the page pool
+    # (runner.decode_multi writes pages once per window, not per step)
+    window_past: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
 ) -> Tuple[jax.Array, jax.Array, Tuple[jax.Array, jax.Array]]:
     """Run the trunk over a chunk.
 
@@ -314,16 +322,24 @@ def forward(
     windows = jnp.asarray(cfg.window_array(), jnp.int32)  # [L]
     thetas = rope_thetas(cfg)
 
+    win_len = None if window_past is None else window_past[2]
     if paged_past is not None:
         k_pages, v_pages, page_table = paged_past
-        xs = (params["layers"], windows, thetas, k_pages, v_pages)
+        xs = [params["layers"], windows, thetas, k_pages, v_pages]
+        if window_past is not None:
+            xs += [window_past[0], window_past[1]]
+        xs = tuple(xs)
     else:
         page_table = None
         xs = (params["layers"], windows, thetas)
 
     def layer_step(h, xs_l):
+        wk_l = wv_l = None
         if paged_past is not None:
-            lp, window, theta, kp_l, vp_l = xs_l
+            if window_past is not None:
+                lp, window, theta, kp_l, vp_l, wk_l, wv_l = xs_l
+            else:
+                lp, window, theta, kp_l, vp_l = xs_l
         else:
             lp, window, theta = xs_l
             kp_l = vp_l = None
@@ -334,6 +350,7 @@ def forward(
             kp_l=kp_l, vp_l=vp_l,
             page_table=page_table, past_len=past_len,
             use_pallas=use_pallas, ring_mesh=ring_mesh,
+            wk_l=wk_l, wv_l=wv_l, win_len=win_len,
         )
 
     h, (k_all, v_all) = jax.lax.scan(layer_step, h, xs)

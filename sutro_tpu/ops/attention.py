@@ -48,6 +48,13 @@ def chunk_attention(
     use_pallas: bool = False,
     ring_mesh=None,                        # Mesh with a >1 "seq" axis =>
                                            # sequence-parallel ring prefill
+    # fused-decode window buffer (runner.decode_multi): K/V of tokens
+    # sampled earlier in the window, not yet written to the page pool.
+    # win_k/win_v [B, W, KVH, Dh]; win_len scalar = valid slots, their
+    # positions are past_len + slot.
+    win_k: Optional[jax.Array] = None,
+    win_v: Optional[jax.Array] = None,
+    win_len: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Returns [B, T, NH, Dh]."""
     B, T = q.shape[:2]
@@ -76,6 +83,7 @@ def chunk_attention(
                 out = paged_decode_attention(
                     q[:, 0], past_k_pages, past_v_pages, page_table,
                     past_len, k[:, 0], v[:, 0], win, sink,
+                    win_k=win_k, win_v=win_v, win_len=win_len,
                 )
                 return out[:, None]
         from ..engine.kvcache import gather_kv_layer
@@ -101,23 +109,34 @@ def chunk_attention(
     scale = Dh ** -0.5
 
     if past_k is not None:
-        keys = jnp.concatenate([past_k, k], axis=1)
-        vals = jnp.concatenate([past_v, v], axis=1)
         ctx = past_k.shape[1]
-        key_pos = jnp.concatenate(
-            [
-                jnp.broadcast_to(jnp.arange(ctx, dtype=jnp.int32)[None], (B, ctx)),
-                positions,
-            ],
-            axis=1,
-        )
-        key_valid = jnp.concatenate(
-            [
-                jnp.arange(ctx, dtype=jnp.int32)[None] < past_len[:, None],
-                jnp.arange(T, dtype=jnp.int32)[None] < valid_len[:, None],
-            ],
-            axis=1,
-        )
+        key_segs = [past_k, k]
+        val_segs = [past_v, v]
+        pos_segs = [
+            jnp.broadcast_to(
+                jnp.arange(ctx, dtype=jnp.int32)[None], (B, ctx)
+            ),
+            positions,
+        ]
+        valid_segs = [
+            jnp.arange(ctx, dtype=jnp.int32)[None] < past_len[:, None],
+            jnp.arange(T, dtype=jnp.int32)[None] < valid_len[:, None],
+        ]
+        if win_k is not None and win_k.shape[1] > 0:
+            # fused-window tokens: positions past_len + slot, valid
+            # while slot < win_len (they are not in the pages yet)
+            W = win_k.shape[1]
+            slot = jnp.arange(W, dtype=jnp.int32)[None]
+            key_segs.insert(1, win_k)
+            val_segs.insert(1, win_v)
+            pos_segs.insert(1, past_len[:, None] + slot)
+            valid_segs.insert(
+                1, jnp.broadcast_to(slot < win_len, (B, W))
+            )
+        keys = jnp.concatenate(key_segs, axis=1)
+        vals = jnp.concatenate(val_segs, axis=1)
+        key_pos = jnp.concatenate(pos_segs, axis=1)
+        key_valid = jnp.concatenate(valid_segs, axis=1)
     else:
         keys, vals = k, v
         key_pos = positions

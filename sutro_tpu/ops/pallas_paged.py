@@ -3,26 +3,29 @@
 The decode hot loop (SURVEY §7.3 "Paged-KV attention in Pallas"). For each
 decode step the jnp fallback gathers a contiguous ``[B, CTX, KVH, Dh]``
 view of the page pool per layer — a pure HBM copy that dominates decode
-time at long context. This kernel instead reads K/V pages **in place**,
-walking the page table via scalar prefetch, with flash-style online
-softmax across pages:
+time. This kernel reads K/V pages **in place** with flash-style online
+softmax across pages.
 
-- grid ``(B, MP)``: batch is parallel; the page axis is sequential and
-  carries running ``(m, l, acc)`` per KV head in VMEM scratch;
-- page blocks are addressed by ``page_table[b, ki]`` in the BlockSpec
-  index_map (scalar-prefetch — the DMA for page ``ki+1`` overlaps the
-  compute on page ``ki``);
-- each block carries the page's full ``[PS, KVH, Dh]`` tile (Mosaic
-  requires the trailing two block dims to be full or (8,128)-aligned;
-  blocking a single KV head would put a size-1 block on the KVH axis,
-  which the TPU lowering rejects). KV heads are processed by a static
-  in-kernel loop, one ``[G, PS]`` score tile per head;
-- pages at or beyond ``past_len[b]`` are skipped entirely (``pl.when``), so
-  work is proportional to actual context, not table capacity;
-- the current token's K/V (not yet in the page pool) and the optional
-  gpt-oss attention sink join the softmax in the finalization step;
-- per-layer sliding windows (Gemma3 / gpt-oss) are dynamic operands, so one
-  compiled kernel serves every layer of the ``lax.scan``.
+Design (second generation — the first used grid ``(B, MP)`` with one
+BlockSpec-fetched page per grid step, which cost a block DMA for every
+table slot, used or not, and ~µs of grid overhead per tiny block; at
+28 layers x B=64 x MP=8 that grid tax dominated the whole decode step):
+
+- grid ``(B,)``: one grid step per decode row;
+- the page walk lives INSIDE the kernel as a ``fori_loop`` bounded by the
+  row's ACTUAL page count (``ceil(past_len/PS)``) — unused table slots
+  cost nothing;
+- pages are fetched from the HBM-resident pool (``memory_space=ANY``)
+  with double-buffered ``make_async_copy``: the DMA for page ``i+1``
+  overlaps compute on page ``i``;
+- KV heads are processed by a static in-kernel loop, one ``[G, PS]``
+  score tile per head, accumulating ``(m, l, acc)`` in VMEM scratch;
+- the current token's K/V, the optional multi-step decode window buffer
+  (tokens sampled in the current fused window, not yet written to the
+  pool — see engine/runner.decode_multi), and the optional gpt-oss
+  attention sink all join the softmax in the finalization step;
+- per-layer sliding windows (Gemma3 / gpt-oss) are dynamic operands, so
+  one compiled kernel serves every layer of the ``lax.scan``.
 
 All math is float32.
 """
@@ -41,47 +44,75 @@ NEG_INF = -1e30
 
 
 def _paged_decode_kernel(
-    # scalar prefetch
-    page_table_ref,   # [B * MP] int32 (flattened)
-    past_len_ref,     # [B] int32
-    window_ref,       # [1] int32 (0 = full attention)
-    # operands
-    q_ref,            # [1, KVH, G, Dh]
-    k_page_ref,       # [1, PS, KVH, Dh]
-    v_page_ref,       # [1, PS, KVH, Dh]
-    k_cur_ref,        # [1, KVH, Dh]
-    v_cur_ref,        # [1, KVH, Dh]
-    sink_ref,         # [KVH, G]
-    # output
-    out_ref,          # [1, KVH, G, Dh]
-    # scratch
-    m_ref,            # [KVH, G, 128] f32
-    l_ref,            # [KVH, G, 128] f32
-    acc_ref,          # [KVH, G, Dh] f32
-    *,
-    num_pages_per_seq: int,
+    # scalar prefetch: page_table [B*MP], past_len [B], window [1], and —
+    # when the caller carries a decode window buffer — win_len [1]
+    *refs,
+    max_pages_per_seq: int,
     page_size: int,
     scale: float,
     kvh: int,
+    window_slots: int = 0,
 ):
+    if window_slots:
+        (page_table_ref, past_len_ref, window_ref, win_len_ref,
+         q_ref, k_pool_ref, v_pool_ref, k_cur_ref, v_cur_ref,
+         wk_ref, wv_ref, sink_ref,
+         out_ref, kbuf, vbuf, ksem, vsem, m_ref, l_ref, acc_ref) = refs
+    else:
+        (page_table_ref, past_len_ref, window_ref,
+         q_ref, k_pool_ref, v_pool_ref, k_cur_ref, v_cur_ref,
+         sink_ref,
+         out_ref, kbuf, vbuf, ksem, vsem, m_ref, l_ref, acc_ref) = refs
+        win_len_ref = wk_ref = wv_ref = None
+
     b = pl.program_id(0)
-    ki = pl.program_id(1)
+    MP = max_pages_per_seq
     PS = page_size
     G = q_ref.shape[2]
 
-    @pl.when(ki == 0)
-    def _init():
-        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[...] = jnp.zeros_like(l_ref)
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-
     past = past_len_ref[b]
-    pos = past  # current token's global position
+    npages = (past + PS - 1) // PS
+    # current token's global position: tokens already in pages plus any
+    # fused-window tokens not yet written back
+    pos = past + (win_len_ref[0] if window_slots else 0)
     win = window_ref[0]
-    page_start = ki * PS
 
-    @pl.when(page_start < past)
-    def _accumulate():
+    m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def k_dma(i, slot):
+        return pltpu.make_async_copy(
+            k_pool_ref.at[page_table_ref[b * MP + i]],
+            kbuf.at[slot],
+            ksem.at[slot],
+        )
+
+    def v_dma(i, slot):
+        return pltpu.make_async_copy(
+            v_pool_ref.at[page_table_ref[b * MP + i]],
+            vbuf.at[slot],
+            vsem.at[slot],
+        )
+
+    @pl.when(npages > 0)
+    def _warmup():
+        k_dma(0, 0).start()
+        v_dma(0, 0).start()
+
+    def page_step(i, _):
+        slot = jax.lax.rem(i, 2)
+        nxt = jax.lax.rem(i + 1, 2)
+
+        @pl.when(i + 1 < npages)
+        def _prefetch_next():
+            k_dma(i + 1, nxt).start()
+            v_dma(i + 1, nxt).start()
+
+        k_dma(i, slot).wait()
+        v_dma(i, slot).wait()
+
+        page_start = i * PS
         tok = page_start + jax.lax.broadcasted_iota(jnp.int32, (G, PS), 1)
         ok = tok < past
         # windowless (win <= 0) ORed in instead of a boolean select —
@@ -90,19 +121,19 @@ def _paged_decode_kernel(
             ok, jnp.logical_or(pos - tok < win, win <= 0)
         )
         for h in range(kvh):  # static unroll over KV heads
-            q = q_ref[0, h].astype(jnp.float32)            # [G, Dh]
-            k = k_page_ref[0, :, h, :].astype(jnp.float32)  # [PS, Dh]
-            v = v_page_ref[0, :, h, :].astype(jnp.float32)  # [PS, Dh]
+            q = q_ref[0, h].astype(jnp.float32)          # [G, Dh]
+            k = kbuf[slot, :, h, :].astype(jnp.float32)  # [PS, Dh]
+            v = vbuf[slot, :, h, :].astype(jnp.float32)  # [PS, Dh]
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            ) * scale                                      # [G, PS]
+            ) * scale                                    # [G, PS]
             s = jnp.where(ok, s, NEG_INF)
 
-            m_prev = m_ref[h, :, 0]                        # [G]
+            m_prev = m_ref[h, :, 0]                      # [G]
             m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
-            alpha = jnp.exp(m_prev - m_new)                # [G]
-            p = jnp.exp(s - m_new[:, None])                # [G, PS]
+            alpha = jnp.exp(m_prev - m_new)              # [G]
+            p = jnp.exp(s - m_new[:, None])              # [G, PS]
             l_new = l_ref[h, :, 0] * alpha + jnp.sum(p, axis=1)
             l_ref[h] = jnp.broadcast_to(
                 l_new[:, None], l_ref.shape[1:]
@@ -114,37 +145,67 @@ def _paged_decode_kernel(
             m_ref[h] = jnp.broadcast_to(
                 m_new[:, None], m_ref.shape[1:]
             )
+        return 0
 
-    @pl.when(ki == num_pages_per_seq - 1)
-    def _finalize():
-        for h in range(kvh):
-            q = q_ref[0, h].astype(jnp.float32)            # [G, Dh]
-            k_cur = k_cur_ref[0, h].astype(jnp.float32)    # [Dh]
-            v_cur = v_cur_ref[0, h].astype(jnp.float32)    # [Dh]
-            sink = sink_ref[h].astype(jnp.float32)         # [G]
+    jax.lax.fori_loop(0, npages, page_step, 0)
 
-            s_self = jnp.sum(q * k_cur[None, :], axis=1) * scale  # [G]
-            m_prev = m_ref[h, :, 0]
-            m_new = jnp.maximum(m_prev, jnp.maximum(s_self, sink))
-            alpha = jnp.exp(m_prev - m_new)
-            p_self = jnp.exp(s_self - m_new)
-            p_sink = jnp.exp(sink - m_new)
-            l = l_ref[h, :, 0] * alpha + p_self + p_sink
-            acc = (
-                acc_ref[h] * alpha[:, None]
-                + p_self[:, None] * v_cur[None, :]
+    # finalize: fused-window tokens + current token + attention sink
+    W = window_slots
+    for h in range(kvh):
+        q = q_ref[0, h].astype(jnp.float32)              # [G, Dh]
+        k_cur = k_cur_ref[0, h].astype(jnp.float32)      # [Dh]
+        v_cur = v_cur_ref[0, h].astype(jnp.float32)      # [Dh]
+        sink = sink_ref[h].astype(jnp.float32)           # [G]
+
+        s_self = jnp.sum(q * k_cur[None, :], axis=1) * scale  # [G]
+        m_prev = m_ref[h, :, 0]
+        m_new = jnp.maximum(m_prev, jnp.maximum(s_self, sink))
+        if W:
+            # window tokens: slot s holds the fused window's s-th
+            # sampled token at position past+s; the query is at pos
+            wlen = win_len_ref[0]
+            wk = wk_ref[0, :, h, :].astype(jnp.float32)  # [W, Dh]
+            wv = wv_ref[0, :, h, :].astype(jnp.float32)
+            slot_i = jax.lax.broadcasted_iota(jnp.int32, (G, W), 1)
+            ok_w = slot_i < wlen
+            ok_w = jnp.logical_and(
+                ok_w,
+                jnp.logical_or(wlen - slot_i < win, win <= 0),
             )
-            out = acc / jnp.maximum(l, 1e-30)[:, None]
-            out_ref[0, h] = out.astype(out_ref.dtype)
+            s_w = jax.lax.dot_general(
+                q, wk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                                    # [G, W]
+            s_w = jnp.where(ok_w, s_w, NEG_INF)
+            m_new = jnp.maximum(m_new, jnp.max(s_w, axis=1))
+        alpha = jnp.exp(m_prev - m_new)
+        p_self = jnp.exp(s_self - m_new)
+        p_sink = jnp.exp(sink - m_new)
+        l = l_ref[h, :, 0] * alpha + p_self + p_sink
+        acc = (
+            acc_ref[h] * alpha[:, None]
+            + p_self[:, None] * v_cur[None, :]
+        )
+        if W:
+            p_w = jnp.exp(s_w - m_new[:, None])          # [G, W]
+            l = l + jnp.sum(p_w, axis=1)
+            acc = acc + jax.lax.dot_general(
+                p_w, wv, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        out = acc / jnp.maximum(l, 1e-30)[:, None]
+        out_ref[0, h] = out.astype(out_ref.dtype)
 
 
-# Below this table capacity (tokens) the XLA gather fallback wins: the
-# gathered view is small, while the kernel pays per-grid-step overhead on
-# B x MP tiny blocks per layer. Above it, gather traffic grows with
-# capacity but the kernel's work stays proportional to *actual* context.
-# Crossover measured on v5e (qwen3-0.6b, B=64): gather 4.5 ms vs kernel
-# 12.9 ms at 384-token tables; gather scales ~linearly past that.
-PALLAS_PAGED_MIN_CTX = 1024
+# Below this table capacity (tokens) the XLA gather fallback wins on
+# grid/DMA overhead. With the in-kernel page walk the kernel's work is
+# proportional to ACTUAL context, so it wins essentially everywhere —
+# the gate is kept env-overridable for benchmarking the crossover.
+import os as _os
+
+PALLAS_PAGED_MIN_CTX = int(
+    _os.environ.get("SUTRO_PAGED_MIN_CTX", "0")
+)
 
 
 def paged_decode_supported(
@@ -175,15 +236,25 @@ def paged_decode_attention(
     v_cur: jax.Array,
     window: jax.Array,     # scalar int32; 0 => full attention
     sink: Optional[jax.Array] = None,   # [NH] logits or None
+    win_k: Optional[jax.Array] = None,  # [B, W, KVH, Dh] fused-window K
+    win_v: Optional[jax.Array] = None,
+    win_len: Optional[jax.Array] = None,  # scalar int32 — valid slots
     *,
     interpret: bool = False,
 ) -> jax.Array:
-    """Returns [B, NH, Dh] attention outputs for one decode step."""
+    """Returns [B, NH, Dh] attention outputs for one decode step.
+
+    ``win_k/win_v/win_len`` carry the multi-step decode window buffer
+    (engine/runner decode_multi): tokens sampled earlier in the fused
+    window whose K/V have NOT been written to the page pool yet — the
+    bulk page write happens once per window, outside the step scan, so
+    the multi-GB pool is never copied per step."""
     B, NH, Dh = q.shape
     NP, PS, KVH, _ = k_pages.shape
     MP = page_table.shape[1]
     G = NH // KVH
     scale = Dh ** -0.5
+    W = 0 if win_k is None else win_k.shape[1]
 
     qg = q.reshape(B, KVH, G, Dh)
     if sink is None:
@@ -193,41 +264,50 @@ def paged_decode_attention(
 
     kernel = functools.partial(
         _paged_decode_kernel,
-        num_pages_per_seq=MP,
+        max_pages_per_seq=MP,
         page_size=PS,
         scale=scale,
         kvh=KVH,
+        window_slots=W,
     )
 
+    # index maps take *s so the scalar-prefetch arity (3 without a
+    # window buffer, 4 with) needs no per-case lambdas
+    in_specs = [
+        pl.BlockSpec((1, KVH, G, Dh), lambda b, *s: (b, 0, 0, 0)),
+        pl.BlockSpec(memory_space=pltpu.ANY),  # K pool stays in HBM
+        pl.BlockSpec(memory_space=pltpu.ANY),  # V pool stays in HBM
+        pl.BlockSpec((1, KVH, Dh), lambda b, *s: (b, 0, 0)),
+        pl.BlockSpec((1, KVH, Dh), lambda b, *s: (b, 0, 0)),
+    ]
+    scalars = [
+        page_table.reshape(-1).astype(jnp.int32),
+        past_len.astype(jnp.int32),
+        jnp.asarray(window, jnp.int32).reshape(1),
+    ]
+    operands = [qg, k_pages, v_pages, k_cur, v_cur]
+    if W:
+        scalars.append(jnp.asarray(win_len, jnp.int32).reshape(1))
+        in_specs += [
+            pl.BlockSpec((1, W, KVH, Dh), lambda b, *s: (b, 0, 0, 0)),
+            pl.BlockSpec((1, W, KVH, Dh), lambda b, *s: (b, 0, 0, 0)),
+        ]
+        operands += [win_k, win_v]
+    in_specs.append(pl.BlockSpec((KVH, G), lambda b, *s: (0, 0)))
+    operands.append(sink_g)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
-        grid=(B, MP),
-        in_specs=[
-            pl.BlockSpec(
-                (1, KVH, G, Dh), lambda b, ki, pt, pls, win: (b, 0, 0, 0)
-            ),
-            pl.BlockSpec(
-                (1, PS, KVH, Dh),
-                lambda b, ki, pt, pls, win: (pt[b * MP + ki], 0, 0, 0),
-            ),
-            pl.BlockSpec(
-                (1, PS, KVH, Dh),
-                lambda b, ki, pt, pls, win: (pt[b * MP + ki], 0, 0, 0),
-            ),
-            pl.BlockSpec(
-                (1, KVH, Dh), lambda b, ki, pt, pls, win: (b, 0, 0)
-            ),
-            pl.BlockSpec(
-                (1, KVH, Dh), lambda b, ki, pt, pls, win: (b, 0, 0)
-            ),
-            pl.BlockSpec(
-                (KVH, G), lambda b, ki, pt, pls, win: (0, 0)
-            ),
-        ],
+        num_scalar_prefetch=len(scalars),
+        grid=(B,),
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
-            (1, KVH, G, Dh), lambda b, ki, pt, pls, win: (b, 0, 0, 0)
+            (1, KVH, G, Dh), lambda b, *s: (b, 0, 0, 0)
         ),
         scratch_shapes=[
+            pltpu.VMEM((2, PS, KVH, Dh), k_pages.dtype),  # K double-buffer
+            pltpu.VMEM((2, PS, KVH, Dh), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
             pltpu.VMEM((KVH, G, 128), jnp.float32),
             pltpu.VMEM((KVH, G, 128), jnp.float32),
             pltpu.VMEM((KVH, G, Dh), jnp.float32),
@@ -237,19 +317,12 @@ def paged_decode_attention(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, KVH, G, Dh), q.dtype),
+        # batch rows are independent (disjoint out rows, scratch is
+        # reinitialized per step) — parallel lets megacore TPUs split
+        # the grid across cores
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"),
+            dimension_semantics=("parallel",),
         ),
         interpret=interpret,
-    )(
-        page_table.reshape(-1).astype(jnp.int32),
-        past_len.astype(jnp.int32),
-        jnp.asarray(window, jnp.int32).reshape(1),
-        qg,
-        k_pages,
-        v_pages,
-        k_cur,
-        v_cur,
-        sink_g,
-    )
+    )(*scalars, *operands)
     return out.reshape(B, NH, Dh)

@@ -26,13 +26,14 @@ paged cache; under PP the cache's layer axis should be sharded over
 ``pipe`` too (``pp_cache_sharding``), keeping each layer's pages resident
 on the stage that produces and consumes them.
 
-Limitation (v1): only *prefill* runs the GPipe schedule. Decode under
-``pp > 1`` executes the plain scanned forward over the pipe-sharded
-params/cache — GSPMD keeps it correct but gathers each stage's weights
-to every device per step, so decode memory is not reduced by PP yet. A
-staged decode schedule (microbatching the decode batch across stages)
-is the planned follow-up; until then PP primarily serves prefill-heavy
-and scoring/embedding workloads.
+Decode runs ``pipeline_decode``: a stage-sequential schedule where the
+activation hops stage-to-stage via ``ppermute`` and each device computes
+ONLY its own ``L/pp`` layers (``lax.cond``-gated, so inactive stages do
+no matmuls and read no weights). Per-device weight/cache residency and
+traffic are 1/pp of the stack — the point of PP (models whose layers
+exceed TP+EP memory). The (pp-1)/pp decode bubble is inherent to a
+single in-flight batch; overlapping multiple decode batches across
+stages is a possible follow-up.
 """
 
 from __future__ import annotations
@@ -172,4 +173,129 @@ def pipeline_forward(
     v_all = v_all.transpose(1, 0, 2, 3, 4, 5).reshape(L, B, T, KVH, Dh)
 
     head_out, h_final = transformer.head_apply(cfg, params, h_final, valid_len)
+    return head_out, h_final, (k_all, v_all)
+
+
+def pipeline_decode(
+    cfg: ModelConfig,
+    params: Any,
+    ids: jax.Array,          # [B, T] int32 (decode: T == 1)
+    positions: jax.Array,    # [B, T] int32
+    valid_len: jax.Array,    # [B] int32
+    k_pages: jax.Array,      # [L, NP, PS, KVH, Dh] (layer axis pipe-sharded)
+    v_pages: jax.Array,
+    page_table: jax.Array,   # [B, MP] int32
+    past_len: jax.Array,     # [B] int32
+    mesh: Mesh,
+    *,
+    use_pallas: bool = False,
+    window_past: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
+) -> Tuple[jax.Array, jax.Array, Tuple[jax.Array, jax.Array]]:
+    """Stage-local decode step under ``pipe > 1``.
+
+    The activation hops stages over ICI (``ppermute``); stage ``s`` runs
+    its local layer slice only on iteration ``t == s`` (``lax.cond``), so
+    each device touches exactly its own ``L/pp`` layers' weights and KV
+    pages per decode step — per-device memory AND weight traffic are
+    1/pp of the stack, unlike the GSPMD fallback which gathered every
+    stage's weights everywhere. Same return contract as
+    ``transformer.forward``.
+    """
+    S = int(mesh.shape["pipe"])
+    B, T = ids.shape
+    L, H = cfg.num_layers, cfg.hidden_size
+    if L % S:
+        raise ValueError(f"layers {L} not divisible by pipe size {S}")
+    Lb = L // S
+    KVH, Dh = cfg.num_kv_heads, cfg.head_dim
+
+    h0 = transformer.embed_tokens(cfg, params, ids)  # [B, T, H]
+    windows = jnp.asarray(cfg.window_array(), jnp.int32)
+    thetas = transformer.rope_thetas(cfg)
+    win_len = None if window_past is None else window_past[2]
+
+    def stage(layers_local, windows_l, thetas_l, kp_local, vp_local,
+              wk_local, wv_local, h0):
+        s = jax.lax.axis_index("pipe")
+        last = S - 1
+        fwd = [(i, i + 1) for i in range(S - 1)]
+
+        def layer_body(carry, xs_l):
+            hh = carry
+            lp, w, th, kp_l, vp_l, wk_l, wv_l = xs_l
+            hh, kv = transformer.layer_apply(
+                cfg, lp, hh,
+                positions=positions, valid_len=valid_len,
+                window=w, theta=th,
+                kp_l=kp_l, vp_l=vp_l,
+                page_table=page_table, past_len=past_len,
+                use_pallas=use_pallas,
+                wk_l=wk_l, wv_l=wv_l, win_len=win_len,
+            )
+            return hh, kv
+
+        def run_stage(x):
+            return jax.lax.scan(
+                layer_body, x,
+                (layers_local, windows_l, thetas_l, kp_local, vp_local,
+                 wk_local, wv_local),
+            )
+
+        k_out = jnp.zeros((Lb, B, T, KVH, Dh), h0.dtype)
+        v_out = jnp.zeros_like(k_out)
+        # the carry becomes pipe-varying after the first stage's layers;
+        # mark it varying from the start so scan carry types line up
+        buf = jax.lax.pcast(h0, ("pipe",), to="varying")
+        y = buf
+        for t in range(S):
+            active = s == t
+            y, (k_l, v_l) = jax.lax.cond(
+                active,
+                run_stage,
+                lambda x: (
+                    x,
+                    jax.lax.pcast(
+                        (jnp.zeros((Lb, B, T, KVH, Dh), h0.dtype),
+                         jnp.zeros((Lb, B, T, KVH, Dh), h0.dtype)),
+                        ("pipe",),
+                        to="varying",
+                    ),
+                ),
+                buf,
+            )
+            k_out = jnp.where(active, k_l, k_out)
+            v_out = jnp.where(active, v_l, v_out)
+            if S > 1 and t < S - 1:
+                buf = jax.lax.ppermute(y, "pipe", fwd)
+        # the full-trunk output lives on the last stage; zeros elsewhere
+        out = jax.lax.psum(
+            jnp.where(s == last, y, jnp.zeros_like(y)), "pipe"
+        )
+        return out, k_out, v_out
+
+    if window_past is not None:
+        wk_all, wv_all = window_past[0], window_past[1]
+    else:  # zero-width dummy keeps the scan xs structure static;
+        # attention ignores W == 0 windows
+        wk_all = jnp.zeros((L, B, 0, KVH, Dh), h0.dtype)
+        wv_all = jnp.zeros((L, B, 0, KVH, Dh), h0.dtype)
+        win_len = jnp.asarray(0, jnp.int32)
+
+    fn = jax.shard_map(
+        stage,
+        mesh=mesh,
+        in_specs=(
+            P("pipe"), P("pipe"), P("pipe"), P("pipe"), P("pipe"),
+            P("pipe"), P("pipe"), P(),
+        ),
+        out_specs=(P(), P("pipe"), P("pipe")),
+        axis_names={"pipe"},
+    )
+    h_final, k_all, v_all = fn(
+        params["layers"], windows, thetas, k_pages, v_pages,
+        wk_all, wv_all, h0,
+    )
+    head_out, h_final = transformer.head_apply(
+        cfg, params, h_final, valid_len
+    )
     return head_out, h_final, (k_all, v_all)

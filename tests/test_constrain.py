@@ -242,3 +242,44 @@ def test_distance_to_accept():
     nfa = cs({"enum": ["ab"]})  # JSON: "ab" -> 4 bytes: " a b "
     d0 = nfa.dist_to_accept(nfa.initial())
     assert d0 == 4
+
+
+def test_schema_min_tokens_raises_generation_cap(tiny_ecfg, tmp_path, monkeypatch):
+    """A max_new_tokens below the schema's shortest accepting output must
+    not break the schema guarantee: the engine raises the row cap to the
+    FSM's min_tokens so constrained rows still emit complete JSON."""
+    import dataclasses
+    import json
+    import time
+
+    monkeypatch.setenv("SUTRO_HOME", str(tmp_path))
+    from sutro_tpu.engine.api import LocalEngine
+    from sutro_tpu.interfaces import JobStatus
+
+    ecfg = dataclasses.replace(
+        tiny_ecfg, max_pages_per_seq=32, max_model_len=256
+    )
+    eng = LocalEngine(ecfg)
+    jid = eng.submit_batch_inference(
+        {
+            "model": "tiny-dense",
+            "inputs": ["x"],
+            "sampling_params": {"max_new_tokens": 4},  # << schema minimum
+            "output_schema": {
+                "type": "object",
+                "properties": {
+                    "label": {"type": "string", "enum": ["aa", "bb"]}
+                },
+                "required": ["label"],
+            },
+        }
+    )
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if JobStatus(eng.job_status(jid)).is_terminal():
+            break
+        time.sleep(0.05)
+    assert eng.job_status(jid) == "SUCCEEDED"
+    out = eng.job_results(jid)["outputs"][0]
+    parsed = json.loads(out)  # complete JSON despite the 4-token cap
+    assert parsed["label"] in ("aa", "bb")

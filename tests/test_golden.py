@@ -1,0 +1,172 @@
+"""Golden path on real HF-format weights (BASELINE config #1 analog).
+
+Two layers of evidence that the engine decodes real checkpoints
+correctly (the README quickstart path, /root/reference/README.md:124-160):
+
+1. **Torch parity**: a real (tiny) Qwen3 architecture instantiated by
+   ``transformers``, saved as a standard HF safetensors checkpoint, is
+   loaded through engine/weights.py and must produce the same logits as
+   the torch reference forward — validating the weight remapping
+   (transpose conventions, stacking), RoPE, QK-norm, GQA attention, and
+   the tied LM head against an independent implementation.
+
+2. **Quickstart classify**: the same checkpoint plus a real trained BPE
+   ``tokenizer.json`` is placed in ``weights_dir/<engine_key>/`` and the
+   3-row sentiment quickstart runs through ``so.classify`` end to end —
+   chat template, schema-constrained decoding, JSON unpack — asserting
+   deterministic, schema-valid labels (greedy). Label *quality* needs
+   trained weights, which the sandbox cannot fetch; correctness of the
+   decode contract does not.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from sutro_tpu.models.configs import MODEL_CONFIGS, ModelConfig
+
+VOCAB = 512
+TINY = ModelConfig(
+    name="tiny-qwen3-hf", vocab_size=VOCAB, hidden_size=64, num_layers=2,
+    num_heads=4, num_kv_heads=2, head_dim=32, intermediate_size=128,
+    qk_norm=True, tie_embeddings=True, rope_theta=1_000_000.0,
+    chat_template="chatml",
+)
+
+
+@pytest.fixture(scope="module")
+def hf_checkpoint(tmp_path_factory):
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    cfg = transformers.Qwen3Config(
+        vocab_size=VOCAB,
+        hidden_size=TINY.hidden_size,
+        num_hidden_layers=TINY.num_layers,
+        num_attention_heads=TINY.num_heads,
+        num_key_value_heads=TINY.num_kv_heads,
+        head_dim=TINY.head_dim,
+        intermediate_size=TINY.intermediate_size,
+        rms_norm_eps=TINY.norm_eps,
+        rope_theta=TINY.rope_theta,
+        tie_word_embeddings=True,
+        attention_bias=False,
+        max_position_embeddings=512,
+    )
+    torch.manual_seed(0)
+    model = transformers.Qwen3ForCausalLM(cfg).eval()
+    out = tmp_path_factory.mktemp("ckpt") / "tiny-qwen3-hf"
+    model.save_pretrained(out, safe_serialization=True)
+    return model, str(out)
+
+
+def _train_tokenizer(path: str) -> None:
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
+
+    corpus = [
+        "I absolutely love this product, it works great!",
+        "Terrible quality, broke after one day.",
+        "It's fine, nothing special either way.",
+        "Classify the sentiment of the review.",
+        "You are an expert classifier. positive negative neutral",
+        "scratchpad classification json schema { } \" : ,",
+        "system user assistant\n",
+    ] * 50
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=VOCAB,
+        special_tokens=["<|endoftext|>", "<|im_start|>", "<|im_end|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+    )
+    tok.train_from_iterator(corpus, trainer)
+    tok.save(path)
+
+
+def test_qwen3_torch_parity(hf_checkpoint):
+    torch = pytest.importorskip("torch")
+    import jax.numpy as jnp
+
+    from sutro_tpu.engine.config import EngineConfig
+    from sutro_tpu.engine.weights import load_checkpoint
+    from sutro_tpu.models import transformer
+
+    model, ckpt_dir = hf_checkpoint
+    ecfg = EngineConfig(param_dtype="float32", use_pallas=False)
+    params = load_checkpoint(ckpt_dir, TINY, ecfg)
+
+    rng = np.random.default_rng(3)
+    B, T = 2, 17
+    ids = rng.integers(0, VOCAB, (B, T)).astype(np.int32)
+
+    with torch.no_grad():
+        ref = model(torch.from_numpy(ids).long()).logits.numpy()
+
+    positions = np.broadcast_to(np.arange(T, dtype=np.int32)[None], (B, T))
+    got, _, _ = transformer.forward(
+        TINY, params, jnp.asarray(ids), jnp.asarray(positions),
+        jnp.full((B,), T, jnp.int32),
+    )
+    got = np.asarray(got)
+
+    np.testing.assert_allclose(got, ref, atol=2e-3, rtol=2e-3)
+    # greedy continuation parity at every position
+    np.testing.assert_array_equal(
+        got.argmax(-1), ref.argmax(-1)
+    )
+
+
+def test_quickstart_classify_on_real_checkpoint(
+    hf_checkpoint, tmp_path, monkeypatch
+):
+    pytest.importorskip("transformers")
+    monkeypatch.setenv("SUTRO_HOME", str(tmp_path / "home"))
+    _, ckpt_dir = hf_checkpoint
+    _train_tokenizer(os.path.join(ckpt_dir, "tokenizer.json"))
+
+    MODEL_CONFIGS["tiny-qwen3-hf"] = TINY
+    try:
+        from sutro_tpu.engine.api import reset_engine
+        from sutro_tpu.sdk import Sutro
+
+        reset_engine()
+        so = Sutro(
+            engine_config=dict(
+                weights_dir=os.path.dirname(ckpt_dir),
+                kv_page_size=8,
+                max_pages_per_seq=32,
+                decode_batch_size=4,
+                max_model_len=256,
+                max_new_tokens=96,
+                use_pallas=False,
+                param_dtype="float32",
+                temperature=0.0,  # greedy => deterministic goldens
+            )
+        )
+        reviews = [
+            "I absolutely love this product, it works great!",
+            "Terrible quality, broke after one day.",
+            "It's fine, nothing special either way.",
+        ]
+        labels = ["positive", "negative", "neutral"]
+        dfs = []
+        for _ in range(2):  # twice: assert determinism
+            df = so.classify(
+                reviews, classes=labels, model="tiny-qwen3-hf",
+                sampling_params={"temperature": 0.0},
+            )
+            assert df is not None and len(df) == 3
+            assert "classification" in df.columns
+            # schema-constrained decoding guarantees every label is from
+            # the enum — even with untrained weights
+            assert all(c in labels for c in df["classification"])
+            dfs.append(list(df["classification"]))
+        assert dfs[0] == dfs[1]
+    finally:
+        MODEL_CONFIGS.pop("tiny-qwen3-hf", None)
+        from sutro_tpu.engine.api import reset_engine
+
+        reset_engine()

@@ -207,3 +207,40 @@ def test_flash_prefill_gate():
     q3 = jnp.zeros((1, 128, 4, 64), jnp.float32)  # Dh % 128 != 0
     k3 = jnp.zeros((1, 128, 2, 64), jnp.float32)
     assert not flash_prefill_supported(q3, k3, None, None)
+
+
+@pytest.mark.parametrize("window", [0, 5])
+def test_paged_decode_with_window_buffer(window):
+    """Fused-window variant: pages + window buffer + current token must
+    reproduce the jnp reference fed the same window K/V."""
+    rng = np.random.default_rng(21)
+    NH, KVH, Dh, W = 4, 2, 16, 8
+    q, k_cur, v_cur, kp, vp, table, past_len = _make_decode_case(rng)
+    B = q.shape[0]
+    win_k = jnp.asarray(
+        rng.standard_normal((B, W, KVH, Dh)), jnp.float32
+    )
+    win_v = jnp.asarray(
+        rng.standard_normal((B, W, KVH, Dh)), jnp.float32
+    )
+    win_len = jnp.asarray(5, jnp.int32)  # slots 0..4 valid
+    win = jnp.asarray(window, jnp.int32)
+    positions = (past_len + win_len)[:, None]
+
+    ref = chunk_attention(
+        q, k_cur, v_cur,
+        positions=positions,
+        valid_len=jnp.ones((B,), jnp.int32),
+        past_k_pages=kp, past_v_pages=vp, page_table=table,
+        past_len=past_len, window=win, sink=None,
+        use_pallas=False,
+        win_k=win_k, win_v=win_v, win_len=win_len,
+    )
+    got = paged_decode_attention(
+        q[:, 0], kp, vp, table, past_len, k_cur[:, 0], v_cur[:, 0],
+        win, None, win_k=win_k, win_v=win_v, win_len=win_len,
+        interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref[:, 0]), atol=2e-5, rtol=2e-5
+    )

@@ -83,3 +83,17 @@ def test_pp_runner_generation_matches_single_device(
     single = run(None)
     assert run(make_mesh(1, 1, 1, eight_devices[:2], pp=2)) == single
     assert run(make_mesh(1, 1, 2, eight_devices[:4], pp=2)) == single
+
+
+def test_pp_decode_stage_local_memory(eight_devices, mesh_ecfg):
+    """Under pp=2 each device holds exactly 1/2 of every layer-stacked
+    param leaf and 1/2 of the KV page pool — PP actually reduces decode
+    residency (decode runs pipeline_decode, not a GSPMD all-gather)."""
+    cfg = MODEL_CONFIGS["tiny-dense"]
+    mesh = make_mesh(1, 1, 1, eight_devices[:2], pp=2)
+    runner = ModelRunner(cfg, mesh_ecfg, mesh=mesh)
+    wq = runner.params["layers"]["wq"]
+    assert wq.sharding.spec[0] == "pipe"
+    assert wq.addressable_shards[0].data.nbytes == wq.nbytes // 2
+    kp = runner.cache.k_pages
+    assert kp.addressable_shards[0].data.nbytes == kp.nbytes // 2
