@@ -121,6 +121,28 @@ class TokenFSM:
         self.states = nfa.initial()
         self._complete = False
 
+    def token_allowed(
+        self, token_id: int, remaining: Optional[int] = None
+    ) -> bool:
+        """O(1) single-token validity check (speculative-decode
+        verification: the scheduler samples fused windows unmasked for
+        greedy rows and accepts the longest FSM-valid prefix). In the
+        budget-infeasible corner this returns False where
+        ``allowed_tokens`` would degrade to the unfiltered mask — the
+        scheduler's follow-up masked step applies the exact degrade
+        semantics, so behavior converges."""
+        token_id = int(token_id)
+        if self._complete:
+            return token_id in self.table.stop_ids
+        m, dist = self.masks.mask_and_dist(self.states)
+        if token_id >= m.shape[0] or not m[token_id]:
+            return False
+        if remaining is not None and dist[token_id] > max(
+            int(remaining) - 1, 0
+        ):
+            return False
+        return True
+
     def min_tokens(self) -> int:
         """Shortest possible accepting output in tokens (upper-bounded by
         bytes: every kept token advances >= 1 byte). The engine raises a

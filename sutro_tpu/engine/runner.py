@@ -304,9 +304,16 @@ class ModelRunner:
     )
     def _decode_jit(
         self, params, cache: KVCache, ids, past_len, page_table,
-        rng, temperature, top_p, top_k, allowed, row_seeds,
+        rng, temperature, top_p, top_k, allowed_packed, row_seeds,
     ):
         B = ids.shape[0]
+        allowed = None
+        if allowed_packed is not None:
+            # FSM masks travel host->device bit-packed (8x less transfer
+            # on the per-step critical path of constrained decoding)
+            allowed = jnp.unpackbits(
+                allowed_packed, axis=1, count=self.mcfg.vocab_size
+            ).astype(bool)
         positions = past_len[:, None]  # current token position == past length
         logits, _, (k, v) = self._trunk_decode(
             params, cache, ids, positions, past_len, page_table
@@ -349,7 +356,9 @@ class ModelRunner:
             jnp.asarray(temperature, jnp.float32),
             jnp.asarray(top_p, jnp.float32),
             jnp.asarray(top_k, jnp.int32),
-            None if allowed is None else jnp.asarray(allowed),
+            None
+            if allowed is None
+            else jnp.asarray(np.packbits(np.asarray(allowed, bool), axis=1)),
             None if row_seeds is None else jnp.asarray(row_seeds, jnp.int32),
         )
         return np.asarray(tok), np.asarray(logp)
@@ -381,6 +390,28 @@ class ModelRunner:
         dynamic_update_slice) that attention reads alongside the pages,
         and the pool takes ONE bulk write per window out here where
         donation makes it truly in-place."""
+        B = last.shape[0]
+        toks, logps, wk, wv = self._window_scan(
+            params, cache, last, past_len, page_table, rng,
+            temperature, top_p, steps, top_k,
+        )
+        cache = write_kv(
+            cache, wk, wv, page_table, past_len,
+            jnp.full((B,), steps, jnp.int32),
+            use_pallas=self.use_pallas,
+        )
+        return toks, logps, cache
+
+    def _window_scan(
+        self, params, cache: KVCache, last, past_len, page_table,
+        rng, temperature, top_p, steps: int, top_k,
+    ):
+        """The shared fused-window scan: ``steps`` trunk forwards over
+        invariant pages + the carried window buffer, sampling on-device.
+        Returns (toks [steps, B], logps [steps, B], wk, wv) with the
+        window K/V NOT yet committed to pages — callers decide the
+        commit (full window for unconstrained decode, verified prefix
+        for speculative constrained decode)."""
         B = last.shape[0]
         L = self.mcfg.num_layers
         KVH, Dh = self.mcfg.num_kv_heads, self.mcfg.head_dim
@@ -415,12 +446,7 @@ class ModelRunner:
             (wk0, wv0, last),
             jnp.arange(steps, dtype=jnp.int32),
         )
-        cache = write_kv(
-            cache, wk, wv, page_table, past_len,
-            jnp.full((B,), steps, jnp.int32),
-            use_pallas=self.use_pallas,
-        )
-        return toks, logps, cache
+        return toks, logps, wk, wv
 
     def decode_multi(
         self,
@@ -450,6 +476,83 @@ class ModelRunner:
             jnp.asarray(top_k, jnp.int32),
         )
         return np.asarray(toks), np.asarray(logps)
+
+    # ------------------------------------------------------------------
+    # speculative window decode (constrained rows)
+    # ------------------------------------------------------------------
+
+    @functools.partial(jax.jit, static_argnums=(0, 8))
+    def _decode_window_jit(
+        self, params, cache: KVCache, last, past_len, page_table,
+        rng, temperature, steps: int, top_p, top_k,
+    ):
+        """Like ``_decode_multi_jit`` but WITHOUT the page commit: the
+        sampled window and its K/V buffers return to the host, which
+        verifies constrained rows against their FSMs and commits only
+        each row's accepted prefix (``commit_window``). The cache is a
+        read-only input here, so a rejected suffix costs nothing."""
+        return self._window_scan(
+            params, cache, last, past_len, page_table, rng,
+            temperature, top_p, steps, top_k,
+        )
+
+    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
+    def _commit_window_jit(
+        self, cache: KVCache, wk, wv, page_table, past_len, accepted
+    ):
+        return write_kv(
+            cache, wk, wv, page_table, past_len, accepted,
+            use_pallas=self.use_pallas,
+        )
+
+    def decode_window(
+        self,
+        last_tokens: np.ndarray,     # [B] int32
+        past_len: np.ndarray,        # [B] int32
+        page_table: np.ndarray,      # [B, MP] int32
+        rng: jax.Array,
+        temperature: np.ndarray,     # [B]
+        top_p: np.ndarray,           # [B]
+        steps: int,
+        top_k: Optional[np.ndarray] = None,
+    ):
+        """Speculative window: returns (tokens [steps, B], logprobs
+        [steps, B], window_kv handle). Pages are NOT written — call
+        ``commit_window(handle, accepted)`` with per-row accepted token
+        counts."""
+        B = len(last_tokens)
+        if top_k is None:
+            top_k = np.zeros((B,), np.int32)
+        toks, logps, wk, wv = self._decode_window_jit(
+            self.params,
+            self.cache,
+            jnp.asarray(last_tokens, jnp.int32),
+            jnp.asarray(past_len, jnp.int32),
+            jnp.asarray(page_table, jnp.int32),
+            rng,
+            jnp.asarray(temperature, jnp.float32),
+            steps,
+            jnp.asarray(top_p, jnp.float32),
+            jnp.asarray(top_k, jnp.int32),
+        )
+        # copy: callers may pass live views (native runtime) that mutate
+        # during host-side verification before commit_window
+        handle = (
+            wk, wv,
+            np.array(past_len, np.int32, copy=True),
+            np.array(page_table, np.int32, copy=True),
+        )
+        return np.asarray(toks), np.asarray(logps), handle
+
+    def commit_window(self, handle, accepted: np.ndarray) -> None:
+        """Write each row's accepted window prefix into the page pool."""
+        wk, wv, past_len, page_table = handle
+        self.cache = self._commit_window_jit(
+            self.cache, wk, wv,
+            jnp.asarray(page_table, jnp.int32),
+            jnp.asarray(past_len, jnp.int32),
+            jnp.asarray(accepted, jnp.int32),
+        )
 
     # ------------------------------------------------------------------
     # embeddings

@@ -58,6 +58,11 @@ class TokenConstraint(Protocol):
     def is_complete(self) -> bool:
         ...
 
+    # OPTIONAL fast path: implementations may additionally provide
+    # ``token_allowed(token_id, remaining=None) -> bool`` (O(1) validity
+    # of one token) — the speculative fused-window verifier uses it when
+    # present and falls back to ``allowed_tokens`` otherwise.
+
 
 # per-method cache: does this allowed_tokens accept ``remaining``? Keyed
 # by the unbound class function (bounded: one entry per implementing
@@ -155,6 +160,9 @@ class ContinuousBatcher:
         self._key = jax.random.PRNGKey(seed)
         self._fixed_key = jax.random.PRNGKey(seed)
         self._step = 0
+        # set when a speculative window rejected a token: the next
+        # iteration runs one masked single-step to guarantee progress
+        self._needs_mask = False
         from .profiling import StepTimer
 
         self.timer = StepTimer()
@@ -382,9 +390,13 @@ class ContinuousBatcher:
             return "length"
         return None
 
-    def _accept_token(self, i: int, tok: int, logp: float, on_result) -> int:
+    def _accept_token(
+        self, i: int, tok: int, logp: float, on_result, release: bool = True
+    ) -> int:
         """Record one sampled token for slot ``i``; release on finish.
-        Returns 1 if the row completed, else 0."""
+        Returns 1 if the row completed, else 0. ``release=False`` defers
+        the release to the caller (speculative windows must commit the
+        accepted K/V to pages BEFORE freeing them)."""
         s = self.slots[i]
         s.pos += 1  # last_token's KV is now cached
         if self.native is not None:
@@ -392,9 +404,26 @@ class ContinuousBatcher:
         self._record_token(s, tok, logp)
         s.last_token = tok
         if self._finish_reason(s, tok):
-            on_result(self._release(i))
+            if release:
+                on_result(self._release(i))
             return 1
         return 0
+
+    def _token_ok(
+        self, c: TokenConstraint, tok: int, remaining: int
+    ) -> bool:
+        """Single-token FSM validity, used to verify speculative window
+        tokens. Prefers the optional O(1) ``token_allowed`` fast path
+        when the constraint offers one (signature-probed like
+        ``allowed_tokens``, so implementations without a ``remaining``
+        parameter still work); otherwise falls back to the full
+        (padded) mask."""
+        fn = getattr(c, "token_allowed", None)
+        if fn is not None:
+            if _probe_takes_budget(fn):
+                return bool(fn(tok, remaining=remaining))
+            return bool(fn(tok))
+        return bool(self._constraint_mask(c, remaining)[tok])
 
     def _release(self, i: int) -> GenResult:
         slot = self.slots[i]
@@ -599,23 +628,30 @@ class ContinuousBatcher:
                     row_seeds[i] = _step_seed(0x5EED0000 ^ (i + 1), self._step)
                 if s.req.constraint is not None:
                     has_constraint = True
-            if has_constraint:
-                allowed = np.ones((self.B, self.vocab), bool)
-                for i in active:
-                    s = self.slots[i]
-                    c = s.req.constraint
-                    if c is not None:
-                        rem = self._remaining(s.req, len(s.out_ids), s.pos)
-                        allowed[i] = self._constraint_mask(c, rem)
 
             # Fuse K decode steps into one device program when no row
-            # needs host work between steps (FSM masks / per-row seeds):
-            # one dispatch + one fetch per window instead of per token.
+            # needs host work between steps: one dispatch + one fetch per
+            # window instead of per token. Constrained rows fuse too when
+            # they are GREEDY (classify-style jobs): the window samples
+            # unmasked, the host verifies tokens against each row's FSM,
+            # and only the longest valid prefix is committed to pages —
+            # exact for greedy (masked argmax == unmasked argmax when
+            # the unmasked argmax is valid). A rejection forces one
+            # masked single-step so the stuck row crosses its scaffold
+            # token before the next window.
             K = 1
             if (
                 self.ecfg.decode_multi_step > 1
-                and not has_constraint
                 and not has_row_seed
+                and not self._needs_mask
+                and (
+                    not has_constraint
+                    or all(
+                        self.slots[i].req.temperature <= 0.0
+                        for i in active
+                        if self.slots[i].req.constraint is not None
+                    )
+                )
             ):
                 cap = min(
                     len(self.slots[i].pages) * self.ecfg.kv_page_size
@@ -633,7 +669,47 @@ class ContinuousBatcher:
             # row-seeded sampling needs a batch-independent base key so a
             # row's stream reproduces regardless of batch composition
             rng = self._fixed_key if has_row_seed else sub
-            if K > 1:
+            if K > 1 and has_constraint:
+                # speculative window: sample unmasked, verify host-side,
+                # commit only each row's FSM-valid prefix
+                with self.timer.time("decode"):
+                    toks_w, logps_w, handle = self.runner.decode_window(
+                        last, past_len, table, sub, temp, top_p, K,
+                        top_k=top_k,
+                    )
+                self._step += K
+                accepted = np.zeros((self.B,), np.int32)
+                finished: List[int] = []
+                for i in active:
+                    s = self.slots[i]
+                    c = s.req.constraint
+                    for j in range(K):
+                        tok = int(toks_w[j][i])
+                        if c is not None:
+                            rem = self._remaining(
+                                s.req, len(s.out_ids), s.pos
+                            )
+                            if not self._token_ok(c, tok, rem):
+                                # next iteration runs one masked step so
+                                # this row crosses its scaffold token
+                                self._needs_mask = True
+                                break
+                        accepted[i] += 1
+                        output_tokens += 1
+                        if self._accept_token(
+                            i, tok, float(logps_w[j][i]), on_result,
+                            release=False,
+                        ):
+                            finished.append(i)
+                            break
+                # pages are still reserved for every row (releases were
+                # deferred), so the accepted K/V lands safely
+                with self.timer.time("decode"):
+                    self.runner.commit_window(handle, accepted)
+                for i in finished:
+                    on_result(self._release(i))
+                    rows_done += 1
+            elif K > 1:
                 with self.timer.time("decode"):
                     toks_w, logps_w = self.runner.decode_multi(
                         last, past_len, table, sub, temp, top_p, K,
@@ -655,6 +731,18 @@ class ContinuousBatcher:
                     if not active:
                         break
             else:
+                if has_constraint:
+                    # masked step: assemble the per-row FSM vocab masks
+                    # (only here — fused windows verify tokens instead)
+                    allowed = np.ones((self.B, self.vocab), bool)
+                    for i in active:
+                        s = self.slots[i]
+                        c = s.req.constraint
+                        if c is not None:
+                            rem = self._remaining(
+                                s.req, len(s.out_ids), s.pos
+                            )
+                            allowed[i] = self._constraint_mask(c, rem)
                 with self.timer.time("decode"):
                     toks, logps = self.runner.decode_step(
                         last, past_len, table, rng, temp, top_p,
@@ -662,6 +750,8 @@ class ContinuousBatcher:
                         row_seeds=row_seeds if has_row_seed else None,
                     )
                 self._step += 1
+                self._needs_mask = False  # masked step crossed the
+                #                           rejected scaffold token
                 for i in active:
                     output_tokens += 1
                     rows_done += self._accept_token(

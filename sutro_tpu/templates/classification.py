@@ -70,6 +70,12 @@ class ClassificationTemplates(BaseSutroClient):
             classification=(label_enum, ...),
         )
 
+        # greedy by default: classification wants reproducible labels,
+        # and greedy constrained rows ride the engine's speculative
+        # fused-window decode (masked argmax == unmasked argmax when the
+        # unmasked argmax is schema-valid)
+        sampling = {"temperature": 0.0}
+        sampling.update(kwargs.pop("sampling_params", None) or {})
         job_id = self.infer(
             data,
             model=model,
@@ -80,6 +86,7 @@ class ClassificationTemplates(BaseSutroClient):
             name=name,
             description=description,
             stay_attached=False,
+            sampling_params=sampling,
             **kwargs,
         )
         if job_id is None:

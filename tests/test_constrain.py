@@ -283,3 +283,60 @@ def test_schema_min_tokens_raises_generation_cap(tiny_ecfg, tmp_path, monkeypatc
     out = eng.job_results(jid)["outputs"][0]
     parsed = json.loads(out)  # complete JSON despite the 4-token cap
     assert parsed["label"] in ("aa", "bb")
+
+
+def test_speculative_constrained_matches_masked(tiny_ecfg, byte_tok):
+    """Greedy schema-constrained generation must produce IDENTICAL
+    outputs whether every step is masked (decode_multi_step=1) or fused
+    speculative windows verify-and-commit (decode_multi_step=8): for
+    greedy rows, the unmasked argmax is accepted only when it equals the
+    masked argmax, and rejections fall back to one masked step."""
+    import dataclasses
+    import json
+
+    from sutro_tpu.engine.constrain import schema_constraint_factory
+    from sutro_tpu.engine.runner import ModelRunner
+    from sutro_tpu.engine.scheduler import ContinuousBatcher, GenRequest
+    from sutro_tpu.models.configs import MODEL_CONFIGS
+
+    schema = {
+        "type": "object",
+        "properties": {
+            "note": {"type": "string", "maxLength": 20},
+            "label": {"type": "string", "enum": ["alpha", "beta"]},
+        },
+        "required": ["note", "label"],
+    }
+
+    def run(multi):
+        ecfg = dataclasses.replace(
+            tiny_ecfg, decode_multi_step=multi, max_pages_per_seq=32,
+            max_model_len=256,
+        )
+        runner = ModelRunner(MODEL_CONFIGS["tiny-dense"], ecfg)
+        factory = schema_constraint_factory(schema, byte_tok)
+        reqs = [
+            GenRequest(
+                row_id=i,
+                prompt_ids=np.array(byte_tok.encode(t), np.int32),
+                max_new_tokens=80,
+                temperature=0.0,
+                constraint=factory(),
+            )
+            for i, t in enumerate(["first row", "second", "third one"])
+        ]
+        b = ContinuousBatcher(runner, stop_ids=byte_tok.stop_ids())
+        res = {}
+        b.run(reqs, on_result=lambda r: res.__setitem__(r.row_id, r))
+        return {
+            i: (tuple(r.token_ids), r.finish_reason)
+            for i, r in res.items()
+        }
+
+    masked = run(1)
+    spec = run(8)
+    assert masked == spec
+    # and every output is complete, schema-valid JSON
+    for toks, _reason in masked.values():
+        parsed = json.loads(byte_tok.decode(list(toks)))
+        assert parsed["label"] in ("alpha", "beta")
