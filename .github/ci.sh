@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# CI entry point: build native helpers, compile-check, run the suite on
+# CPU with 8 virtual devices. (The reference's CI was compileall only —
+# .github/monorepo-ci.sh in /root/reference; SURVEY §4 calls for better.)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+make native
+make compile-check
+python -m pytest tests/ -q

@@ -1,0 +1,45 @@
+# Top-level developer/CI entry points (reference analogue: Makefile +
+# .github/monorepo-ci.sh, which only compile-checked; this one actually
+# builds the native runtime and runs the suite).
+
+PY ?= python
+
+.PHONY: all native test test-fast compile-check bench bench-e2e dryrun clean
+
+all: native compile-check
+
+native:
+	$(MAKE) -C native
+
+# full suite (CPU, 8 virtual devices via tests/conftest.py)
+test: native
+	$(PY) -m pytest tests/ -q
+
+# quick gate: everything except the slow multi-device / golden suites
+test-fast: native
+	$(PY) -m pytest tests/ -q -x \
+		--ignore=tests/test_pipeline.py \
+		--ignore=tests/test_golden.py \
+		--ignore=tests/test_parallel.py \
+		--ignore=tests/test_ring.py
+
+# the reference CI ran `python -m compileall` only (SURVEY §4); kept as
+# the cheapest smoke layer
+compile-check:
+	$(PY) -m compileall -q sutro_tpu tests bench.py bench_e2e.py
+
+# raw decode microbench (one JSON line; driver contract)
+bench:
+	$(PY) bench.py
+
+# full-engine workloads: classify / generate / embed -> BENCH_E2E.json
+bench-e2e:
+	$(PY) bench_e2e.py
+
+# multi-chip sharding dry run on 8 virtual CPU devices
+dryrun:
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

@@ -282,6 +282,10 @@ class LocalEngine:
             out["inputs"] = self.jobs.read_inputs(job_id)
         if include_cumulative_logprobs and "cumulative_logprobs" in df:
             out["cumulative_logprobs"] = df["cumulative_logprobs"].tolist()
+            if "gen_tokens" in df:  # sampled-token counts per row
+                out["gen_tokens"] = [
+                    int(x) for x in df["gen_tokens"].fillna(0)
+                ]
         return out
 
     def stream_job_progress(self, job_id: str) -> Iterator[Dict[str, Any]]:
@@ -577,6 +581,10 @@ class LocalEngine:
                 "row_id": res.row_id,
                 "outputs": render_output(res.token_ids),
                 "cumulative_logprobs": res.cumulative_logprob,
+                # true sampled-token count: the denominator matching
+                # cumulative_logprobs (re-tokenizing the decoded text
+                # would drop stop tokens and need not round-trip)
+                "gen_tokens": len(res.token_ids),
                 "finish_reason": res.finish_reason,
             }
             results[res.row_id] = row
@@ -641,6 +649,7 @@ class LocalEngine:
             "row_id": [],
             "outputs": [],
             "cumulative_logprobs": [],
+            "gen_tokens": [],
             "finish_reason": [],
         }
         for i in range(rec.num_rows):
@@ -650,10 +659,11 @@ class LocalEngine:
                     "row_id": i,
                     "outputs": None,
                     "cumulative_logprobs": 0.0,
+                    "gen_tokens": 0,
                     "finish_reason": "cancelled",
                 }
             for k in ordered:
-                ordered[k].append(row[k])
+                ordered[k].append(row.get(k, 0))
         output_tokens = int(
             sum(
                 len(tok.encode(o)) if o else 0 for o in ordered["outputs"]

@@ -89,6 +89,30 @@ def _probe_takes_budget(fn: Any) -> bool:
     )
 
 
+def _method_takes_budget(obj: Any, bound: Any, attr_cache: str) -> bool:
+    """Cached signature probe for a bound constraint method: one probe
+    per implementing class (``_TAKES_BUDGET``) or, for instance-attribute
+    callables, memoized on the instance. Called on per-token hot paths —
+    must not hit ``inspect.signature`` repeatedly."""
+    fn = getattr(bound, "__func__", None)
+    if fn is not None:
+        key = id(fn)
+        cached = _TAKES_BUDGET.get(key)
+        if cached is not None:
+            return cached[1]
+        takes = _probe_takes_budget(fn)
+        _TAKES_BUDGET[key] = (fn, takes)
+        return takes
+    takes = getattr(obj, attr_cache, None)
+    if takes is None:
+        takes = _probe_takes_budget(bound)
+        try:
+            setattr(obj, attr_cache, takes)
+        except Exception:
+            pass  # __slots__ etc.: re-probe next call
+    return takes
+
+
 @dataclasses.dataclass
 class GenRequest:
     row_id: int
@@ -292,23 +316,7 @@ class ContinuousBatcher:
         # *inside* a budget-aware allowed_tokens must propagate, not
         # silently disable budget enforcement.
         bound = c.allowed_tokens
-        fn = getattr(bound, "__func__", None)
-        if fn is not None:  # normal bound method: class-level cache
-            key = id(fn)
-            cached = _TAKES_BUDGET.get(key)
-            if cached is not None:
-                takes_budget = cached[1]
-            else:
-                takes_budget = _probe_takes_budget(fn)
-                _TAKES_BUDGET[key] = (fn, takes_budget)
-        else:  # instance-attribute callable: memoize on the instance
-            takes_budget = getattr(c, "_sutro_takes_budget", None)
-            if takes_budget is None:
-                takes_budget = _probe_takes_budget(bound)
-                try:
-                    c._sutro_takes_budget = takes_budget
-                except Exception:
-                    pass  # __slots__ etc.: re-probe next step
+        takes_budget = _method_takes_budget(c, bound, "_sutro_takes_budget")
         m = bound(remaining=remaining) if takes_budget else bound()
         return self._pad_mask(m)
 
@@ -420,7 +428,7 @@ class ContinuousBatcher:
         (padded) mask."""
         fn = getattr(c, "token_allowed", None)
         if fn is not None:
-            if _probe_takes_budget(fn):
+            if _method_takes_budget(c, fn, "_sutro_tok_takes_budget"):
                 return bool(fn(tok, remaining=remaining))
             return bool(fn(tok))
         return bool(self._constraint_mask(c, remaining)[tok])

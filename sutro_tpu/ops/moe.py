@@ -26,6 +26,17 @@ import jax
 import jax.numpy as jnp
 
 
+def _grouped(lhs: jax.Array, rhs: jax.Array, group_sizes: jax.Array):
+    """Grouped GEMM: the Pallas MXU kernel on TPU when shapes allow
+    (ops/pallas_gmm.py), ``jax.lax.ragged_dot`` otherwise."""
+    if jax.default_backend() == "tpu":
+        from .pallas_gmm import grouped_matmul, grouped_matmul_supported
+
+        if grouped_matmul_supported(lhs, rhs):
+            return grouped_matmul(lhs, rhs, group_sizes)
+    return jax.lax.ragged_dot(lhs, rhs, group_sizes)
+
+
 def _act(gate: jax.Array, up: jax.Array, activation: str):
     if activation == "gelu":
         a = jax.nn.gelu(gate.astype(jnp.float32), approximate=True)
@@ -96,13 +107,13 @@ def moe_mlp(
     group_sizes = jnp.bincount(sorted_expert, length=E).astype(jnp.int32)
 
     lhs = xt[sorted_token]                                # [M, H]
-    g = jax.lax.ragged_dot(lhs, we_gate, group_sizes)     # [M, F]
-    u = jax.lax.ragged_dot(lhs, we_up, group_sizes)
+    g = _grouped(lhs, we_gate, group_sizes)               # [M, F]
+    u = _grouped(lhs, we_up, group_sizes)
     if bias_gate is not None:
         g = g + bias_gate[sorted_expert].astype(g.dtype)
         u = u + bias_up[sorted_expert].astype(u.dtype)
     a, u = _act(g, u, activation)
-    y = jax.lax.ragged_dot(a * u, we_down, group_sizes)   # [M, H]
+    y = _grouped(a * u, we_down, group_sizes)             # [M, H]
     if bias_down is not None:
         y = y + bias_down[sorted_expert].astype(y.dtype)
     y = y * sorted_prob[:, None].astype(y.dtype)

@@ -21,6 +21,7 @@ Intentional divergences from reference quirks (SURVEY §2.5):
 from __future__ import annotations
 
 import json
+import math
 import os
 import shutil
 import time
@@ -394,10 +395,26 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
                  "truncate_rows": False}
             )
             self._wait_terminal(job_id, timeout=600)
-            res = self.engine.job_results(job_id)
+            res = self.engine.job_results(
+                job_id, include_cumulative_logprobs=True
+            )
+            # reference contract carries a confidence score
+            # (/root/reference/sutro/sdk.py:535-544); locally it is the
+            # geometric-mean token probability of the generation
+            # (cumulative logprob over the SAME sampled-token count the
+            # engine recorded). ``predictions`` stays empty: remote
+            # Functions return model-specific candidate lists the local
+            # single-model path has no analogue for.
+            logps = res.get("cumulative_logprobs") or [None]
+            gen_tokens = (res.get("gen_tokens") or [0])[0]
+            confidence = (
+                float(math.exp(logps[0] / max(gen_tokens, 1)))
+                if logps[0] is not None
+                else None
+            )
             return {
                 "response": res["outputs"][0],
-                "confidence": None,
+                "confidence": confidence,
                 "predictions": [],
                 "run_id": job_id,
             }

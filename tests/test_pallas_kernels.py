@@ -244,3 +244,33 @@ def test_paged_decode_with_window_buffer(window):
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(ref[:, 0]), atol=2e-5, rtol=2e-5
     )
+
+
+# ---------------------------------------------------------------------------
+# grouped matmul (MoE expert GEMM)
+# ---------------------------------------------------------------------------
+
+from sutro_tpu.ops.pallas_gmm import grouped_matmul  # noqa: E402
+
+
+@pytest.mark.parametrize(
+    "sizes",
+    [
+        [100, 28, 0, 128],       # ragged + one empty group
+        [64, 64, 64, 64],        # tile-aligned
+        [256, 0, 0, 0],          # single hot expert
+        [1, 2, 3, 250],          # tiny groups
+    ],
+)
+def test_grouped_matmul_matches_ragged_dot(sizes):
+    rng = np.random.default_rng(13)
+    E, H, F = len(sizes), 128, 256
+    M = sum(sizes)
+    lhs = jnp.asarray(rng.standard_normal((M, H)), jnp.float32)
+    rhs = jnp.asarray(rng.standard_normal((E, H, F)), jnp.float32)
+    gs = jnp.asarray(sizes, jnp.int32)
+    want = jax.lax.ragged_dot(lhs, rhs, gs)
+    got = grouped_matmul(lhs, rhs, gs, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4
+    )

@@ -272,3 +272,16 @@ def test_unpack_json_thinking_contract(sdk):
     got = Sutro._unpack_json_outputs(df, "out")
     assert got["reasoning_content"][0] == "thought"
     assert got["a"][0] == 1
+
+
+def test_run_function_local_contract(sdk):
+    """Local Functions path carries the full reference response contract
+    (/root/reference/sutro/sdk.py:535-544): response text, a real
+    confidence score (geometric-mean token probability), and a run id."""
+    out = sdk.run_function("tiny-dense", {"q": "hello"})
+    assert set(out) == {"response", "confidence", "predictions", "run_id"}
+    assert isinstance(out["response"], str)
+    assert out["run_id"].startswith("job-")
+    assert out["confidence"] is not None
+    assert 0.0 < out["confidence"] <= 1.0
+    assert out["predictions"] == []
