@@ -17,6 +17,7 @@
 //     max_batch_tokens budget would be exceeded
 //   - release returns all pages and zeroes the slot's dense row
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <vector>
@@ -31,7 +32,7 @@ struct Runtime {
     int64_t max_batch_tokens;
     int32_t max_context;
 
-    std::vector<int32_t> free_pages;          // LIFO free list
+    std::vector<int32_t> free_pages;          // SORTED ascending free set
     std::vector<std::vector<int32_t>> slot_pages;
     std::vector<int64_t> slot_total;          // reserved worst-case tokens
     std::vector<uint8_t> active;
@@ -61,7 +62,7 @@ Runtime* rt_create(
     rt->max_batch_tokens = max_batch_tokens;
     rt->max_context = max_context;
     rt->free_pages.reserve(num_pages > 0 ? num_pages - 1 : 0);
-    for (int32_t p = num_pages - 1; p >= 1; --p) rt->free_pages.push_back(p);
+    for (int32_t p = 1; p < num_pages; ++p) rt->free_pages.push_back(p);
     rt->slot_pages.resize(num_slots);
     rt->slot_total.assign(num_slots, 0);
     rt->active.assign(num_slots, 0);
@@ -111,12 +112,30 @@ int32_t rt_try_admit(Runtime* rt, int32_t prompt_len, int32_t max_new) {
     int64_t inflight = rt_inflight_tokens(rt);
     if (inflight > 0 && inflight + total > rt->max_batch_tokens) return -1;
 
+    // contiguous-first allocation (mirrors engine/kvcache.PageAllocator):
+    // an ascending run lets the Pallas decode kernel fetch the row's
+    // context in chunked DMAs instead of one DMA per page
     std::vector<int32_t>& pages = rt->slot_pages[slot];
     pages.clear();
-    for (int32_t k = 0; k < need; ++k) {
-        pages.push_back(rt->free_pages.back());
-        rt->free_pages.pop_back();
+    std::vector<int32_t>& fp = rt->free_pages;
+    size_t take = fp.size();  // sentinel: no run found
+    size_t run_start = 0;
+    int32_t run_len = 1;
+    for (size_t i = 1; i < fp.size(); ++i) {
+        if (fp[i] == fp[i - 1] + 1) {
+            if (++run_len == need) {
+                take = run_start;
+                break;
+            }
+        } else {
+            run_start = i;
+            run_len = 1;
+        }
     }
+    if (need == 1) take = 0;
+    if (take == fp.size()) take = 0;  // scattered fallback (ascending)
+    pages.assign(fp.begin() + take, fp.begin() + take + need);
+    fp.erase(fp.begin() + take, fp.begin() + take + need);
     int32_t* row = rt->table.data() + (size_t)slot * rt->max_pages_per_seq;
     std::memset(row, 0, sizeof(int32_t) * rt->max_pages_per_seq);
     for (size_t k = 0; k < pages.size(); ++k) row[k] = pages[k];
@@ -150,6 +169,8 @@ void rt_release(Runtime* rt, int32_t slot) {
     if (!rt->active[slot]) return;
     for (int32_t p : rt->slot_pages[slot])
         if (p != 0) rt->free_pages.push_back(p);
+    // keep the free set sorted so contiguous-first allocation works
+    std::sort(rt->free_pages.begin(), rt->free_pages.end());
     rt->slot_pages[slot].clear();
     rt->slot_total[slot] = 0;
     rt->active[slot] = 0;

@@ -663,7 +663,12 @@ class LocalEngine:
                     "finish_reason": "cancelled",
                 }
             for k in ordered:
-                ordered[k].append(row.get(k, 0))
+                # default ONLY the gen_tokens backfill (pre-upgrade
+                # partial rows lack it); any other missing key is a bug
+                # and must raise, not record 0
+                ordered[k].append(
+                    row.get(k, 0) if k == "gen_tokens" else row[k]
+                )
         output_tokens = int(
             sum(
                 len(tok.encode(o)) if o else 0 for o in ordered["outputs"]

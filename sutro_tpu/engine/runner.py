@@ -110,9 +110,36 @@ class ModelRunner:
             params = jax.device_put(params)
         self.params = params
         self.use_pallas = self._resolve_pallas(ecfg)
+        # contiguous-KV chunked fetch (PERF.md next-step 1): pages per
+        # decode-kernel DMA when a batch's page runs are contiguous
+        # (contiguous-first allocators make that the common case)
+        from ..ops.pallas_paged import chunk_pages_for
+
+        self.kv_chunk = (
+            chunk_pages_for(
+                ecfg.kv_page_size,
+                ecfg.max_pages_per_seq,
+                kv_heads=mcfg.num_kv_heads,
+                head_dim=mcfg.head_dim,
+                dtype_bytes=dtype.itemsize,
+            )
+            if self.use_pallas
+            else 1
+        )
         if num_pages is None:
             num_pages = 1 + ecfg.decode_batch_size * ecfg.max_pages_per_seq
+            # slack for the final chunk's masked over-read — these pages
+            # exist in the pool but are NEVER allocatable (alloc_pages),
+            # so a run ending at the allocatable boundary still has
+            # kv_chunk-1 valid pages beyond it
+            num_pages += self.kv_chunk - 1
+        else:
+            # explicit pool size: chunked fetch is only safe with the
+            # slack the default sizing adds, so fall back to per-page
+            self.kv_chunk = 1
         self.num_pages = num_pages
+        # page count visible to allocators (excludes over-read slack)
+        self.alloc_pages = num_pages - (self.kv_chunk - 1)
         self.cache = alloc_cache(mcfg, ecfg, num_pages, dtype=dtype)
         if self._cache_sharding is not None:
             self.cache = KVCache(
@@ -275,7 +302,7 @@ class ModelRunner:
 
     def _trunk_decode(
         self, params, cache: KVCache, ids, positions, past_len,
-        page_table, window_past=None,
+        page_table, window_past=None, kv_chunk: int = 1,
     ):
         """One decode trunk forward over the paged past — the plain
         scanned forward, or the stage-local pipeline schedule under
@@ -297,14 +324,30 @@ class ModelRunner:
             past_len=past_len,
             window_past=window_past,
             use_pallas=self.use_pallas,
+            kv_chunk=kv_chunk,
         )
 
+    def _chunk_for_table(self, page_table: np.ndarray) -> int:
+        """Static pages-per-DMA for this decode batch: the configured
+        chunk when every row's table is one ascending run (zeros after),
+        else 1 (per-page walk). At most two kernel specializations."""
+        if self.kv_chunk <= 1:
+            return 1
+        t = np.asarray(page_table)
+        if t.ndim == 1:
+            t = t[None]
+        nxt, prev = t[:, 1:], t[:, :-1]
+        if bool(((nxt == prev + 1) | (nxt == 0)).all()):
+            return self.kv_chunk
+        return 1
+
     @functools.partial(
-        jax.jit, static_argnums=(0,), donate_argnums=(2,)
+        jax.jit, static_argnums=(0, 12), donate_argnums=(2,)
     )
     def _decode_jit(
         self, params, cache: KVCache, ids, past_len, page_table,
         rng, temperature, top_p, top_k, allowed_packed, row_seeds,
+        kv_chunk: int = 1,
     ):
         B = ids.shape[0]
         allowed = None
@@ -316,7 +359,8 @@ class ModelRunner:
             ).astype(bool)
         positions = past_len[:, None]  # current token position == past length
         logits, _, (k, v) = self._trunk_decode(
-            params, cache, ids, positions, past_len, page_table
+            params, cache, ids, positions, past_len, page_table,
+            kv_chunk=kv_chunk,
         )
         cache = write_kv(
             cache, k, v, page_table, past_len, jnp.ones((B,), jnp.int32),
@@ -360,6 +404,7 @@ class ModelRunner:
             if allowed is None
             else jnp.asarray(np.packbits(np.asarray(allowed, bool), axis=1)),
             None if row_seeds is None else jnp.asarray(row_seeds, jnp.int32),
+            self._chunk_for_table(page_table),
         )
         return np.asarray(tok), np.asarray(logp)
 
@@ -368,11 +413,12 @@ class ModelRunner:
     # ------------------------------------------------------------------
 
     @functools.partial(
-        jax.jit, static_argnums=(0, 9), donate_argnums=(2,)
+        jax.jit, static_argnums=(0, 9, 11), donate_argnums=(2,)
     )
     def _decode_multi_jit(
         self, params, cache: KVCache, last, past_len, page_table,
         rng, temperature, top_p, steps: int, top_k,
+        kv_chunk: int = 1,
     ):
         """``steps`` decode iterations in ONE device program: the sampled
         token feeds the next step on-device, so the host pays one dispatch
@@ -393,7 +439,7 @@ class ModelRunner:
         B = last.shape[0]
         toks, logps, wk, wv = self._window_scan(
             params, cache, last, past_len, page_table, rng,
-            temperature, top_p, steps, top_k,
+            temperature, top_p, steps, top_k, kv_chunk,
         )
         cache = write_kv(
             cache, wk, wv, page_table, past_len,
@@ -405,6 +451,7 @@ class ModelRunner:
     def _window_scan(
         self, params, cache: KVCache, last, past_len, page_table,
         rng, temperature, top_p, steps: int, top_k,
+        kv_chunk: int = 1,
     ):
         """The shared fused-window scan: ``steps`` trunk forwards over
         invariant pages + the carried window buffer, sampling on-device.
@@ -424,7 +471,7 @@ class ModelRunner:
             logits, _, (k, v) = self._trunk_decode(
                 params, cache, last[:, None],
                 (past_len + step_idx)[:, None], past_len, page_table,
-                window_past=(wk, wv, step_idx),
+                window_past=(wk, wv, step_idx), kv_chunk=kv_chunk,
             )
             wk = jax.lax.dynamic_update_slice(
                 wk, k.astype(dtype), (0, 0, step_idx, 0, 0)
@@ -474,6 +521,7 @@ class ModelRunner:
             jnp.asarray(top_p, jnp.float32),
             steps,
             jnp.asarray(top_k, jnp.int32),
+            self._chunk_for_table(page_table),
         )
         return np.asarray(toks), np.asarray(logps)
 
@@ -481,10 +529,11 @@ class ModelRunner:
     # speculative window decode (constrained rows)
     # ------------------------------------------------------------------
 
-    @functools.partial(jax.jit, static_argnums=(0, 8))
+    @functools.partial(jax.jit, static_argnums=(0, 8, 11))
     def _decode_window_jit(
         self, params, cache: KVCache, last, past_len, page_table,
         rng, temperature, steps: int, top_p, top_k,
+        kv_chunk: int = 1,
     ):
         """Like ``_decode_multi_jit`` but WITHOUT the page commit: the
         sampled window and its K/V buffers return to the host, which
@@ -493,7 +542,7 @@ class ModelRunner:
         read-only input here, so a rejected suffix costs nothing."""
         return self._window_scan(
             params, cache, last, past_len, page_table, rng,
-            temperature, top_p, steps, top_k,
+            temperature, top_p, steps, top_k, kv_chunk,
         )
 
     @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
@@ -534,6 +583,7 @@ class ModelRunner:
             steps,
             jnp.asarray(top_p, jnp.float32),
             jnp.asarray(top_k, jnp.int32),
+            self._chunk_for_table(page_table),
         )
         # copy: callers may pass live views (native runtime) that mutate
         # during host-side verification before commit_window

@@ -172,13 +172,17 @@ class ContinuousBatcher:
         # or SUTRO_NATIVE_RUNTIME=0.
         from .native_runtime import maybe_native_runtime
 
+        # allocators see alloc_pages, NOT num_pages: the difference is
+        # the chunked-DMA over-read slack at the pool end, which must
+        # stay unallocatable (runner._chunk_for_table / pallas_paged)
+        alloc_pages = getattr(runner, "alloc_pages", runner.num_pages)
         self.native = maybe_native_runtime(
-            runner.num_pages, self.B, self.MP, self.ecfg.kv_page_size,
+            alloc_pages, self.B, self.MP, self.ecfg.kv_page_size,
             self.ecfg.max_batch_tokens, self.ecfg.max_context(),
         )
         self.allocator = (
             None if self.native is not None
-            else PageAllocator(runner.num_pages)
+            else PageAllocator(alloc_pages)
         )
         self.slots: List[Optional[_Slot]] = [None] * self.B
         self._key = jax.random.PRNGKey(seed)

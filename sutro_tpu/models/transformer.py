@@ -189,6 +189,7 @@ def layer_apply(
     wk_l: Optional[jax.Array] = None,   # this layer's fused-decode
     wv_l: Optional[jax.Array] = None,   # window buffer [B, W, KVH, Dh]
     win_len: Optional[jax.Array] = None,
+    kv_chunk: int = 1,
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     """One decoder block. Shared by the scanned ``forward`` and the
     pipeline-parallel stage loop (parallel/pipeline.py). Returns
@@ -220,6 +221,7 @@ def layer_apply(
         use_pallas=use_pallas,
         ring_mesh=ring_mesh,
         win_k=wk_l, win_v=wv_l, win_len=win_len,
+        kv_chunk=kv_chunk,
     )
     attn = attn.reshape(B, T, cfg.q_size) @ _w(lp, "wo", h.dtype)
     if cfg.attn_bias:
@@ -310,6 +312,7 @@ def forward(
     # win_len scalar) — K/V of window tokens not yet in the page pool
     # (runner.decode_multi writes pages once per window, not per step)
     window_past: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
+    kv_chunk: int = 1,  # static: pages per decode-kernel DMA
 ) -> Tuple[jax.Array, jax.Array, Tuple[jax.Array, jax.Array]]:
     """Run the trunk over a chunk.
 
@@ -351,6 +354,7 @@ def forward(
             page_table=page_table, past_len=past_len,
             use_pallas=use_pallas, ring_mesh=ring_mesh,
             wk_l=wk_l, wv_l=wv_l, win_len=win_len,
+            kv_chunk=kv_chunk,
         )
 
     h, (k_all, v_all) = jax.lax.scan(layer_step, h, xs)

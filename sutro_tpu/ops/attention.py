@@ -55,6 +55,8 @@ def chunk_attention(
     win_k: Optional[jax.Array] = None,
     win_v: Optional[jax.Array] = None,
     win_len: Optional[jax.Array] = None,
+    kv_chunk: int = 1,  # static: pages per decode-kernel DMA (>1 means
+                        # the caller guarantees contiguous page runs)
 ) -> jax.Array:
     """Returns [B, T, NH, Dh]."""
     B, T = q.shape[:2]
@@ -84,6 +86,7 @@ def chunk_attention(
                     q[:, 0], past_k_pages, past_v_pages, page_table,
                     past_len, k[:, 0], v[:, 0], win, sink,
                     win_k=win_k, win_v=win_v, win_len=win_len,
+                    kv_chunk=kv_chunk,
                 )
                 return out[:, None]
         from ..engine.kvcache import gather_kv_layer

@@ -47,10 +47,18 @@ def _gmm_kernel(tile_expert_ref, x_ref, w_ref, o_ref):
 
 
 def grouped_matmul_supported(lhs: jax.Array, rhs: jax.Array) -> bool:
-    """Static gate for the compiled TPU path (interpret mode bypasses)."""
+    """Static gate for the compiled TPU path (interpret mode bypasses).
+    Requires M large relative to E*BLOCK_M: the padded layout wastes up
+    to one row tile per expert, so decode-sized calls (M ~ B*top_k)
+    would pay ~E times the FLOPs of exact ragged_dot — prefill-sized
+    calls amortize the padding away."""
     M, H = lhs.shape
     E, _, F = rhs.shape
-    return H % 128 == 0 and F % BLOCK_F == 0 and M >= BLOCK_M
+    return (
+        H % 128 == 0
+        and F % BLOCK_F == 0
+        and M >= max(BLOCK_M, E * BLOCK_M)
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

@@ -52,6 +52,7 @@ def _paged_decode_kernel(
     scale: float,
     kvh: int,
     window_slots: int = 0,
+    chunk_pages: int = 1,
 ):
     if window_slots:
         (page_table_ref, past_len_ref, window_ref, win_len_ref,
@@ -68,10 +69,12 @@ def _paged_decode_kernel(
     b = pl.program_id(0)
     MP = max_pages_per_seq
     PS = page_size
+    CH = chunk_pages
+    CT = CH * PS  # tokens per fetched chunk
     G = q_ref.shape[2]
 
     past = past_len_ref[b]
-    npages = (past + PS - 1) // PS
+    nchunks = (past + CT - 1) // CT
     # current token's global position: tokens already in pages plus any
     # fused-window tokens not yet written back
     pos = past + (win_len_ref[0] if window_slots else 0)
@@ -81,21 +84,30 @@ def _paged_decode_kernel(
     l_ref[...] = jnp.zeros_like(l_ref)
     acc_ref[...] = jnp.zeros_like(acc_ref)
 
+    # CH == 1: each chunk is one table-walked page (any layout).
+    # CH > 1: the row's pages are one ascending run (contiguous-first
+    # allocator) — chunk i is pages [start + i*CH, start + (i+1)*CH),
+    # ONE DMA for CH pages instead of CH DMAs. The caller guarantees
+    # CH-1 slack pages at the pool end so the final chunk's over-read
+    # stays in bounds (over-read tokens are masked by ``tok < past``).
+    start_page = page_table_ref[b * MP]
+
+    def src_at(pool_ref, i):
+        if CH == 1:
+            return pool_ref.at[pl.ds(page_table_ref[b * MP + i], 1)]
+        return pool_ref.at[pl.ds(start_page + i * CH, CH)]
+
     def k_dma(i, slot):
         return pltpu.make_async_copy(
-            k_pool_ref.at[page_table_ref[b * MP + i]],
-            kbuf.at[slot],
-            ksem.at[slot],
+            src_at(k_pool_ref, i), kbuf.at[slot], ksem.at[slot]
         )
 
     def v_dma(i, slot):
         return pltpu.make_async_copy(
-            v_pool_ref.at[page_table_ref[b * MP + i]],
-            vbuf.at[slot],
-            vsem.at[slot],
+            src_at(v_pool_ref, i), vbuf.at[slot], vsem.at[slot]
         )
 
-    @pl.when(npages > 0)
+    @pl.when(nchunks > 0)
     def _warmup():
         k_dma(0, 0).start()
         v_dma(0, 0).start()
@@ -104,7 +116,7 @@ def _paged_decode_kernel(
         slot = jax.lax.rem(i, 2)
         nxt = jax.lax.rem(i + 1, 2)
 
-        @pl.when(i + 1 < npages)
+        @pl.when(i + 1 < nchunks)
         def _prefetch_next():
             k_dma(i + 1, nxt).start()
             v_dma(i + 1, nxt).start()
@@ -112,8 +124,8 @@ def _paged_decode_kernel(
         k_dma(i, slot).wait()
         v_dma(i, slot).wait()
 
-        page_start = i * PS
-        tok = page_start + jax.lax.broadcasted_iota(jnp.int32, (G, PS), 1)
+        chunk_start = i * CT
+        tok = chunk_start + jax.lax.broadcasted_iota(jnp.int32, (G, CT), 1)
         ok = tok < past
         # windowless (win <= 0) ORed in instead of a boolean select —
         # Mosaic cannot legalize arith.select on i1 vectors
@@ -122,8 +134,8 @@ def _paged_decode_kernel(
         )
         for h in range(kvh):  # static unroll over KV heads
             q = q_ref[0, h].astype(jnp.float32)          # [G, Dh]
-            k = kbuf[slot, :, h, :].astype(jnp.float32)  # [PS, Dh]
-            v = vbuf[slot, :, h, :].astype(jnp.float32)  # [PS, Dh]
+            k = kbuf[slot, :, :, h, :].reshape(CT, -1).astype(jnp.float32)
+            v = vbuf[slot, :, :, h, :].reshape(CT, -1).astype(jnp.float32)
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -147,7 +159,7 @@ def _paged_decode_kernel(
             )
         return 0
 
-    jax.lax.fori_loop(0, npages, page_step, 0)
+    jax.lax.fori_loop(0, nchunks, page_step, 0)
 
     # finalize: fused-window tokens + current token + attention sink
     W = window_slots
@@ -208,6 +220,29 @@ PALLAS_PAGED_MIN_CTX = int(
 )
 
 
+def chunk_pages_for(
+    page_size: int,
+    max_pages_per_seq: int,
+    kv_heads: int = 8,
+    head_dim: int = 128,
+    dtype_bytes: int = 2,
+    budget_bytes: int = 1 << 20,
+) -> int:
+    """Pages fetched per DMA in contiguous-KV mode: the largest divisor
+    of MP whose chunk stays under ``budget_bytes`` PER double-buffer
+    slot (4 buffers total: K+V x 2 slots — 1 MiB each keeps the scratch
+    well inside ~16 MiB VMEM alongside m/l/acc). Callers enabling
+    chunked fetch must (a) allocate slots as contiguous page runs and
+    (b) leave ``chunk-1`` unallocatable slack pages at the pool end for
+    the final chunk's masked over-read (engine/runner)."""
+    page_bytes = max(page_size * kv_heads * head_dim * dtype_bytes, 1)
+    budget = max(1, budget_bytes // page_bytes)
+    ch = min(max_pages_per_seq, budget)
+    while ch > 1 and max_pages_per_seq % ch:
+        ch -= 1
+    return max(ch, 1)
+
+
 def paged_decode_supported(
     q: jax.Array, k_pages: jax.Array, page_table: jax.Array
 ) -> bool:
@@ -224,7 +259,7 @@ def paged_decode_supported(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("interpret",),
+    static_argnames=("kv_chunk", "interpret"),
 )
 def paged_decode_attention(
     q: jax.Array,          # [B, NH, Dh] — current-step queries
@@ -240,6 +275,7 @@ def paged_decode_attention(
     win_v: Optional[jax.Array] = None,
     win_len: Optional[jax.Array] = None,  # scalar int32 — valid slots
     *,
+    kv_chunk: int = 1,  # pages per DMA (>1 requires contiguous runs)
     interpret: bool = False,
 ) -> jax.Array:
     """Returns [B, NH, Dh] attention outputs for one decode step.
@@ -269,6 +305,7 @@ def paged_decode_attention(
         scale=scale,
         kvh=KVH,
         window_slots=W,
+        chunk_pages=kv_chunk,
     )
 
     # index maps take *s so the scalar-prefetch arity (3 without a
@@ -304,8 +341,9 @@ def paged_decode_attention(
             (1, KVH, G, Dh), lambda b, *s: (b, 0, 0, 0)
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, PS, KVH, Dh), k_pages.dtype),  # K double-buffer
-            pltpu.VMEM((2, PS, KVH, Dh), v_pages.dtype),
+            # K/V double-buffers: [2, chunk, PS, KVH, Dh]
+            pltpu.VMEM((2, kv_chunk, PS, KVH, Dh), k_pages.dtype),
+            pltpu.VMEM((2, kv_chunk, PS, KVH, Dh), v_pages.dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.VMEM((KVH, G, 128), jnp.float32),

@@ -274,3 +274,41 @@ def test_grouped_matmul_matches_ragged_dot(sizes):
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4
     )
+
+
+@pytest.mark.parametrize("kv_chunk", [2, 3])
+def test_paged_decode_chunked_contiguous(kv_chunk):
+    """Contiguous-KV mode: fetching kv_chunk pages per DMA over an
+    ascending page run must match the per-page walk and the jnp
+    reference (over-read past the run is masked by past_len)."""
+    rng = np.random.default_rng(31)
+    B, NH, KVH, Dh, PS, MP, NP = 3, 4, 2, 16, 8, 6, 64
+    q = jnp.asarray(rng.standard_normal((B, 1, NH, Dh)), jnp.float32)
+    k_cur = jnp.asarray(rng.standard_normal((B, 1, KVH, Dh)), jnp.float32)
+    v_cur = jnp.asarray(rng.standard_normal((B, 1, KVH, Dh)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((NP, PS, KVH, Dh)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((NP, PS, KVH, Dh)), jnp.float32)
+    # ascending contiguous runs per row
+    table = np.zeros((B, MP), np.int32)
+    starts = [1, 11, 21]
+    for b in range(B):
+        table[b] = np.arange(starts[b], starts[b] + MP)
+    table = jnp.asarray(table)
+    past_len = jnp.asarray([5, 17, MP * PS - 1], jnp.int32)
+    win = jnp.asarray(0, jnp.int32)
+
+    ref = chunk_attention(
+        q, k_cur, v_cur,
+        positions=past_len[:, None],
+        valid_len=jnp.ones((B,), jnp.int32),
+        past_k_pages=kp, past_v_pages=vp, page_table=table,
+        past_len=past_len, window=win, sink=None,
+        use_pallas=False,
+    )
+    got = paged_decode_attention(
+        q[:, 0], kp, vp, table, past_len, k_cur[:, 0], v_cur[:, 0],
+        win, None, kv_chunk=kv_chunk, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref[:, 0]), atol=2e-5, rtol=2e-5
+    )

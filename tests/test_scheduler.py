@@ -267,3 +267,26 @@ def test_python_fallback_batched_admission(tiny_runner, byte_tok, monkeypatch):
     )
     assert set(res) == set(range(len(texts)))
     assert b.free_page_count == b.allocator.num_pages - 1  # all released
+
+
+def test_page_allocator_contiguous_runs():
+    """Contiguous-first allocation: runs are ascending and re-allocation
+    after frees still finds holes (first-fit), falling back to scattered
+    only when no hole fits."""
+    from sutro_tpu.engine.kvcache import PageAllocator
+
+    a = PageAllocator(num_pages=17)  # pages 1..16
+    r1 = a.alloc(4)
+    r2 = a.alloc(4)
+    r3 = a.alloc(4)
+    for r in (r1, r2, r3):
+        assert r == list(range(r[0], r[0] + 4))
+    a.free(r2)  # hole of 4 in the middle
+    r4 = a.alloc(3)  # fits the hole (first fit)
+    assert r4 == list(range(r4[0], r4[0] + 3))
+    a.free(r1)
+    a.free(r3)
+    a.free(r4)
+    assert a.free_count == 16
+    big = a.alloc(16)
+    assert big == list(range(1, 17))
