@@ -132,8 +132,8 @@ int32_t rt_try_admit(Runtime* rt, int32_t prompt_len, int32_t max_new) {
             run_len = 1;
         }
     }
-    if (need == 1) take = 0;
-    if (take == fp.size()) take = 0;  // scattered fallback (ascending)
+    if (take == fp.size()) take = 0;  // need==1 / scattered fallback
+                                      // (ascending from the front)
     pages.assign(fp.begin() + take, fp.begin() + take + need);
     fp.erase(fp.begin() + take, fp.begin() + take + need);
     int32_t* row = rt->table.data() + (size_t)slot * rt->max_pages_per_seq;
@@ -167,10 +167,14 @@ void rt_note_token(Runtime* rt, int32_t slot, int32_t tok) {
 
 void rt_release(Runtime* rt, int32_t slot) {
     if (!rt->active[slot]) return;
+    // slot_pages is ascending (assigned from the sorted free list):
+    // append then merge the two sorted ranges — O(F), not a full sort
+    size_t mid = rt->free_pages.size();
     for (int32_t p : rt->slot_pages[slot])
         if (p != 0) rt->free_pages.push_back(p);
-    // keep the free set sorted so contiguous-first allocation works
-    std::sort(rt->free_pages.begin(), rt->free_pages.end());
+    std::inplace_merge(
+        rt->free_pages.begin(), rt->free_pages.begin() + mid,
+        rt->free_pages.end());
     rt->slot_pages[slot].clear();
     rt->slot_total[slot] = 0;
     rt->active[slot] = 0;

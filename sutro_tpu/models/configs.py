@@ -58,6 +58,12 @@ class ModelConfig:
     post_norms: bool = False              # post-attn/post-mlp RMSNorm
     embed_scale: bool = False             # embeddings * sqrt(hidden)
     local_rope_theta: Optional[float] = None  # gemma local layers use 10k
+    # YaRN RoPE scaling (gpt-oss ships with factor 32 over a 4096-token
+    # original window). 0 disables.
+    rope_scaling_factor: float = 0.0
+    rope_original_max: int = 0
+    rope_beta_fast: float = 32.0
+    rope_beta_slow: float = 1.0
     # activation: "silu" (SwiGLU) | "gelu" (GeGLU) | "swiglu_oss" (clamped)
     activation: str = "silu"
     # head: "lm" | "embedding" (mean-pool, normalized)
@@ -146,6 +152,7 @@ def _gpt_oss(name: str, h: int, l: int, nh: int, nkv: int,
         attention_sink=True, attn_bias=True, moe_bias=True,
         activation="swiglu_oss",
         chat_template="chatml",
+        rope_scaling_factor=32.0, rope_original_max=4096,
     )
 
 

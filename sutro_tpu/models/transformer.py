@@ -129,14 +129,62 @@ def rms_norm(x: jax.Array, w: jax.Array, eps: float, zero_centered: bool) -> jax
     return (x32 * scale).astype(dt)
 
 
-def apply_rope(x: jax.Array, positions: jax.Array, theta: jax.Array) -> jax.Array:
+def _yarn_inv_freq(cfg: ModelConfig, half: int) -> Tuple[np.ndarray, float]:
+    """Static YaRN-scaled inverse frequencies + attention scaling
+    (gpt-oss ships factor-32 YaRN over a 4096-token original window).
+    NTK-by-parts: low dims (fast-rotating, within the original window)
+    extrapolate, high dims interpolate by ``factor``, with a linear ramp
+    between the beta_fast/beta_slow wavelength cutoffs; cos/sin are
+    scaled by ``0.1 ln(factor) + 1``."""
+    base = cfg.rope_theta
+    factor = cfg.rope_scaling_factor
+    orig = max(cfg.rope_original_max, 1)
+    dim = 2 * half
+    pos_freqs = base ** (np.arange(0, dim, 2, dtype=np.float64) / dim)
+    extrap = 1.0 / pos_freqs
+    interp = 1.0 / (factor * pos_freqs)
+
+    def find_dim(n_rot: float) -> float:
+        return (
+            dim * np.log(orig / (n_rot * 2 * np.pi))
+        ) / (2 * np.log(base))
+
+    low = np.floor(find_dim(cfg.rope_beta_fast))
+    high = np.ceil(find_dim(cfg.rope_beta_slow))
+    rng = np.arange(half, dtype=np.float64)
+    ramp = np.clip((rng - low) / max(high - low, 1e-3), 0.0, 1.0)
+    extrap_factor = 1.0 - ramp
+    inv_freq = interp * (1 - extrap_factor) + extrap * extrap_factor
+    attn_scale = 0.1 * float(np.log(factor)) + 1.0
+    return inv_freq.astype(np.float32), attn_scale
+
+
+def apply_rope(
+    x: jax.Array,
+    positions: jax.Array,
+    theta: jax.Array,
+    cfg: Optional[ModelConfig] = None,
+) -> jax.Array:
     """rotate-half RoPE. x: [B, T, N, Dh]; positions: [B, T]."""
     dh = x.shape[-1]
     half = dh // 2
-    freq = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    scale = 1.0
+    if cfg is not None and cfg.rope_scaling_factor:
+        if cfg.local_rope_theta:
+            # YaRN frequencies derive from the GLOBAL base only; a
+            # config mixing per-layer thetas with YaRN would silently
+            # mis-rotate local layers (the traced per-layer theta is
+            # unused on this path)
+            raise NotImplementedError(
+                "YaRN rope_scaling with local_rope_theta is unsupported"
+            )
+        freq, scale = _yarn_inv_freq(cfg, half)
+        freq = jnp.asarray(freq)
+    else:
+        freq = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
     ang = positions.astype(jnp.float32)[..., None] * freq  # [B, T, half]
-    cos = jnp.cos(ang)[:, :, None, :]
-    sin = jnp.sin(ang)[:, :, None, :]
+    cos = jnp.cos(ang)[:, :, None, :] * scale
+    sin = jnp.sin(ang)[:, :, None, :] * scale
     x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
     return jnp.concatenate(
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
@@ -208,8 +256,8 @@ def layer_apply(
     if cfg.qk_norm:
         q = rms_norm(q, lp["q_norm"], cfg.norm_eps, cfg.norm_zero_centered)
         k = rms_norm(k, lp["k_norm"], cfg.norm_eps, cfg.norm_zero_centered)
-    q = apply_rope(q, positions, theta)
-    k = apply_rope(k, positions, theta)
+    q = apply_rope(q, positions, theta, cfg)
+    k = apply_rope(k, positions, theta, cfg)
     sink = lp.get("sink") if cfg.attention_sink else None
     attn = chunk_attention(
         q, k, v,

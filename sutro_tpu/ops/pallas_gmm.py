@@ -54,11 +54,7 @@ def grouped_matmul_supported(lhs: jax.Array, rhs: jax.Array) -> bool:
     calls amortize the padding away."""
     M, H = lhs.shape
     E, _, F = rhs.shape
-    return (
-        H % 128 == 0
-        and F % BLOCK_F == 0
-        and M >= max(BLOCK_M, E * BLOCK_M)
-    )
+    return H % 128 == 0 and F % BLOCK_F == 0 and M >= E * BLOCK_M
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

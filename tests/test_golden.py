@@ -170,3 +170,157 @@ def test_quickstart_classify_on_real_checkpoint(
         from sutro_tpu.engine.api import reset_engine
 
         reset_engine()
+
+
+# ---------------------------------------------------------------------------
+# family parity: every catalog architecture vs its torch reference
+# ---------------------------------------------------------------------------
+
+
+def _forward_ours(cfg, ckpt_dir, ids):
+    import jax.numpy as jnp
+
+    from sutro_tpu.engine.config import EngineConfig
+    from sutro_tpu.engine.weights import load_checkpoint
+
+    from sutro_tpu.models import transformer
+
+    ecfg = EngineConfig(param_dtype="float32", use_pallas=False)
+    params = load_checkpoint(ckpt_dir, cfg, ecfg)
+    B, T = ids.shape
+    positions = np.broadcast_to(np.arange(T, dtype=np.int32)[None], (B, T))
+    got, _, _ = transformer.forward(
+        cfg, params, jnp.asarray(ids), jnp.asarray(positions),
+        jnp.full((B,), T, jnp.int32),
+    )
+    return np.asarray(got)
+
+
+def _parity(hf_model, cfg, tmp_path, atol=3e-3):
+    torch = pytest.importorskip("torch")
+    out_dir = str(tmp_path / cfg.name)
+    hf_model.save_pretrained(out_dir, safe_serialization=True)
+    rng = np.random.default_rng(5)
+    ids = rng.integers(0, cfg.vocab_size, (2, 13)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf_model(torch.from_numpy(ids).long()).logits.numpy()
+    got = _forward_ours(cfg, out_dir, ids)
+    np.testing.assert_allclose(got, ref, atol=atol, rtol=atol)
+    np.testing.assert_array_equal(got.argmax(-1), ref.argmax(-1))
+
+
+def test_llama3_torch_parity(tmp_path):
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    cfg = ModelConfig(
+        name="tiny-llama3-hf", vocab_size=256, hidden_size=64,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+        intermediate_size=128, qk_norm=False, tie_embeddings=False,
+        rope_theta=500_000.0, norm_eps=1e-5, chat_template="llama3",
+    )
+    hf = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        intermediate_size=128, rms_norm_eps=1e-5, rope_theta=500_000.0,
+        tie_word_embeddings=False, attention_bias=False,
+        mlp_bias=False, max_position_embeddings=256,
+    )
+    torch.manual_seed(1)
+    _parity(transformers.LlamaForCausalLM(hf).eval(), cfg, tmp_path)
+
+
+def test_qwen3_moe_torch_parity(tmp_path):
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    cfg = ModelConfig(
+        name="tiny-qwen3moe-hf", vocab_size=256, hidden_size=64,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+        intermediate_size=96, qk_norm=True, tie_embeddings=False,
+        moe_experts=4, moe_top_k=2, moe_intermediate_size=96,
+        rope_theta=1_000_000.0,
+    )
+    hf = transformers.Qwen3MoeConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        moe_intermediate_size=96, intermediate_size=96,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=True,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        rms_norm_eps=1e-6, rope_theta=1_000_000.0,
+        tie_word_embeddings=False, attention_bias=False,
+        max_position_embeddings=256,
+    )
+    torch.manual_seed(2)
+    _parity(
+        transformers.Qwen3MoeForCausalLM(hf).eval(), cfg, tmp_path
+    )
+
+
+def test_gemma3_torch_parity(tmp_path):
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    # 8 layers exercises the 5-local:1-global pattern + both RoPE bases
+    cfg = ModelConfig(
+        name="tiny-gemma3-hf", vocab_size=256, hidden_size=64,
+        num_layers=8, num_heads=4, num_kv_heads=2, head_dim=16,
+        intermediate_size=128, qk_norm=True, tie_embeddings=True,
+        rope_theta=1_000_000.0, local_rope_theta=10_000.0,
+        sliding_window=8, sliding_pattern="gemma", post_norms=True,
+        embed_scale=True, activation="gelu", norm_zero_centered=True,
+        chat_template="gemma",
+    )
+    hf = transformers.Gemma3TextConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=8,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        intermediate_size=128, rms_norm_eps=1e-6,
+        rope_theta=1_000_000.0, rope_local_base_freq=10_000.0,
+        sliding_window=8, sliding_window_pattern=6,
+        query_pre_attn_scalar=16,  # == head_dim: same softmax scale
+        tie_word_embeddings=True, attention_bias=False,
+        max_position_embeddings=256, attn_logit_softcapping=None,
+        final_logit_softcapping=None,
+    )
+    torch.manual_seed(3)
+    _parity(
+        transformers.Gemma3ForCausalLM(hf).eval(), cfg, tmp_path
+    )
+
+
+def test_gpt_oss_torch_parity(tmp_path):
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    cfg = ModelConfig(
+        name="tiny-oss-hf", vocab_size=256, hidden_size=64,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+        intermediate_size=96, qk_norm=False, tie_embeddings=False,
+        moe_experts=4, moe_top_k=2, moe_intermediate_size=96,
+        rope_theta=150_000.0, sliding_window=8,
+        sliding_pattern="alternate", attention_sink=True,
+        attn_bias=True, moe_bias=True, activation="swiglu_oss",
+        # real gpt-oss checkpoints ship factor-32 YaRN over 4096
+        rope_scaling_factor=32.0, rope_original_max=4096,
+    )
+    hf = transformers.GptOssConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        intermediate_size=96, num_local_experts=4,
+        num_experts_per_tok=2, rms_norm_eps=1e-6,
+        rope_theta=150_000.0, sliding_window=8,
+        layer_types=["sliding_attention", "full_attention"],
+        tie_word_embeddings=False, attention_bias=True,
+        rope_scaling={
+            "rope_type": "yarn",
+            "factor": 32.0,
+            "original_max_position_embeddings": 4096,
+            "beta_fast": 32.0,
+            "beta_slow": 1.0,
+        },
+        max_position_embeddings=131_072,
+    )
+    torch.manual_seed(4)
+    _parity(
+        transformers.GptOssForCausalLM(hf).eval(), cfg, tmp_path
+    )
