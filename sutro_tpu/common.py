@@ -81,7 +81,7 @@ def model_catalog() -> Dict[str, Dict[str, Any]]:
 
     ``engine_key`` indexes ``sutro_tpu.models.registry.MODEL_CONFIGS``;
     ``thinking`` toggles reasoning-content output unpacking (reference
-    sdk.py:1225-1234); ``embedding`` selects the mean-pool head path.
+    sdk.py:1225-1234); ``embedding`` selects the pooled-embedding head path (last-token for Qwen3-Embedding).
     """
     cat: Dict[str, Dict[str, Any]] = {}
 

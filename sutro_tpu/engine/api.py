@@ -687,7 +687,7 @@ class LocalEngine:
     def _run_embedding_job(
         self, job_id, rec, runner, tok, token_rows, jm
     ) -> Optional[int]:
-        """Embedding path: mean-pool head, batched (BASELINE config #3).
+        """Embedding path: pooled head, batched (BASELINE config #3).
 
         Row-granular durability like the generation path (SURVEY §5.3):
         embeddings flush to the partial store every few batches, so a

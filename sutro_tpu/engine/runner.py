@@ -11,7 +11,7 @@ is three jitted functions over static shapes:
 - ``decode(ids[B,1])``: one token for every slot in the fixed-size decode
   batch; past gathered from pages, new K/V scattered back, sampling fused
   in (with optional constrained-decoding vocab masks).
-- ``embed(ids[B,T])``: trunk + mean-pool head for the embedding models.
+- ``embed(ids[B,T])``: trunk + pooled head (last-token for Qwen3-Embedding).
 
 Host-side state (slots, page tables, FSM states) lives in
 engine/scheduler.py; this module is stateless apart from params + cache.

@@ -79,8 +79,17 @@ def load_checkpoint(
     dtype = jnp.dtype(ecfg.param_dtype)
     L = mcfg.num_layers
 
+    def resolve(name: str) -> str:
+        """Embedding-model checkpoints saved from the bare trunk (e.g.
+        Qwen3-Embedding's Qwen3Model) drop the ``model.`` prefix."""
+        if name in idx:
+            return name
+        if name.startswith("model.") and name[6:] in idx:
+            return name[6:]
+        return name
+
     def get(name: str, transpose: bool = False) -> np.ndarray:
-        arr = idx.get(name)
+        arr = idx.get(resolve(name))
         if transpose:
             arr = np.ascontiguousarray(arr.T)
         return arr
@@ -95,7 +104,7 @@ def load_checkpoint(
         return jnp.asarray(np.stack(outs), dtype)
 
     def maybe_stack(fmt: str, transpose: bool = False) -> Optional[jnp.ndarray]:
-        if fmt.format(i=0) in idx:
+        if resolve(fmt.format(i=0)) in idx:
             return stack(fmt, transpose)
         return None
 

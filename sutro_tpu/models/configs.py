@@ -66,8 +66,11 @@ class ModelConfig:
     rope_beta_slow: float = 1.0
     # activation: "silu" (SwiGLU) | "gelu" (GeGLU) | "swiglu_oss" (clamped)
     activation: str = "silu"
-    # head: "lm" | "embedding" (mean-pool, normalized)
+    # head: "lm" | "embedding" (pooled, normalized)
     head: str = "lm"
+    # embedding pooling: "mean" | "last" (Qwen3-Embedding pools the
+    # final valid token's hidden state, not the mean)
+    pooling: str = "mean"
     # chat template key for engine/tokenizer.render_chat
     chat_template: str = "chatml"
 
@@ -101,6 +104,9 @@ def _qwen3(name: str, h: int, l: int, nh: int, nkv: int, inter: int,
         num_heads=nh, num_kv_heads=nkv, head_dim=hd,
         intermediate_size=inter, qk_norm=True, tie_embeddings=tie,
         rope_theta=1_000_000.0, head=head, chat_template="chatml",
+        # Qwen3-Embedding pools the last valid token (model card), not
+        # the mean
+        pooling="last" if head == "embedding" else "mean",
     )
 
 
@@ -177,7 +183,7 @@ MODEL_CONFIGS: Dict[str, ModelConfig] = {
     # gpt-oss
     "gpt-oss-20b": _gpt_oss("gpt-oss-20b", 2880, 24, 64, 8, 32, 4, 2880),
     "gpt-oss-120b": _gpt_oss("gpt-oss-120b", 2880, 36, 64, 8, 128, 4, 2880),
-    # Embeddings (Qwen3 trunk + mean-pool head)
+    # Embeddings (Qwen3 trunk + last-token-pool head)
     "qwen3-emb-0.6b": _qwen3("qwen3-emb-0.6b", 1024, 28, 16, 8, 3072, head="embedding"),
     "qwen3-emb-6b": _qwen3("qwen3-emb-6b", 4096, 36, 32, 8, 12288, tie=False, head="embedding"),
     "qwen3-emb-8b": _qwen3("qwen3-emb-8b", 4096, 36, 32, 8, 12288, tie=False, head="embedding"),

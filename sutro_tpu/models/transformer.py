@@ -322,9 +322,20 @@ def head_apply(
     T = h.shape[1]
     h = rms_norm(h, params["final_norm"], cfg.norm_eps, cfg.norm_zero_centered)
     if cfg.head == "embedding":
-        mask = (jnp.arange(T)[None, :] < valid_len[:, None]).astype(jnp.float32)
-        pooled = jnp.sum(h.astype(jnp.float32) * mask[..., None], axis=1)
-        pooled = pooled / jnp.maximum(mask.sum(axis=1, keepdims=True), 1.0)
+        if cfg.pooling == "last":
+            # Qwen3-Embedding: the final valid token's hidden state
+            last = jnp.maximum(valid_len - 1, 0)
+            pooled = jnp.take_along_axis(
+                h.astype(jnp.float32), last[:, None, None], axis=1
+            )[:, 0]
+        else:
+            mask = (
+                jnp.arange(T)[None, :] < valid_len[:, None]
+            ).astype(jnp.float32)
+            pooled = jnp.sum(h.astype(jnp.float32) * mask[..., None], axis=1)
+            pooled = pooled / jnp.maximum(
+                mask.sum(axis=1, keepdims=True), 1.0
+            )
         emb = pooled / jnp.maximum(
             jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9
         )

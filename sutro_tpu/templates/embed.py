@@ -1,6 +1,6 @@
 """Embeddings template: thin infer + await wrapper defaulting to
 ``qwen-3-embedding-0.6b`` (reference /root/reference/sutro/templates/
-embed.py:8-53). On the TPU backend this runs the mean-pool embedding head
+embed.py:8-53). On the TPU backend this runs the pooled embedding head
 (models with ``head='embedding'``) through the batched embed path."""
 
 from __future__ import annotations

@@ -324,3 +324,57 @@ def test_gpt_oss_torch_parity(tmp_path):
     _parity(
         transformers.GptOssForCausalLM(hf).eval(), cfg, tmp_path
     )
+
+
+def test_qwen3_embedding_torch_parity(tmp_path):
+    """Embedding head parity: the bare Qwen3 trunk (as Qwen3-Embedding
+    ships it — no LM head, no 'model.' key prefix) loaded through the
+    weight converter must reproduce torch's last-token-pooled,
+    L2-normalized embeddings."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    import jax.numpy as jnp
+
+    from sutro_tpu.engine.config import EngineConfig
+    from sutro_tpu.engine.weights import load_checkpoint
+    from sutro_tpu.models import transformer
+
+    cfg = ModelConfig(
+        name="tiny-qwen3emb-hf", vocab_size=256, hidden_size=64,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+        intermediate_size=128, qk_norm=True, tie_embeddings=True,
+        rope_theta=1_000_000.0, head="embedding", pooling="last",
+    )
+    hf = transformers.Qwen3Config(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        intermediate_size=128, rms_norm_eps=1e-6,
+        rope_theta=1_000_000.0, max_position_embeddings=256,
+    )
+    torch.manual_seed(6)
+    trunk = transformers.Qwen3Model(hf).eval()
+    out_dir = str(tmp_path / "emb")
+    trunk.save_pretrained(out_dir, safe_serialization=True)
+
+    rng = np.random.default_rng(7)
+    B, T = 3, 11
+    ids = rng.integers(0, 256, (B, T)).astype(np.int32)
+    lens = np.asarray([11, 7, 1], np.int32)
+    mask = (np.arange(T)[None] < lens[:, None]).astype(np.int64)
+    with torch.no_grad():
+        hs = trunk(
+            torch.from_numpy(ids).long(),
+            attention_mask=torch.from_numpy(mask),
+        ).last_hidden_state.numpy()
+    pooled = hs[np.arange(B), lens - 1]
+    want = pooled / np.linalg.norm(pooled, axis=-1, keepdims=True)
+
+    params = load_checkpoint(
+        out_dir, cfg, EngineConfig(param_dtype="float32", use_pallas=False)
+    )
+    positions = np.broadcast_to(np.arange(T, dtype=np.int32)[None], (B, T))
+    got, _, _ = transformer.forward(
+        cfg, params, jnp.asarray(ids), jnp.asarray(positions),
+        jnp.asarray(lens),
+    )
+    np.testing.assert_allclose(np.asarray(got), want, atol=3e-4, rtol=3e-4)
