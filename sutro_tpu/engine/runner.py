@@ -112,7 +112,13 @@ class ModelRunner:
         self.use_pallas = self._resolve_pallas(ecfg)
         # contiguous-KV chunked fetch (PERF.md next-step 1): pages per
         # decode-kernel DMA when a batch's page runs are contiguous
-        # (contiguous-first allocators make that the common case)
+        # (contiguous-first allocators make that the common case).
+        # Opt-in via SUTRO_KV_CHUNK=1 until the chunked DMA form is
+        # validated on a real chip (interpret-mode parity is covered;
+        # the round's TPU tunnel died before a compiled run) — the
+        # per-page walk is the chip-validated default.
+        import os as _os
+
         from ..ops.pallas_paged import chunk_pages_for
 
         self.kv_chunk = (
@@ -124,6 +130,7 @@ class ModelRunner:
                 dtype_bytes=dtype.itemsize,
             )
             if self.use_pallas
+            and _os.environ.get("SUTRO_KV_CHUNK", "0") != "0"
             else 1
         )
         if num_pages is None:

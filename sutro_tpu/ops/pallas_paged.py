@@ -92,19 +92,30 @@ def _paged_decode_kernel(
     # stays in bounds (over-read tokens are masked by ``tok < past``).
     start_page = page_table_ref[b * MP]
 
-    def src_at(pool_ref, i):
-        if CH == 1:
-            return pool_ref.at[pl.ds(page_table_ref[b * MP + i], 1)]
-        return pool_ref.at[pl.ds(start_page + i * CH, CH)]
-
     def k_dma(i, slot):
+        if CH == 1:  # per-page walk: any table layout
+            return pltpu.make_async_copy(
+                k_pool_ref.at[page_table_ref[b * MP + i]],
+                kbuf.at[slot, 0],
+                ksem.at[slot],
+            )
         return pltpu.make_async_copy(
-            src_at(k_pool_ref, i), kbuf.at[slot], ksem.at[slot]
+            k_pool_ref.at[pl.ds(start_page + i * CH, CH)],
+            kbuf.at[slot],
+            ksem.at[slot],
         )
 
     def v_dma(i, slot):
+        if CH == 1:
+            return pltpu.make_async_copy(
+                v_pool_ref.at[page_table_ref[b * MP + i]],
+                vbuf.at[slot, 0],
+                vsem.at[slot],
+            )
         return pltpu.make_async_copy(
-            src_at(v_pool_ref, i), vbuf.at[slot], vsem.at[slot]
+            v_pool_ref.at[pl.ds(start_page + i * CH, CH)],
+            vbuf.at[slot],
+            vsem.at[slot],
         )
 
     @pl.when(nchunks > 0)
