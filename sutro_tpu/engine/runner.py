@@ -20,7 +20,8 @@ engine/scheduler.py; this module is stateless apart from params + cache.
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, Optional, Tuple
+import os
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -117,8 +118,6 @@ class ModelRunner:
         # validated on a real chip (interpret-mode parity is covered;
         # the round's TPU tunnel died before a compiled run) — the
         # per-page walk is the chip-validated default.
-        import os as _os
-
         from ..ops.pallas_paged import chunk_pages_for
 
         self.kv_chunk = (
@@ -130,7 +129,7 @@ class ModelRunner:
                 dtype_bytes=dtype.itemsize,
             )
             if self.use_pallas
-            and _os.environ.get("SUTRO_KV_CHUNK", "0") != "0"
+            and os.environ.get("SUTRO_KV_CHUNK", "0") != "0"
             else 1
         )
         if num_pages is None:
@@ -153,8 +152,6 @@ class ModelRunner:
                 k_pages=jax.device_put(self.cache.k_pages, self._cache_sharding),
                 v_pages=jax.device_put(self.cache.v_pages, self._cache_sharding),
             )
-        self._decode_fn = None
-        self._embed_cache: Dict[int, Any] = {}
 
     @staticmethod
     def _resolve_pallas(ecfg: EngineConfig) -> bool:

@@ -620,7 +620,6 @@ class ContinuousBatcher:
             has_constraint = False
             has_row_seed = False
             row_seeds = np.zeros((self.B,), np.int32)
-            allowed = None
             for i in active:
                 s = self.slots[i]
                 if self.native is None:
@@ -743,6 +742,7 @@ class ContinuousBatcher:
                     if not active:
                         break
             else:
+                allowed = None
                 if has_constraint:
                     # masked step: assemble the per-row FSM vocab masks
                     # (only here — fused windows verify tokens instead)

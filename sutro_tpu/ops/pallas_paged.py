@@ -33,6 +33,7 @@ All math is float32.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -224,10 +225,8 @@ def _paged_decode_kernel(
 # grid/DMA overhead. With the in-kernel page walk the kernel's work is
 # proportional to ACTUAL context, so it wins essentially everywhere —
 # the gate is kept env-overridable for benchmarking the crossover.
-import os as _os
-
 PALLAS_PAGED_MIN_CTX = int(
-    _os.environ.get("SUTRO_PAGED_MIN_CTX", "0")
+    os.environ.get("SUTRO_PAGED_MIN_CTX", "0")
 )
 
 
