@@ -1,0 +1,99 @@
+"""Profile one fused decode window and report where device time goes.
+
+Captures a jax.profiler trace around decode_multi, then parses the
+chrome-trace events and aggregates device op durations by HLO name
+prefix. Ground truth for PERF.md's step breakdown.
+"""
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+
+import numpy as np
+import jax
+
+from sutro_tpu.engine.config import EngineConfig
+from sutro_tpu.engine.runner import ModelRunner
+from sutro_tpu.models.configs import MODEL_CONFIGS
+
+B = int(os.environ.get("B", "64"))
+MULTI = int(os.environ.get("MULTI", "16"))
+PROMPT = 128
+STEPS_PREFILLED = 128  # match bench.py table sizing
+
+mcfg = MODEL_CONFIGS[os.environ.get("MODEL", "qwen3-0.6b")]
+PS = 64
+MP = (PROMPT + STEPS_PREFILLED) // PS + 2
+ecfg = EngineConfig(
+    kv_page_size=PS, max_pages_per_seq=MP, decode_batch_size=B,
+    max_model_len=PROMPT + STEPS_PREFILLED + 64,
+    param_dtype="bfloat16",
+)
+runner = ModelRunner(mcfg, ecfg)
+rng = np.random.default_rng(0)
+pages_per_seq = MP - 1
+tables = np.zeros((B, MP), np.int32)
+n = 1
+for b in range(B):
+    tables[b, :pages_per_seq] = np.arange(n, n + pages_per_seq)
+    n += pages_per_seq
+last = rng.integers(0, 50000, B).astype(np.int32)
+past = np.full((B,), 260, np.int32)
+temp = np.full((B,), 0.7, np.float32)
+top_p = np.full((B,), 0.95, np.float32)
+
+# compile
+toks, _ = runner.decode_multi(
+    last, past, tables, jax.random.PRNGKey(0), temp, top_p, MULTI
+)
+
+tracedir = "/tmp/jaxtrace"
+os.system(f"rm -rf {tracedir}")
+t0 = time.monotonic()
+with jax.profiler.trace(tracedir):
+    for i in range(4):
+        toks, _ = runner.decode_multi(
+            last, past, tables, jax.random.PRNGKey(i + 1), temp, top_p,
+            MULTI,
+        )
+    jax.block_until_ready(toks)
+wall = (time.monotonic() - t0) / (4 * MULTI) * 1e3
+print(f"wall: {wall:.2f} ms/decode-step (B={B}, multi={MULTI})")
+
+paths = glob.glob(f"{tracedir}/**/*.trace.json.gz", recursive=True)
+if not paths:
+    print("no trace found", glob.glob(f"{tracedir}/**", recursive=True))
+    sys.exit(1)
+with gzip.open(sorted(paths)[-1], "rt") as f:
+    trace = json.load(f)
+
+# device-lane complete events only
+dev_pids = set()
+for ev in trace["traceEvents"]:
+    if ev.get("ph") == "M" and ev.get("name") == "process_name":
+        name = ev.get("args", {}).get("name", "")
+        if "TPU" in name or "/device:" in name or "Chip" in name:
+            dev_pids.add(ev["pid"])
+
+bykey = defaultdict(float)
+total = 0.0
+for ev in trace["traceEvents"]:
+    if ev.get("ph") != "X" or ev.get("pid") not in dev_pids:
+        continue
+    # XLA op lanes have 'tid' names like 'XLA Ops'; keep leaf op events
+    name = ev.get("name", "")
+    dur = ev.get("dur", 0) / 1e3  # -> ms
+    args = ev.get("args", {})
+    if "run_id" in args or name.startswith("jit_"):
+        continue  # module-level envelope events, not leaf ops
+    key = name.split(".")[0].split("(")[0]
+    bykey[key] += dur
+    total += dur
+
+per_step = 4 * MULTI
+print(f"device op time total: {total/per_step:.3f} ms/step over {per_step} steps")
+for k, v in sorted(bykey.items(), key=lambda kv: -kv[1])[:40]:
+    print(f"  {v/per_step:8.4f} ms/step  {k}")

@@ -1,0 +1,143 @@
+"""Isolate one layer's decode attention: paged kernel (per-page vs
+chunked DMA) vs a dense batched-GQA jnp attention reading an equivalent
+[B, CTX] cache in place (the no-gather XLA ceiling)."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sutro_tpu.ops.pallas_paged import paged_decode_attention
+
+B = 64
+NH, KVH, Dh = 16, 8, 128
+PS, MP = 64, 8
+PAST = 260
+L = 28  # layers, for the per-step extrapolation printout
+
+rng = np.random.default_rng(0)
+NP = 1 + B * MP + MP  # + slack for chunked over-read
+q = jnp.asarray(rng.standard_normal((B, NH, Dh)), jnp.bfloat16)
+k_pages = jnp.asarray(rng.standard_normal((NP, PS, KVH, Dh)), jnp.bfloat16)
+v_pages = jnp.asarray(rng.standard_normal((NP, PS, KVH, Dh)), jnp.bfloat16)
+k_cur = jnp.asarray(rng.standard_normal((B, KVH, Dh)), jnp.bfloat16)
+v_cur = jnp.asarray(rng.standard_normal((B, KVH, Dh)), jnp.bfloat16)
+tables = np.zeros((B, MP), np.int32)
+n = 1
+for b in range(B):
+    tables[b] = np.arange(n, n + MP)
+    n += MP
+tables = jnp.asarray(tables)
+past = jnp.full((B,), PAST, jnp.int32)
+window = jnp.asarray(0, jnp.int32)
+
+
+def timeit(f, *args, reps=50):
+    out = f(*args)
+    jax.block_until_ready(out)
+    t0 = time.monotonic()
+    for _ in range(reps):
+        out = f(*args)
+    jax.block_until_ready(out)
+    return (time.monotonic() - t0) / reps * 1e3  # ms
+
+
+# --- paged kernel, per-page walk
+f1 = jax.jit(functools.partial(paged_decode_attention, kv_chunk=1))
+ms1 = timeit(f1, q, k_pages, v_pages, tables, past, k_cur, v_cur, window)
+
+# --- paged kernel, chunked (whole row in one DMA)
+f2 = jax.jit(functools.partial(paged_decode_attention, kv_chunk=MP))
+ms2 = timeit(f2, q, k_pages, v_pages, tables, past, k_cur, v_cur, window)
+
+
+# --- paged kernel with a 16-slot fused-window buffer (decode_multi's
+# actual configuration: W operands + per-head window finalize block)
+W = 16
+win_k = jnp.asarray(rng.standard_normal((B, W, KVH, Dh)), jnp.bfloat16)
+win_v = jnp.asarray(rng.standard_normal((B, W, KVH, Dh)), jnp.bfloat16)
+win_len = jnp.asarray(8, jnp.int32)
+f1w = jax.jit(functools.partial(paged_decode_attention, kv_chunk=1))
+ms1w = timeit(
+    f1w, q, k_pages, v_pages, tables, past, k_cur, v_cur, window,
+    None, win_k, win_v, win_len,
+)
+f2w = jax.jit(functools.partial(paged_decode_attention, kv_chunk=MP))
+ms2w = timeit(
+    f2w, q, k_pages, v_pages, tables, past, k_cur, v_cur, window,
+    None, win_k, win_v, win_len,
+)
+
+# --- dense ceiling: rows live at [B, CTX] directly, no table
+CTX = MP * PS
+k_dense = jnp.asarray(
+    rng.standard_normal((B, CTX, KVH, Dh)), jnp.bfloat16
+)
+v_dense = jnp.asarray(
+    rng.standard_normal((B, CTX, KVH, Dh)), jnp.bfloat16
+)
+
+
+@jax.jit
+def dense_attn(q, k_dense, v_dense, past, k_cur, v_cur):
+    qg = q.reshape(B, KVH, NH // KVH, Dh).astype(jnp.float32)
+    k = k_dense.astype(jnp.float32)
+    v = v_dense.astype(jnp.float32)
+    # s[b,h,g,t]
+    s = jnp.einsum("bhgd,bthd->bhgt", qg, k) * (Dh ** -0.5)
+    tok = jnp.arange(CTX, dtype=jnp.int32)[None, None, None, :]
+    ok = tok < past[:, None, None, None]
+    s = jnp.where(ok, s, -1e30)
+    s_cur = jnp.einsum("bhgd,bhd->bhg", qg, k_cur.astype(jnp.float32))
+    s_cur = s_cur * (Dh ** -0.5)
+    m = jnp.maximum(jnp.max(s, axis=-1), s_cur)
+    p = jnp.exp(s - m[..., None])
+    p_cur = jnp.exp(s_cur - m)
+    l = jnp.sum(p, axis=-1) + p_cur
+    acc = jnp.einsum("bhgt,bthd->bhgd", p, v)
+    acc = acc + p_cur[..., None] * v_cur.astype(jnp.float32)[:, :, None, :]
+    out = acc / l[..., None]
+    return out.reshape(B, NH, Dh).astype(q.dtype)
+
+
+ms3 = timeit(dense_attn, q, k_dense, v_dense, past, k_cur, v_cur)
+
+# dense bf16 variant (matmuls in bf16, softmax f32)
+@jax.jit
+def dense_attn_bf16(q, k_dense, v_dense, past, k_cur, v_cur):
+    qg = q.reshape(B, KVH, NH // KVH, Dh)
+    s = jnp.einsum(
+        "bhgd,bthd->bhgt", qg, k_dense,
+        preferred_element_type=jnp.float32,
+    ) * (Dh ** -0.5)
+    tok = jnp.arange(CTX, dtype=jnp.int32)[None, None, None, :]
+    ok = tok < past[:, None, None, None]
+    s = jnp.where(ok, s, -1e30)
+    s_cur = jnp.einsum(
+        "bhgd,bhd->bhg", qg, k_cur, preferred_element_type=jnp.float32
+    ) * (Dh ** -0.5)
+    m = jnp.maximum(jnp.max(s, axis=-1), s_cur)
+    p = jnp.exp(s - m[..., None])
+    p_cur = jnp.exp(s_cur - m)
+    l = jnp.sum(p, axis=-1) + p_cur
+    acc = jnp.einsum(
+        "bhgt,bthd->bhgd", p.astype(jnp.bfloat16), v_dense,
+        preferred_element_type=jnp.float32,
+    )
+    acc = acc + p_cur[..., None] * v_cur.astype(jnp.float32)[:, :, None, :]
+    out = acc / l[..., None]
+    return out.reshape(B, NH, Dh).astype(q.dtype)
+
+
+ms4 = timeit(dense_attn_bf16, q, k_dense, v_dense, past, k_cur, v_cur)
+
+kv_bytes = B * PAST * KVH * Dh * 2 * 2  # K+V, bf16, actual tokens
+print(f"B={B} past={PAST} ctx_cap={CTX} KV(actual)={kv_bytes/1e6:.0f} MB/layer")
+print(f"paged kernel per-page : {ms1:.3f} ms/layer -> {L*ms1:.1f} ms/step for {L} layers")
+print(f"paged kernel chunked  : {ms2:.3f} ms/layer -> {L*ms2:.1f} ms/step")
+print(f"per-page + window W=16: {ms1w:.3f} ms/layer -> {L*ms1w:.1f} ms/step")
+print(f"chunked  + window W=16: {ms2w:.3f} ms/layer -> {L*ms2w:.1f} ms/step")
+print(f"dense einsum f32      : {ms3:.3f} ms/layer -> {L*ms3:.1f} ms/step")
+print(f"dense einsum bf16     : {ms4:.3f} ms/layer -> {L*ms4:.1f} ms/step")
+print(f"roofline (819 GB/s)   : {kv_bytes/819e9*1e3:.3f} ms/layer")

@@ -114,10 +114,9 @@ class ModelRunner:
         # contiguous-KV chunked fetch (PERF.md next-step 1): pages per
         # decode-kernel DMA when a batch's page runs are contiguous
         # (contiguous-first allocators make that the common case).
-        # Opt-in via SUTRO_KV_CHUNK=1 until the chunked DMA form is
-        # validated on a real chip (interpret-mode parity is covered;
-        # the round's TPU tunnel died before a compiled run) — the
-        # per-page walk is the chip-validated default.
+        # Chip-validated (compiles and beats the per-page walk on v5e:
+        # 2521 vs 2430 tok/s on the bench config); default ON, opt out
+        # with SUTRO_KV_CHUNK=0.
         from ..ops.pallas_paged import chunk_pages_for
 
         self.kv_chunk = (
@@ -129,7 +128,7 @@ class ModelRunner:
                 dtype_bytes=dtype.itemsize,
             )
             if self.use_pallas
-            and os.environ.get("SUTRO_KV_CHUNK", "0") != "0"
+            and os.environ.get("SUTRO_KV_CHUNK", "1") != "0"
             else 1
         )
         if num_pages is None:
@@ -140,8 +139,11 @@ class ModelRunner:
             # kv_chunk-1 valid pages beyond it
             num_pages += self.kv_chunk - 1
         else:
-            # explicit pool size: chunked fetch is only safe with the
-            # slack the default sizing adds, so fall back to per-page
+            # Explicit pool size: chunked fetch is only safe with the
+            # slack the default sizing adds, so fall back to per-page —
+            # SUTRO_KV_CHUNK has no effect for callers that size their
+            # own pool (benchmarks/sweep_decode_*.py measure the
+            # per-page walk for this reason).
             self.kv_chunk = 1
         self.num_pages = num_pages
         # page count visible to allocators (excludes over-read slack)
