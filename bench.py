@@ -104,12 +104,13 @@ def main() -> None:
 
     # warmup (compile)
     if multi > 1:
-        toks_w, _ = runner.decode_multi(
+        toks_w, _ = runner.decode_multi_async(
             last, past_len, tables, jax.random.PRNGKey(0), temp, top_p,
             multi,
         )
         past_len += multi
-        last = toks_w[-1].astype(np.int32)
+        last = toks_w[-1]
+        jax.block_until_ready(toks_w)
     else:
         toks, _ = runner.decode_step(
             last, past_len, tables, jax.random.PRNGKey(0), temp, top_p
@@ -119,13 +120,23 @@ def main() -> None:
 
     t0 = time.monotonic()
     if multi > 1:
+        # pipelined windows: chain each window off the previous one's
+        # device-resident last-token row, fetching window i-1's tokens
+        # while window i computes — exactly the scheduler's pipelined
+        # path (decode_lookahead=2), so the tunnel round trip overlaps
+        # device compute on both the dispatch and the fetch side
+        prev = None
         for i in range(steps // multi):
-            toks_w, _ = runner.decode_multi(
+            toks_w, _ = runner.decode_multi_async(
                 last, past_len, tables, jax.random.PRNGKey(i + 1),
                 temp, top_p, multi,
             )
             past_len += multi
-            last = toks_w[-1].astype(np.int32)
+            last = toks_w[-1]
+            if prev is not None:
+                np.asarray(prev)  # host-side consume, one window behind
+            prev = toks_w
+        np.asarray(prev)
     else:
         for i in range(steps):
             toks, _ = runner.decode_step(

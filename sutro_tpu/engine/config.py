@@ -53,6 +53,13 @@ class EngineConfig:
                                     # program when no row needs host-side
                                     # FSM masks/seeds (runner.decode_multi);
                                     # amortizes dispatch+fetch latency
+    decode_lookahead: int = 2       # fused windows in flight at once on the
+                                    # unconstrained decode path: window k+1
+                                    # chains off window k's device-resident
+                                    # tokens, so the host<->device round
+                                    # trip is hidden behind device compute
+                                    # (scheduler pipelined windows); 1 =
+                                    # synchronous (process before dispatch)
     # --- generation defaults ----------------------------------------------
     max_new_tokens: int = 1024
     temperature: float = 0.7

@@ -513,7 +513,31 @@ class ModelRunner:
         top_k: Optional[np.ndarray] = None,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Returns (tokens [steps, B], logprobs [steps, B])."""
-        B = len(last_tokens)
+        toks, logps = self.decode_multi_async(
+            last_tokens, past_len, page_table, rng, temperature, top_p,
+            steps, top_k=top_k,
+        )
+        return np.asarray(toks), np.asarray(logps)
+
+    def decode_multi_async(
+        self,
+        last_tokens,                 # [B] int32 (numpy OR device array)
+        past_len: np.ndarray,        # [B] int32
+        page_table: np.ndarray,      # [B, MP] int32
+        rng: jax.Array,
+        temperature: np.ndarray,     # [B]
+        top_p: np.ndarray,           # [B]
+        steps: int,
+        top_k: Optional[np.ndarray] = None,
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Like ``decode_multi`` but returns DEVICE arrays without
+        blocking: dispatch is async, so callers can chain the next
+        window off ``toks[-1]`` (still on device) before this window's
+        results ever cross the host link. That hides the full
+        host<->device round trip — the dominant cost when the chip sits
+        behind a network tunnel (PERF.md round-2 profile: ~135 ms RTT vs
+        ~16 ms device compute per step)."""
+        B = past_len.shape[0]
         if top_k is None:
             top_k = np.zeros((B,), np.int32)
         toks, logps, self.cache = self._decode_multi_jit(
@@ -529,7 +553,22 @@ class ModelRunner:
             jnp.asarray(top_k, jnp.int32),
             self._chunk_for_table(page_table),
         )
-        return np.asarray(toks), np.asarray(logps)
+        return toks, logps
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _merge_last_jit(self, prev_last, refresh_mask, refresh_vals):
+        """Device-side merge for pipelined windows: rows whose slot was
+        re-admitted between dispatches take their host-known first token;
+        everyone else chains the previous window's last sampled token.
+        No host sync — all inputs are uploads or device arrays."""
+        return jnp.where(refresh_mask, refresh_vals, prev_last)
+
+    def merge_last(self, prev_last, refresh_mask, refresh_vals):
+        return self._merge_last_jit(
+            prev_last,
+            jnp.asarray(refresh_mask, bool),
+            jnp.asarray(refresh_vals, jnp.int32),
+        )
 
     # ------------------------------------------------------------------
     # speculative window decode (constrained rows)

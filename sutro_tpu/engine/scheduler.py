@@ -185,6 +185,10 @@ class ContinuousBatcher:
             else PageAllocator(alloc_pages)
         )
         self.slots: List[Optional[_Slot]] = [None] * self.B
+        # per-slot generation counter: bumped on release so a pipelined
+        # window dispatched against a slot's OLD occupant fails the
+        # (slot, gen) check at processing time after the slot is reused
+        self._gen = [0] * self.B
         self._key = jax.random.PRNGKey(seed)
         self._fixed_key = jax.random.PRNGKey(seed)
         self._step = 0
@@ -445,6 +449,7 @@ class ContinuousBatcher:
         else:
             self.allocator.free(slot.pages)
         self.slots[i] = None
+        self._gen[i] += 1
         out = list(slot.out_ids)
         reason = "stop"
         if out and out[-1] in self.stop_ids:
@@ -461,6 +466,101 @@ class ContinuousBatcher:
             finish_reason=reason,
             input_tokens=len(slot.req.prompt_ids),
         )
+
+    # ------------------------------------------------------------------
+    # pipelined fused windows (unconstrained decode fast path)
+    # ------------------------------------------------------------------
+
+    def _pipe_projection(self, pipe) -> np.ndarray:
+        """[B] extra decode steps already dispatched (in-flight windows)
+        but not yet processed, per slot — only windows whose (slot, gen)
+        snapshot still matches count."""
+        proj = np.zeros((self.B,), np.int32)
+        for _, _, w_active, w_gens, wK in pipe:
+            for idx, i in enumerate(w_active):
+                if self._gen[i] == w_gens[idx]:
+                    proj[i] += wK
+        return proj
+
+    def _pipe_capacity_ok(
+        self, active, proj: np.ndarray, K: int
+    ) -> bool:
+        """True when every active row's up-front page reservation covers
+        ``K`` more steps BEYOND everything already in flight — the
+        invariant that makes speculative window writes always land in
+        the row's own reserved pages."""
+        if not active:
+            return False
+        PS = self.ecfg.kv_page_size
+        for i in active:
+            s = self.slots[i]
+            if len(s.pages) * PS - s.pos - int(proj[i]) < K:
+                return False
+        return True
+
+    def _dispatch_pipelined(
+        self, pipe, active, last, past, table, temp, top_p, top_k,
+        K: int,
+    ) -> None:
+        """Dispatch one fused window WITHOUT waiting for in-flight ones.
+
+        ``past`` must already include the in-flight projection. The last
+        tokens chain from the previous window's device-resident sample
+        row; slots admitted (or re-admitted) since that dispatch take
+        their host-known token via a device-side merge — no host sync
+        anywhere on this path."""
+        if pipe:
+            prev_toks, _, p_active, p_gens, _ = pipe[-1]
+            chained = {
+                i
+                for idx, i in enumerate(p_active)
+                if p_gens[idx] == self._gen[i]
+            }
+            refresh = np.ones((self.B,), bool)
+            for i in chained:
+                refresh[i] = False
+            last_arg = self.runner.merge_last(
+                prev_toks[-1], refresh, np.asarray(last, np.int32)
+            )
+        else:
+            last_arg = last
+        self._key, sub = jax.random.split(self._key)
+        with self.timer.time("decode"):
+            toks_dev, logps_dev = self.runner.decode_multi_async(
+                last_arg, past, table, sub, temp, top_p, K, top_k=top_k
+            )
+        self._step += K
+        pipe.append(
+            (
+                toks_dev,
+                logps_dev,
+                list(active),
+                [self._gen[i] for i in active],
+                K,
+            )
+        )
+
+    def _process_pipelined(self, entry, on_result) -> Tuple[int, int]:
+        """Fetch one in-flight window's results (the only host sync in
+        the pipelined path) and accept its tokens. Tokens for slots
+        whose generation changed since dispatch (released, possibly
+        re-admitted) are discarded. Returns (tokens_accepted,
+        rows_finished)."""
+        toks_dev, logps_dev, w_active, w_gens, wK = entry
+        with self.timer.time("decode"):
+            toks = np.asarray(toks_dev)
+            logps = np.asarray(logps_dev)
+        out_toks = 0
+        done = 0
+        for j in range(wK):
+            for idx, i in enumerate(w_active):
+                if self._gen[i] != w_gens[idx] or self.slots[i] is None:
+                    continue
+                out_toks += 1
+                done += self._accept_token(
+                    i, int(toks[j][i]), float(logps[j][i]), on_result
+                )
+        return out_toks, done
 
     # ------------------------------------------------------------------
 
@@ -507,6 +607,9 @@ class ContinuousBatcher:
         input_tokens = 0
         output_tokens = 0
         rows_done = 0
+        # in-flight fused windows (pipelined unconstrained decode):
+        # entries are (toks_dev, logps_dev, active, gens, K)
+        pipe: List[Any] = []
         t_start = time.monotonic()
         t_last = t_start
 
@@ -540,6 +643,7 @@ class ContinuousBatcher:
                     if s is not None:
                         self._unreserve(i, s.pages)
                         self.slots[i] = None
+                        self._gen[i] += 1
                 return "yielded"
             # Admit as many pending rows as slots/pages allow, prefilling
             # them in batches of up to ``prefill_batch_size`` per device
@@ -639,6 +743,45 @@ class ContinuousBatcher:
                     row_seeds[i] = _step_seed(0x5EED0000 ^ (i + 1), self._step)
                 if s.req.constraint is not None:
                     has_constraint = True
+
+            # Pipelined fused windows: when no row needs host work
+            # between steps, window k+1 is dispatched chained off window
+            # k's device-resident tokens BEFORE window k's results cross
+            # the host link, hiding the host<->device round trip behind
+            # device compute (PERF.md: the RTT dominates when the chip
+            # sits behind a network tunnel). Page-capacity at dispatch
+            # covers every in-flight window, and (slot, generation)
+            # snapshots make stale windows' tokens discardable after a
+            # slot is released/reused mid-pipeline.
+            KS = self.ecfg.decode_multi_step
+            pipe_ok = (
+                KS > 1
+                and self.ecfg.decode_lookahead > 1
+                and not has_constraint
+                and not has_row_seed
+                and not self._needs_mask
+            )
+            if pipe_ok or pipe:
+                if pipe_ok:
+                    while len(pipe) < self.ecfg.decode_lookahead:
+                        proj = self._pipe_projection(pipe)
+                        if not self._pipe_capacity_ok(active, proj, KS):
+                            break
+                        self._dispatch_pipelined(
+                            pipe, active, last, past_len + proj, table,
+                            temp, top_p, top_k, KS,
+                        )
+                if pipe:
+                    # drain-one: also covers pipe_ok going false (e.g. a
+                    # constrained row admitted mid-pipeline) — windows
+                    # drain one per iteration, then other paths resume
+                    nt, nd = self._process_pipelined(pipe.pop(0), on_result)
+                    output_tokens += nt
+                    rows_done += nd
+                    progress()
+                    continue
+                # pipe empty and nothing dispatchable (capacity below
+                # one window): fall through to the single-step path
 
             # Fuse K decode steps into one device program when no row
             # needs host work between steps: one dispatch + one fetch per
@@ -771,3 +914,4 @@ class ContinuousBatcher:
                     )
             progress()
         progress(force=True)
+        return "completed"
