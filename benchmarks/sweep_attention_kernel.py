@@ -19,8 +19,8 @@ L = 28  # layers, for the per-step extrapolation printout
 rng = np.random.default_rng(0)
 NP = 1 + B * MP + MP  # + slack for chunked over-read
 q = jnp.asarray(rng.standard_normal((B, NH, Dh)), jnp.bfloat16)
-k_pages = jnp.asarray(rng.standard_normal((NP, PS, KVH, Dh)), jnp.bfloat16)
-v_pages = jnp.asarray(rng.standard_normal((NP, PS, KVH, Dh)), jnp.bfloat16)
+k_pages = jnp.asarray(rng.standard_normal((NP, PS, KVH * Dh)), jnp.bfloat16)
+v_pages = jnp.asarray(rng.standard_normal((NP, PS, KVH * Dh)), jnp.bfloat16)
 k_cur = jnp.asarray(rng.standard_normal((B, KVH, Dh)), jnp.bfloat16)
 v_cur = jnp.asarray(rng.standard_normal((B, KVH, Dh)), jnp.bfloat16)
 tables = np.zeros((B, MP), np.int32)
@@ -55,8 +55,8 @@ ms2 = timeit(f2, q, k_pages, v_pages, tables, past, k_cur, v_cur, window)
 # --- paged kernel with a 16-slot fused-window buffer (decode_multi's
 # actual configuration: W operands + per-head window finalize block)
 W = 16
-win_k = jnp.asarray(rng.standard_normal((B, W, KVH, Dh)), jnp.bfloat16)
-win_v = jnp.asarray(rng.standard_normal((B, W, KVH, Dh)), jnp.bfloat16)
+win_k = jnp.asarray(rng.standard_normal((B, W, KVH * Dh)), jnp.bfloat16)
+win_v = jnp.asarray(rng.standard_normal((B, W, KVH * Dh)), jnp.bfloat16)
 win_len = jnp.asarray(8, jnp.int32)
 f1w = jax.jit(functools.partial(paged_decode_attention, kv_chunk=1))
 ms1w = timeit(

@@ -32,8 +32,8 @@ dtype = cache.k_pages.dtype
 def make(do_sample, do_write):
     @jax.jit
     def f(params, cache, last, past, key):
-        wk0 = jnp.zeros((L, B, K, KVH, Dh), dtype)
-        wv0 = jnp.zeros((L, B, K, KVH, Dh), dtype)
+        wk0 = jnp.zeros((L, B, K, KVH * Dh), dtype)
+        wv0 = jnp.zeros((L, B, K, KVH * Dh), dtype)
         def body(carry, step_idx):
             wk, wv, last = carry
             logits, _, (k, v) = transformer.forward(
@@ -41,8 +41,10 @@ def make(do_sample, do_write):
                 paged_past=(cache.k_pages, cache.v_pages, tables),
                 past_len=past, window_past=(wk, wv, step_idx),
                 use_pallas=True)
-            wk = jax.lax.dynamic_update_slice(wk, k.astype(dtype), (0,0,step_idx,0,0))
-            wv = jax.lax.dynamic_update_slice(wv, v.astype(dtype), (0,0,step_idx,0,0))
+            wk = jax.lax.dynamic_update_slice(
+                wk, k.astype(dtype).reshape(L, B, 1, KVH * Dh), (0,0,step_idx,0))
+            wv = jax.lax.dynamic_update_slice(
+                wv, v.astype(dtype).reshape(L, B, 1, KVH * Dh), (0,0,step_idx,0))
             sl = logits[:, 0]
             if do_sample:
                 kk = jax.random.fold_in(key, step_idx)
@@ -54,8 +56,8 @@ def make(do_sample, do_write):
         (wk, wv, _), (toks, lps) = jax.lax.scan(body, (wk0, wv0, last), jnp.arange(K, dtype=jnp.int32))
         if do_write:
             c2 = write_kv(cache, wk, wv, tables, past, jnp.full((B,), K, jnp.int32), use_pallas=True)
-            return toks, c2.k_pages[0,0,0,0,0]
-        return toks, wk[0,0,0,0,0]
+            return toks, c2.k_pages[0,0,0,0]
+        return toks, wk[0,0,0,0]
     return f
 
 def timeit(name, fn):

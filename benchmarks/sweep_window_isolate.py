@@ -36,8 +36,8 @@ def make(mode):
     #       "nodus" (never update buffer), "nothread" (no window at all)
     @jax.jit
     def f(params, cache, last, past):
-        wk0 = jnp.zeros((L, B, K, KVH, Dh), dtype)
-        wv0 = jnp.zeros((L, B, K, KVH, Dh), dtype)
+        wk0 = jnp.zeros((L, B, K, KVH * Dh), dtype)
+        wv0 = jnp.zeros((L, B, K, KVH * Dh), dtype)
         def body(carry, step_idx):
             wk, wv, last = carry
             wp = None if mode == "nothread" else (wk, wv, step_idx)
@@ -46,12 +46,14 @@ def make(mode):
                 paged_past=(cache.k_pages, cache.v_pages, tables),
                 past_len=past, window_past=wp, use_pallas=True)
             if mode not in ("nodus",):
-                wk = jax.lax.dynamic_update_slice(wk, k.astype(dtype), (0,0,step_idx,0,0))
-                wv = jax.lax.dynamic_update_slice(wv, v.astype(dtype), (0,0,step_idx,0,0))
+                wk = jax.lax.dynamic_update_slice(
+                    wk, k.astype(dtype).reshape(L, B, 1, KVH * Dh), (0,0,step_idx,0))
+                wv = jax.lax.dynamic_update_slice(
+                    wv, v.astype(dtype).reshape(L, B, 1, KVH * Dh), (0,0,step_idx,0))
             tok = jnp.argmax(logits[:, 0, :1024], axis=-1).astype(jnp.int32)
             return (wk, wv, tok), tok
         (wk, wv, _), toks = jax.lax.scan(body, (wk0, wv0, last0), jnp.arange(K, dtype=jnp.int32))
-        return toks, wk[0,0,0,0,0]
+        return toks, wk[0,0,0,0]
     return f
 
 def timeit(name, fn, patch):

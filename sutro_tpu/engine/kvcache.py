@@ -4,18 +4,24 @@ TPU-native replacement for the server-side KV management the reference
 delegates to its remote fleet (SURVEY §2.3 row 1: "continuous-batching
 scheduler ... paged-KV decode attention"). Layout:
 
-- ``k_pages`` / ``v_pages``: ``[L, NP, PS, KVH, Dh]`` device arrays. Page 0
+- ``k_pages`` / ``v_pages``: ``[L, NP, PS, KVH*Dh]`` device arrays. Page 0
   is a reserved garbage page — padding tokens scatter there, so the write
-  path needs no masks or dynamic shapes.
+  path needs no masks or dynamic shapes. The KV-head and head-dim axes are
+  stored FUSED as one trailing axis: the Pallas decode kernel's
+  block-diagonal score/value matmuls contract over exactly that axis, and
+  Mosaic supports collapsing leading dims of a fetched page but not
+  merging (KVH, Dh) into the lane dim in-kernel — so the pool carries the
+  kernel-native layout and the small per-step tensors reshape outside.
 - ``page_table``: host-side ``numpy`` ``[B, MP]`` int32, passed into each
   jitted step as a device argument. Pages are allocated/freed by a
   host-side free list (allocation is control-plane work; the device only
   ever sees dense int32 tables).
 
-Gather (`gather_kv`) produces the fixed-size ``[L, B, CTX, KVH, Dh]`` view
-decode attention consumes; scatter (`write_kv`) lands a chunk's K/V into
-pages. Both are pure functions over pytrees, jitted as part of the runner's
-step functions.
+``write_kv`` lands a chunk's K/V into pages (Pallas in-place RMW kernel
+on TPU, XLA scatter fallback elsewhere); ``gather_kv_layer`` produces one
+layer's contiguous ``[B, CTX, KVH, Dh]`` view for the non-Pallas
+attention fallback. Both are pure functions over pytrees, jitted as part
+of the runner's step functions.
 """
 
 from __future__ import annotations
@@ -34,8 +40,8 @@ from .config import EngineConfig
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class KVCache:
-    k_pages: jax.Array  # [L, NP, PS, KVH, Dh]
-    v_pages: jax.Array  # [L, NP, PS, KVH, Dh]
+    k_pages: jax.Array  # [L, NP, PS, KVH*Dh]
+    v_pages: jax.Array  # [L, NP, PS, KVH*Dh]
 
     @property
     def page_size(self) -> int:
@@ -54,8 +60,7 @@ def alloc_cache(
         mcfg.num_layers,
         num_pages,
         ecfg.kv_page_size,
-        mcfg.num_kv_heads,
-        mcfg.head_dim,
+        mcfg.num_kv_heads * mcfg.head_dim,
     )
     return KVCache(k_pages=jnp.zeros(shape, dtype), v_pages=jnp.zeros(shape, dtype))
 
@@ -122,7 +127,7 @@ def pages_needed(length: int, page_size: int) -> int:
 
 def write_kv(
     cache: KVCache,
-    k_chunk: jax.Array,        # [L, B, T, KVH, Dh]
+    k_chunk: jax.Array,        # [L, B, T, KVH, Dh] or fused [L, B, T, KD]
     v_chunk: jax.Array,
     page_table: jax.Array,     # [B, MP] int32
     start: jax.Array,          # [B] int32 — global position of chunk token 0
@@ -132,73 +137,64 @@ def write_kv(
     """Scatter a chunk's K/V into pages. Padding positions are routed to
     garbage page 0. With ``use_pallas`` the write is a true in-place DMA
     (ops/pallas_kv.py) instead of an XLA scatter over the full pool."""
-    L, B, T, KVH, Dh = k_chunk.shape
+    if k_chunk.ndim == 4:  # already fused (decode window buffers)
+        L, B, T, KD = k_chunk.shape
+    else:
+        L, B, T, KVH, Dh = k_chunk.shape
+        KD = KVH * Dh
     PS = cache.page_size
     NP = cache.num_pages
+    if use_pallas:
+        from ..ops.pallas_kv import kv_write_pallas
+
+        k_pages, v_pages = kv_write_pallas(
+            cache.k_pages,
+            cache.v_pages,
+            k_chunk.reshape(L, B, T, KD).astype(cache.k_pages.dtype),
+            v_chunk.reshape(L, B, T, KD).astype(cache.v_pages.dtype),
+            page_table.astype(jnp.int32),
+            start.astype(jnp.int32),
+            valid_len.astype(jnp.int32),
+        )
+        return KVCache(k_pages=k_pages, v_pages=v_pages)
+
     pos = start[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]   # [B, T]
     valid = jnp.arange(T, dtype=jnp.int32)[None, :] < valid_len[:, None]
     page_idx = jnp.take_along_axis(page_table, pos // PS, axis=1)    # [B, T]
     flat = jnp.where(valid, page_idx * PS + pos % PS, 0)             # [B, T]
 
-    k_flat = cache.k_pages.reshape(L, NP * PS, KVH, Dh)
-    v_flat = cache.v_pages.reshape(L, NP * PS, KVH, Dh)
-    if use_pallas:
-        from ..ops.pallas_kv import kv_write_pallas
-
-        k_flat, v_flat = kv_write_pallas(
-            k_flat,
-            v_flat,
-            k_chunk.reshape(L, B * T, KVH, Dh).astype(k_flat.dtype),
-            v_chunk.reshape(L, B * T, KVH, Dh).astype(v_flat.dtype),
-            flat.reshape(-1).astype(jnp.int32),
-        )
-    else:
-        # advanced indexing [L dim kept, flat [B,T]] -> [L, B, T, KVH, Dh]
-        k_flat = k_flat.at[:, flat].set(k_chunk.astype(k_flat.dtype))
-        v_flat = v_flat.at[:, flat].set(v_chunk.astype(v_flat.dtype))
+    k_flat = cache.k_pages.reshape(L, NP * PS, KD)
+    v_flat = cache.v_pages.reshape(L, NP * PS, KD)
+    # advanced indexing [L dim kept, flat [B,T]] -> [L, B, T, KD]
+    k_flat = k_flat.at[:, flat].set(
+        k_chunk.reshape(L, B, T, KD).astype(k_flat.dtype)
+    )
+    v_flat = v_flat.at[:, flat].set(
+        v_chunk.reshape(L, B, T, KD).astype(v_flat.dtype)
+    )
     return KVCache(
-        k_pages=k_flat.reshape(L, NP, PS, KVH, Dh),
-        v_pages=v_flat.reshape(L, NP, PS, KVH, Dh),
+        k_pages=k_flat.reshape(L, NP, PS, KD),
+        v_pages=v_flat.reshape(L, NP, PS, KD),
     )
 
 
-def gather_kv(
-    cache: KVCache, page_table: jax.Array
-) -> Tuple[jax.Array, jax.Array]:
-    """[B, MP] page table -> contiguous ([L, B, CTX, KVH, Dh]) x2 view,
-    CTX = MP * PS. Invalid positions contain garbage; attention masks them
-    by ``past_len``.
-
-    NOTE: materializes the gathered view for ALL layers at once — decode
-    uses the per-layer path (``gather_kv_layer`` inside the layer scan)
-    instead, which keeps the transient at 1/L of this. Kept for tests and
-    small models.
-    """
-    L, NP, PS, KVH, Dh = cache.k_pages.shape
-    B, MP = page_table.shape
-    k = jnp.take(cache.k_pages, page_table.reshape(-1), axis=1)
-    v = jnp.take(cache.v_pages, page_table.reshape(-1), axis=1)
-    k = k.reshape(L, B, MP * PS, KVH, Dh)
-    v = v.reshape(L, B, MP * PS, KVH, Dh)
-    return k, v
-
-
 def gather_kv_layer(
-    k_pages_l: jax.Array,  # [NP, PS, KVH, Dh] — one layer's pages
+    k_pages_l: jax.Array,  # [NP, PS, KVH*Dh] — one layer's pages
     v_pages_l: jax.Array,
     page_table: jax.Array,  # [B, MP] int32
+    kv_heads: int,
 ) -> Tuple[jax.Array, jax.Array]:
     """Per-layer page gather: [B, MP] table -> ([B, CTX, KVH, Dh]) x2,
     CTX = MP * PS. Used inside the layer scan so only one layer's context
     view is ever live (the XLA fallback when the Pallas paged kernel does
     not run — the kernel reads pages in place and skips this copy)."""
-    NP, PS, KVH, Dh = k_pages_l.shape
+    NP, PS, KD = k_pages_l.shape
     B, MP = page_table.shape
     k = jnp.take(k_pages_l, page_table.reshape(-1), axis=0)
     v = jnp.take(v_pages_l, page_table.reshape(-1), axis=0)
     return (
-        k.reshape(B, MP * PS, KVH, Dh),
-        v.reshape(B, MP * PS, KVH, Dh),
+        k.reshape(B, MP * PS, kv_heads, KD // kv_heads),
+        v.reshape(B, MP * PS, kv_heads, KD // kv_heads),
     )
 
 

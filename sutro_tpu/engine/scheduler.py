@@ -516,12 +516,18 @@ class ContinuousBatcher:
                 for idx, i in enumerate(p_active)
                 if p_gens[idx] == self._gen[i]
             }
-            refresh = np.ones((self.B,), bool)
-            for i in chained:
-                refresh[i] = False
-            last_arg = self.runner.merge_last(
-                prev_toks[-1], refresh, np.asarray(last, np.int32)
-            )
+            if all(i in chained for i in active):
+                # steady state: every active row chains from the previous
+                # window — skip the merge program entirely (tokens at
+                # non-active slots are garbage either way)
+                last_arg = prev_toks[-1]
+            else:
+                refresh = np.ones((self.B,), bool)
+                for i in chained:
+                    refresh[i] = False
+                last_arg = self.runner.merge_last(
+                    prev_toks[-1], refresh, np.asarray(last, np.int32)
+                )
         else:
             last_arg = last
         self._key, sub = jax.random.split(self._key)

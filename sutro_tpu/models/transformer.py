@@ -235,7 +235,7 @@ def layer_apply(
     use_pallas: bool = False,
     ring_mesh=None,
     wk_l: Optional[jax.Array] = None,   # this layer's fused-decode
-    wv_l: Optional[jax.Array] = None,   # window buffer [B, W, KVH, Dh]
+    wv_l: Optional[jax.Array] = None,   # window buffer [B, W, KVH*Dh]
     win_len: Optional[jax.Array] = None,
     kv_chunk: int = 1,
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
@@ -360,14 +360,15 @@ def forward(
     positions: jax.Array,               # [B, T] int32 (global positions)
     valid_len: jax.Array,               # [B] int32 — tokens of chunk that are real
     paged_past: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
-    # paged_past: (k_pages, v_pages, page_table) — pages [L, NP, PS, KVH,
-    # Dh] scanned per layer, table [B, MP]. Attention reads pages directly
-    # (Pallas) or gathers one layer's view at a time (XLA fallback) — the
-    # full [L, B, CTX, ...] gather is never materialized.
+    # paged_past: (k_pages, v_pages, page_table) — pages [L, NP, PS,
+    # KVH*Dh] (FUSED trailing axis, engine/kvcache.py) scanned per
+    # layer, table [B, MP]. Attention reads pages directly (Pallas) or
+    # gathers one layer's view at a time (XLA fallback) — the full
+    # [L, B, CTX, ...] gather is never materialized.
     past_len: Optional[jax.Array] = None,  # [B] int32 — valid past tokens
     use_pallas: bool = False,
     ring_mesh=None,  # Mesh with "seq" axis > 1 => ring-attention prefill
-    # fused-decode window buffer: (win_k [L, B, W, KVH, Dh], win_v,
+    # fused-decode window buffer: (win_k [L, B, W, KVH*Dh] fused, win_v,
     # win_len scalar) — K/V of window tokens not yet in the page pool
     # (runner.decode_multi writes pages once per window, not per step)
     window_past: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,

@@ -38,9 +38,10 @@ def chunk_attention(
     past_v: Optional[jax.Array] = None,
     past_len: Optional[jax.Array] = None,  # [B]
     # paged past (decode): one layer's page pool + table; mutually
-    # exclusive with past_k/past_v. The Pallas paged kernel reads pages in
-    # place; the fallback gathers this layer's contiguous view.
-    past_k_pages: Optional[jax.Array] = None,  # [NP, PS, KVH, Dh]
+    # exclusive with past_k/past_v. Pools carry the FUSED [NP, PS,
+    # KVH*Dh] layout (engine/kvcache.py). The Pallas paged kernel reads
+    # pages in place; the fallback gathers this layer's contiguous view.
+    past_k_pages: Optional[jax.Array] = None,  # [NP, PS, KVH*Dh]
     past_v_pages: Optional[jax.Array] = None,
     page_table: Optional[jax.Array] = None,    # [B, MP] int32
     window: Optional[jax.Array] = None,    # scalar int32; 0 => full attention
@@ -50,8 +51,8 @@ def chunk_attention(
                                            # sequence-parallel ring prefill
     # fused-decode window buffer (runner.decode_multi): K/V of tokens
     # sampled earlier in the window, not yet written to the page pool.
-    # win_k/win_v [B, W, KVH, Dh]; win_len scalar = valid slots, their
-    # positions are past_len + slot.
+    # win_k/win_v [B, W, KVH*Dh] (FUSED trailing axis, matching the page
+    # pool); win_len scalar = valid slots, positions are past_len + slot.
     win_k: Optional[jax.Array] = None,
     win_v: Optional[jax.Array] = None,
     win_len: Optional[jax.Array] = None,
@@ -92,7 +93,7 @@ def chunk_attention(
         from ..engine.kvcache import gather_kv_layer
 
         past_k, past_v = gather_kv_layer(
-            past_k_pages, past_v_pages, page_table
+            past_k_pages, past_v_pages, page_table, k.shape[2]
         )
 
     if use_pallas:
@@ -127,11 +128,12 @@ def chunk_attention(
         ]
         if win_k is not None and win_k.shape[1] > 0:
             # fused-window tokens: positions past_len + slot, valid
-            # while slot < win_len (they are not in the pages yet)
+            # while slot < win_len (they are not in the pages yet);
+            # buffers arrive lane-fused [B, W, KVH*Dh]
             W = win_k.shape[1]
             slot = jnp.arange(W, dtype=jnp.int32)[None]
-            key_segs.insert(1, win_k)
-            val_segs.insert(1, win_v)
+            key_segs.insert(1, win_k.reshape(B, W, KVH, Dh))
+            val_segs.insert(1, win_v.reshape(B, W, KVH, Dh))
             pos_segs.insert(1, past_len[:, None] + slot)
             valid_segs.insert(
                 1, jnp.broadcast_to(slot < win_len, (B, W))

@@ -1,17 +1,31 @@
-"""Pallas TPU kernel: in-place KV page writes.
+"""Pallas TPU kernel: in-place KV page writes (fused-layout pool).
 
-The XLA path for landing a decode step's K/V into the paged cache is a
-scatter over a ~GB-scale buffer; under jit donation that costs several ms
-per step of pure buffer churn (measured ~8 ms/donated buffer through the
-axon PJRT path, ~57 ms for the full two-tensor scatter). This kernel makes
-the write a true in-place DMA: grid over (layer, token), each step copies
-one [KVH, D] tile into its (page, slot) destination, with
-``input_output_aliases`` pinning the output to the input buffer — no
-copies, no churn.
+The XLA path for landing a chunk's K/V into the paged cache is a scatter
+over a ~GB-scale buffer; under jit donation that costs several ms per
+call of pure buffer churn (measured ~8 ms/donated buffer through the
+axon PJRT path round 1). This kernel keeps the pool in place with
+``input_output_aliases`` and explicit DMAs.
 
-Used by engine/runner for both decode (N = batch) and prefill (N = B*T
-chunk tokens); invalid/padding tokens are routed to flat index 0, the
-reserved garbage page (kvcache.py convention).
+Constraint driving the design: the pool's fused layout ``[L, NP, PS,
+KVH*Dh]`` (engine/kvcache.py) makes the page-slot axis a TILED memref
+dim, so single-row DMA writes are illegal (8-row alignment). Instead the
+kernel is a page-granular read-modify-write:
+
+- the token run of each batch row is split IN-GRAPH into per-page
+  segments (page id, row range, shift), passed as scalar prefetch;
+- grid ``(segments, layer-chunks)``: each step DMAs a ``[lc, PS, KD]``
+  slab of the target page (``lc`` layers at once, sized to a VMEM
+  budget — fewer, bigger DMAs), rotates the row's token buffer so token
+  ``j`` lands on its page row ((start+j) % PS) via ``pltpu.roll``
+  (dynamic shift, f32 — Mosaic's rotate is 32-bit only), blends rows
+  inside the segment's range, and DMAs the slab back;
+- empty segments (rows whose run touches fewer pages than the static
+  bound, padding rows) skip all work under ``pl.when``.
+
+The RMW costs one extra page read per touched page — writes happen once
+per prefill chunk / decode window, so this is noise next to the decode
+loop — and buys exact in-place semantics at any offset with zero pool
+copies or padding blowup.
 """
 
 from __future__ import annotations
@@ -26,59 +40,176 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 def _kv_write_kernel(
-    flat_idx_ref,  # scalar prefetch [N]
-    k_new_ref,     # [L, 1, KVH, D] block — all layers of one token
+    # scalar prefetch (flattened [B*S] segment tables)
+    seg_page_ref, seg_rs_ref, seg_re_ref, seg_shift_ref, seg_row_ref,
+    # operands
+    k_new_ref,     # VMEM block [lc, 1, Tb, KD] — (layer chunk, seg row)
     v_new_ref,
-    k_io_ref,      # aliased in/out blocks (unused as input)
+    k_io_ref,      # ANY [L, NP, PS, KD] aliased inputs
     v_io_ref,
-    k_out_ref,
+    k_out_ref,     # ANY aliased outputs
     v_out_ref,
+    # scratch
+    kpage, vpage, ksem, vsem,
+    *,
+    page_size: int,
+    layer_chunk: int,
 ):
-    del flat_idx_ref, k_io_ref, v_io_ref
-    k_out_ref[...] = k_new_ref[...]
-    v_out_ref[...] = v_new_ref[...]
+    del k_io_ref, v_io_ref
+    s = pl.program_id(0)
+    lchunk = pl.program_id(1)
+    PS = page_size
+    lc = layer_chunk
+    page = seg_page_ref[s]
+    rs = seg_rs_ref[s]
+    re = seg_re_ref[s]
+
+    @pl.when(re > rs)
+    def _do():
+        lsl = pl.ds(lchunk * lc, lc)
+        kin = pltpu.make_async_copy(
+            k_out_ref.at[lsl, page], kpage, ksem
+        )
+        vin = pltpu.make_async_copy(
+            v_out_ref.at[lsl, page], vpage, vsem
+        )
+        kin.start()
+        vin.start()
+
+        # token j lives at page row (start + j) % PS; rolling the token
+        # buffer by -shift puts token (r + shift) at row r for every r
+        shift = seg_shift_ref[s]
+        Tb = k_new_ref.shape[2]
+        row = jax.lax.broadcasted_iota(
+            jnp.int32, (PS, k_new_ref.shape[3]), 0
+        )
+        sel = jnp.logical_and(row >= rs, row < re)
+
+        def rotated(tok):  # [Tb, KD] -> [PS, KD] rolled into page rows
+            t = tok.astype(jnp.float32)
+            if Tb < PS:  # decode windows are narrower than a page
+                t = jnp.concatenate(
+                    [t, jnp.zeros((PS - Tb, t.shape[-1]), jnp.float32)],
+                    axis=0,
+                )
+            return pltpu.roll(t, -shift, 0)[:PS]
+
+        kin.wait()
+        vin.wait()
+        for j in range(lc):  # static unroll over the layer chunk
+            krot = rotated(k_new_ref[j, 0])
+            vrot = rotated(v_new_ref[j, 0])
+            kpage[j] = jnp.where(
+                sel, krot.astype(kpage.dtype), kpage[j]
+            )
+            vpage[j] = jnp.where(
+                sel, vrot.astype(vpage.dtype), vpage[j]
+            )
+
+        kout = pltpu.make_async_copy(
+            kpage, k_out_ref.at[lsl, page], ksem
+        )
+        vout = pltpu.make_async_copy(
+            vpage, v_out_ref.at[lsl, page], vsem
+        )
+        kout.start()
+        vout.start()
+        kout.wait()
+        vout.wait()
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _layer_chunk(L: int, Tb: int, PS: int, KD: int, itemsize: int) -> int:
+    """Largest divisor of L whose token blocks + page slabs fit a ~4 MiB
+    VMEM budget per tensor."""
+    budget = 4 << 20
+    per_layer = (Tb + PS) * KD * itemsize
+    lc = max(1, min(L, budget // max(per_layer, 1)))
+    while L % lc:
+        lc -= 1
+    return lc
+
+
+@functools.partial(
+    jax.jit, donate_argnums=(0, 1), static_argnames=("interpret",)
+)
 def kv_write_pallas(
-    k_pages: jax.Array,   # [L, R, KVH, D]  (R = NP * PS, flat rows)
+    k_pages: jax.Array,   # [L, NP, PS, KD] fused page pool
     v_pages: jax.Array,
-    k_new: jax.Array,     # [L, N, KVH, D]
+    k_new: jax.Array,     # [L, B, Tb, KD]
     v_new: jax.Array,
-    flat_idx: jax.Array,  # [N] int32 row index into R (0 = garbage)
+    page_table: jax.Array,  # [B, MP] int32
+    start: jax.Array,       # [B] int32 — global position of token 0
+    valid_len: jax.Array,   # [B] int32 — real tokens in the chunk
+    *,
+    interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
-    L, R, KVH, D = k_pages.shape
-    N = k_new.shape[1]
+    L, NP, PS, KD = k_pages.shape
+    _, B, Tb, _ = k_new.shape
+    MP = page_table.shape[1]
 
-    # one grid step per token, whole layer stack in one block: N DMAs of
-    # L*KVH*D elements each, instead of L*N tiny tile copies
+    # per-(row, page) segments; a run of Tb tokens at any offset touches
+    # at most ceil(Tb/PS)+1 pages
+    S = (Tb + PS - 1) // PS + 1
+    si = jnp.arange(S, dtype=jnp.int32)[None, :]          # [1, S]
+    start = start.astype(jnp.int32)[:, None]              # [B, 1]
+    end = start + valid_len.astype(jnp.int32)[:, None]
+    pi = start // PS + si                                 # [B, S]
+    page = jnp.take_along_axis(
+        page_table.astype(jnp.int32), jnp.clip(pi, 0, MP - 1), axis=1
+    )
+    lo = jnp.maximum(start, pi * PS)
+    hi = jnp.minimum(end, (pi + 1) * PS)
+    rs = lo - pi * PS
+    re = jnp.maximum(hi - pi * PS, rs)                    # empty => re==rs
+    # page 0 is the garbage page: it backs padding rows' tables, and
+    # clipped out-of-table indices may alias real entries — mask those
+    # segments off entirely (re = rs)
+    ok = jnp.logical_and(page > 0, pi < MP)
+    re = jnp.where(ok, re, rs)
+    shift = pi * PS - start                               # [B, S]
+    row = jnp.broadcast_to(
+        jnp.arange(B, dtype=jnp.int32)[:, None], (B, S)
+    )
+
+    lc = _layer_chunk(L, Tb, PS, KD, k_pages.dtype.itemsize)
+    kernel = functools.partial(
+        _kv_write_kernel, page_size=PS, layer_chunk=lc
+    )
+    any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
     new_spec = pl.BlockSpec(
-        (L, 1, KVH, D), lambda n, idx: (0, n, 0, 0)
+        (lc, 1, Tb, KD), lambda s, l, *refs: (l, refs[4][s], 0, 0)
     )
-    io_spec = pl.BlockSpec(
-        (L, 1, KVH, D), lambda n, idx: (0, idx[n], 0, 0)
-    )
-
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(N,),
-        in_specs=[new_spec, new_spec, io_spec, io_spec],
-        out_specs=[io_spec, io_spec],
+        num_scalar_prefetch=5,
+        grid=(B * S, L // lc),
+        in_specs=[new_spec, new_spec, any_spec, any_spec],
+        out_specs=[any_spec, any_spec],
+        scratch_shapes=[
+            pltpu.VMEM((lc, PS, KD), k_pages.dtype),
+            pltpu.VMEM((lc, PS, KD), v_pages.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
     )
     out_k, out_v = pl.pallas_call(
-        _kv_write_kernel,
+        kernel,
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
             jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
         ],
-        # flattened operand order: flat_idx(0), k_new(1), v_new(2),
-        # k_pages(3), v_pages(4) -> outputs 0, 1
-        input_output_aliases={3: 0, 4: 1},
+        # flattened operands: scalars(0-4), k_new(5), v_new(6),
+        # k_pages(7), v_pages(8) -> outputs 0, 1
+        input_output_aliases={7: 0, 8: 1},
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",),
+            dimension_semantics=("arbitrary", "arbitrary"),
         ),
-    )(flat_idx, k_new, v_new, k_pages, v_pages)
+        interpret=interpret,
+    )(
+        page.reshape(-1), rs.reshape(-1), re.reshape(-1),
+        shift.reshape(-1), row.reshape(-1),
+        k_new, v_new, k_pages, v_pages,
+    )
     return out_k, out_v
 
 

@@ -72,7 +72,10 @@ def _paged_decode_kernel(
     PS = page_size
     CH = chunk_pages
     CT = CH * PS  # tokens per fetched chunk
-    G = q_ref.shape[2]
+    NH = q_ref.shape[1]
+    Dh = q_ref.shape[2]
+    G = NH // kvh
+    KD = kvh * Dh
 
     past = past_len_ref[b]
     nchunks = (past + CT - 1) // CT
@@ -80,6 +83,28 @@ def _paged_decode_kernel(
     # fused-window tokens not yet written back
     pos = past + (win_len_ref[0] if window_slots else 0)
     win = window_ref[0]
+
+    # Block-diagonal queries: fold the per-KV-head loop into ONE score
+    # matmul and ONE value matmul per chunk. Row i (= head i, KV head
+    # i // G) of q_bd carries q[i] in column block i // G of the fused
+    # [KVH*Dh] axis and zeros elsewhere, so q_bd @ k_chunk.T computes
+    # every head's scores in a single MXU op (the off-block FLOPs are
+    # wasted but free — the kernel is bound by op count / latency, not
+    # MXU throughput: 2*KVH tiny per-head dots per chunk cost ~3x more
+    # wall time than these two). Mosaic cannot merge (KVH, Dh) into the
+    # lane dim in-kernel, so the page pool arrives pre-fused [.., KD]
+    # and lane-space masks are built from iota instead of reshapes.
+    q = q_ref[0].astype(jnp.float32)                      # [NH, Dh]
+    row_head = jax.lax.broadcasted_iota(jnp.int32, (NH, KD), 0) // G
+    col_head = jax.lax.broadcasted_iota(jnp.int32, (NH, KD), 1) // Dh
+    blk_kd = (row_head == col_head).astype(jnp.float32)   # [NH, KD]
+    q_rep = jnp.concatenate([q] * kvh, axis=1)            # [NH, KD]
+    q_bd = q_rep * blk_kd
+    # selector S[kd, d] = (kd % Dh == d): one dot extracts each row's
+    # own head block from fused-lane space back to [NH, Dh]
+    sel_kd = jax.lax.broadcasted_iota(jnp.int32, (KD, Dh), 0)
+    sel_d = jax.lax.broadcasted_iota(jnp.int32, (KD, Dh), 1)
+    S = (sel_kd % Dh == sel_d).astype(jnp.float32)        # [KD, Dh]
 
     m_ref[...] = jnp.full_like(m_ref, NEG_INF)
     l_ref[...] = jnp.zeros_like(l_ref)
@@ -137,88 +162,93 @@ def _paged_decode_kernel(
         v_dma(i, slot).wait()
 
         chunk_start = i * CT
-        tok = chunk_start + jax.lax.broadcasted_iota(jnp.int32, (G, CT), 1)
+        tok = chunk_start + jax.lax.broadcasted_iota(
+            jnp.int32, (NH, CT), 1
+        )
         ok = tok < past
         # windowless (win <= 0) ORed in instead of a boolean select —
         # Mosaic cannot legalize arith.select on i1 vectors
         ok = jnp.logical_and(
             ok, jnp.logical_or(pos - tok < win, win <= 0)
         )
-        for h in range(kvh):  # static unroll over KV heads
-            q = q_ref[0, h].astype(jnp.float32)          # [G, Dh]
-            k = kbuf[slot, :, :, h, :].reshape(CT, -1).astype(jnp.float32)
-            v = vbuf[slot, :, :, h, :].reshape(CT, -1).astype(jnp.float32)
-            s = jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scale                                    # [G, PS]
-            s = jnp.where(ok, s, NEG_INF)
+        # [CH, PS, KD] -> [CT, KD]: leading-dim collapse only (the lane
+        # dim KD is untouched — Mosaic supports this shape cast)
+        k = kbuf[slot].reshape(CT, KD).astype(jnp.float32)
+        v = vbuf[slot].reshape(CT, KD).astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q_bd, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                        # [NH, CT]
+        s = jnp.where(ok, s, NEG_INF)
 
-            m_prev = m_ref[h, :, 0]                      # [G]
-            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
-            alpha = jnp.exp(m_prev - m_new)              # [G]
-            p = jnp.exp(s - m_new[:, None])              # [G, PS]
-            l_new = l_ref[h, :, 0] * alpha + jnp.sum(p, axis=1)
-            l_ref[h] = jnp.broadcast_to(
-                l_new[:, None], l_ref.shape[1:]
-            )
-            acc_ref[h] = acc_ref[h] * alpha[:, None] + jax.lax.dot_general(
-                p, v, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            m_ref[h] = jnp.broadcast_to(
-                m_new[:, None], m_ref.shape[1:]
-            )
+        m_prev = m_ref[:, 0]                             # [NH]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_prev - m_new)                  # [NH]
+        p = jnp.exp(s - m_new[:, None])                  # [NH, CT]
+        l_new = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
+        l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+        # acc holds the full [NH, KVH*Dh] product; only each row's own
+        # head block is meaningful (extracted at the end)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
         return 0
 
     jax.lax.fori_loop(0, nchunks, page_step, 0)
 
-    # finalize: fused-window tokens + current token + attention sink
+    # finalize: fused-window tokens + current token + attention sink,
+    # in the same block-diagonal space (2 dots total, not 2 per head)
     W = window_slots
-    for h in range(kvh):
-        q = q_ref[0, h].astype(jnp.float32)              # [G, Dh]
-        k_cur = k_cur_ref[0, h].astype(jnp.float32)      # [Dh]
-        v_cur = v_cur_ref[0, h].astype(jnp.float32)      # [Dh]
-        sink = sink_ref[h].astype(jnp.float32)           # [G]
+    k_cur = k_cur_ref[0].astype(jnp.float32)             # [1, KD]
+    v_cur = v_cur_ref[0].astype(jnp.float32)             # [1, KD]
+    sink = sink_ref[0].astype(jnp.float32)               # [NH]
 
-        s_self = jnp.sum(q * k_cur[None, :], axis=1) * scale  # [G]
-        m_prev = m_ref[h, :, 0]
-        m_new = jnp.maximum(m_prev, jnp.maximum(s_self, sink))
-        if W:
-            # window tokens: slot s holds the fused window's s-th
-            # sampled token at position past+s; the query is at pos
-            wlen = win_len_ref[0]
-            wk = wk_ref[0, :, h, :].astype(jnp.float32)  # [W, Dh]
-            wv = wv_ref[0, :, h, :].astype(jnp.float32)
-            slot_i = jax.lax.broadcasted_iota(jnp.int32, (G, W), 1)
-            ok_w = slot_i < wlen
-            ok_w = jnp.logical_and(
-                ok_w,
-                jnp.logical_or(wlen - slot_i < win, win <= 0),
-            )
-            s_w = jax.lax.dot_general(
-                q, wk, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scale                                    # [G, W]
-            s_w = jnp.where(ok_w, s_w, NEG_INF)
-            m_new = jnp.maximum(m_new, jnp.max(s_w, axis=1))
-        alpha = jnp.exp(m_prev - m_new)
-        p_self = jnp.exp(s_self - m_new)
-        p_sink = jnp.exp(sink - m_new)
-        l = l_ref[h, :, 0] * alpha + p_self + p_sink
-        acc = (
-            acc_ref[h] * alpha[:, None]
-            + p_self[:, None] * v_cur[None, :]
+    s_self = jax.lax.dot_general(
+        q_bd, k_cur, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )[:, 0] * scale                                      # [NH]
+    m_prev = m_ref[:, 0]
+    m_new = jnp.maximum(m_prev, jnp.maximum(s_self, sink))
+    if W:
+        # window tokens: slot s holds the fused window's s-th sampled
+        # token at position past+s; the query is at pos
+        wlen = win_len_ref[0]
+        wk = wk_ref[0].astype(jnp.float32)               # [W, KD]
+        wv = wv_ref[0].astype(jnp.float32)
+        slot_i = jax.lax.broadcasted_iota(jnp.int32, (NH, W), 1)
+        ok_w = slot_i < wlen
+        ok_w = jnp.logical_and(
+            ok_w,
+            jnp.logical_or(wlen - slot_i < win, win <= 0),
         )
-        if W:
-            p_w = jnp.exp(s_w - m_new[:, None])          # [G, W]
-            l = l + jnp.sum(p_w, axis=1)
-            acc = acc + jax.lax.dot_general(
-                p_w, wv, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-        out = acc / jnp.maximum(l, 1e-30)[:, None]
-        out_ref[0, h] = out.astype(out_ref.dtype)
+        s_w = jax.lax.dot_general(
+            q_bd, wk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                        # [NH, W]
+        s_w = jnp.where(ok_w, s_w, NEG_INF)
+        m_new = jnp.maximum(m_new, jnp.max(s_w, axis=1))
+    alpha = jnp.exp(m_prev - m_new)
+    p_self = jnp.exp(s_self - m_new)
+    p_sink = jnp.exp(sink - m_new)
+    l = l_ref[:, 0] * alpha + p_self + p_sink
+    acc = acc_ref[...] * alpha[:, None] + p_self[:, None] * v_cur
+    if W:
+        p_w = jnp.exp(s_w - m_new[:, None])              # [NH, W]
+        l = l + jnp.sum(p_w, axis=1)
+        acc = acc + jax.lax.dot_general(
+            p_w, wv, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    # extract each row's own head block from the block-diagonal acc:
+    # zero the off-blocks, then sum the lane blocks with the selector dot
+    acc_bd = jax.lax.dot_general(
+        acc * blk_kd, S, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                    # [NH, Dh]
+    out = acc_bd / jnp.maximum(l, 1e-30)[:, None]
+    out_ref[0] = out.astype(out_ref.dtype)
 
 
 # Below this table capacity (tokens) the XLA gather fallback wins on
@@ -273,7 +303,7 @@ def paged_decode_supported(
 )
 def paged_decode_attention(
     q: jax.Array,          # [B, NH, Dh] — current-step queries
-    k_pages: jax.Array,    # [NP, PS, KVH, Dh] — one layer's page pool
+    k_pages: jax.Array,    # [NP, PS, KVH*Dh] — one layer's FUSED page pool
     v_pages: jax.Array,
     page_table: jax.Array, # [B, MP] int32
     past_len: jax.Array,   # [B] int32 — tokens already in the cache
@@ -281,7 +311,7 @@ def paged_decode_attention(
     v_cur: jax.Array,
     window: jax.Array,     # scalar int32; 0 => full attention
     sink: Optional[jax.Array] = None,   # [NH] logits or None
-    win_k: Optional[jax.Array] = None,  # [B, W, KVH, Dh] fused-window K
+    win_k: Optional[jax.Array] = None,  # [B, W, KVH*Dh] fused-window K
     win_v: Optional[jax.Array] = None,
     win_len: Optional[jax.Array] = None,  # scalar int32 — valid slots
     *,
@@ -290,23 +320,28 @@ def paged_decode_attention(
 ) -> jax.Array:
     """Returns [B, NH, Dh] attention outputs for one decode step.
 
+    The page pools carry the fused ``[NP, PS, KVH*Dh]`` layout
+    (engine/kvcache.py): the kernel's block-diagonal matmuls contract
+    over exactly that axis. The small per-step tensors (k_cur, win_k,
+    sink) are reshaped into the fused layout HERE, outside the kernel,
+    where XLA reshapes are free.
+
     ``win_k/win_v/win_len`` carry the multi-step decode window buffer
     (engine/runner decode_multi): tokens sampled earlier in the fused
     window whose K/V have NOT been written to the page pool yet — the
     bulk page write happens once per window, outside the step scan, so
     the multi-GB pool is never copied per step."""
     B, NH, Dh = q.shape
-    NP, PS, KVH, _ = k_pages.shape
+    NP, PS, KD = k_pages.shape
+    KVH = k_cur.shape[1]
     MP = page_table.shape[1]
-    G = NH // KVH
     scale = Dh ** -0.5
     W = 0 if win_k is None else win_k.shape[1]
 
-    qg = q.reshape(B, KVH, G, Dh)
     if sink is None:
-        sink_g = jnp.full((KVH, G), NEG_INF, jnp.float32)
+        sink_g = jnp.full((1, NH), NEG_INF, jnp.float32)
     else:
-        sink_g = sink.astype(jnp.float32).reshape(KVH, G)
+        sink_g = sink.astype(jnp.float32).reshape(1, NH)
 
     kernel = functools.partial(
         _paged_decode_kernel,
@@ -321,50 +356,54 @@ def paged_decode_attention(
     # index maps take *s so the scalar-prefetch arity (3 without a
     # window buffer, 4 with) needs no per-case lambdas
     in_specs = [
-        pl.BlockSpec((1, KVH, G, Dh), lambda b, *s: (b, 0, 0, 0)),
+        pl.BlockSpec((1, NH, Dh), lambda b, *s: (b, 0, 0)),
         pl.BlockSpec(memory_space=pltpu.ANY),  # K pool stays in HBM
         pl.BlockSpec(memory_space=pltpu.ANY),  # V pool stays in HBM
-        pl.BlockSpec((1, KVH, Dh), lambda b, *s: (b, 0, 0)),
-        pl.BlockSpec((1, KVH, Dh), lambda b, *s: (b, 0, 0)),
+        pl.BlockSpec((1, 1, KD), lambda b, *s: (b, 0, 0)),
+        pl.BlockSpec((1, 1, KD), lambda b, *s: (b, 0, 0)),
     ]
     scalars = [
         page_table.reshape(-1).astype(jnp.int32),
         past_len.astype(jnp.int32),
         jnp.asarray(window, jnp.int32).reshape(1),
     ]
-    operands = [qg, k_pages, v_pages, k_cur, v_cur]
+    operands = [
+        q,
+        k_pages,
+        v_pages,
+        k_cur.reshape(B, 1, KD),
+        v_cur.reshape(B, 1, KD),
+    ]
     if W:
         scalars.append(jnp.asarray(win_len, jnp.int32).reshape(1))
         in_specs += [
-            pl.BlockSpec((1, W, KVH, Dh), lambda b, *s: (b, 0, 0, 0)),
-            pl.BlockSpec((1, W, KVH, Dh), lambda b, *s: (b, 0, 0, 0)),
+            pl.BlockSpec((1, W, KD), lambda b, *s: (b, 0, 0)),
+            pl.BlockSpec((1, W, KD), lambda b, *s: (b, 0, 0)),
         ]
         operands += [win_k, win_v]
-    in_specs.append(pl.BlockSpec((KVH, G), lambda b, *s: (0, 0)))
+    in_specs.append(pl.BlockSpec((1, NH), lambda b, *s: (0, 0)))
     operands.append(sink_g)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=len(scalars),
         grid=(B,),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec(
-            (1, KVH, G, Dh), lambda b, *s: (b, 0, 0, 0)
-        ),
+        out_specs=pl.BlockSpec((1, NH, Dh), lambda b, *s: (b, 0, 0)),
         scratch_shapes=[
-            # K/V double-buffers: [2, chunk, PS, KVH, Dh]
-            pltpu.VMEM((2, kv_chunk, PS, KVH, Dh), k_pages.dtype),
-            pltpu.VMEM((2, kv_chunk, PS, KVH, Dh), v_pages.dtype),
+            # K/V double-buffers: [2, chunk, PS, KD]
+            pltpu.VMEM((2, kv_chunk, PS, KD), k_pages.dtype),
+            pltpu.VMEM((2, kv_chunk, PS, KD), v_pages.dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
-            pltpu.VMEM((KVH, G, 128), jnp.float32),
-            pltpu.VMEM((KVH, G, 128), jnp.float32),
-            pltpu.VMEM((KVH, G, Dh), jnp.float32),
+            pltpu.VMEM((NH, 128), jnp.float32),          # m
+            pltpu.VMEM((NH, 128), jnp.float32),          # l
+            pltpu.VMEM((NH, KD), jnp.float32),           # block-diag acc
         ],
     )
-    out = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, KVH, G, Dh), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, NH, Dh), q.dtype),
         # batch rows are independent (disjoint out rows, scratch is
         # reinitialized per step) — parallel lets megacore TPUs split
         # the grid across cores
@@ -373,4 +412,3 @@ def paged_decode_attention(
         ),
         interpret=interpret,
     )(*scalars, *operands)
-    return out.reshape(B, NH, Dh)

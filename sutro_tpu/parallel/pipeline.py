@@ -70,10 +70,16 @@ def pp_param_shardings(params: Any, mesh: Mesh) -> Any:
     )
 
 
-def pp_cache_sharding(mesh: Mesh) -> NamedSharding:
-    """KV pages [L, NP, PS, KVH, Dh]: layers over ``pipe``, heads over
-    ``model`` (matches pp_param_shardings / cache_shardings)."""
-    return NamedSharding(mesh, P("pipe", None, None, "model", None))
+def pp_cache_sharding(
+    mesh: Mesh, kv_heads: "int | None" = None
+) -> NamedSharding:
+    """KV pages [L, NP, PS, KVH*Dh]: layers over ``pipe``, the fused
+    KV-head-major trailing axis over ``model`` in whole-KV-head blocks
+    (matches pp_param_shardings / cache_shardings)."""
+    from .sharding import check_tp_divides_kv_heads
+
+    check_tp_divides_kv_heads(mesh, kv_heads)
+    return NamedSharding(mesh, P("pipe", None, None, "model"))
 
 
 def pipeline_forward(
@@ -182,7 +188,7 @@ def pipeline_decode(
     ids: jax.Array,          # [B, T] int32 (decode: T == 1)
     positions: jax.Array,    # [B, T] int32
     valid_len: jax.Array,    # [B] int32
-    k_pages: jax.Array,      # [L, NP, PS, KVH, Dh] (layer axis pipe-sharded)
+    k_pages: jax.Array,      # [L, NP, PS, KVH*Dh] (layer axis pipe-sharded)
     v_pages: jax.Array,
     page_table: jax.Array,   # [B, MP] int32
     past_len: jax.Array,     # [B] int32
@@ -276,9 +282,10 @@ def pipeline_decode(
     if window_past is not None:
         wk_all, wv_all = window_past[0], window_past[1]
     else:  # zero-width dummy keeps the scan xs structure static;
-        # attention ignores W == 0 windows
-        wk_all = jnp.zeros((L, B, 0, KVH, Dh), h0.dtype)
-        wv_all = jnp.zeros((L, B, 0, KVH, Dh), h0.dtype)
+        # attention ignores W == 0 windows (fused [.., KVH*Dh] layout,
+        # matching runner._window_scan)
+        wk_all = jnp.zeros((L, B, 0, KVH * Dh), h0.dtype)
+        wv_all = jnp.zeros((L, B, 0, KVH * Dh), h0.dtype)
         win_len = jnp.asarray(0, jnp.int32)
 
     fn = jax.shard_map(

@@ -22,7 +22,7 @@ models/transformer.init_params and engine/weights.load_checkpoint.
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -94,9 +94,24 @@ def param_shardings(params: Any, mesh: Mesh) -> Any:
     return jax.tree_util.tree_map_with_path(rule, params)
 
 
-def cache_shardings(mesh: Mesh) -> NamedSharding:
-    """[L, NP, PS, KVH, Dh]: KV heads follow the attention-head sharding."""
-    return NamedSharding(mesh, P(None, None, None, "model", None))
+def check_tp_divides_kv_heads(mesh: Mesh, kv_heads: Optional[int]) -> None:
+    """The fused KV-pool trailing axis is KV-head-major (kvcache.py), so
+    sharding it over ``model`` splits whole KV heads across the TP axis —
+    PROVIDED the model-axis size divides the KV head count. A mid-head
+    split would silently corrupt per-shard attention."""
+    tp = int(mesh.shape.get("model", 1))
+    if kv_heads is not None and kv_heads % max(tp, 1):
+        raise ValueError(
+            f"TP axis size {tp} must divide num_kv_heads {kv_heads}: the "
+            "fused KV-pool axis shards in whole-head blocks"
+        )
+
+
+def cache_shardings(mesh: Mesh, kv_heads: Optional[int] = None) -> NamedSharding:
+    """[L, NP, PS, KVH*Dh]: fused trailing axis over ``model`` in
+    whole-KV-head blocks (see check_tp_divides_kv_heads)."""
+    check_tp_divides_kv_heads(mesh, kv_heads)
+    return NamedSharding(mesh, P(None, None, None, "model"))
 
 
 def data_sharding(mesh: Mesh) -> NamedSharding:

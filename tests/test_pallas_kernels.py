@@ -20,11 +20,12 @@ def _make_decode_case(
     q = jnp.asarray(rng.standard_normal((B, 1, NH, Dh)), jnp.float32)
     k_cur = jnp.asarray(rng.standard_normal((B, 1, KVH, Dh)), jnp.float32)
     v_cur = jnp.asarray(rng.standard_normal((B, 1, KVH, Dh)), jnp.float32)
+    # pools carry the fused [NP, PS, KVH*Dh] layout (engine/kvcache.py)
     k_pages = jnp.asarray(
-        rng.standard_normal((NP, PS, KVH, Dh)), jnp.float32
+        rng.standard_normal((NP, PS, KVH * Dh)), jnp.float32
     )
     v_pages = jnp.asarray(
-        rng.standard_normal((NP, PS, KVH, Dh)), jnp.float32
+        rng.standard_normal((NP, PS, KVH * Dh)), jnp.float32
     )
     # distinct pages per row
     table = np.zeros((B, MP), np.int32)
@@ -217,11 +218,12 @@ def test_paged_decode_with_window_buffer(window):
     NH, KVH, Dh, W = 4, 2, 16, 8
     q, k_cur, v_cur, kp, vp, table, past_len = _make_decode_case(rng)
     B = q.shape[0]
+    # window buffers carry the fused [B, W, KVH*Dh] layout
     win_k = jnp.asarray(
-        rng.standard_normal((B, W, KVH, Dh)), jnp.float32
+        rng.standard_normal((B, W, KVH * Dh)), jnp.float32
     )
     win_v = jnp.asarray(
-        rng.standard_normal((B, W, KVH, Dh)), jnp.float32
+        rng.standard_normal((B, W, KVH * Dh)), jnp.float32
     )
     win_len = jnp.asarray(5, jnp.int32)  # slots 0..4 valid
     win = jnp.asarray(window, jnp.int32)
@@ -286,8 +288,8 @@ def test_paged_decode_chunked_contiguous(kv_chunk):
     q = jnp.asarray(rng.standard_normal((B, 1, NH, Dh)), jnp.float32)
     k_cur = jnp.asarray(rng.standard_normal((B, 1, KVH, Dh)), jnp.float32)
     v_cur = jnp.asarray(rng.standard_normal((B, 1, KVH, Dh)), jnp.float32)
-    kp = jnp.asarray(rng.standard_normal((NP, PS, KVH, Dh)), jnp.float32)
-    vp = jnp.asarray(rng.standard_normal((NP, PS, KVH, Dh)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((NP, PS, KVH * Dh)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((NP, PS, KVH * Dh)), jnp.float32)
     # ascending contiguous runs per row
     table = np.zeros((B, MP), np.int32)
     starts = [1, 11, 21]
@@ -311,4 +313,57 @@ def test_paged_decode_chunked_contiguous(kv_chunk):
     )
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(ref[:, 0]), atol=2e-5, rtol=2e-5
+    )
+
+
+# ---------------------------------------------------------------------------
+# KV page write kernel (RMW + roll)
+# ---------------------------------------------------------------------------
+
+from sutro_tpu.engine.kvcache import KVCache, write_kv  # noqa: E402
+from sutro_tpu.ops.pallas_kv import kv_write_pallas  # noqa: E402
+
+
+@pytest.mark.parametrize(
+    "starts,valids,tb",
+    [
+        ([0, 8, 3], [16, 16, 5], 16),    # aligned, offset, ragged
+        ([7, 60, 0], [16, 9, 0], 16),    # page-crossing, empty row
+        ([0, 5, 63], [40, 33, 1], 40),   # multi-page runs
+    ],
+)
+def test_kv_write_pallas_matches_scatter(starts, valids, tb):
+    """The RMW+roll write kernel (interpret mode) must land exactly the
+    same bytes as the XLA scatter fallback, at any offset/page split,
+    and leave every untouched row intact."""
+    rng = np.random.default_rng(5)
+    L, NP, PS, KD = 2, 12, 8, 256
+    B, MP = 3, 4
+    k0 = jnp.asarray(rng.standard_normal((L, NP, PS, KD)), jnp.float32)
+    v0 = jnp.asarray(rng.standard_normal((L, NP, PS, KD)), jnp.float32)
+    table = np.zeros((B, MP), np.int32)
+    nxt = 1
+    for b in range(B):
+        table[b] = np.arange(nxt, nxt + MP)
+        nxt += MP
+    kc = jnp.asarray(rng.standard_normal((L, B, tb, KD)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((L, B, tb, KD)), jnp.float32)
+    start = jnp.asarray(starts, jnp.int32)
+    valid = jnp.asarray(valids, jnp.int32)
+    tab = jnp.asarray(table)
+
+    ref = write_kv(
+        KVCache(k_pages=k0, v_pages=v0), kc, vc, tab, start, valid,
+        use_pallas=False,
+    )
+    got_k, got_v = kv_write_pallas(
+        k0.copy(), v0.copy(), kc, vc, tab, start, valid, interpret=True
+    )
+    # page 0 is the garbage page: the scatter fallback dumps invalid
+    # tokens there, the kernel skips them — its content is unspecified
+    np.testing.assert_array_equal(
+        np.asarray(got_k)[:, 1:], np.asarray(ref.k_pages)[:, 1:]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got_v)[:, 1:], np.asarray(ref.v_pages)[:, 1:]
     )
