@@ -175,7 +175,7 @@ def kv_write_pallas(
     kernel = functools.partial(
         _kv_write_kernel, page_size=PS, layer_chunk=lc
     )
-    any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+    any_spec = pl.BlockSpec(memory_space=pl.ANY)
     new_spec = pl.BlockSpec(
         (lc, 1, Tb, KD), lambda s, l, *refs: (l, refs[4][s], 0, 0)
     )

@@ -54,6 +54,7 @@ def _paged_decode_kernel(
     kvh: int,
     window_slots: int = 0,
     chunk_pages: int = 1,
+    cross_row: bool = False,
 ):
     if window_slots:
         (page_table_ref, past_len_ref, window_ref, win_len_ref,
@@ -116,50 +117,68 @@ def _paged_decode_kernel(
     # ONE DMA for CH pages instead of CH DMAs. The caller guarantees
     # CH-1 slack pages at the pool end so the final chunk's over-read
     # stays in bounds (over-read tokens are masked by ``tok < past``).
-    start_page = page_table_ref[b * MP]
+    #
+    # cross_row: row b also starts row b+1's FIRST chunk after its own
+    # page walk drains (all kbuf/vbuf reads done), so the next grid
+    # step's warmup latency hides behind this row's finalize + the grid
+    # transition. Slots are row-parity offset (chunk i of row r lives in
+    # slot (r+i)%2) so the handed-over chunk lands where the next row's
+    # walk expects it and never collides with a buffer still being read.
+    # Requires "arbitrary" grid semantics (cross-step scratch flow).
 
-    def k_dma(i, slot):
+    def _slot(row, i):
+        return jax.lax.rem(row + i, 2) if cross_row else jax.lax.rem(i, 2)
+
+    def k_dma(row, i, slot):
         if CH == 1:  # per-page walk: any table layout
             return pltpu.make_async_copy(
-                k_pool_ref.at[page_table_ref[b * MP + i]],
+                k_pool_ref.at[page_table_ref[row * MP + i]],
                 kbuf.at[slot, 0],
                 ksem.at[slot],
             )
         return pltpu.make_async_copy(
-            k_pool_ref.at[pl.ds(start_page + i * CH, CH)],
+            k_pool_ref.at[pl.ds(page_table_ref[row * MP] + i * CH, CH)],
             kbuf.at[slot],
             ksem.at[slot],
         )
 
-    def v_dma(i, slot):
+    def v_dma(row, i, slot):
         if CH == 1:
             return pltpu.make_async_copy(
-                v_pool_ref.at[page_table_ref[b * MP + i]],
+                v_pool_ref.at[page_table_ref[row * MP + i]],
                 vbuf.at[slot, 0],
                 vsem.at[slot],
             )
         return pltpu.make_async_copy(
-            v_pool_ref.at[pl.ds(start_page + i * CH, CH)],
+            v_pool_ref.at[pl.ds(page_table_ref[row * MP] + i * CH, CH)],
             vbuf.at[slot],
             vsem.at[slot],
         )
 
-    @pl.when(nchunks > 0)
+    def _chunks_of(row):
+        return (past_len_ref[row] + CT - 1) // CT
+
+    # warmup: row 0 fetches its own first chunk; under cross_row every
+    # later row's first chunk was started by its predecessor
+    self_warm = (b == 0) if cross_row else (nchunks > 0)
+
+    @pl.when(jnp.logical_and(self_warm, nchunks > 0))
     def _warmup():
-        k_dma(0, 0).start()
-        v_dma(0, 0).start()
+        s0 = _slot(b, 0)
+        k_dma(b, 0, s0).start()
+        v_dma(b, 0, s0).start()
 
     def page_step(i, _):
-        slot = jax.lax.rem(i, 2)
-        nxt = jax.lax.rem(i + 1, 2)
+        slot = _slot(b, i)
+        nxt = _slot(b, i + 1)
 
         @pl.when(i + 1 < nchunks)
         def _prefetch_next():
-            k_dma(i + 1, nxt).start()
-            v_dma(i + 1, nxt).start()
+            k_dma(b, i + 1, nxt).start()
+            v_dma(b, i + 1, nxt).start()
 
-        k_dma(i, slot).wait()
-        v_dma(i, slot).wait()
+        k_dma(b, i, slot).wait()
+        v_dma(b, i, slot).wait()
 
         chunk_start = i * CT
         tok = chunk_start + jax.lax.broadcasted_iota(
@@ -197,6 +216,22 @@ def _paged_decode_kernel(
         return 0
 
     jax.lax.fori_loop(0, nchunks, page_step, 0)
+
+    if cross_row:
+        # hand off: start the NEXT row's first chunk now that every DMA
+        # of this row has been waited (both slots idle). The matching
+        # wait is the next grid step's page_step(0) on slot (b+1)%2 —
+        # predicated on the same ``nchunks > 0`` so semaphores balance.
+        nb = b + 1
+        # clamp the probe: logical_and evaluates both operands, so the
+        # last row must not read past_len_ref[B] (OOB SMEM on hardware)
+        nb_c = jnp.minimum(nb, pl.num_programs(0) - 1)
+
+        @pl.when(jnp.logical_and(nb < pl.num_programs(0), _chunks_of(nb_c) > 0))
+        def _handoff():
+            s0 = _slot(nb, 0)
+            k_dma(nb, 0, s0).start()
+            v_dma(nb, 0, s0).start()
 
     # finalize: fused-window tokens + current token + attention sink,
     # in the same block-diagonal space (2 dots total, not 2 per head)
@@ -259,6 +294,15 @@ PALLAS_PAGED_MIN_CTX = int(
     os.environ.get("SUTRO_PAGED_MIN_CTX", "0")
 )
 
+# Cross-row DMA warmup: each row starts the next row's first chunk as
+# soon as its own page walk drains, hiding per-row first-fetch latency
+# behind finalize + grid transition. Costs "arbitrary" grid semantics
+# (rows run sequentially on one core) — free on single-TensorCore chips
+# (v5e); on megacore parts (v4/v5p) "parallel" row-splitting may win
+# instead. Default OFF until chip-validated (interpret mode cannot model
+# DMA/semaphore timing): SUTRO_KV_XROW=1 enables.
+PALLAS_PAGED_XROW = os.environ.get("SUTRO_KV_XROW", "0") == "1"
+
 
 def chunk_pages_for(
     page_size: int,
@@ -299,7 +343,7 @@ def paged_decode_supported(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("kv_chunk", "interpret"),
+    static_argnames=("kv_chunk", "interpret", "cross_row"),
 )
 def paged_decode_attention(
     q: jax.Array,          # [B, NH, Dh] — current-step queries
@@ -317,6 +361,7 @@ def paged_decode_attention(
     *,
     kv_chunk: int = 1,  # pages per DMA (>1 requires contiguous runs)
     interpret: bool = False,
+    cross_row: Optional[bool] = None,  # None => PALLAS_PAGED_XROW
 ) -> jax.Array:
     """Returns [B, NH, Dh] attention outputs for one decode step.
 
@@ -343,6 +388,8 @@ def paged_decode_attention(
     else:
         sink_g = sink.astype(jnp.float32).reshape(1, NH)
 
+    if cross_row is None:
+        cross_row = PALLAS_PAGED_XROW
     kernel = functools.partial(
         _paged_decode_kernel,
         max_pages_per_seq=MP,
@@ -351,14 +398,15 @@ def paged_decode_attention(
         kvh=KVH,
         window_slots=W,
         chunk_pages=kv_chunk,
+        cross_row=cross_row,
     )
 
     # index maps take *s so the scalar-prefetch arity (3 without a
     # window buffer, 4 with) needs no per-case lambdas
     in_specs = [
         pl.BlockSpec((1, NH, Dh), lambda b, *s: (b, 0, 0)),
-        pl.BlockSpec(memory_space=pltpu.ANY),  # K pool stays in HBM
-        pl.BlockSpec(memory_space=pltpu.ANY),  # V pool stays in HBM
+        pl.BlockSpec(memory_space=pl.ANY),  # K pool stays in HBM
+        pl.BlockSpec(memory_space=pl.ANY),  # V pool stays in HBM
         pl.BlockSpec((1, 1, KD), lambda b, *s: (b, 0, 0)),
         pl.BlockSpec((1, 1, KD), lambda b, *s: (b, 0, 0)),
     ]
@@ -404,11 +452,14 @@ def paged_decode_attention(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, NH, Dh), q.dtype),
-        # batch rows are independent (disjoint out rows, scratch is
-        # reinitialized per step) — parallel lets megacore TPUs split
-        # the grid across cores
+        # without cross-row warmup, batch rows are independent (disjoint
+        # out rows, scratch reinitialized per step) and "parallel" lets
+        # megacore TPUs split the grid; the cross-row handoff threads
+        # DMA state between steps and needs sequential "arbitrary" rows
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel",),
+            dimension_semantics=(
+                "arbitrary" if cross_row else "parallel",
+            ),
         ),
         interpret=interpret,
     )(*scalars, *operands)

@@ -316,6 +316,39 @@ def test_paged_decode_chunked_contiguous(kv_chunk):
     )
 
 
+@pytest.mark.parametrize("kv_chunk", [1, 2])
+def test_paged_decode_cross_row_handoff(kv_chunk):
+    """cross_row mode (row b prefetches row b+1's first chunk) must be
+    bit-identical to the independent-row kernel, including across a
+    zero-past row in the middle (handoff predicate skips it) and ragged
+    chunk counts (slot parity never collides)."""
+    rng = np.random.default_rng(77)
+    B, NH, KVH, Dh, PS, MP, NP = 4, 4, 2, 16, 8, 6, 64
+    q = jnp.asarray(rng.standard_normal((B, 1, NH, Dh)), jnp.float32)
+    k_cur = jnp.asarray(rng.standard_normal((B, 1, KVH, Dh)), jnp.float32)
+    v_cur = jnp.asarray(rng.standard_normal((B, 1, KVH, Dh)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((NP, PS, KVH * Dh)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((NP, PS, KVH * Dh)), jnp.float32)
+    table = np.zeros((B, MP), np.int32)
+    starts = [1, 11, 21, 31]
+    for b in range(B):
+        table[b] = np.arange(starts[b], starts[b] + MP)
+    table = jnp.asarray(table)
+    # odd/even chunk counts + an empty row mid-batch
+    past_len = jnp.asarray([5, 0, 17, MP * PS - 1], jnp.int32)
+    win = jnp.asarray(0, jnp.int32)
+
+    base = paged_decode_attention(
+        q[:, 0], kp, vp, table, past_len, k_cur[:, 0], v_cur[:, 0],
+        win, None, kv_chunk=kv_chunk, interpret=True, cross_row=False,
+    )
+    xrow = paged_decode_attention(
+        q[:, 0], kp, vp, table, past_len, k_cur[:, 0], v_cur[:, 0],
+        win, None, kv_chunk=kv_chunk, interpret=True, cross_row=True,
+    )
+    np.testing.assert_array_equal(np.asarray(xrow), np.asarray(base))
+
+
 # ---------------------------------------------------------------------------
 # KV page write kernel (RMW + roll)
 # ---------------------------------------------------------------------------
