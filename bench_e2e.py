@@ -12,9 +12,16 @@ headline workflows (/root/reference/README.md:173-192):
   path with fused multi-step windows).
 - **embed**: BASELINE config #3 analog — rows through the embedding
   model (mean-pool head, batched).
+- **longgen**: BASELINE config #5 analog — 2k-token long-output
+  generation stress (long decode tails, KV growth across 30+ pages).
+  Needs a differently-sized engine (more pages, smaller batch), so it
+  runs via ``SUTRO_E2E_WORKLOADS=longgen`` as a separate invocation;
+  results merge into the same BENCH_E2E.json.
 
-Row counts are time-boxed defaults; raise with SUTRO_E2E_ROWS /
-SUTRO_E2E_EMBED_ROWS for full-dataset runs (20k / 1M). Weights are
+``SUTRO_E2E_WORKLOADS`` selects a comma-set of the above (default
+"classify,generate,embed"). Row counts are time-boxed defaults; raise
+with SUTRO_E2E_ROWS / SUTRO_E2E_EMBED_ROWS for full-dataset runs
+(20k / 1M). Weights are
 random — throughput is weight-value independent — so rows/hour and
 tok/s/chip are real; classification *quality* is not measured here (see
 tests/test_golden.py for decode correctness on real checkpoints).
@@ -55,29 +62,73 @@ def main() -> None:
 
     on_tpu = jax.default_backend() not in ("cpu",)
     n_chips = max(jax.device_count(), 1)
+    workloads = {
+        w.strip()
+        for w in os.environ.get(
+            "SUTRO_E2E_WORKLOADS", "classify,generate,embed"
+        ).split(",")
+        if w.strip()
+    }
+    known = {"classify", "generate", "embed", "longgen"}
+    if not workloads or workloads - known:
+        raise SystemExit(
+            f"SUTRO_E2E_WORKLOADS must name a subset of {sorted(known)}, "
+            f"got {sorted(workloads)}"
+        )
+    long_only = workloads == {"longgen"}
+    if "longgen" in workloads and not long_only:
+        # the 2k-token stress needs its own engine sizing — a shared
+        # engine would silently record a short-tail run as "longgen"
+        raise SystemExit(
+            "longgen requires its own invocation: "
+            "SUTRO_E2E_WORKLOADS=longgen"
+        )
 
     if on_tpu:
         model = os.environ.get("SUTRO_E2E_MODEL", "qwen-3-0.6b")
         emb_model = "qwen-3-embedding-0.6b"
         rows = int(os.environ.get("SUTRO_E2E_ROWS", "1024"))
         emb_rows = int(os.environ.get("SUTRO_E2E_EMBED_ROWS", "20000"))
-        ecfg = dict(
-            decode_batch_size=64,
-            kv_page_size=64,
-            max_pages_per_seq=8,
-            max_model_len=512,
-            max_new_tokens=48,
-        )
+        long_rows = int(os.environ.get("SUTRO_E2E_LONG_ROWS", "32"))
+        if long_only:
+            # 2k-token tails: 34 pages cover 128 prompt + 2048 new
+            ecfg = dict(
+                decode_batch_size=16,
+                kv_page_size=64,
+                max_pages_per_seq=34,
+                max_model_len=2304,
+                max_new_tokens=2048,
+            )
+        else:
+            ecfg = dict(
+                decode_batch_size=64,
+                kv_page_size=64,
+                max_pages_per_seq=8,
+                max_model_len=512,
+                max_new_tokens=48,
+            )
     else:  # CPU smoke
         model = emb_model = "tiny-dense"
         emb_model = "tiny-emb"
         rows = int(os.environ.get("SUTRO_E2E_ROWS", "16"))
         emb_rows = int(os.environ.get("SUTRO_E2E_EMBED_ROWS", "64"))
-        ecfg = dict(
-            decode_batch_size=4, kv_page_size=8, max_pages_per_seq=16,
-            max_model_len=128, max_new_tokens=16, use_pallas=False,
-            param_dtype="float32",
-        )
+        long_rows = int(os.environ.get("SUTRO_E2E_LONG_ROWS", "2"))
+        if long_only:
+            # smoke the long-tail path only: CPU decode is ~5 tok/s, so
+            # the "long" output is 48 tokens, not 2k. The byte tokenizer
+            # makes the system prompt ~200 tokens/row — the context must
+            # cover prompt + 48 or admission truncates generation away
+            ecfg = dict(
+                decode_batch_size=2, kv_page_size=8, max_pages_per_seq=36,
+                max_model_len=280, max_new_tokens=48, use_pallas=False,
+                param_dtype="float32",
+            )
+        else:
+            ecfg = dict(
+                decode_batch_size=4, kv_page_size=8, max_pages_per_seq=16,
+                max_model_len=128, max_new_tokens=16, use_pallas=False,
+                param_dtype="float32",
+            )
 
     os.environ.setdefault("SUTRO_HOME", "/tmp/sutro-bench-e2e")
     from sutro_tpu.sdk import Sutro
@@ -94,6 +145,8 @@ def main() -> None:
         cost = rec.get("job_cost") or 0.0
         entry = {
             "model": rec["model"],
+            "backend": jax.default_backend(),
+            "n_chips": n_chips,
             "rows": n_rows,
             "elapsed_s": round(elapsed, 2),
             "rows_per_hour": round(n_rows / elapsed * 3600, 1),
@@ -110,59 +163,98 @@ def main() -> None:
 
     reviews = make_reviews(rows)
 
+    # -- longgen (BASELINE config #5: 2k-token output stress) ----------
+    if "longgen" in workloads:
+        long_reviews = make_reviews(long_rows)
+        t0 = time.monotonic()
+        jid = so.infer(
+            long_reviews,
+            model=model,
+            system_prompt=(
+                "Write a detailed multi-paragraph analysis of this "
+                "review: themes, sentiment, implied product issues, "
+                "and suggested vendor responses."
+            ),
+            sampling_params={"temperature": 0.8},
+            stay_attached=False,
+        )
+        df = so.await_job_completion(jid, timeout=24 * 3600)
+        assert df is not None and len(df) == long_rows
+        record("longgen", jid, long_rows, time.monotonic() - t0)
+
     # -- classify (schema-constrained; reference README.md:124-160) ----
-    t0 = time.monotonic()
-    jid = so.infer(
-        reviews,
-        model=model,
-        system_prompt=(
-            "You are an expert classifier. Classify the sentiment of "
-            "the review as positive, negative, or neutral."
-        ),
-        output_schema={
-            "type": "object",
-            "properties": {
-                "classification": {
-                    "type": "string",
-                    "enum": ["positive", "negative", "neutral"],
+    if "classify" in workloads:
+        t0 = time.monotonic()
+        jid = so.infer(
+            reviews,
+            model=model,
+            system_prompt=(
+                "You are an expert classifier. Classify the sentiment of "
+                "the review as positive, negative, or neutral."
+            ),
+            output_schema={
+                "type": "object",
+                "properties": {
+                    "classification": {
+                        "type": "string",
+                        "enum": ["positive", "negative", "neutral"],
+                    },
                 },
+                "required": ["classification"],
             },
-            "required": ["classification"],
-        },
-        stay_attached=False,
-    )
-    df = so.await_job_completion(jid, timeout=24 * 3600)
-    assert df is not None and len(df) == rows
-    record("classify", jid, rows, time.monotonic() - t0)
+            stay_attached=False,
+        )
+        df = so.await_job_completion(jid, timeout=24 * 3600)
+        assert df is not None and len(df) == rows
+        record("classify", jid, rows, time.monotonic() - t0)
 
     # -- generate (unconstrained, fused multi-step decode) --------------
-    t0 = time.monotonic()
-    jid = so.infer(
-        reviews,
-        model=model,
-        system_prompt="Summarize the review in one short sentence.",
-        stay_attached=False,
-    )
-    df = so.await_job_completion(jid, timeout=24 * 3600)
-    assert df is not None and len(df) == rows
-    record("generate", jid, rows, time.monotonic() - t0)
+    if "generate" in workloads:
+        t0 = time.monotonic()
+        jid = so.infer(
+            reviews,
+            model=model,
+            system_prompt="Summarize the review in one short sentence.",
+            stay_attached=False,
+        )
+        df = so.await_job_completion(jid, timeout=24 * 3600)
+        assert df is not None and len(df) == rows
+        record("generate", jid, rows, time.monotonic() - t0)
 
     # -- embed (BASELINE config #3) --------------------------------------
-    emb_reviews = make_reviews(emb_rows)
-    t0 = time.monotonic()
-    jid = so.infer(emb_reviews, model=emb_model, stay_attached=False)
-    df = so.await_job_completion(jid, timeout=24 * 3600)
-    assert df is not None and len(df) == emb_rows
-    record("embed", jid, emb_rows, time.monotonic() - t0)
+    if "embed" in workloads:
+        emb_reviews = make_reviews(emb_rows)
+        t0 = time.monotonic()
+        jid = so.infer(emb_reviews, model=emb_model, stay_attached=False)
+        df = so.await_job_completion(jid, timeout=24 * 3600)
+        assert df is not None and len(df) == emb_rows
+        record("embed", jid, emb_rows, time.monotonic() - t0)
 
+    # merge into any existing BENCH_E2E.json so separately-invoked
+    # workload sets (e.g. longgen) accumulate in one artifact; every
+    # entry carries its own backend/n_chips, so runs from different
+    # hardware never clobber each other — same-named workloads from the
+    # same backend are replaced, everything else is kept
+    path = Path(__file__).parent.joinpath("BENCH_E2E.json")
+    backend = jax.default_backend()
     out = {
-        "backend": jax.default_backend(),
+        "backend": backend,
         "n_chips": n_chips,
-        "workloads": results,
+        "workloads": dict(results),
     }
-    Path(__file__).parent.joinpath("BENCH_E2E.json").write_text(
-        json.dumps(out, indent=2)
-    )
+    if path.exists():
+        try:
+            prev = json.loads(path.read_text())
+            merged = dict(prev.get("workloads", {}))
+            for name, entry in prev.get("workloads", {}).items():
+                # legacy entries lack per-entry backend; stamp them
+                entry.setdefault("backend", prev.get("backend"))
+                entry.setdefault("n_chips", prev.get("n_chips"))
+            merged.update(results)
+            out["workloads"] = merged
+        except (json.JSONDecodeError, OSError):
+            pass
+    path.write_text(json.dumps(out, indent=2))
     print(json.dumps({"bench_e2e": "written"}), flush=True)
 
 

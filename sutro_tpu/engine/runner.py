@@ -86,6 +86,15 @@ class ModelRunner:
         self.sp = int(mesh.shape.get("seq", 1)) if mesh is not None else 1
         # GPipe pipeline stages when the mesh carries a "pipe" axis
         self.pp = int(mesh.shape.get("pipe", 1)) if mesh is not None else 1
+        # explicit shard_map EP for MoE MLPs (ops/moe_ep.py). Not under
+        # sp/pp: those paths already wrap layers in their own shard_map
+        # and nesting is unsupported — they keep GSPMD MoE semantics.
+        ep = int(mesh.shape.get("expert", 1)) if mesh is not None else 1
+        self.ep_mesh = (
+            mesh
+            if (ep > 1 and self.sp == 1 and self.pp == 1 and mcfg.moe_experts)
+            else None
+        )
         if mesh is not None:
             from ..parallel.sharding import param_shardings, cache_shardings
 
@@ -190,6 +199,7 @@ class ModelRunner:
                 self.mcfg, params, ids, positions, valid_len,
                 use_pallas=self.use_pallas,
                 ring_mesh=self.mesh if self.sp > 1 else None,
+                ep_mesh=self.ep_mesh,
             )
         cache = write_kv(
             cache, k, v, page_table, start, valid_len,
@@ -215,6 +225,7 @@ class ModelRunner:
             paged_past=(cache.k_pages, cache.v_pages, page_table),
             past_len=start,
             use_pallas=self.use_pallas,
+            ep_mesh=self.ep_mesh,
         )
         cache = write_kv(
             cache, k, v, page_table, start, valid_len,
@@ -335,6 +346,7 @@ class ModelRunner:
             window_past=window_past,
             use_pallas=self.use_pallas,
             kv_chunk=kv_chunk,
+            ep_mesh=self.ep_mesh,
         )
 
     def _chunk_for_table(self, page_table: np.ndarray) -> int:

@@ -191,14 +191,11 @@ def apply_rope(
     ).astype(x.dtype)
 
 
-def _mlp(cfg: ModelConfig, lp: Dict[str, Any], x: jax.Array) -> jax.Array:
+def _mlp(
+    cfg: ModelConfig, lp: Dict[str, Any], x: jax.Array, ep_mesh=None
+) -> jax.Array:
     if cfg.moe_experts:
-        return moe_mlp(
-            x,
-            lp["router"],
-            _w(lp, "we_gate", x.dtype),
-            _w(lp, "we_up", x.dtype),
-            _w(lp, "we_down", x.dtype),
+        kwargs = dict(
             top_k=cfg.moe_top_k,
             activation=cfg.activation,
             router_b=lp.get("router_b"),
@@ -206,6 +203,21 @@ def _mlp(cfg: ModelConfig, lp: Dict[str, Any], x: jax.Array) -> jax.Array:
             bias_up=lp.get("we_up_b"),
             bias_down=lp.get("we_down_b"),
         )
+        args = (
+            x,
+            lp["router"],
+            _w(lp, "we_gate", x.dtype),
+            _w(lp, "we_up", x.dtype),
+            _w(lp, "we_down", x.dtype),
+        )
+        if ep_mesh is not None:
+            # explicit shard_map EP: expert weights stay resident at
+            # 1/(ep*tp) per shard (ops/moe_ep.py) instead of GSPMD
+            # all-gathering them for the ragged grouped GEMM
+            from ..ops.moe_ep import moe_mlp_ep
+
+            return moe_mlp_ep(*args, mesh=ep_mesh, **kwargs)
+        return moe_mlp(*args, **kwargs)
     gate = x @ _w(lp, "w_gate", x.dtype)
     up = x @ _w(lp, "w_up", x.dtype)
     if cfg.activation == "gelu":
@@ -238,6 +250,7 @@ def layer_apply(
     wv_l: Optional[jax.Array] = None,   # window buffer [B, W, KVH*Dh]
     win_len: Optional[jax.Array] = None,
     kv_chunk: int = 1,
+    ep_mesh=None,  # Mesh with "expert" axis > 1 => shard_map EP MLP
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     """One decoder block. Shared by the scanned ``forward`` and the
     pipeline-parallel stage loop (parallel/pipeline.py). Returns
@@ -281,7 +294,7 @@ def layer_apply(
     h = resid + attn
     resid = h
     x = rms_norm(h, lp["mlp_norm"], cfg.norm_eps, cfg.norm_zero_centered)
-    x = _mlp(cfg, lp, x)
+    x = _mlp(cfg, lp, x, ep_mesh=ep_mesh)
     if cfg.post_norms:
         x = rms_norm(
             x, lp["post_mlp_norm"], cfg.norm_eps, cfg.norm_zero_centered
@@ -373,6 +386,7 @@ def forward(
     # (runner.decode_multi writes pages once per window, not per step)
     window_past: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
     kv_chunk: int = 1,  # static: pages per decode-kernel DMA
+    ep_mesh=None,  # Mesh with "expert" axis > 1 => shard_map EP MLP
 ) -> Tuple[jax.Array, jax.Array, Tuple[jax.Array, jax.Array]]:
     """Run the trunk over a chunk.
 
@@ -414,7 +428,7 @@ def forward(
             page_table=page_table, past_len=past_len,
             use_pallas=use_pallas, ring_mesh=ring_mesh,
             wk_l=wk_l, wv_l=wv_l, win_len=win_len,
-            kv_chunk=kv_chunk,
+            kv_chunk=kv_chunk, ep_mesh=ep_mesh,
         )
 
     h, (k_all, v_all) = jax.lax.scan(layer_step, h, xs)

@@ -37,6 +37,29 @@ def _grouped(lhs: jax.Array, rhs: jax.Array, group_sizes: jax.Array):
     return jax.lax.ragged_dot(lhs, rhs, group_sizes)
 
 
+def _route(
+    xt: jax.Array,        # [N, H]
+    router: jax.Array,    # [H, E]
+    router_b,             # [E] or None
+    top_k: int,
+):
+    """Shared routing: fp32 logits -> top-k -> renormalized softmax,
+    plus the flattened [N*top_k] expansion (token, expert, prob) used by
+    the grouped-GEMM paths. One definition so the EP path
+    (ops/moe_ep.py) can never diverge from the single-device reference."""
+    N = xt.shape[0]
+    logits = xt.astype(jnp.float32) @ router.astype(jnp.float32)  # [N, E]
+    if router_b is not None:
+        logits = logits + router_b.astype(jnp.float32)
+    top_logits, top_idx = jax.lax.top_k(logits, top_k)            # [N, K]
+    probs = jax.nn.softmax(top_logits, axis=-1)
+    M = N * top_k
+    flat_expert = top_idx.reshape(M)
+    flat_token = jnp.repeat(jnp.arange(N), top_k)
+    flat_prob = probs.reshape(M)
+    return top_idx, probs, flat_expert, flat_token, flat_prob
+
+
 def _act(gate: jax.Array, up: jax.Array, activation: str):
     if activation == "gelu":
         a = jax.nn.gelu(gate.astype(jnp.float32), approximate=True)
@@ -70,11 +93,9 @@ def moe_mlp(
     N = B * T
     xt = x.reshape(N, H)
 
-    logits = xt.astype(jnp.float32) @ router.astype(jnp.float32)  # [N, E]
-    if router_b is not None:
-        logits = logits + router_b.astype(jnp.float32)
-    top_logits, top_idx = jax.lax.top_k(logits, top_k)            # [N, K]
-    probs = jax.nn.softmax(top_logits, axis=-1)                   # [N, K]
+    top_idx, probs, flat_expert, flat_token, flat_prob = _route(
+        xt, router, router_b, top_k
+    )
 
     if method == "auto":
         method = "dense" if E <= 8 else "ragged"
@@ -95,11 +116,6 @@ def moe_mlp(
         return out.reshape(B, T, H)
 
     # ragged grouped-GEMM path
-    K = top_k
-    M = N * K
-    flat_expert = top_idx.reshape(M)                      # expert per expanded row
-    flat_token = jnp.repeat(jnp.arange(N), K)
-    flat_prob = probs.reshape(M)
     order = jnp.argsort(flat_expert)                      # stable order by expert
     sorted_expert = flat_expert[order]
     sorted_token = flat_token[order]

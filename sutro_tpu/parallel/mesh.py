@@ -33,12 +33,24 @@ AXES = ("data", "pipe", "seq", "expert", "model")
 
 
 def init_distributed() -> None:
-    """Multi-host init (no-op when single-process or already initialized)."""
-    if os.environ.get("JAX_COORDINATOR_ADDRESS"):
-        try:
-            jax.distributed.initialize()
-        except RuntimeError:
-            pass  # already initialized
+    """Multi-host init (no-op when single-process or already initialized).
+
+    ``jax.distributed.initialize`` only auto-detects topology under
+    cluster launchers (SLURM/GKE); for plain multi-process launches the
+    JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID env
+    vars are forwarded explicitly here."""
+    addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if not addr:
+        return
+    kwargs = {}
+    if os.environ.get("JAX_NUM_PROCESSES"):
+        kwargs["num_processes"] = int(os.environ["JAX_NUM_PROCESSES"])
+    if os.environ.get("JAX_PROCESS_ID"):
+        kwargs["process_id"] = int(os.environ["JAX_PROCESS_ID"])
+    try:
+        jax.distributed.initialize(coordinator_address=addr, **kwargs)
+    except RuntimeError:
+        pass  # already initialized
 
 
 def make_mesh(

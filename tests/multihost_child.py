@@ -1,0 +1,95 @@
+"""Child process for tests/test_multihost.py.
+
+Each of two processes contributes 4 virtual CPU devices to one global
+8-device engine mesh (dp=2 outermost / tp=4 innermost — the DCN-out,
+ICI-in ordering of parallel/mesh.py). The ``data`` axis spans the
+PROCESS boundary, so the cross-``data`` psum below rides the
+inter-process (DCN-analog) transport, while the ``model``-axis
+all-gather stays intra-process (ICI analog). The reference has no
+distributed layer to mirror (its transport is HTTPS, SURVEY §5.8);
+this validates our replacement actually crosses hosts.
+
+Run via the parent test only — it needs JAX_COORDINATOR_ADDRESS,
+JAX_NUM_PROCESSES and JAX_PROCESS_ID in the environment.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from sutro_tpu.parallel.mesh import init_distributed, make_mesh  # noqa: E402
+
+
+def main() -> None:
+    init_distributed()
+    pid = jax.process_index()
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8, len(jax.devices())
+    assert len(jax.local_devices()) == 4
+
+    mesh = make_mesh(dp=2, tp=4)
+    assert mesh.shape["data"] == 2 and mesh.shape["model"] == 4
+
+    # global [8, 16] array: row i carries value i; dp shards rows across
+    # processes, tp shards columns within each process
+    spec = P("data", "model")
+    rows = jnp.broadcast_to(
+        jnp.arange(8.0)[:, None], (8, 16)
+    )
+    arr = jax.make_array_from_callback(
+        (8, 16),
+        NamedSharding(mesh, spec),
+        lambda idx: np.asarray(rows[idx]),
+    )
+
+    @jax.jit
+    def reduce_all(x):
+        # full sum touches BOTH axes: the partial sums of the two
+        # process-local row shards combine across the data axis
+        return jnp.sum(x)
+
+    total = float(reduce_all(arr))
+    assert total == float(sum(range(8)) * 16), total
+
+    # cross-process collective inside shard_map: psum over "data"
+    # moves activations between the two processes
+    from functools import partial
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=P("data", "model"),
+        out_specs=P(None, "model"),
+    )
+    def dp_psum(x):
+        return jax.lax.psum(x, "data")
+
+    out = jax.jit(dp_psum)(arr)
+    # rows 0..3 (proc 0) + rows 4..7 (proc 1) pairwise: row r of the
+    # result = r + (r+4)
+    got = np.asarray(
+        jax.device_get(
+            jax.jit(
+                lambda x: x, out_shardings=NamedSharding(mesh, P())
+            )(out)
+        )
+    )
+    want = np.broadcast_to(
+        (np.arange(4.0) + np.arange(4.0, 8.0))[:, None], (4, 16)
+    )
+    np.testing.assert_allclose(got, want)
+
+    print(f"MULTIHOST_OK process={pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
