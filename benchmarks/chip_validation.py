@@ -1,0 +1,103 @@
+"""One-shot chip-validation queue: run after a TPU tunnel outage to
+(re)validate every gated optimization and sweep the decode operating
+point, each case in its own subprocess so a hang or OOM cannot take the
+whole queue down.
+
+Cases (in order):
+  1. numerics  — chip_numerics_check.py (Pallas vs jnp greedy tokens)
+  2. bench B=64  (baseline, then SUTRO_KV_XROW=1)
+  3. bench B=128 (both xrow settings)
+  4. bench B=256
+  5. MULTI sweep {8, 16} at the best batch so far
+
+Writes CHIP_VALIDATION.json (list of case records incl. stdout tails)
+and prints one line per case. A dead tunnel shows up as rc=124
+timeouts on every case — rerun when the chip is back.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+RESULTS: list = []
+
+
+def run_case(name: str, argv: list, env: dict, timeout: int = 1500):
+    t0 = time.monotonic()
+    e = dict(os.environ)
+    e.update(env)
+    try:
+        p = subprocess.run(
+            argv, cwd=REPO, env=e, timeout=timeout,
+            capture_output=True, text=True,
+        )
+        rc, tail = p.returncode, (p.stdout + p.stderr)[-2000:]
+    except subprocess.TimeoutExpired:
+        rc, tail = 124, "timeout"
+    rec = {
+        "case": name,
+        "rc": rc,
+        "elapsed_s": round(time.monotonic() - t0, 1),
+        "tail": tail,
+    }
+    # pull the bench JSON line out if present
+    for line in tail.splitlines():
+        line = line.strip()
+        if line.startswith("{") and '"metric"' in line:
+            try:
+                rec["bench"] = json.loads(line)
+            except json.JSONDecodeError:
+                pass
+    RESULTS.append(rec)
+    print(
+        json.dumps(
+            {k: rec[k] for k in ("case", "rc", "elapsed_s")}
+            | ({"value": rec["bench"]["value"]} if "bench" in rec else {})
+        ),
+        flush=True,
+    )
+    Path(REPO / "CHIP_VALIDATION.json").write_text(
+        json.dumps(RESULTS, indent=2)
+    )
+    return rec
+
+
+def bench_value(rec) -> float:
+    return rec.get("bench", {}).get("value", -1.0)
+
+
+def main() -> None:
+    py = sys.executable
+
+    run_case("numerics", [py, "benchmarks/chip_numerics_check.py"], {})
+    base = run_case("bench_b64", [py, "bench.py"], {})
+    xrow64 = run_case(
+        "bench_b64_xrow", [py, "bench.py"], {"SUTRO_KV_XROW": "1"}
+    )
+    b128 = run_case(
+        "bench_b128", [py, "bench.py"], {"SUTRO_BENCH_BATCH": "128"}
+    )
+    run_case(
+        "bench_b128_xrow", [py, "bench.py"],
+        {"SUTRO_BENCH_BATCH": "128", "SUTRO_KV_XROW": "1"},
+    )
+    if bench_value(b128) > bench_value(base):
+        run_case(
+            "bench_b256", [py, "bench.py"], {"SUTRO_BENCH_BATCH": "256"}
+        )
+    best_b = "128" if bench_value(b128) > bench_value(base) else "64"
+    run_case(
+        f"bench_b{best_b}_multi8", [py, "bench.py"],
+        {"SUTRO_BENCH_BATCH": best_b, "SUTRO_BENCH_MULTI": "8"},
+    )
+    print(json.dumps({"chip_validation": "written"}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
