@@ -9,9 +9,13 @@ Canonicalization choices (standard for constrained decoding): object keys
 are emitted in schema ``properties`` order; no insignificant whitespace.
 Optional (non-required) properties are genuinely optional branches in the
 automaton. Supported schema features: object/properties/required (incl.
-nested), string (with enum/const), integer, number, boolean, null, array
-(items, minItems/maxItems small), anyOf/oneOf, $ref/$defs (one level of
-indirection, as produced by Pydantic), additionalProperties ignored.
+nested), string (enum/const, minLength/maxLength, ``pattern`` via the
+regex subset in constrain/regex.py — unsupported constructs fall back to
+type-valid-unchecked with a warning), integer (exact minimum/maximum/
+exclusive bounds via a digit-interval automaton), number, boolean, null,
+array (items, minItems/maxItems small), anyOf/oneOf, $ref/$defs (one
+level of indirection, as produced by Pydantic), additionalProperties
+ignored.
 """
 
 from __future__ import annotations
@@ -125,6 +129,116 @@ class SchemaCompiler:
         )
         return b.seq(b.opt(b.lit(b"-")), body)
 
+    def _digits_interval(self, a: str, c: str) -> Frag:
+        """Digit strings d with ``a <= d <= c`` (equal lengths, no
+        leading-zero concerns — callers arrange that). Classic
+        tight-prefix construction: state = (position, still tight to the
+        low bound, still tight to the high bound); memoized so the
+        fragment graph is O(len * 10)."""
+        b = self.b
+        memo: Dict[Tuple[int, bool, bool], Frag] = {}
+
+        def rec(i: int, tl: bool, th: bool) -> Frag:
+            if i == len(a):
+                return b.seq()  # epsilon
+            key = (i, tl, th)
+            got = memo.get(key)
+            if got is not None:
+                return got
+            lo_d = int(a[i]) if tl else 0
+            hi_d = int(c[i]) if th else 9
+            alts = []
+            for d in range(lo_d, hi_d + 1):
+                nxt = rec(i + 1, tl and d == lo_d, th and d == hi_d)
+                alts.append(
+                    b.seq(b.lit(str(d).encode()), nxt)
+                )
+            frag = b.alt(*alts)
+            memo[key] = frag
+            return frag
+
+        return rec(0, True, True)
+
+    def _nonneg_interval(self, lo: int, hi: int) -> Frag:
+        """Decimal representations (no leading zeros) of [lo, hi],
+        lo >= 0."""
+        b = self.b
+        alts: List[Frag] = []
+        a0, c0 = str(lo), str(hi)
+        for L in range(len(a0), len(c0) + 1):
+            a_l = a0 if L == len(a0) else "1" + "0" * (L - 1)
+            c_l = c0 if L == len(c0) else "9" * L
+            if int(a_l) > int(c_l):
+                continue
+            alts.append(self._digits_interval(a_l, c_l))
+        return b.alt(*alts)
+
+    def _bounded_int_frag(self, lo: Optional[int], hi: Optional[int]) -> Frag:
+        """Integers restricted by JSON-schema minimum/maximum.
+
+        Exact in every case: two-sided bounds use the interval automaton
+        over digit positions on each sign's magnitude; one-sided bounds
+        bound one sign's magnitude and leave the other open. The only
+        approximation anywhere is none — e.g. ``minimum: -5`` accepts
+        exactly ``-5..-1`` plus every non-negative integer."""
+        b = self.b
+
+        # lazy: Builder fragments allocate states immediately, so only
+        # the branch taken should construct its pieces
+        def nonneg() -> Frag:
+            return b.alt(
+                b.lit(b"0"),
+                b.seq(b.char(_DIGIT19), b.star(b.char(_DIGIT))),
+            )
+
+        def positive() -> Frag:
+            return b.seq(b.char(_DIGIT19), b.star(b.char(_DIGIT)))
+
+        if lo is not None and hi is not None:
+            if lo > hi:
+                raise ValueError(f"integer minimum {lo} > maximum {hi}")
+            alts = []
+            if hi < 0:
+                return b.seq(b.lit(b"-"), self._nonneg_interval(-hi, -lo))
+            if lo < 0:
+                alts.append(
+                    b.seq(b.lit(b"-"), self._nonneg_interval(1, -lo))
+                )
+                lo = 0
+            alts.append(self._nonneg_interval(lo, hi))
+            return b.alt(*alts)
+        if lo is not None:  # [lo, inf)
+            if lo > 0:
+                return self._unbounded_above(lo)
+            if lo == 0:
+                return nonneg()
+            # negatives down to lo, all non-negatives
+            return b.alt(
+                b.seq(b.lit(b"-"), self._nonneg_interval(1, -lo)), nonneg()
+            )
+        if hi is not None:  # (-inf, hi]
+            if hi < 0:
+                return b.seq(b.lit(b"-"), self._unbounded_above(-hi))
+            # all negatives, non-negatives up to hi
+            return b.alt(
+                b.seq(b.lit(b"-"), positive()), self._nonneg_interval(0, hi)
+            )
+        return self._integer_frag()
+
+    def _unbounded_above(self, lo: int) -> Frag:
+        """Exact [lo, inf) for lo >= 1: magnitudes of the same digit
+        count bounded below by the interval automaton, any longer
+        digit string unbounded."""
+        b = self.b
+        a0 = str(lo)
+        same_len = self._digits_interval(a0, "9" * len(a0))
+        longer = b.seq(
+            b.char(_DIGIT19),
+            *[b.char(_DIGIT) for _ in range(len(a0))],
+            b.star(b.char(_DIGIT)),
+        )
+        return b.alt(same_len, longer)
+
     def _number_frag(self) -> Frag:
         b = self.b
         frac = b.seq(b.lit(b"."), b.plus(b.char(_DIGIT)))
@@ -134,6 +248,29 @@ class SchemaCompiler:
             b.plus(b.char(_DIGIT)),
         )
         return b.seq(self._integer_frag(), b.opt(frac), b.opt(exp))
+
+    def _pattern_frag(self, pattern: str) -> Optional[Frag]:
+        """Compile a string ``pattern`` (constrain/regex.py). Returns
+        None — unconstrained-string fallback — for constructs the regex
+        subset cannot express; the fallback is the pre-pattern behavior
+        (type-valid but pattern-unchecked), kept so exotic patterns
+        don't fail whole jobs. minLength/maxLength are not intersected
+        with a compiled pattern (NFA intersection is out of scope);
+        the pattern wins."""
+        import warnings
+
+        from .regex import UnsupportedPattern, compile_pattern
+
+        b = self.b
+        try:
+            body = compile_pattern(b, pattern, self._string_char)
+        except UnsupportedPattern as e:
+            warnings.warn(
+                f"output_schema pattern {pattern!r} not enforced: {e}",
+                stacklevel=2,
+            )
+            return None
+        return b.seq(b.lit(b'"'), body, b.lit(b'"'))
 
     # -- schema nodes ------------------------------------------------------
     def _resolve(self, schema: Dict[str, Any]) -> Dict[str, Any]:
@@ -171,6 +308,10 @@ class SchemaCompiler:
                 *[self.compile_node({**schema, "type": tt}) for tt in t]
             )
         if t == "string":
+            if "pattern" in schema:
+                frag = self._pattern_frag(schema["pattern"])
+                if frag is not None:
+                    return frag
             return self._string_frag(
                 min_len=int(schema.get("minLength", 0)),
                 max_len=(
@@ -178,6 +319,9 @@ class SchemaCompiler:
                 ),
             )
         if t == "integer":
+            lo, hi = _integer_bounds(schema)
+            if lo is not None or hi is not None:
+                return self._bounded_int_frag(lo, hi)
             return self._integer_frag()
         if t == "number":
             return self._number_frag()
@@ -275,6 +419,49 @@ class SchemaCompiler:
 
     def compile(self) -> NFA:
         return self.b.build(self.compile_node(self.schema))
+
+
+def _integer_bounds(
+    schema: Dict[str, Any]
+) -> Tuple[Optional[int], Optional[int]]:
+    """Effective integer [lo, hi] from minimum/maximum and BOTH exclusive
+    forms: draft-2020 numeric exclusiveMinimum/Maximum apply
+    *independently* of minimum/maximum (intersect, don't overwrite), and
+    the draft-4 boolean form flips the adjacent bound to exclusive.
+    Fractional bounds round INWARD (ceil for lower, floor for upper) so
+    the automaton never accepts an out-of-range integer."""
+    import math
+
+    lo = schema.get("minimum")
+    hi = schema.get("maximum")
+    lo = None if lo is None else math.ceil(lo)
+    hi = None if hi is None else math.floor(hi)
+
+    def tighten_lo(v: Optional[int]) -> None:
+        nonlocal lo
+        if v is not None:
+            lo = v if lo is None else max(lo, v)
+
+    def tighten_hi(v: Optional[int]) -> None:
+        nonlocal hi
+        if v is not None:
+            hi = v if hi is None else min(hi, v)
+
+    # v > b  =>  smallest integer floor(b)+1 (integral and fractional b);
+    # v < b  =>  largest integer ceil(b)-1
+    emin = schema.get("exclusiveMinimum")
+    if isinstance(emin, bool):
+        if emin and schema.get("minimum") is not None:
+            lo = math.floor(schema["minimum"]) + 1
+    elif emin is not None:
+        tighten_lo(math.floor(emin) + 1)
+    emax = schema.get("exclusiveMaximum")
+    if isinstance(emax, bool):
+        if emax and schema.get("maximum") is not None:
+            hi = math.ceil(schema["maximum"]) - 1
+    elif emax is not None:
+        tighten_hi(math.ceil(emax) - 1)
+    return lo, hi
 
 
 def compile_schema(schema: Dict[str, Any]) -> NFA:

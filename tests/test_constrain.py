@@ -340,3 +340,145 @@ def test_speculative_constrained_matches_masked(tiny_ecfg, byte_tok):
     for toks, _reason in masked.values():
         parsed = json.loads(byte_tok.decode(list(toks)))
         assert parsed["label"] in ("alpha", "beta")
+
+
+# ---------------------------------------------------------------------------
+# Integer minimum/maximum (interval automaton) + string pattern (regex)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "lo,hi",
+    [(0, 10), (1, 5), (7, 7), (-5, 5), (-30, -7), (17, 40163), (None, 12),
+     (3, None), (None, -4), (-9, None), (0, None), (None, 0)],
+)
+def test_integer_bounds_exact(lo, hi):
+    """The digit-interval automaton accepts exactly the integers in
+    range — brute-force checked against int comparison."""
+    schema = {"type": "integer"}
+    if lo is not None:
+        schema["minimum"] = lo
+    if hi is not None:
+        schema["maximum"] = hi
+    nfa = compile_schema(schema)
+    for v in list(range(-60, 61)) + [1234, -1234, 40162, 40163, 40164, 99999]:
+        want = (lo is None or v >= lo) and (hi is None or v <= hi)
+        assert accepts(nfa, str(v)) == want, (v, lo, hi)
+    # canonical form only: no leading zeros / plus signs ever
+    assert not accepts(nfa, "007")
+    assert not accepts(nfa, "+3")
+
+
+def test_integer_exclusive_bounds():
+    nfa = compile_schema(
+        {"type": "integer", "exclusiveMinimum": 2, "exclusiveMaximum": 6}
+    )
+    for v in range(-3, 10):
+        assert accepts(nfa, str(v)) == (3 <= v <= 5), v
+
+
+@pytest.mark.parametrize(
+    "pattern,good,bad",
+    [
+        (r"^[a-z]+$", ["abc", "z"], ["", "Abc", "ab1"]),
+        (r"^\d{3}-\d{4}$", ["555-0199"], ["5550199", "55-0199", "555-019"]),
+        (r"^(yes|no)$", ["yes", "no"], ["maybe", "yesno", ""]),
+        # unanchored (JSON Schema semantics): substring match
+        (r"cat", ["cat", "concatenate", "cat!"], ["dog", "ca t"]),
+        (r"^[A-Z][a-z]*( [A-Z][a-z]*)*$", ["Hello World", "A"], ["hello", "A  B"]),
+        (r"^v\d+\.\d+\.\d+$", ["v1.20.3"], ["v1.2", "1.2.3"]),
+        (r"^[^0-9]*$", ["abc", ""], ["a1"]),
+        (r"^a{2,4}$", ["aa", "aaaa"], ["a", "aaaaa"]),
+    ],
+)
+def test_string_pattern_enforced(pattern, good, bad):
+    nfa = compile_schema(
+        {
+            "type": "object",
+            "properties": {"s": {"type": "string", "pattern": pattern}},
+            "required": ["s"],
+        }
+    )
+    for s in good:
+        assert accepts(nfa, json.dumps({"s": s}, separators=(",", ":"))), s
+    for s in bad:
+        assert not accepts(nfa, json.dumps({"s": s}, separators=(",", ":"))), s
+
+
+def test_unsupported_pattern_falls_back_with_warning():
+    """Exotic constructs keep the job alive: the string is type-checked
+    but the pattern is not enforced (documented fallback)."""
+    import warnings
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        nfa = compile_schema(
+            {"type": "string", "pattern": r"^(?=lookahead)x$"}
+        )
+        assert any("not enforced" in str(x.message) for x in w)
+    assert accepts(nfa, '"anything"')
+
+
+def test_pattern_masks_drive_valid_generation():
+    """End-to-end with the token FSM: masked sampling over a byte
+    vocabulary can only produce strings matching the pattern."""
+    schema = {
+        "type": "object",
+        "properties": {"id": {"type": "string", "pattern": r"^[A-Z]{2}\d{2}$"}},
+        "required": ["id"],
+    }
+    tok = ByteTokenizer()
+    factory = schema_constraint_factory(schema, tok)
+    fsm = factory()
+    rng = np.random.default_rng(0)
+    out = bytearray()
+    for _ in range(64):
+        if fsm.is_complete():
+            break
+        ids = np.flatnonzero(fsm.allowed_tokens())
+        assert len(ids), "dead state"
+        t = int(rng.choice(ids))
+        fsm.advance(t)
+        out += tok.token_bytes(t)
+    obj = json.loads(out.decode())
+    import re
+
+    assert re.fullmatch(r"[A-Z]{2}\d{2}", obj["id"])
+
+
+def test_integer_bounds_edge_semantics():
+    """Fractional bounds round inward; draft-4 boolean and draft-2020
+    numeric exclusive forms intersect with minimum/maximum."""
+    # fractional: minimum 2.5 -> 3 is the smallest valid integer
+    nfa = compile_schema({"type": "integer", "minimum": 2.5})
+    assert not accepts(nfa, "2") and accepts(nfa, "3")
+    nfa = compile_schema({"type": "integer", "maximum": -0.5})
+    assert not accepts(nfa, "0") and accepts(nfa, "-1")
+    # draft-2020: both keywords apply independently
+    nfa = compile_schema(
+        {"type": "integer", "minimum": 10, "exclusiveMinimum": 2}
+    )
+    assert not accepts(nfa, "3") and not accepts(nfa, "9")
+    assert accepts(nfa, "10")
+    # draft-4 boolean form
+    nfa = compile_schema(
+        {"type": "integer", "minimum": 10, "exclusiveMinimum": True,
+         "maximum": 12}
+    )
+    assert not accepts(nfa, "10") and accepts(nfa, "11")
+    # exclusiveMinimum -2.5: v > -2.5 -> -2 is valid
+    nfa = compile_schema({"type": "integer", "exclusiveMinimum": -2.5})
+    assert accepts(nfa, "-2") and not accepts(nfa, "-3")
+
+
+def test_malformed_and_oversized_patterns_fall_back():
+    """Malformed braces and unbounded repetition caps degrade to the
+    unconstrained string (warning), never crash or blow up memory."""
+    import warnings
+
+    for pat in ["a{b}", "x{}", "a{2,x}", "^a{200000,}$", "a{-1}"]:
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            nfa = compile_schema({"type": "string", "pattern": pat})
+            assert any("not enforced" in str(x.message) for x in w), pat
+        assert accepts(nfa, '"whatever"'), pat
