@@ -25,7 +25,41 @@ from pathlib import Path
 import numpy as np
 
 
+_BACKEND_UP = False
+
+
+def _backend_watchdog(seconds: int = 180) -> None:
+    """The axon TPU tunnel, when down, makes the first backend touch
+    block FOREVER inside a C call (no error, signals can't preempt it) —
+    a bench run would hang until the driver gives up. A daemon thread
+    fails fast and loud instead so the outage is visible in the round
+    record."""
+    import threading
+
+    def _fire():
+        if _BACKEND_UP:
+            return
+        print(
+            json.dumps(
+                {
+                    "metric": "bench-aborted: accelerator backend "
+                    "unreachable (tunnel down?)",
+                    "value": 0,
+                    "unit": "error",
+                    "vs_baseline": 0,
+                }
+            ),
+            flush=True,
+        )
+        os._exit(3)
+
+    t = threading.Timer(seconds, _fire)
+    t.daemon = True  # never keep a finished bench process alive
+    t.start()
+
+
 def main() -> None:
+    _backend_watchdog()
     import jax
 
     from sutro_tpu.engine.config import EngineConfig
@@ -39,6 +73,10 @@ def main() -> None:
     multi = int(os.environ.get("SUTRO_BENCH_MULTI", "16"))
 
     on_tpu = jax.default_backend() not in ("cpu",)
+    # backend is up — disarm the init watchdog (compiles may take longer
+    # than its budget legitimately)
+    global _BACKEND_UP
+    _BACKEND_UP = True
     if not on_tpu:  # keep CPU smoke runs fast
         model_key = os.environ.get("SUTRO_BENCH_MODEL", "tiny-dense")
         B, steps, prompt_len = 4, 16, 16
