@@ -1,0 +1,47 @@
+"""Shared example bootstrap: `--cpu` forces the CPU backend + a tiny
+random-weights model so every example runs anywhere in seconds."""
+
+import argparse
+import sys
+from pathlib import Path
+
+# runnable from a source checkout without installation
+_repo = Path(__file__).resolve().parent.parent
+if str(_repo) not in sys.path:
+    sys.path.insert(0, str(_repo))
+
+
+def example_client(description: str):
+    """Returns (Sutro client, generation model, embedding model)."""
+    ap = argparse.ArgumentParser(description=description)
+    ap.add_argument(
+        "--cpu", action="store_true",
+        help="tiny random model on the CPU backend (fast smoke run)",
+    )
+    ap.add_argument("--model", default=None, help="catalog model override")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from sutro_tpu.sdk import Sutro
+
+        # context must cover template system prompts (~250 bytes through
+        # the byte tokenizer) PLUS each schema's minimal JSON
+        client = Sutro(
+            engine_config=dict(
+                kv_page_size=8, max_pages_per_seq=48, decode_batch_size=4,
+                max_model_len=384, max_new_tokens=64, use_pallas=False,
+                param_dtype="float32",
+            )
+        )
+        return client, args.model or "tiny-dense", "tiny-emb"
+
+    from sutro_tpu.sdk import Sutro
+
+    return (
+        Sutro(),
+        args.model or "qwen-3-0.6b",
+        "qwen-3-embedding-0.6b",
+    )

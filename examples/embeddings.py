@@ -1,0 +1,23 @@
+"""Embedding jobs: rows -> unit-norm vectors -> similarity matrix."""
+
+import numpy as np
+
+from _common import example_client
+
+
+def main() -> None:
+    so, _, emb_model = example_client(__doc__)
+    rows = [
+        "the battery lasts forever",
+        "battery life is amazing",
+        "the screen cracked immediately",
+    ]
+    df = so.embed(rows, model=emb_model)
+    vecs = np.array(df["embedding"].tolist())
+    sims = vecs @ vecs.T
+    print("similarity matrix:")
+    print(np.round(sims, 3))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""Job lifecycle and datasets: detach, poll, attach, results cache,
+dataset CRUD — the reference's ops workflows (SURVEY §3.1/§3.5)."""
+
+import tempfile
+from pathlib import Path
+
+from _common import example_client
+
+
+def main() -> None:
+    so, model, _ = example_client(__doc__)
+
+    # detached submit -> poll -> results (cached to ~/.sutro/job-results)
+    jid = so.infer(
+        ["first row", "second row"], model=model, stay_attached=False
+    )
+    print("job:", jid, "status:", so.get_job_status(jid))
+    df = so.await_job_completion(jid)
+    print(df)
+    # second fetch hits the local parquet cache
+    df2 = so.get_job_results(jid)
+    assert df2 is not None
+
+    # datasets: create -> upload -> list -> download
+    ds = so.create_dataset()
+    with tempfile.TemporaryDirectory() as td:
+        p = Path(td) / "rows.csv"
+        p.write_text("text\nalpha\nbeta\n")
+        so.upload_to_dataset(ds, str(p))
+        print("datasets:", [d["dataset_id"] for d in so.list_datasets()])
+        print("files:", so.list_dataset_files(ds))
+        so.download_from_dataset(ds, output_path=td + "/out")
+        print("downloaded:", list((Path(td) / "out").iterdir()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""The README golden path: classify 3 product reviews by sentiment.
+
+Mirrors the reference quickstart (SURVEY §6 "Quickstart golden path"):
+DataFrame in, labeled DataFrame out, schema-guaranteed labels.
+"""
+
+import pandas as pd
+
+from _common import example_client
+
+
+def main() -> None:
+    so, model, _ = example_client(__doc__)
+    df = pd.DataFrame(
+        {
+            "review_text": [
+                "great product, works perfectly",
+                "broke after one day, do not buy",
+                "it's fine I guess",
+            ]
+        }
+    )
+    out = so.classify(
+        df,
+        column="review_text",
+        classes=["positive", "negative", "neutral"],
+        model=model,
+    )
+    print(out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""Eval templates: LLM-judge scores, ranking, and Bradley-Terry Elo."""
+
+import pandas as pd
+
+from _common import example_client
+
+
+def main() -> None:
+    so, model, _ = example_client(__doc__)
+    df = pd.DataFrame(
+        {
+            "answer_a": ["Paris is the capital of France.", "It is 42."],
+            "answer_b": ["France's capital is Paris, founded long ago.",
+                         "The answer is forty-two."],
+        }
+    )
+
+    scored = so.score(
+        df,
+        criteria="Rate the factual quality of this answer.",
+        column="answer_a",
+        min_score=1,
+        max_score=5,
+        model=model,
+    )
+    print(scored)
+
+    ranked = so.rank(
+        df,
+        options=["answer_a", "answer_b"],
+        criteria="Which answer is clearer?",
+        model=model,
+        compute_elo=True,
+    )
+    print(ranked)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Constrained decoding: every row's output is schema-valid JSON.
+
+Shows both schema forms the reference accepts (Pydantic model or plain
+JSON-schema dict) plus the constraint features compiled to the byte FSM:
+enums, integer ranges (minimum/maximum), and regex string patterns.
+"""
+
+import json
+
+from pydantic import BaseModel, Field
+
+from _common import example_client
+
+
+class Ticket(BaseModel):
+    category: str = Field(
+        description="one of billing/shipping/product/other"
+    )
+    severity: int = Field(ge=1, le=5)
+
+
+def main() -> None:
+    so, model, _ = example_client(__doc__)
+    rows = [
+        "my package never arrived and support won't answer",
+        "the invoice charged me twice this month",
+    ]
+
+    # Pydantic form
+    jid = so.infer(
+        rows, model=model, output_schema=Ticket, stay_attached=False
+    )
+    df = so.await_job_completion(jid)
+    for v in df["inference_result"]:
+        print("pydantic:", json.loads(v))
+
+    # dict form with enum + integer range + regex pattern
+    schema = {
+        "type": "object",
+        "properties": {
+            "label": {"enum": ["refund", "replace", "escalate"]},
+            "confidence": {"type": "integer", "minimum": 0, "maximum": 100},
+            "case_id": {"type": "string", "pattern": r"^CASE-\d{4}$"},
+        },
+        "required": ["label", "confidence", "case_id"],
+    }
+    jid = so.infer(
+        rows, model=model, output_schema=schema, stay_attached=False
+    )
+    df = so.await_job_completion(jid)
+    for v in df["inference_result"]:
+        print("dict-schema:", json.loads(v))
+
+
+if __name__ == "__main__":
+    main()

@@ -138,14 +138,16 @@ class LocalEngine:
             # persisted. Schema compile errors surface when the job runs.
             try:
                 from .constrain import schema_constraint_factory
+                from .constrain.fsm import constraint_room
 
                 probe = schema_constraint_factory(
                     payload["output_schema"],
                     self._get_tokenizer(engine_key, mcfg),
                 )()
-                need = probe.min_tokens()
-                if need and int(sampling["max_new_tokens"]) < need + 1:
-                    sampling["max_new_tokens"] = need + 1
+                # same room rule the scheduler's truncation reserve uses
+                room = constraint_room(probe)
+                if int(sampling["max_new_tokens"]) < room:
+                    sampling["max_new_tokens"] = room
             except Exception:
                 pass
         rec = self.jobs.create(

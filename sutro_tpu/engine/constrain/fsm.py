@@ -213,3 +213,29 @@ class ConstraintFactory:
 
 def schema_constraint_factory(schema: Dict, tokenizer) -> ConstraintFactory:
     return ConstraintFactory(schema, tokenizer)
+
+
+def constraint_room(constraint) -> int:
+    """Minimum generation room (tokens) a row needs to honor its
+    constraint: the shortest accepting output plus one stop token.
+
+    Single source of truth for BOTH the job-creation max_new_tokens bump
+    (api.py) and the scheduler's truncation reserve — the two must agree
+    or admission and truncation drift apart. Constraints are duck-typed;
+    one that cannot report a minimum falls back to 1 WITH a logged
+    warning (a silent fallback would reintroduce the invalid-JSON
+    truncation bug this exists to prevent)."""
+    mt = getattr(constraint, "min_tokens", None)
+    if not callable(mt):
+        return 1
+    try:
+        return max(1, int(mt()) + 1)
+    except Exception:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "constraint min_tokens() failed; assuming 1 token of room "
+            "(schema-completeness no longer guaranteed for this row)",
+            exc_info=True,
+        )
+        return 1

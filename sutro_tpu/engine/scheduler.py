@@ -589,15 +589,26 @@ class ContinuousBatcher:
         caller re-runs the job; completed rows were already emitted) and
         returns immediately — the preemption primitive behind priority
         scheduling (reference two-priority semantics, README.md:168-171)."""
-        max_prompt = self.ecfg.max_context() - 1  # leave >=1 token of gen room
         pending = []
         for req in requests:
+            # truncation must leave enough generation room to honor the
+            # row's schema: a prompt that fills the context would leave
+            # a constrained row 1 token ("{") and silently break the
+            # guaranteed-JSON contract. Plain rows keep >=1 token.
+            need = 1
+            if req.constraint is not None:
+                from .constrain.fsm import constraint_room
+
+                need = constraint_room(req.constraint)
+            max_prompt = self.ecfg.max_context() - need
             if len(req.prompt_ids) > max_prompt:
-                if req.allow_truncate:
+                if req.allow_truncate and max_prompt > 0:
                     req = dataclasses.replace(
                         req, prompt_ids=req.prompt_ids[:max_prompt]
                     )
                 else:
+                    # schema minimum cannot fit the context at all —
+                    # an explicit per-row error beats invalid JSON
                     on_result(
                         GenResult(
                             row_id=req.row_id,

@@ -290,3 +290,74 @@ def test_page_allocator_contiguous_runs():
     assert a.free_count == 16
     big = a.alloc(16)
     assert big == list(range(1, 17))
+
+
+def test_truncation_reserves_schema_room(tiny_runner):
+    """A long prompt on a constrained row is truncated far enough that
+    the schema's minimal JSON still fits (regression: prompts that fill
+    the context left 1 token of room and emitted just "{")."""
+    import json
+
+    from sutro_tpu.engine.constrain import schema_constraint_factory
+    from sutro_tpu.engine.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    schema = {
+        "type": "object",
+        "properties": {
+            "scratchpad": {"type": "string"},
+            "label": {"enum": ["a", "b"]},
+        },
+        "required": ["scratchpad", "label"],
+    }
+    fac = schema_constraint_factory(schema, tok)
+    b = ContinuousBatcher(tiny_runner, stop_ids=tok.stop_ids())
+    cap = tiny_runner.ecfg.max_context()
+    long_prompt = np.asarray(
+        tok.encode("x" * (cap + 40)), np.int32
+    )
+    results = {}
+    b.run(
+        [
+            GenRequest(
+                row_id=0, prompt_ids=long_prompt, max_new_tokens=64,
+                temperature=0.0, constraint=fac(),
+            )
+        ],
+        on_result=lambda r: results.__setitem__(r.row_id, r),
+    )
+    r = results[0]
+    assert r.finish_reason not in ("error_too_long",)
+    obj = json.loads(tok.decode(r.token_ids))
+    assert obj["label"] in ("a", "b")
+
+
+def test_unfittable_schema_fails_row_clearly(tiny_runner):
+    """If the schema's minimal JSON cannot fit the context at all, the
+    row fails with error_too_long instead of emitting invalid JSON."""
+    from sutro_tpu.engine.constrain import schema_constraint_factory
+    from sutro_tpu.engine.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    cap = tiny_runner.ecfg.max_context()
+    # enum of one long literal whose minimal JSON exceeds the context
+    schema = {
+        "type": "object",
+        "properties": {"v": {"enum": ["y" * (cap + 16)]}},
+        "required": ["v"],
+    }
+    fac = schema_constraint_factory(schema, tok)
+    b = ContinuousBatcher(tiny_runner, stop_ids=tok.stop_ids())
+    results = {}
+    b.run(
+        [
+            GenRequest(
+                row_id=0,
+                prompt_ids=np.asarray(tok.encode("hi"), np.int32),
+                max_new_tokens=cap + 64, temperature=0.0,
+                constraint=fac(),
+            )
+        ],
+        on_result=lambda r: results.__setitem__(r.row_id, r),
+    )
+    assert results[0].finish_reason == "error_too_long"
