@@ -292,10 +292,19 @@ class SchemaCompiler:
 
         if "enum" in schema:
             return b.alt(
-                *[b.lit(json.dumps(v).encode()) for v in schema["enum"]]
+                *[
+                    # canonical no-whitespace form, like every other
+                    # structured emission in this compiler
+                    b.lit(
+                        json.dumps(v, separators=(",", ":")).encode()
+                    )
+                    for v in schema["enum"]
+                ]
             )
         if "const" in schema:
-            return b.lit(json.dumps(schema["const"]).encode())
+            return b.lit(
+                json.dumps(schema["const"], separators=(",", ":")).encode()
+            )
         for comb in ("anyOf", "oneOf"):
             if comb in schema:
                 return b.alt(

@@ -78,6 +78,24 @@ class Score(BaseSutroClient):
         return results
 
 
+def _ranking_schema(options: List[str]) -> dict:
+    """Schema for a ranked array of ``options``. Up to 5 options it
+    constrains to TRUE permutations (<=120 enum alternatives — the FSM
+    can afford exact "each label once"); beyond that it falls back to a
+    fixed-length label array (repeats possible; the prompt still demands
+    uniqueness)."""
+    if len(options) <= 5:
+        from itertools import permutations
+
+        return {"enum": [list(p) for p in permutations(options)]}
+    return {
+        "type": "array",
+        "items": {"enum": options},
+        "minItems": len(options),
+        "maxItems": len(options),
+    }
+
+
 class Rank(BaseSutroClient):
     def rank(
         self,
@@ -115,14 +133,7 @@ class Rank(BaseSutroClient):
         )
         output_schema = {
             "type": "object",
-            "properties": {
-                "ranking": {
-                    "type": "array",
-                    "items": {"enum": options},
-                    "minItems": len(options),
-                    "maxItems": len(options),
-                }
-            },
+            "properties": {"ranking": _ranking_schema(options)},
             "required": ["ranking"],
         }
         job_id = self.infer(

@@ -52,3 +52,36 @@ def test_rank_validates_options():
         d.rank(
             pd.DataFrame({"a": ["1"]}), options=["a", "missing"], criteria="c"
         )
+
+
+def test_rank_schema_is_true_permutation():
+    """<=5 options: the ranking FSM accepts only permutations (each
+    label exactly once) — repeats and omissions are rejected."""
+    import json
+
+    from sutro_tpu.engine.constrain import compile_schema
+
+    from sutro_tpu.templates.evals import _ranking_schema
+
+    options = ["a", "b", "c"]
+    schema = {
+        "type": "object",
+        "properties": {"ranking": _ranking_schema(options)},
+        "required": ["ranking"],
+    }
+    nfa = compile_schema(schema)
+
+    def accepts(text):
+        states = nfa.initial()
+        for byte in text.encode():
+            states = nfa.step(states, byte)
+            if not states:
+                return False
+        return nfa.is_accepting(states)
+
+    enc = lambda r: json.dumps({"ranking": r}, separators=(",", ":"))  # noqa: E731
+    assert accepts(enc(["b", "a", "c"]))
+    assert accepts(enc(["c", "b", "a"]))
+    assert not accepts(enc(["a", "a", "b"]))   # repeat
+    assert not accepts(enc(["a", "b"]))        # omission
+    assert not accepts(enc(["a", "b", "d"]))   # unknown label
