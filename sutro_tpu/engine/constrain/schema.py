@@ -39,6 +39,29 @@ _DIGIT = bitmap((0x30, 0x39))
 _DIGIT19 = bitmap((0x31, 0x39))
 
 
+# canonical textual grammars for the common string formats (enforced —
+# see the format branch in compile_node). RFC-shaped, not exhaustive
+# calendars: 2026-02-31 passes (per-month day counts would explode the
+# automaton for negligible gain).
+_DATE = r"\d{4}-(0[1-9]|1[0-2])-(0[1-9]|[12][0-9]|3[01])"
+_TIME = (
+    r"([01][0-9]|2[0-3]):[0-5][0-9]:[0-5][0-9]"
+    r"(\.[0-9]{1,9})?(Z|[+-]([01][0-9]|2[0-3]):[0-5][0-9])?"
+)
+_IPV4_OCTET = r"(25[0-5]|2[0-4][0-9]|1[0-9][0-9]|[1-9]?[0-9])"
+_FORMAT_PATTERNS = {
+    "uuid": (
+        r"^[0-9a-f]{8}-[0-9a-f]{4}-[0-9a-f]{4}-"
+        r"[0-9a-f]{4}-[0-9a-f]{12}$"
+    ),
+    "date": f"^{_DATE}$",
+    "time": f"^{_TIME}$",
+    "date-time": f"^{_DATE}T{_TIME}$",
+    "email": r"^[A-Za-z0-9._%+-]{1,64}@[A-Za-z0-9.-]{1,63}\.[A-Za-z]{2,24}$",
+    "ipv4": f"^({_IPV4_OCTET}\\.){{3}}{_IPV4_OCTET}$",
+}
+
+
 class SchemaCompiler:
     def __init__(self, schema: Dict[str, Any]):
         self.b = Builder()
@@ -319,6 +342,26 @@ class SchemaCompiler:
         if t == "string":
             if "pattern" in schema:
                 frag = self._pattern_frag(schema["pattern"])
+                if frag is not None:
+                    return frag
+            if (
+                schema.get("format") in _FORMAT_PATTERNS
+                # a validator enforces minLength/maxLength but treats
+                # format as annotation — when both appear, the length
+                # bounds must win or generated values could fail the
+                # user's own validation
+                and "minLength" not in schema
+                and "maxLength" not in schema
+            ):
+                # JSON Schema treats format as annotation, but schema-
+                # constrained users expect e.g. a Pydantic UUID field to
+                # BE a UUID — enforce the well-known ones via the same
+                # regex path (canonical textual forms). Also the
+                # fallback when a user pattern fails to compile: closer
+                # than an unconstrained string.
+                frag = self._pattern_frag(
+                    _FORMAT_PATTERNS[schema["format"]]
+                )
                 if frag is not None:
                     return frag
             return self._string_frag(

@@ -482,3 +482,59 @@ def test_malformed_and_oversized_patterns_fall_back():
             nfa = compile_schema({"type": "string", "pattern": pat})
             assert any("not enforced" in str(x.message) for x in w), pat
         assert accepts(nfa, '"whatever"'), pat
+
+
+@pytest.mark.parametrize(
+    "fmt,good,bad",
+    [
+        ("uuid", ["123e4567-e89b-12d3-a456-426614174000"],
+         ["123e4567e89b12d3a456426614174000", "123E4567-e89b-12d3-a456-426614174000", "xyz"]),
+        ("date", ["2026-07-30", "1999-12-01"],
+         ["2026-13-01", "2026-00-10", "2026-01-32", "26-07-30"]),
+        ("date-time", ["2026-07-30T23:59:59Z", "2026-07-30T00:00:00+05:30",
+                       "2026-07-30T12:00:00.123"],
+         ["2026-07-30 12:00:00", "2026-07-30T24:00:00Z"]),
+        ("time", ["23:59:59", "00:00:00Z", "12:30:45.5+05:30"],
+         ["24:00:00", "12:60:00", "1:00:00", "12:00"]),
+        ("email", ["a@b.co", "first.last+tag@example.org"],
+         ["no-at-sign", "@x.com", "a@b", "a@b."]),
+        ("ipv4", ["0.0.0.0", "255.255.255.255", "192.168.1.7"],
+         ["256.1.1.1", "1.2.3", "01.2.3.4", "1.2.3.4.5"]),
+    ],
+)
+def test_string_format_enforced(fmt, good, bad):
+    nfa = compile_schema({"type": "string", "format": fmt})
+    for s in good:
+        assert accepts(nfa, json.dumps(s)), (fmt, s)
+    for s in bad:
+        assert not accepts(nfa, json.dumps(s)), (fmt, s)
+
+
+def test_unknown_format_is_annotation_only():
+    nfa = compile_schema({"type": "string", "format": "hostname"})
+    assert accepts(nfa, '"anything at all"')
+
+
+def test_format_with_length_bounds_defers_to_lengths():
+    """minLength/maxLength are validator-enforced; format is annotation.
+    When both appear the length bounds win, so generated values never
+    fail the user's own validation."""
+    nfa = compile_schema(
+        {"type": "string", "format": "uuid", "maxLength": 10}
+    )
+    assert accepts(nfa, '"short"')          # within maxLength
+    assert not accepts(nfa, '"12345678901"')  # 11 chars > maxLength
+
+
+def test_unsupported_pattern_falls_back_to_format():
+    """A pattern outside the regex subset degrades to the format grammar
+    (closer than an unconstrained string) when one is available."""
+    import warnings
+
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        nfa = compile_schema(
+            {"type": "string", "pattern": r"(?=x)a", "format": "ipv4"}
+        )
+    assert accepts(nfa, '"10.0.0.1"')
+    assert not accepts(nfa, '"not an ip"')
