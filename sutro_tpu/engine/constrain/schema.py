@@ -11,11 +11,13 @@ Optional (non-required) properties are genuinely optional branches in the
 automaton. Supported schema features: object/properties/required (incl.
 nested), string (enum/const, minLength/maxLength, ``pattern`` via the
 regex subset in constrain/regex.py — unsupported constructs fall back to
-type-valid-unchecked with a warning), integer (exact minimum/maximum/
-exclusive bounds via a digit-interval automaton), number, boolean, null,
-array (items, minItems/maxItems small), anyOf/oneOf, $ref/$defs (one
-level of indirection, as produced by Pydantic), additionalProperties
-ignored.
+type-valid-unchecked with a warning; well-known ``format`` grammars
+enforced), integer (exact minimum/maximum/exclusive bounds via a
+digit-interval automaton), number (exact minimum/maximum incl. STRICT
+real bounds via a decimal interval automaton — bounded numbers emit in
+plain positional form, no exponent), boolean, null, array (items,
+minItems/maxItems small), anyOf/oneOf, $ref/$defs (one level of
+indirection, as produced by Pydantic), additionalProperties ignored.
 """
 
 from __future__ import annotations
@@ -60,6 +62,22 @@ _FORMAT_PATTERNS = {
     "email": r"^[A-Za-z0-9._%+-]{1,64}@[A-Za-z0-9.-]{1,63}\.[A-Za-z]{2,24}$",
     "ipv4": f"^({_IPV4_OCTET}\\.){{3}}{_IPV4_OCTET}$",
 }
+
+
+def _dec_digits(value) -> Tuple[str, str]:
+    """Decimal -> (integer-digit string, fraction-digit string), both
+    without signs; e.g. 12.305 -> ("12", "305"), 7 -> ("7", "")."""
+    import decimal
+
+    # copy_abs also strips the sign of negative zero (-0.0 compares == 0,
+    # so `if d < 0: d = -d` would leak the '-' into the digit string)
+    d = decimal.Decimal(value).copy_abs()
+    s = format(d, "f")
+    if "." in s:
+        i, f = s.split(".", 1)
+    else:
+        i, f = s, ""
+    return (i.lstrip("0") or "0"), f
 
 
 class SchemaCompiler:
@@ -272,6 +290,237 @@ class SchemaCompiler:
         )
         return b.seq(self._integer_frag(), b.opt(frac), b.opt(exp))
 
+    # -- bounded decimals --------------------------------------------------
+    def _bounded_number_frag(
+        self, lo, hi, open_lo: bool = False, open_hi: bool = False
+    ) -> Frag:
+        """Plain decimals (canonical positional form, NO exponent — a
+        deliberate canonicalization for bounded numbers) in the interval
+        between ``lo`` and ``hi`` (``decimal.Decimal`` or None for an
+        open side; ``open_*`` make the bound strict). Exact including
+        strict real bounds: the tight digit walk simply never accepts
+        the boundary string itself. The negative side mirrors via
+        reversed magnitudes."""
+        import decimal
+
+        b = self.b
+        ZERO = decimal.Decimal(0)
+        alts: List[Frag] = []
+        # negative side: value v = -m; v >= lo <=> m <= -lo (open flips
+        # to the magnitude's high side), v <= hi<=0 <=> m >= -hi
+        if lo is None or lo < 0:
+            if hi is not None and hi <= 0:
+                m_lo, m_open_lo = -hi, open_hi
+            else:
+                m_lo, m_open_lo = None, False
+            m_hi = None if lo is None else -lo
+            neg = self._nonneg_decimal(
+                m_lo, m_hi, open_lo=m_open_lo, open_hi=open_lo
+            )
+            if neg is not None:
+                alts.append(b.seq(b.lit(b"-"), neg))
+        # non-negative side (absent when hi < 0, or hi == 0 strict)
+        if hi is None or hi > 0 or (hi == 0 and not open_hi):
+            if lo is not None and lo >= 0:
+                nn_lo, nn_open = lo, open_lo
+            else:
+                nn_lo, nn_open = ZERO, False
+            nn = self._nonneg_decimal(
+                nn_lo, hi, open_lo=nn_open, open_hi=open_hi
+            )
+            if nn is not None:
+                alts.append(nn)
+        if not alts:
+            raise ValueError(f"empty number interval [{lo}, {hi}]")
+        return b.alt(*alts) if len(alts) > 1 else alts[0]
+
+    def _nonneg_decimal(
+        self, lo, hi, open_lo: bool = False, open_hi: bool = False
+    ) -> Optional[Frag]:
+        """Decimals d >= 0 between lo and hi (None = open side). Split
+        by integer-digit count so leading zeros never arise; only the
+        spans touching a bound walk tight. None = empty language."""
+        import decimal
+
+        b = self.b
+        if lo is None or lo < 0:
+            lo, open_lo = decimal.Decimal(0), False
+        if hi is not None and (lo > hi or (lo == hi and (open_lo or open_hi))):
+            return None
+        ilo_len = max(len(str(int(lo))), 1)
+        alts: List[Frag] = []
+        if hi is None:
+            span = self._decimal_span(lo, None, ilo_len, open_lo, False)
+            if span is not None:
+                alts.append(span)
+            # any number with more integer digits clears lo
+            alts.append(
+                b.seq(
+                    b.char(_DIGIT19),
+                    *[b.char(_DIGIT) for _ in range(ilo_len)],
+                    b.star(b.char(_DIGIT)),
+                    b.opt(b.seq(b.lit(b"."), b.plus(b.char(_DIGIT)))),
+                )
+            )
+        else:
+            ihi_len = max(len(str(int(hi))), 1)
+            if ilo_len == ihi_len:
+                span = self._decimal_span(
+                    lo, hi, ilo_len, open_lo, open_hi
+                )
+                if span is not None:
+                    alts.append(span)
+            else:
+                # tight-low span at lo's width, tight-high span at hi's
+                # width, and ONE compact fragment for every interior
+                # integer-digit length — O(width) total, not a per-
+                # length span loop (quadratic for astronomically wide
+                # bounds like le=1.8e308)
+                span = self._decimal_span(
+                    lo, None, ilo_len, open_lo, False
+                )
+                if span is not None:
+                    alts.append(span)
+                if ihi_len - ilo_len >= 2:
+                    mlo, mhi = ilo_len + 1, ihi_len - 1
+                    tail = None
+                    for _ in range(mhi - mlo):
+                        piece = b.char(_DIGIT)
+                        tail = b.opt(
+                            piece if tail is None else b.seq(piece, tail)
+                        )
+                    parts: List[Frag] = [b.char(_DIGIT19)]
+                    parts += [b.char(_DIGIT) for _ in range(mlo - 1)]
+                    if tail is not None:
+                        parts.append(tail)
+                    parts.append(
+                        b.opt(b.seq(b.lit(b"."), b.plus(b.char(_DIGIT))))
+                    )
+                    alts.append(b.seq(*parts))
+                span = self._decimal_span(
+                    decimal.Decimal(10 ** (ihi_len - 1)), hi, ihi_len,
+                    False, open_hi,
+                )
+                if span is not None:
+                    alts.append(span)
+        if not alts:
+            return None
+        return b.alt(*alts) if len(alts) > 1 else alts[0]
+
+    def _decimal_span(
+        self, lo, hi, width: int, open_lo: bool, open_hi: bool
+    ) -> Optional[Frag]:
+        """Decimals whose integer part has exactly ``width`` digits
+        (width 1 admits 0), between lo and hi. ``hi`` None = free high
+        side WITHIN this width (caller caps the span). Returns None for
+        an empty language (e.g. lo == hi with a strict bound)."""
+        b = self.b
+        ilo, flo = _dec_digits(lo)
+        ilo = ilo.rjust(width, "0")
+        flo = flo.rstrip("0")
+        if hi is not None:
+            ihi, fhi = _dec_digits(hi)
+            ihi = ihi.rjust(width, "0")
+            fhi = fhi.rstrip("0")
+        else:
+            ihi, fhi = "", ""
+        memo: Dict[Tuple[str, int, bool, bool, bool], Optional[Frag]] = {}
+
+        def frac(j: int, tl: bool, th: bool, first: bool) -> Optional[Frag]:
+            # tight-low normalization: once lo's remaining fraction
+            # digits are all zeros (flo is stripped, so that means
+            # exhausted), a CLOSED low bound is vacuously satisfied; a
+            # STRICT one persists (the value must still exceed lo)
+            if tl and j >= len(flo) and not open_lo:
+                tl = False
+            if not tl and not th:
+                d = b.char(_DIGIT)
+                return b.plus(d) if first else b.star(d)
+            exhausted_lo = tl and j >= len(flo)   # strict-low equality path
+            exhausted_hi = th and j >= len(fhi)
+            if exhausted_lo and exhausted_hi:
+                # prefix equals BOTH bounds' extensions: only zeros can
+                # follow, value stays == lo (== hi); a strict bound on
+                # either side makes this path dead
+                return None if (open_lo or open_hi) else (
+                    b.plus(b.char(bitmap_of(b"0"))) if first
+                    else b.star(b.char(bitmap_of(b"0")))
+                )
+            if exhausted_lo and not th:
+                # strict low, equality so far: zeros then a nonzero
+                # digit, then free
+                return b.seq(
+                    b.star(b.char(bitmap_of(b"0"))),
+                    b.char(_DIGIT19),
+                    b.star(b.char(_DIGIT)),
+                )
+            if exhausted_hi and not tl:
+                # equality-with-hi path: zeros keep it equal — dead when
+                # strict, zeros-only when closed
+                if open_hi:
+                    return None
+                z = b.char(bitmap_of(b"0"))
+                return b.plus(z) if first else b.star(z)
+            key = ("f", j, tl, th, first)
+            if key in memo:
+                return memo[key]
+            lo_d = int(flo[j]) if (tl and j < len(flo)) else 0
+            hi_d = int(fhi[j]) if (th and j < len(fhi)) else (0 if th else 9)
+            alts = []
+            for d in range(lo_d, hi_d + 1):
+                rest = frac(
+                    j + 1, tl and d == lo_d, th and d == hi_d, False
+                )
+                if rest is not None:
+                    alts.append(b.seq(b.lit(str(d).encode()), rest))
+            # stop: value becomes prefix+zeros. While tl (closed, digits
+            # remain) that undershoots lo; under strict-low equality it
+            # EQUALS lo — both forbidden, so `not tl` covers it. On the
+            # high side j < len(fhi) here, so prefix+zeros < hi strictly.
+            if not first and not tl:
+                alts.append(b.seq())
+            f = b.alt(*alts) if alts else None
+            memo[key] = f
+            return f
+
+        def intpart(i: int, tl: bool, th: bool) -> Optional[Frag]:
+            if i == width:
+                dot_body = frac(0, tl, th, True)
+                dot = (
+                    None if dot_body is None
+                    else b.seq(b.lit(b"."), dot_body)
+                )
+                # stopping here = integer value (no fraction): equals lo
+                # exactly iff tl and flo empty; equals hi iff th and fhi
+                # empty (strict bounds forbid those)
+                stop_ok = not (tl and (len(flo) > 0 or open_lo))
+                if stop_ok and th and len(fhi) == 0 and open_hi:
+                    stop_ok = False
+                if dot is None and not stop_ok:
+                    return None
+                if not stop_ok:
+                    return dot
+                if dot is None:
+                    return b.seq()
+                return b.opt(dot)
+            key = ("i", i, tl, th, False)
+            if key in memo:
+                return memo[key]
+            lo_d = int(ilo[i]) if tl else 0
+            hi_d = int(ihi[i]) if th else 9
+            if i == 0 and width > 1 and not tl:
+                lo_d = max(lo_d, 1)  # no leading zeros
+            alts = []
+            for d in range(lo_d, hi_d + 1):
+                rest = intpart(i + 1, tl and d == lo_d, th and d == hi_d)
+                if rest is not None:
+                    alts.append(b.seq(b.lit(str(d).encode()), rest))
+            f = b.alt(*alts) if alts else None
+            memo[key] = f
+            return f
+
+        return intpart(0, True, hi is not None)
+
     def _pattern_frag(self, pattern: str) -> Optional[Frag]:
         """Compile a string ``pattern`` (constrain/regex.py). Returns
         None — unconstrained-string fallback — for constructs the regex
@@ -376,6 +625,11 @@ class SchemaCompiler:
                 return self._bounded_int_frag(lo, hi)
             return self._integer_frag()
         if t == "number":
+            nlo, n_open_lo, nhi, n_open_hi = _number_bounds(schema)
+            if nlo is not None or nhi is not None:
+                return self._bounded_number_frag(
+                    nlo, nhi, open_lo=n_open_lo, open_hi=n_open_hi
+                )
             return self._number_frag()
         if t == "boolean":
             return b.alt(b.lit(b"true"), b.lit(b"false"))
@@ -471,6 +725,43 @@ class SchemaCompiler:
 
     def compile(self) -> NFA:
         return self.b.build(self.compile_node(self.schema))
+
+
+def _number_bounds(schema: Dict[str, Any]):
+    """Effective (lo, open_lo, hi, open_hi) for a number schema as
+    Decimals + strictness flags. Numeric exclusive bounds (draft 2020)
+    apply independently of minimum/maximum; the draft-4 boolean form
+    flips the adjacent bound strict. The tightest combination wins, and
+    a strict bound at the same value as a closed one stays strict."""
+    import decimal
+
+    def dec(v):
+        # non-finite bounds (a Python-dict schema can carry float inf/
+        # nan) constrain nothing — treat as the open side
+        if v is None:
+            return None
+        d = decimal.Decimal(str(v))
+        return d if d.is_finite() else None
+
+    lo = dec(schema.get("minimum"))
+    hi = dec(schema.get("maximum"))
+    open_lo = open_hi = False
+
+    emin = schema.get("exclusiveMinimum")
+    if isinstance(emin, bool):
+        open_lo = emin and lo is not None
+    else:
+        v = dec(emin)
+        if v is not None and (lo is None or v >= lo):
+            lo, open_lo = v, True
+    emax = schema.get("exclusiveMaximum")
+    if isinstance(emax, bool):
+        open_hi = emax and hi is not None
+    else:
+        v = dec(emax)
+        if v is not None and (hi is None or v <= hi):
+            hi, open_hi = v, True
+    return lo, open_lo, hi, open_hi
 
 
 def _integer_bounds(

@@ -538,3 +538,105 @@ def test_unsupported_pattern_falls_back_to_format():
         )
     assert accepts(nfa, '"10.0.0.1"')
     assert not accepts(nfa, '"not an ip"')
+
+
+@pytest.mark.parametrize(
+    "lo,hi",
+    [("0", "10"), ("1.5", "3.5"), ("0.25", "0.75"), ("2", "2"),
+     ("-5.5", "5.5"), ("-30.2", "-7.85"), ("17", "40163.125"),
+     (None, "12.5"), ("3.25", None), (None, "-4.5"), ("-0.5", None)],
+)
+def test_number_bounds_exact(lo, hi):
+    """The decimal interval automaton accepts exactly the in-range
+    plain decimals — brute-force checked against Decimal comparison."""
+    import decimal
+
+    schema = {"type": "number"}
+    if lo is not None:
+        schema["minimum"] = float(lo)
+    if hi is not None:
+        schema["maximum"] = float(hi)
+    nfa = compile_schema(schema)
+    dlo = None if lo is None else decimal.Decimal(lo)
+    dhi = None if hi is None else decimal.Decimal(hi)
+
+    cands = set()
+    for base in [-31, -30.2, -8, -7.85, -7.8, -5.5, -4.5, -4.49, -1,
+                 -0.75, -0.5, -0.25, 0, 0.24, 0.25, 0.5, 0.75, 0.76,
+                 1, 1.4, 1.5, 2, 2.5, 3.5, 3.51, 5.5, 9, 10, 10.5, 12.5,
+                 12.51, 17, 40163, 40163.125, 40163.13, 99999]:
+        cands.add(str(decimal.Decimal(str(base))))
+    for s in sorted(cands):
+        v = decimal.Decimal(s)
+        want = (dlo is None or v >= dlo) and (dhi is None or v <= dhi)
+        assert accepts(nfa, s) == want, (s, lo, hi)
+    # canonical form only
+    assert not accepts(nfa, "01.5")
+    assert not accepts(nfa, "1.")
+    assert not accepts(nfa, "+2")
+    assert not accepts(nfa, "2e0")  # no exponent form under bounds
+    # trailing zeros are fine when the value is in range
+    mid = dlo if dlo is not None else dhi
+    if mid is not None:
+        s = str(mid)
+        if "." in s:
+            assert accepts(nfa, s + "0") == (
+                (dlo is None or mid >= dlo) and (dhi is None or mid <= dhi)
+            )
+
+
+def test_number_exclusive_bounds_are_subset():
+    """Exclusive real bounds: the compiled language must EXCLUDE the
+    boundary and stay within the open interval."""
+    nfa = compile_schema(
+        {"type": "number", "exclusiveMinimum": 1.5, "exclusiveMaximum": 4}
+    )
+    assert not accepts(nfa, "1.5")
+    assert not accepts(nfa, "4")
+    assert accepts(nfa, "2")
+    assert accepts(nfa, "3.999")
+    assert not accepts(nfa, "1.4")
+    assert not accepts(nfa, "4.1")
+
+
+def test_number_exclusive_bounds_arbitrary_depth():
+    """Strict real bounds admit values arbitrarily close to the
+    boundary but never the boundary itself (at any trailing-zero
+    depth)."""
+    nfa = compile_schema(
+        {"type": "number", "exclusiveMinimum": 1.5, "exclusiveMaximum": 4}
+    )
+    for good in ["1.500001", "1.51", "3.9999999", "2", "3.5"]:
+        assert accepts(nfa, good), good
+    for bad in ["1.5", "1.50", "1.5000", "4", "4.0", "4.000", "1.49",
+                "4.0001"]:
+        assert not accepts(nfa, bad), bad
+
+
+def test_number_negative_strict_zero():
+    """maximum 0 strict => only negative values; "-0" variants equal
+    zero and must be rejected."""
+    nfa = compile_schema({"type": "number", "exclusiveMaximum": 0})
+    for good in ["-0.001", "-1", "-99.5"]:
+        assert accepts(nfa, good), good
+    for bad in ["0", "0.0", "-0", "-0.0", "-0.000", "0.001"]:
+        assert not accepts(nfa, bad), bad
+
+
+def test_number_bounds_edge_cases():
+    """Negative-zero bounds compile (sign-strip regression) and
+    astronomically wide bounds stay cheap (O(width) construction)."""
+    import time
+
+    nfa = compile_schema({"type": "number", "minimum": -0.0})
+    assert accepts(nfa, "0") and accepts(nfa, "7.5")
+    assert not accepts(nfa, "-1")
+
+    t0 = time.monotonic()
+    nfa = compile_schema({"type": "number", "minimum": 0,
+                          "maximum": 1.7e308})
+    dt = time.monotonic() - t0
+    assert dt < 1.0, f"wide-bound compile took {dt:.2f}s"
+    assert accepts(nfa, "12345.678")
+    assert accepts(nfa, "9" * 300)
+    assert not accepts(nfa, "-1")
