@@ -170,39 +170,48 @@ class SchemaCompiler:
         )
         return b.seq(b.opt(b.lit(b"-")), body)
 
-    def _digits_interval(self, a: str, c: str) -> Frag:
+    def _digits_interval(
+        self, a: str, c: str, mod: Optional[int] = None
+    ) -> Optional[Frag]:
         """Digit strings d with ``a <= d <= c`` (equal lengths, no
         leading-zero concerns — callers arrange that). Classic
         tight-prefix construction: state = (position, still tight to the
         low bound, still tight to the high bound); memoized so the
-        fragment graph is O(len * 10)."""
+        fragment graph is O(len * 10). With ``mod`` the walk also
+        tracks the running remainder (product automaton) and only
+        strings whose VALUE is divisible by ``mod`` are accepted —
+        exact multipleOf composed with the interval. Returns None when
+        the language is empty (no multiple in range)."""
         b = self.b
-        memo: Dict[Tuple[int, bool, bool], Frag] = {}
+        memo: Dict[Tuple[int, bool, bool, int], Optional[Frag]] = {}
 
-        def rec(i: int, tl: bool, th: bool) -> Frag:
+        def rec(i: int, tl: bool, th: bool, r: int) -> Optional[Frag]:
             if i == len(a):
+                if mod is not None and r != 0:
+                    return None
                 return b.seq()  # epsilon
-            key = (i, tl, th)
-            got = memo.get(key)
-            if got is not None:
-                return got
+            key = (i, tl, th, r)
+            if key in memo:
+                return memo[key]
             lo_d = int(a[i]) if tl else 0
             hi_d = int(c[i]) if th else 9
             alts = []
             for d in range(lo_d, hi_d + 1):
-                nxt = rec(i + 1, tl and d == lo_d, th and d == hi_d)
-                alts.append(
-                    b.seq(b.lit(str(d).encode()), nxt)
-                )
-            frag = b.alt(*alts)
+                nr = (r * 10 + d) % mod if mod is not None else 0
+                nxt = rec(i + 1, tl and d == lo_d, th and d == hi_d, nr)
+                if nxt is not None:
+                    alts.append(b.seq(b.lit(str(d).encode()), nxt))
+            frag = b.alt(*alts) if alts else None
             memo[key] = frag
             return frag
 
-        return rec(0, True, True)
+        return rec(0, True, True, 0)
 
-    def _nonneg_interval(self, lo: int, hi: int) -> Frag:
+    def _nonneg_interval(
+        self, lo: int, hi: int, mod: Optional[int] = None
+    ) -> Optional[Frag]:
         """Decimal representations (no leading zeros) of [lo, hi],
-        lo >= 0."""
+        lo >= 0, optionally restricted to multiples of ``mod``."""
         b = self.b
         alts: List[Frag] = []
         a0, c0 = str(lo), str(hi)
@@ -211,74 +220,181 @@ class SchemaCompiler:
             c_l = c0 if L == len(c0) else "9" * L
             if int(a_l) > int(c_l):
                 continue
-            alts.append(self._digits_interval(a_l, c_l))
-        return b.alt(*alts)
+            frag = self._digits_interval(a_l, c_l, mod)
+            if frag is not None:
+                alts.append(frag)
+        if not alts:
+            return None
+        return b.alt(*alts) if len(alts) > 1 else alts[0]
 
-    def _bounded_int_frag(self, lo: Optional[int], hi: Optional[int]) -> Frag:
-        """Integers restricted by JSON-schema minimum/maximum.
+    def _bounded_int_frag(
+        self,
+        lo: Optional[int],
+        hi: Optional[int],
+        mod: Optional[int] = None,
+    ) -> Frag:
+        """Integers restricted by JSON-schema minimum/maximum and
+        (optionally) ``multipleOf``.
 
         Exact in every case: two-sided bounds use the interval automaton
-        over digit positions on each sign's magnitude; one-sided bounds
-        bound one sign's magnitude and leave the other open. The only
-        approximation anywhere is none — e.g. ``minimum: -5`` accepts
-        exactly ``-5..-1`` plus every non-negative integer."""
+        over digit positions on each sign's magnitude — with ``mod``
+        the same walk carries the running remainder (product automaton),
+        so e.g. minimum 3 / maximum 100 / multipleOf 7 admits exactly
+        7, 14, ..., 98. One-sided bounds bound one sign's magnitude and
+        leave the other open (k | v <=> k | |v|, so the mod walk applies
+        per magnitude)."""
         b = self.b
 
         # lazy: Builder fragments allocate states immediately, so only
         # the branch taken should construct its pieces
         def nonneg() -> Frag:
+            if mod is not None:
+                return self._mod_dfa(mod, include_zero=True)
             return b.alt(
                 b.lit(b"0"),
                 b.seq(b.char(_DIGIT19), b.star(b.char(_DIGIT))),
             )
 
         def positive() -> Frag:
+            if mod is not None:
+                return self._mod_dfa(mod, include_zero=False)
             return b.seq(b.char(_DIGIT19), b.star(b.char(_DIGIT)))
+
+        def guard(f: Optional[Frag]) -> Frag:
+            if f is None:
+                raise ValueError(
+                    f"no multiple of {mod} in integer range [{lo}, {hi}]"
+                )
+            return f
 
         if lo is not None and hi is not None:
             if lo > hi:
                 raise ValueError(f"integer minimum {lo} > maximum {hi}")
             alts = []
             if hi < 0:
-                return b.seq(b.lit(b"-"), self._nonneg_interval(-hi, -lo))
-            if lo < 0:
-                alts.append(
-                    b.seq(b.lit(b"-"), self._nonneg_interval(1, -lo))
+                return b.seq(
+                    b.lit(b"-"),
+                    guard(self._nonneg_interval(-hi, -lo, mod)),
                 )
+            if lo < 0:
+                neg = self._nonneg_interval(1, -lo, mod)
+                if neg is not None:
+                    alts.append(b.seq(b.lit(b"-"), neg))
                 lo = 0
-            alts.append(self._nonneg_interval(lo, hi))
-            return b.alt(*alts)
+            pos = self._nonneg_interval(lo, hi, mod)
+            if pos is not None:
+                alts.append(pos)
+            if not alts:
+                raise ValueError(
+                    f"no multiple of {mod} in integer range [{lo}, {hi}]"
+                )
+            return b.alt(*alts) if len(alts) > 1 else alts[0]
         if lo is not None:  # [lo, inf)
             if lo > 0:
-                return self._unbounded_above(lo)
+                return self._unbounded_above(lo, mod)
             if lo == 0:
                 return nonneg()
             # negatives down to lo, all non-negatives
-            return b.alt(
-                b.seq(b.lit(b"-"), self._nonneg_interval(1, -lo)), nonneg()
-            )
+            alts = [nonneg()]
+            neg = self._nonneg_interval(1, -lo, mod)
+            if neg is not None:
+                alts.append(b.seq(b.lit(b"-"), neg))
+            return b.alt(*alts) if len(alts) > 1 else alts[0]
         if hi is not None:  # (-inf, hi]
             if hi < 0:
-                return b.seq(b.lit(b"-"), self._unbounded_above(-hi))
+                return b.seq(b.lit(b"-"), self._unbounded_above(-hi, mod))
             # all negatives, non-negatives up to hi
-            return b.alt(
-                b.seq(b.lit(b"-"), positive()), self._nonneg_interval(0, hi)
-            )
+            alts = [b.seq(b.lit(b"-"), positive())]
+            pos = self._nonneg_interval(0, hi, mod)
+            if pos is not None:
+                alts.append(pos)
+            return b.alt(*alts) if len(alts) > 1 else alts[0]
+        if mod is not None:
+            return b.seq(b.opt(b.lit(b"-")), nonneg())
         return self._integer_frag()
 
-    def _unbounded_above(self, lo: int) -> Frag:
-        """Exact [lo, inf) for lo >= 1: magnitudes of the same digit
-        count bounded below by the interval automaton, any longer
-        digit string unbounded."""
+    def _mod_core(self, k: int) -> Tuple[List[int], int]:
+        """Remainder-state machine shared by the divisibility paths:
+        k states with digit edges r -> (10r+d) % k, plus the accept
+        state reachable (epsilon) from remainder 0. O(k * 10) edges."""
+        b = self.b
+        states = [b.state() for _ in range(k)]
+        accept = b.state()
+        for r in range(k):
+            for d in range(10):
+                b.edge(
+                    states[r],
+                    bitmap_of(str(d).encode()),
+                    states[(r * 10 + d) % k],
+                )
+        b.epsilon(states[0], accept)
+        return states, accept
+
+    def _mod_dfa(self, k: int, include_zero: bool) -> Frag:
+        """Non-negative decimal strings (no leading zeros) whose value
+        is divisible by ``k``."""
+        b = self.b
+        states, accept = self._mod_core(k)
+        start = b.state()
+        for d in range(1, 10):
+            b.edge(start, bitmap_of(str(d).encode()), states[d % k])
+        if include_zero:
+            z = b.state()
+            b.edge(start, bitmap_of(b"0"), z)
+            b.epsilon(z, accept)
+        return start, accept
+
+    def _unbounded_above(self, lo: int, mod: Optional[int] = None) -> Frag:
+        """Exact [lo, inf) for lo >= 1 (optionally multiples of
+        ``mod``): magnitudes of the same digit count bounded below by
+        the interval automaton, any longer digit string free — with
+        ``mod`` the longer branch threads its running remainder through
+        the same-length walk into a remainder DFA tail."""
         b = self.b
         a0 = str(lo)
-        same_len = self._digits_interval(a0, "9" * len(a0))
-        longer = b.seq(
-            b.char(_DIGIT19),
-            *[b.char(_DIGIT) for _ in range(len(a0))],
-            b.star(b.char(_DIGIT)),
-        )
-        return b.alt(same_len, longer)
+        alts: List[Frag] = []
+        same = self._digits_interval(a0, "9" * len(a0), mod)
+        if same is not None:
+            alts.append(same)
+        if mod is None:
+            longer = b.seq(
+                b.char(_DIGIT19),
+                *[b.char(_DIGIT) for _ in range(len(a0))],
+                b.star(b.char(_DIGIT)),
+            )
+            alts.append(longer)
+        else:
+            # longer strings: walk len(a0)+1 leading digits tracking the
+            # remainder, then land in the mod-DFA's remainder states
+            states, accept = self._mod_core(mod)
+            # feeders: (len(a0)+1)-digit prefixes ending at remainder r
+            # can stop (accept iff r == 0) or continue in the DFA
+            feed: Dict[int, int] = {}
+
+            def feeder(i: int, r: int) -> int:
+                key = i * mod + r
+                got = feed.get(key)
+                if got is not None:
+                    return got
+                s = b.state()
+                if i == len(a0) + 1:
+                    b.epsilon(s, states[r])
+                else:
+                    first = i == 0
+                    for d in range(0 if not first else 1, 10):
+                        b.edge(
+                            s,
+                            bitmap_of(str(d).encode()),
+                            feeder(i + 1, (r * 10 + d) % mod),
+                        )
+                feed[key] = s
+                return s
+
+            alts.append((feeder(0, 0), accept))
+        # alts is never empty: the longer/feeder branch is unconditional
+        # (multiples of mod >= lo always exist)
+        assert alts
+        return b.alt(*alts) if len(alts) > 1 else alts[0]
 
     def _number_frag(self) -> Frag:
         b = self.b
@@ -621,10 +737,44 @@ class SchemaCompiler:
             )
         if t == "integer":
             lo, hi = _integer_bounds(schema)
-            if lo is not None or hi is not None:
-                return self._bounded_int_frag(lo, hi)
+            mod = schema.get("multipleOf")
+            # NOTE: no float() on arbitrary ints — json can carry
+            # integers too large to convert (OverflowError)
+            if isinstance(mod, bool):
+                mod_ok = False
+            elif isinstance(mod, int):
+                mod_ok = 1 <= mod <= 512
+            elif isinstance(mod, float):
+                mod_ok = mod.is_integer() and 1 <= int(mod) <= 512
+            else:
+                mod_ok = False
+            if mod is not None and mod_ok:
+                mod = int(mod)
+            elif mod is not None:
+                # fractional or huge multipleOf: out of the automaton's
+                # scope — bounds still enforced, divisibility is not
+                import warnings
+
+                warnings.warn(
+                    f"integer multipleOf {mod!r} not enforced "
+                    "(supported: integer 1..512)",
+                    stacklevel=2,
+                )
+                mod = None
+            if mod == 1:
+                mod = None  # every integer qualifies
+            if lo is not None or hi is not None or mod is not None:
+                return self._bounded_int_frag(lo, hi, mod)
             return self._integer_frag()
         if t == "number":
+            if schema.get("multipleOf") is not None:
+                import warnings
+
+                warnings.warn(
+                    "number multipleOf is not enforced by constrained "
+                    "decoding (bounds still are)",
+                    stacklevel=2,
+                )
             nlo, n_open_lo, nhi, n_open_hi = _number_bounds(schema)
             if nlo is not None or nhi is not None:
                 return self._bounded_number_frag(

@@ -640,3 +640,47 @@ def test_number_bounds_edge_cases():
     assert accepts(nfa, "12345.678")
     assert accepts(nfa, "9" * 300)
     assert not accepts(nfa, "-1")
+
+
+@pytest.mark.parametrize(
+    "schema,k,lo,hi",
+    [
+        ({"type": "integer", "multipleOf": 7}, 7, None, None),
+        ({"type": "integer", "multipleOf": 5, "minimum": 3,
+          "maximum": 100}, 5, 3, 100),
+        ({"type": "integer", "multipleOf": 12, "minimum": -40,
+          "maximum": 40}, 12, -40, 40),
+        ({"type": "integer", "multipleOf": 9, "minimum": 17}, 9, 17, None),
+        ({"type": "integer", "multipleOf": 4, "maximum": -6}, 4, None, -6),
+    ],
+)
+def test_integer_multiple_of(schema, k, lo, hi):
+    """multipleOf composes exactly with bounds via the remainder-
+    tracking product automaton."""
+    nfa = compile_schema(schema)
+    for v in list(range(-130, 131)) + [252, 999, 1008, -1008]:
+        want = (
+            v % k == 0
+            and (lo is None or v >= lo)
+            and (hi is None or v <= hi)
+        )
+        assert accepts(nfa, str(v)) == want, (v, schema)
+    assert not accepts(nfa, "014")
+
+
+def test_multiple_of_empty_range_raises():
+    with pytest.raises(ValueError, match="no multiple"):
+        compile_schema(
+            {"type": "integer", "multipleOf": 50, "minimum": 3,
+             "maximum": 40}
+        )
+
+
+def test_fractional_multiple_of_warns_and_ignores():
+    import warnings
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        nfa = compile_schema({"type": "integer", "multipleOf": 0.5})
+        assert any("not enforced" in str(x.message) for x in w)
+    assert accepts(nfa, "3")
