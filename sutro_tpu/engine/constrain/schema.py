@@ -807,6 +807,60 @@ class SchemaCompiler:
         def item() -> Frag:
             return self.compile_node(item_schema)
 
+        if schema.get("uniqueItems"):
+            resolved = self._resolve(item_schema)
+            values = resolved.get("enum")
+            if values is not None:
+                # dedupe by canonical serialization first: a schema
+                # enum like ["a", "a", "b"] or [1, 1.0] has positional
+                # duplicates that permutations() would treat as
+                # distinct, producing repeat-carrying "arrangements"
+                seen = set()
+                uniq = []
+                for v in values:
+                    k = json.dumps(v, separators=(",", ":"))
+                    if k not in seen:
+                        seen.add(k)
+                        uniq.append(v)
+                values = uniq
+            if values is not None and len(values) <= 5:
+                # small enum item pools: enumerate the DISTINCT ordered
+                # arrangements directly (sum of P(n, k) over the size
+                # range — <= 325 alternatives at n=5), so repeats are
+                # impossible by construction. Larger pools / non-enum
+                # items fall through with a warning: type-valid arrays,
+                # uniqueness unchecked.
+                from itertools import permutations
+
+                n = len(values)
+                lo_k = min_items
+                hi_k = min(int(max_items), n) if max_items is not None else n
+                arrangements = [
+                    list(p)
+                    for k in range(lo_k, hi_k + 1)
+                    for p in permutations(values, k)
+                ]
+                if not arrangements:
+                    raise ValueError(
+                        f"uniqueItems array needs {min_items}+ of "
+                        f"{n} distinct enum values"
+                    )
+                return b.alt(
+                    *[
+                        b.lit(
+                            json.dumps(a, separators=(",", ":")).encode()
+                        )
+                        for a in arrangements
+                    ]
+                )
+            import warnings
+
+            warnings.warn(
+                "uniqueItems not enforced (supported: enum items with "
+                "<=5 values)",
+                stacklevel=2,
+            )
+
         if max_items is not None and int(max_items) <= 16:
             # bounded unrolling for small fixed sizes
             alts = []

@@ -684,3 +684,48 @@ def test_fractional_multiple_of_warns_and_ignores():
         nfa = compile_schema({"type": "integer", "multipleOf": 0.5})
         assert any("not enforced" in str(x.message) for x in w)
     assert accepts(nfa, "3")
+
+
+def test_unique_items_enum_array():
+    """uniqueItems + small enum items: repeats are impossible by
+    construction; size bounds respected."""
+    schema = {
+        "type": "array",
+        "items": {"enum": ["a", "b", "c"]},
+        "uniqueItems": True,
+        "minItems": 1,
+        "maxItems": 2,
+    }
+    nfa = compile_schema(schema)
+    enc = lambda a: json.dumps(a, separators=(",", ":"))  # noqa: E731
+    for good in [["a"], ["c"], ["a", "b"], ["c", "a"]]:
+        assert accepts(nfa, enc(good)), good
+    for bad in [[], ["a", "a"], ["a", "b", "c"], ["d"], ["a", "d"]]:
+        assert not accepts(nfa, enc(bad)), bad
+
+
+def test_unique_items_large_pool_warns():
+    import warnings
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        nfa = compile_schema(
+            {
+                "type": "array",
+                "items": {"enum": list("abcdefgh")},
+                "uniqueItems": True,
+            }
+        )
+        assert any("uniqueItems" in str(x.message) for x in w)
+    assert accepts(nfa, '["a","a"]')  # unchecked fallback
+
+
+def test_unique_items_dedupes_enum_values():
+    """Positional duplicates in the enum pool must not defeat the
+    uniqueness guarantee."""
+    nfa = compile_schema(
+        {"type": "array", "items": {"enum": ["a", "a", "b"]},
+         "uniqueItems": True, "minItems": 1}
+    )
+    assert accepts(nfa, '["a","b"]')
+    assert not accepts(nfa, '["a","a"]')
