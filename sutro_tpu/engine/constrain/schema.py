@@ -13,11 +13,13 @@ nested), string (enum/const, minLength/maxLength, ``pattern`` via the
 regex subset in constrain/regex.py — unsupported constructs fall back to
 type-valid-unchecked with a warning; well-known ``format`` grammars
 enforced), integer (exact minimum/maximum/exclusive bounds via a
-digit-interval automaton), number (exact minimum/maximum incl. STRICT
-real bounds via a decimal interval automaton — bounded numbers emit in
-plain positional form, no exponent), boolean, null, array (items,
-minItems/maxItems small), anyOf/oneOf, $ref/$defs (one level of
-indirection, as produced by Pydantic), additionalProperties ignored.
+digit-interval automaton; ``multipleOf`` 1..512 composed exactly via a
+remainder-tracking product automaton), number (exact minimum/maximum
+incl. STRICT real bounds via a decimal interval automaton — bounded
+numbers emit in plain positional form, no exponent), boolean, null,
+array (items, minItems/maxItems small; ``uniqueItems`` enforced for
+enum pools of <=5 distinct values), anyOf/oneOf, $ref/$defs (one level
+of indirection, as produced by Pydantic), additionalProperties ignored.
 """
 
 from __future__ import annotations

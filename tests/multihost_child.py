@@ -73,20 +73,73 @@ def main() -> None:
     def dp_psum(x):
         return jax.lax.psum(x, "data")
 
+    def fetch_replicated(x):
+        """Gather a sharded global array to every process as numpy."""
+        return np.asarray(
+            jax.device_get(
+                jax.jit(
+                    lambda v: v, out_shardings=NamedSharding(mesh, P())
+                )(x)
+            )
+        )
+
     out = jax.jit(dp_psum)(arr)
     # rows 0..3 (proc 0) + rows 4..7 (proc 1) pairwise: row r of the
     # result = r + (r+4)
-    got = np.asarray(
-        jax.device_get(
-            jax.jit(
-                lambda x: x, out_shardings=NamedSharding(mesh, P())
-            )(out)
-        )
-    )
+    got = fetch_replicated(out)
     want = np.broadcast_to(
         (np.arange(4.0) + np.arange(4.0, 8.0))[:, None], (4, 16)
     )
     np.testing.assert_allclose(got, want)
+
+    # full model forward across the boundary: params TP-sharded over
+    # the intra-process "model" axis, batch DP-sharded over the
+    # PROCESS-spanning "data" axis — the logits must match an
+    # unsharded local forward bit-for-bit shape-wise and numerically
+    from sutro_tpu.models import transformer
+    from sutro_tpu.models.configs import MODEL_CONFIGS
+    from sutro_tpu.parallel.sharding import param_shardings
+
+    cfg = MODEL_CONFIGS["tiny-dense"]
+    params = transformer.init_params(
+        cfg, jax.random.PRNGKey(0), jnp.float32
+    )
+    sharded = jax.device_put(params, param_shardings(params, mesh))
+    B, T = 4, 6
+    ids_np = np.arange(B * T, dtype=np.int32).reshape(B, T) % 100
+    ids = jax.make_array_from_callback(
+        (B, T),
+        NamedSharding(mesh, P("data", None)),
+        lambda idx: ids_np[idx],
+    )
+    pos = jax.make_array_from_callback(
+        (B, T),
+        NamedSharding(mesh, P("data", None)),
+        lambda idx: np.broadcast_to(
+            np.arange(T, dtype=np.int32)[None], (B, T)
+        )[idx],
+    )
+    vlen = jax.make_array_from_callback(
+        (B,),
+        NamedSharding(mesh, P("data")),
+        lambda idx: np.full((B,), T, np.int32)[idx],
+    )
+
+    @jax.jit
+    def fwd(p, i, po, vl):
+        logits, _, _ = transformer.forward(cfg, p, i, po, vl)
+        return logits
+
+    logits = fwd(sharded, ids, pos, vlen)
+    got = fetch_replicated(logits)
+    ref, _, _ = transformer.forward(
+        cfg,
+        params,
+        jnp.asarray(ids_np),
+        jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T)),
+        jnp.full((B,), T, jnp.int32),
+    )
+    np.testing.assert_allclose(got, np.asarray(ref), atol=2e-4, rtol=2e-4)
 
     print(f"MULTIHOST_OK process={pid}", flush=True)
 
