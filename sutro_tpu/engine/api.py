@@ -474,6 +474,36 @@ class LocalEngine:
         inputs = self.jobs.read_inputs(job_id)
         sampling = rec.sampling_params or {}
         max_new = int(sampling.get("max_new_tokens", self.ecfg.max_new_tokens))
+        # stop sequences (vLLM-style sampling_params["stop"]): engine
+        # detects via a rolling byte tail; exact truncation happens at
+        # render time below where the full decoded string exists
+        raw_stop = sampling.get("stop") or []
+        if isinstance(raw_stop, str):
+            raw_stop = [raw_stop]
+        if not all(isinstance(s, str) for s in raw_stop):
+            raise ValueError(
+                "sampling_params['stop'] must be a string or list of "
+                f"strings, got {raw_stop!r}"
+            )
+        stop_strs = [s for s in raw_stop if s]
+        stop_seqs = [s.encode() for s in stop_strs] or None
+        stop_token_bytes = None
+        if stop_seqs:
+            stop_token_bytes = getattr(tok, "token_bytes", None)
+            if stop_token_bytes is not None:
+                try:  # base-class stubs raise; probe once
+                    stop_token_bytes(0)
+                except Exception:
+                    stop_token_bytes = None
+            if stop_token_bytes is None:
+                # no byte view of the vocab: early stopping is off, but
+                # render-time truncation below still applies
+                import warnings
+
+                warnings.warn(
+                    "tokenizer lacks token_bytes; stop sequences only "
+                    "truncate output, they cannot end generation early"
+                )
 
         # Prompt build: system prompt + chat template, then tokenize.
         prompts = [
@@ -549,18 +579,40 @@ class LocalEngine:
                     ),
                     allow_truncate=rec.truncate_rows,
                     row_seed=i if rec.random_seed_per_input else None,
+                    stop_seqs=stop_seqs,
                 )
             )
 
         batcher = ContinuousBatcher(
             runner, stop_ids=getattr(tok, "stop_ids", lambda: [tok.eos_id])(),
             seed=self.ecfg.seed,
+            token_bytes=stop_token_bytes,
         )
 
         thinking = bool(meta.get("thinking"))
 
         def render_output(token_ids) -> str:
             text = tok.decode(token_ids)
+            stop_cut = False
+            if stop_strs:
+                # truncate at the FIRST occurrence of any stop string
+                # (the stop string itself is excluded, vLLM semantics).
+                # Known edge: detection is byte-level while this search
+                # is over the decoder's string, so a decoder that
+                # normalizes (e.g. strips a leading Metaspace space) can
+                # stop generation without a matching cut here — output
+                # then keeps the sequence rather than losing text.
+                cut = min(
+                    (
+                        p
+                        for p in (text.find(s) for s in stop_strs)
+                        if p >= 0
+                    ),
+                    default=-1,
+                )
+                if cut >= 0:
+                    text = text[:cut]
+                    stop_cut = True
             if thinking:
                 # thinking models emit {content, reasoning_content} JSON so
                 # the SDK's unpack contract applies (reference
@@ -569,6 +621,13 @@ class LocalEngine:
                 if sep:
                     reasoning = reasoning.replace("<think>", "").strip()
                     content = content.strip()
+                elif stop_cut:
+                    # the stop hit INSIDE the reasoning section (the
+                    # separator never appeared): keep the chain of
+                    # thought in reasoning_content, not user-visible
+                    # content
+                    reasoning = text.replace("<think>", "").strip()
+                    content = ""
                 else:
                     content, reasoning = text, ""
                 import json as _json

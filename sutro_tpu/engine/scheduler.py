@@ -129,6 +129,11 @@ class GenRequest:
     # tokens are drawn from keys folded from (row_seed, step) — reproducible
     # regardless of batch composition.
     row_seed: Optional[int] = None
+    # Stop SEQUENCES (byte strings): generation ends once any appears in
+    # the decoded output (detection here via a rolling byte tail; exact
+    # text truncation happens at the result-rendering layer, which has
+    # the full decoded string). Requires the batcher's ``token_bytes``.
+    stop_seqs: Optional[List[bytes]] = None
 
 
 @dataclasses.dataclass
@@ -150,6 +155,11 @@ class _Slot:
     last_token: int
     out_ids: List[int] = dataclasses.field(default_factory=list)
     logprob_sum: float = 0.0
+    # rolling decoded-byte tail for stop-sequence detection (window =
+    # longest stop seq + the current token's bytes)
+    tail: bytes = b""
+    hit_stop_seq: bool = False
+    stop_longest: int = 0  # cached max stop-seq length (set on arm)
 
 
 class ContinuousBatcher:
@@ -159,11 +169,14 @@ class ContinuousBatcher:
         stop_ids: List[int],
         *,
         seed: int = 0,
+        token_bytes=None,  # tokenizer token_bytes(id) -> bytes; enables
+        #                    GenRequest.stop_seqs detection
     ):
         self.runner = runner
         self.ecfg = runner.ecfg
         self.vocab = runner.mcfg.vocab_size
         self.stop_ids = set(int(s) for s in stop_ids)
+        self.token_bytes = token_bytes
         self.B = self.ecfg.decode_batch_size
         self.MP = self.ecfg.max_pages_per_seq
         # Native host runtime (native/runtime.cpp): page allocator +
@@ -393,9 +406,25 @@ class ContinuousBatcher:
         slot.logprob_sum += float(logp)
         if slot.req.constraint is not None and tok not in self.stop_ids:
             slot.req.constraint.advance(tok)
+        seqs = slot.req.stop_seqs
+        if seqs and self.token_bytes is not None and not slot.hit_stop_seq:
+            # match against the FULL tail+token first (a long token must
+            # not push a boundary-spanning match out of the window),
+            # then keep only what the next boundary match could need
+            longest = slot.stop_longest
+            if not longest:
+                longest = slot.stop_longest = max(len(s) for s in seqs)
+            grown = slot.tail + self.token_bytes(tok)
+            for s in seqs:
+                if s in grown:
+                    slot.hit_stop_seq = True
+                    break
+            slot.tail = grown[-(longest - 1):] if longest > 1 else b""
 
     def _finish_reason(self, slot: _Slot, tok: int) -> Optional[str]:
         c = slot.req.constraint
+        if slot.hit_stop_seq:
+            return "stop"
         if tok in self.stop_ids:
             return "stop"
         if c is not None and c.is_complete():
@@ -454,6 +483,8 @@ class ContinuousBatcher:
         reason = "stop"
         if out and out[-1] in self.stop_ids:
             out = out[:-1]
+            reason = "stop"
+        elif slot.hit_stop_seq:
             reason = "stop"
         elif slot.req.constraint is not None and slot.req.constraint.is_complete():
             reason = "schema_complete"

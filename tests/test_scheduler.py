@@ -361,3 +361,36 @@ def test_unfittable_schema_fails_row_clearly(tiny_runner):
         on_result=lambda r: results.__setitem__(r.row_id, r),
     )
     assert results[0].finish_reason == "error_too_long"
+
+
+def test_stop_sequences_end_generation(tiny_runner, byte_tok):
+    """A stop sequence appearing in the decoded output (even spanning
+    token boundaries) finishes the row with reason "stop"."""
+    b = ContinuousBatcher(
+        tiny_runner, stop_ids=byte_tok.stop_ids(),
+        token_bytes=byte_tok.token_bytes,
+    )
+    results = {}
+    # force the output deterministically by constraining to a const
+    # string that CONTAINS the stop sequence
+    from sutro_tpu.engine.constrain import schema_constraint_factory
+
+    fac = schema_constraint_factory(
+        {"const": "abcSTOPdef"}, byte_tok
+    )
+    b.run(
+        [
+            GenRequest(
+                row_id=0,
+                prompt_ids=np.asarray(byte_tok.encode("x"), np.int32),
+                max_new_tokens=40, temperature=0.0, constraint=fac(),
+                stop_seqs=[b"STOP"],
+            )
+        ],
+        on_result=lambda r: results.__setitem__(r.row_id, r),
+    )
+    r = results[0]
+    assert r.finish_reason == "stop"
+    out = byte_tok.decode(r.token_ids)
+    assert "STOP" in out            # engine stops AT the sequence...
+    assert not out.endswith("def")  # ...without generating the rest

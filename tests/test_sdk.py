@@ -285,3 +285,21 @@ def test_run_function_local_contract(sdk):
     assert out["confidence"] is not None
     assert 0.0 < out["confidence"] <= 1.0
     assert out["predictions"] == []
+
+
+def test_stop_sequences_truncate_output(sdk):
+    """sampling_params["stop"]: generation ends at the sequence and the
+    rendered output excludes it (vLLM semantics)."""
+    jid = sdk.infer(
+        ["alpha", "beta"],
+        model="tiny-dense",
+        output_schema={"const": "one|two|three"},
+        sampling_params={"stop": "|"},
+        stay_attached=False,
+    )
+    df = sdk.await_job_completion(jid)
+    assert df is not None
+    for v in df["inference_result"]:
+        # const schema emits a JSON string: '"one|two|three"'; the stop
+        # cut keeps everything before the first '|'
+        assert v == '"one', v
