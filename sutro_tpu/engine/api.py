@@ -580,6 +580,15 @@ class LocalEngine:
                     allow_truncate=rec.truncate_rows,
                     row_seed=i if rec.random_seed_per_input else None,
                     stop_seqs=stop_seqs,
+                    presence_penalty=float(
+                        sampling.get("presence_penalty", 0.0)
+                    ),
+                    frequency_penalty=float(
+                        sampling.get("frequency_penalty", 0.0)
+                    ),
+                    repetition_penalty=float(
+                        sampling.get("repetition_penalty", 1.0)
+                    ),
                 )
             )
 

@@ -369,7 +369,7 @@ class ModelRunner:
     def _decode_jit(
         self, params, cache: KVCache, ids, past_len, page_table,
         rng, temperature, top_p, top_k, allowed_packed, row_seeds,
-        kv_chunk: int = 1,
+        kv_chunk: int = 1, penalties=None,
     ):
         B = ids.shape[0]
         allowed = None
@@ -389,6 +389,18 @@ class ModelRunner:
             use_pallas=self.use_pallas,
         )
         step_logits = logits[:, 0]  # [B, V]
+        if penalties is not None:
+            # pre-applied so the reported logprob is w.r.t. the
+            # penalized distribution too (seen-bits arrive packed)
+            from ..ops.sampling import apply_penalties
+
+            seen_packed, ids_p, cnt_p, pres, freq, rep = penalties
+            seen = jnp.unpackbits(
+                seen_packed, axis=1, count=self.mcfg.vocab_size
+            ).astype(bool)
+            step_logits = apply_penalties(
+                step_logits, seen, ids_p, cnt_p, pres, freq, rep
+            )
         tok = sample(
             step_logits, rng,
             temperature=temperature, top_p=top_p, top_k=top_k,
@@ -408,10 +420,25 @@ class ModelRunner:
         top_k: Optional[np.ndarray] = None,     # [B] int32; None => disabled
         allowed: Optional[np.ndarray] = None,   # [B, V] bool
         row_seeds: Optional[np.ndarray] = None,  # [B] int32
+        penalties=None,  # (seen_packed [B, ceil(V/8)] uint8, pen_ids
+        #                   [B,K], pen_cnt [B,K], presence [B],
+        #                   frequency [B], repetition [B]) — seen bits
+        #                   arrive PRE-PACKED (scheduler maintains them
+        #                   incrementally; no O(B*V) host work here)
     ) -> Tuple[np.ndarray, np.ndarray]:
         B = len(last_tokens)
         if top_k is None:
             top_k = np.zeros((B,), np.int32)
+        if penalties is not None:
+            seen_packed, ids_p, cnt_p, pres, freq, rep = penalties
+            penalties = (
+                jnp.asarray(seen_packed, jnp.uint8),
+                jnp.asarray(ids_p, jnp.int32),
+                jnp.asarray(cnt_p, jnp.float32),
+                jnp.asarray(pres, jnp.float32),
+                jnp.asarray(freq, jnp.float32),
+                jnp.asarray(rep, jnp.float32),
+            )
         tok, logp, self.cache = self._decode_jit(
             self.params,
             self.cache,
@@ -427,6 +454,7 @@ class ModelRunner:
             else jnp.asarray(np.packbits(np.asarray(allowed, bool), axis=1)),
             None if row_seeds is None else jnp.asarray(row_seeds, jnp.int32),
             self._chunk_for_table(page_table),
+            penalties,
         )
         return np.asarray(tok), np.asarray(logp)
 

@@ -134,6 +134,19 @@ class GenRequest:
     # text truncation happens at the result-rendering layer, which has
     # the full decoded string). Requires the batcher's ``token_bytes``.
     stop_seqs: Optional[List[bytes]] = None
+    # vLLM-style sampling penalties over GENERATED tokens (defaults
+    # disable). Rows using them decode single-step (the host threads
+    # token counts between steps).
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0
+
+    def has_penalties(self) -> bool:
+        return (
+            self.presence_penalty != 0.0
+            or self.frequency_penalty != 0.0
+            or self.repetition_penalty != 1.0
+        )
 
 
 @dataclasses.dataclass
@@ -160,6 +173,13 @@ class _Slot:
     tail: bytes = b""
     hit_stop_seq: bool = False
     stop_longest: int = 0  # cached max stop-seq length (set on arm)
+    # generated-token counts for sampling penalties (only maintained
+    # when the request uses them), plus the packed seen-bitmap for the
+    # repetition scope (prompt + generated, vLLM/HF semantics) — built
+    # incrementally so the per-step assembly is a memcpy, not an
+    # O(vocab) packbits
+    counts: Dict[int, int] = dataclasses.field(default_factory=dict)
+    seen_bits: Optional[np.ndarray] = None  # uint8 [ceil(V/8)]
 
 
 class ContinuousBatcher:
@@ -314,6 +334,15 @@ class ContinuousBatcher:
                 req=req, pages=pages, pos=len(req.prompt_ids),
                 last_token=first,
             )
+            if req.has_penalties():
+                # repetition scope includes the PROMPT (vLLM/HF)
+                bits = np.zeros((self.vocab + 7) // 8, np.uint8)
+                ids = np.unique(np.asarray(req.prompt_ids, np.int64))
+                ids = ids[(ids >= 0) & (ids < self.vocab)]
+                np.bitwise_or.at(
+                    bits, ids // 8, (0x80 >> (ids % 8)).astype(np.uint8)
+                )
+                slot.seen_bits = bits
             self.slots[slot_idx] = slot
             if self.native is not None:
                 self.native.arm_slot(
@@ -406,6 +435,10 @@ class ContinuousBatcher:
         slot.logprob_sum += float(logp)
         if slot.req.constraint is not None and tok not in self.stop_ids:
             slot.req.constraint.advance(tok)
+        if slot.req.has_penalties() and tok not in self.stop_ids:
+            slot.counts[tok] = slot.counts.get(tok, 0) + 1
+            if slot.seen_bits is not None and 0 <= tok < self.vocab:
+                slot.seen_bits[tok // 8] |= 0x80 >> (tok % 8)
         seqs = slot.req.stop_seqs
         if seqs and self.token_bytes is not None and not slot.hit_stop_seq:
             # match against the FULL tail+token first (a long token must
@@ -771,9 +804,12 @@ class ContinuousBatcher:
                 top_k = np.zeros((self.B,), np.int32)
             has_constraint = False
             has_row_seed = False
+            has_penalty = False
             row_seeds = np.zeros((self.B,), np.int32)
             for i in active:
                 s = self.slots[i]
+                if s.req.has_penalties():
+                    has_penalty = True
                 if self.native is None:
                     last[i] = s.last_token
                     past_len[i] = s.pos
@@ -807,6 +843,7 @@ class ContinuousBatcher:
                 and self.ecfg.decode_lookahead > 1
                 and not has_constraint
                 and not has_row_seed
+                and not has_penalty
                 and not self._needs_mask
             )
             if pipe_ok or pipe:
@@ -845,6 +882,7 @@ class ContinuousBatcher:
             if (
                 self.ecfg.decode_multi_step > 1
                 and not has_row_seed
+                and not has_penalty  # counts update host-side per token
                 and not self._needs_mask
                 and (
                     not has_constraint
@@ -946,11 +984,37 @@ class ContinuousBatcher:
                                 s.req, len(s.out_ids), s.pos
                             )
                             allowed[i] = self._constraint_mask(c, rem)
+                penalties = None
+                if has_penalty:
+                    PK = 256  # distinct generated ids carried per row
+                    nb = (self.vocab + 7) // 8
+                    seen_packed = np.zeros((self.B, nb), np.uint8)
+                    ids_p = np.full((self.B, PK), -1, np.int32)
+                    cnt_p = np.zeros((self.B, PK), np.float32)
+                    pres = np.zeros((self.B,), np.float32)
+                    freq = np.zeros((self.B,), np.float32)
+                    rep = np.ones((self.B,), np.float32)
+                    for i in active:
+                        s = self.slots[i]
+                        if not s.req.has_penalties():
+                            continue
+                        pres[i] = s.req.presence_penalty
+                        freq[i] = s.req.frequency_penalty
+                        rep[i] = s.req.repetition_penalty
+                        if s.seen_bits is not None:
+                            seen_packed[i] = s.seen_bits  # memcpy
+                        for j, t in enumerate(list(s.counts)[:PK]):
+                            ids_p[i, j] = t
+                            cnt_p[i, j] = s.counts[t]
+                    penalties = (
+                        seen_packed, ids_p, cnt_p, pres, freq, rep
+                    )
                 with self.timer.time("decode"):
                     toks, logps = self.runner.decode_step(
                         last, past_len, table, rng, temp, top_p,
                         top_k=top_k, allowed=allowed,
                         row_seeds=row_seeds if has_row_seed else None,
+                        penalties=penalties,
                     )
                 self._step += 1
                 self._needs_mask = False  # masked step crossed the

@@ -23,6 +23,37 @@ NEG_INF = -1e30
 NUCLEUS_CAP = 256
 
 
+def apply_penalties(
+    logits: jax.Array,      # [B, V] float32 (raw, pre-temperature)
+    seen_rep: jax.Array,    # [B, V] bool — repetition scope: PROMPT +
+    #                         generated tokens (vLLM/HF semantics)
+    pen_ids: jax.Array,     # [B, K] int32, -1 padded — distinct GENERATED ids
+    pen_cnt: jax.Array,     # [B, K] float32 — their counts
+    presence: jax.Array,    # [B] float32; 0 disables
+    frequency: jax.Array,   # [B] float32; 0 disables
+    repetition: jax.Array,  # [B] float32; 1 disables
+) -> jax.Array:
+    """Sampling penalties applied to raw logits before temperature:
+    repetition divides positive / multiplies negative logits of tokens
+    in ``seen_rep`` (prompt + output); presence subtracts a flat bias
+    and frequency a count-proportional bias from GENERATED tokens only
+    (both derived on-device from the sparse [B, K] id/count list —
+    outputs rarely exceed K distinct ids; overflow ids keep the
+    repetition penalty via ``seen_rep`` but lose presence/frequency)."""
+    B, V = logits.shape
+    rep = repetition[:, None]
+    rep_l = jnp.where(
+        logits > 0, logits / rep, logits * rep
+    )
+    logits = jnp.where(seen_rep, rep_l, logits)
+    ids = jnp.clip(pen_ids, 0, V - 1)
+    counts = jnp.zeros((B, V), jnp.float32).at[
+        jnp.arange(B)[:, None], ids
+    ].add(jnp.where(pen_ids >= 0, pen_cnt, 0.0))
+    logits = logits - presence[:, None] * (counts > 0)
+    return logits - frequency[:, None] * counts
+
+
 def sample(
     logits: jax.Array,                  # [B, V] float32
     key: jax.Array,

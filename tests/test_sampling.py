@@ -105,3 +105,62 @@ def test_top_k_above_cap_clamps_not_disables():
             top_k=jnp.int32(V),  # "keep everything" — clamps to cap
         )
         assert int(tok[0]) in head
+
+
+def test_apply_penalties_math():
+    """Repetition/presence/frequency against a hand-computed reference.
+    Repetition scope covers prompt+output (seen_rep); presence and
+    frequency derive from the generated-token counts only."""
+    import jax.numpy as jnp
+
+    from sutro_tpu.ops.sampling import apply_penalties
+
+    logits = jnp.asarray([[2.0, -1.0, 0.5, 3.0]])
+    # token 2 was in the PROMPT only: repetition applies, presence/
+    # frequency (generated scope) do not
+    seen_rep = jnp.asarray([[True, True, True, False]])
+    ids_p = jnp.asarray([[0, 1, -1]], jnp.int32)
+    cnt_p = jnp.asarray([[3.0, 1.0, 0.0]])
+    out = apply_penalties(
+        logits, seen_rep, ids_p, cnt_p,
+        presence=jnp.asarray([0.5]),
+        frequency=jnp.asarray([0.25]),
+        repetition=jnp.asarray([2.0]),
+    )
+    out = np.asarray(out[0])
+    # tok0: 2.0/2 (rep) - 0.5 (presence) - 0.25*3 (freq) = -0.25
+    # tok1: -1*2 (rep) - 0.5 - 0.25*1 = -2.75
+    # tok2: 0.5/2 (rep only, prompt token) = 0.25
+    # tok3: unseen, untouched
+    np.testing.assert_allclose(out, [-0.25, -2.75, 0.25, 3.0], atol=1e-6)
+
+
+def test_repetition_penalty_changes_greedy_choice():
+    """Penalized logits flip the greedy argmax away from a seen token."""
+    from sutro_tpu.ops.sampling import apply_penalties, sample
+
+    B, V = 2, 16
+    logits = np.zeros((B, V), np.float32)
+    logits[:, 3] = 5.0   # dominant token
+    logits[:, 7] = 4.0   # runner-up
+    seen = np.zeros((B, V), bool)
+    seen[0, 3] = True    # row 0 already emitted token 3
+    ids_p = np.full((B, 4), -1, np.int32)
+    cnt_p = np.zeros((B, 4), np.float32)
+    ids_p[0, 0] = 3
+    cnt_p[0, 0] = 1.0
+    pen = apply_penalties(
+        jnp.asarray(logits), jnp.asarray(seen),
+        jnp.asarray(ids_p), jnp.asarray(cnt_p),
+        presence=jnp.zeros(B), frequency=jnp.zeros(B),
+        repetition=jnp.full(B, 3.0),
+    )
+    toks = np.asarray(
+        sample(
+            pen, jax.random.PRNGKey(0),
+            temperature=np.zeros(B, np.float32),
+            top_p=np.ones(B, np.float32),
+        )
+    )
+    assert toks[0] == 7   # 5/3 < 4: penalty flips the choice
+    assert toks[1] == 3   # row 1 unpenalized
