@@ -2,7 +2,10 @@
 
 Shows both schema forms the reference accepts (Pydantic model or plain
 JSON-schema dict) plus the constraint features compiled to the byte FSM:
-enums, integer ranges (minimum/maximum), and regex string patterns.
+enums, integer ranges and multipleOf, strict number bounds, regex string
+patterns, date formats, and uniqueItems enum arrays. If the engine's
+minimal-JSON bound exceeds max_new_tokens, the cap is raised
+automatically so outputs always parse.
 """
 
 import json
@@ -34,15 +37,25 @@ def main() -> None:
     for v in df["inference_result"]:
         print("pydantic:", json.loads(v))
 
-    # dict form with enum + integer range + regex pattern
+    # dict form: enum, integer range + multipleOf, regex pattern, date
+    # format, strict number bounds, unique enum array — every field is
+    # guaranteed by the token-level FSM, whatever the model wants
     schema = {
         "type": "object",
         "properties": {
             "label": {"enum": ["refund", "replace", "escalate"]},
             "confidence": {"type": "integer", "minimum": 0, "maximum": 100},
+            "sla_days": {"type": "integer", "multipleOf": 7,
+                         "minimum": 7, "maximum": 28},
             "case_id": {"type": "string", "pattern": r"^CASE-\d{4}$"},
+            "opened": {"type": "string", "format": "date"},
+            "refund_usd": {"type": "number", "exclusiveMinimum": 0,
+                           "maximum": 500},
+            "tags": {"type": "array", "items": {"enum": ["vip", "repeat",
+                     "fraud-risk"]}, "uniqueItems": True, "minItems": 1},
         },
-        "required": ["label", "confidence", "case_id"],
+        "required": ["label", "confidence", "sla_days", "case_id",
+                     "opened", "refund_usd", "tags"],
     }
     jid = so.infer(
         rows, model=model, output_schema=schema, stay_attached=False
