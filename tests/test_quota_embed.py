@@ -103,3 +103,36 @@ def test_embedding_job_resumes_from_partial(
     # embeddings are unit-norm vectors
     for v in res["outputs"]:
         assert abs(float(np.linalg.norm(np.asarray(v))) - 1.0) < 1e-3
+
+
+def test_embedding_mixed_lengths_order_preserved(
+    tiny_ecfg, tmp_path, monkeypatch
+):
+    """Length-sorted batching (multi-batch: 20 rows over batch size 8)
+    must not disturb the 1:1 row order — spot rows of distinct lengths
+    each match their solo computation."""
+    monkeypatch.setenv("SUTRO_HOME", str(tmp_path))
+    from sutro_tpu.engine.api import LocalEngine
+
+    eng = LocalEngine(tiny_ecfg)
+    lengths = [50, 3, 30, 9, 21, 5, 44, 2, 17, 8,
+               29, 4, 40, 11, 26, 6, 35, 13, 23, 7]
+    texts = ["a" * n + "b" * (i % 3) for i, n in enumerate(lengths)]
+    jid = eng.submit_batch_inference(
+        {"model": "tiny-emb", "inputs": texts}
+    )
+    assert _wait_terminal(eng, jid) == "SUCCEEDED"
+    res = eng.job_results(jid)
+    assert len(res["outputs"]) == len(texts)
+    # spot-check rows across the length spectrum (incl. ones that land
+    # in different sorted batches) against their solo embeddings
+    for probe in (0, 1, 7, 12, 19):
+        solo_job = eng.submit_batch_inference(
+            {"model": "tiny-emb", "inputs": [texts[probe]]}
+        )
+        assert _wait_terminal(eng, solo_job) == "SUCCEEDED"
+        solo = eng.job_results(solo_job)["outputs"][0]
+        np.testing.assert_allclose(
+            np.asarray(res["outputs"][probe]), np.asarray(solo),
+            atol=2e-4, rtol=2e-4,
+        )
