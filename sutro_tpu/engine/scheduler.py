@@ -684,7 +684,12 @@ class ContinuousBatcher:
                     )
                     continue
             pending.append(req)
-        pending = pending[::-1]  # pop() from the input-order front
+        # pop() serves the SHORTEST prompts first: batched prefill pads
+        # every row in a dispatch to the group's bucket, so grouping
+        # similar lengths cuts padding FLOPs on mixed-length jobs (and
+        # quick rows finish early for progress). Results are keyed by
+        # row_id — output order is unaffected (reference 1:1 contract).
+        pending.sort(key=lambda r: len(r.prompt_ids), reverse=True)
         input_tokens = 0
         output_tokens = 0
         rows_done = 0
