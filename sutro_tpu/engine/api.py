@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import warnings
 import traceback
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
@@ -486,6 +487,16 @@ class LocalEngine:
                 f"strings, got {raw_stop!r}"
             )
         stop_strs = [s for s in raw_stop if s]
+        if stop_strs and rec.output_schema:
+            # a stop string can cut the constrained output mid-JSON —
+            # the guaranteed-valid-JSON contract outranks it (the SDK
+            # also warns at submit time, where the caller can see it)
+            warnings.warn(
+                "sampling_params['stop'] is ignored for output_schema "
+                "jobs: stopping mid-JSON would break the schema "
+                "guarantee (the schema's own closure ends generation)"
+            )
+            stop_strs = []
         stop_seqs = [s.encode() for s in stop_strs] or None
         stop_token_bytes = None
         if stop_seqs:
@@ -498,8 +509,6 @@ class LocalEngine:
             if stop_token_bytes is None:
                 # no byte view of the vocab: early stopping is off, but
                 # render-time truncation below still applies
-                import warnings
-
                 warnings.warn(
                     "tokenizer lacks token_bytes; stop sequences only "
                     "truncate output, they cannot end generation early"

@@ -314,6 +314,17 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
         if stay_attached is None:
             stay_attached = job_priority == 0
         schema = normalize_output_schema(output_schema)
+        if schema is not None and (sampling_params or {}).get("stop"):
+            # surfaced HERE so the caller sees it even for detached /
+            # remote jobs; the engine enforces the same rule at run time
+            import warnings
+
+            warnings.warn(
+                "sampling_params['stop'] is ignored for output_schema "
+                "jobs: stopping mid-JSON would break the schema "
+                "guarantee (the schema's own closure ends generation)",
+                stacklevel=2,
+            )
         return self._run_one_batch_inference(
             data=data,
             model=model,
