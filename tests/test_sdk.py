@@ -337,3 +337,46 @@ def test_stop_ignored_for_schema_jobs(sdk):
         df = sdk.await_job_completion(jid)
     assert df is not None
     assert json.loads(df["inference_result"][0]) == "a|b"
+
+
+def test_feature_composition_end_to_end(sdk):
+    """Kitchen-sink: a penalized p1 generation job and an interactive
+    p0 schema job in flight together, then an embedding job — all
+    through the public SDK surface with every contract holding.
+    (Deterministic preemption ordering is asserted in
+    tests/test_priority.py; here the point is feature composition.)"""
+    # long-ish p1 batch with penalties (single-step decode path)
+    p1 = sdk.infer(
+        [f"background row {i}" for i in range(6)],
+        model="tiny-dense",
+        job_priority=1,
+        sampling_params={
+            "temperature": 0.7, "repetition_penalty": 1.3,
+            "max_new_tokens": 24,
+        },
+        stay_attached=False,
+    )
+    # interactive p0 schema job submitted while p1 runs
+    p0 = sdk.infer(
+        ["urgent"],
+        model="tiny-dense",
+        job_priority=0,
+        output_schema={
+            "type": "object",
+            "properties": {
+                "score": {"type": "integer", "minimum": 1, "maximum": 5}
+            },
+            "required": ["score"],
+        },
+        stay_attached=False,
+    )
+    df0 = sdk.await_job_completion(p0)
+    obj = json.loads(df0["inference_result"][0])
+    assert 1 <= obj["score"] <= 5
+    df1 = sdk.await_job_completion(p1)
+    assert df1 is not None and len(df1) == 6
+    # embedding job on the same engine process
+    dfe = sdk.embed(["alpha", "beta"], model="tiny-emb")
+    assert len(dfe) == 2
+    assert sdk.get_job_status(p0) == "SUCCEEDED"
+    assert sdk.get_job_status(p1) == "SUCCEEDED"
