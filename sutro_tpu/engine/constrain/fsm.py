@@ -215,6 +215,10 @@ def schema_constraint_factory(schema: Dict, tokenizer) -> ConstraintFactory:
     return ConstraintFactory(schema, tokenizer)
 
 
+# constraint type names whose missing-min_tokens warning already fired
+_room_warned: set = set()
+
+
 def constraint_room(constraint) -> int:
     """Minimum generation room (tokens) a row needs to honor its
     constraint: the shortest accepting output plus one stop token.
@@ -227,6 +231,20 @@ def constraint_room(constraint) -> int:
     truncation bug this exists to prevent)."""
     mt = getattr(constraint, "min_tokens", None)
     if not callable(mt):
+        # warn once per constraint TYPE, not per row — constraint_room
+        # sits in the per-row admission loop and a 10k-row job would
+        # otherwise emit 10k identical lines
+        t = type(constraint)
+        if t not in _room_warned:
+            _room_warned.add(t)
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "constraint %r has no callable min_tokens(); assuming 1 "
+                "token of room (schema-completeness no longer guaranteed "
+                "for its rows)",
+                t.__name__,
+            )
         return 1
     try:
         return max(1, int(mt()) + 1)

@@ -65,6 +65,34 @@ class UnsupportedPattern(ValueError):
     pass
 
 
+_SHORT_ESC = {
+    ord("t"): 0x09, ord("n"): 0x0A, ord("r"): 0x0D,
+    ord("f"): 0x0C, ord("v"): 0x0B,
+}
+
+
+def _escape_literal(e: int, *, in_class: bool) -> int:
+    """Single resolver for ``\\<e>`` as a literal byte, shared by
+    parse_atom and character-class parsing so the two cannot drift:
+    short escapes map, metachars and non-plain bytes are literals, and
+    anything else (``\\x``, ``\\u``, ``\\A``, backrefs, ...) raises
+    rather than silently degrading to the escape letter itself. Inside
+    a class, escaped punctuation (``\\-``, ``\\!``) is additionally a
+    literal — the one context-dependent rule."""
+    lit = _SHORT_ESC.get(e)
+    if lit == 0x0B:
+        raise UnsupportedPattern(r"\v has no JSON short escape")
+    if lit is not None:
+        return lit
+    if e == -1:
+        raise UnsupportedPattern("dangling escape")
+    if e in _META or not _PLAIN[e]:
+        return e
+    if in_class and not _WORD[e]:
+        return e
+    raise UnsupportedPattern(f"unsupported escape \\{chr(e)}")
+
+
 class _CharSet:
     """A single-character matcher: plain-byte bitmap + JSON-escaped
     control members (each matched as its escape literal)."""
@@ -284,19 +312,7 @@ class _Parser:
                     cs.add_class(bm)
                     return cs.frag(b)
                 return esc_cls
-            lit = {
-                ord("t"): 0x09, ord("n"): 0x0A, ord("r"): 0x0D,
-                ord("f"): 0x0C, ord("v"): 0x0B,
-            }.get(e)
-            if lit is None:
-                if e in _META or not _PLAIN[e]:
-                    lit = e
-                else:
-                    raise UnsupportedPattern(
-                        f"unsupported escape \\{chr(e)}"
-                    )
-            if lit == 0x0B:
-                raise UnsupportedPattern(r"\v has no JSON short escape")
+            lit = _escape_literal(e, in_class=False)
 
             def esc_lit(x=lit) -> Frag:
                 cs = _CharSet()
@@ -338,16 +354,13 @@ class _Parser:
                 if bm is not None:
                     members.append(("class", bm))
                     continue
-                c = {
-                    ord("t"): 0x09, ord("n"): 0x0A, ord("r"): 0x0D,
-                    ord("f"): 0x0C,
-                }.get(e, e)
+                c = _escape_literal(e, in_class=True)
             if self.peek() == ord("-") and self.i + 1 < len(self.src) \
                     and self.src[self.i + 1] != ord("]"):
                 self.take()  # '-'
                 hi = self.take()
                 if hi == ord("\\"):
-                    hi = self.take()
+                    hi = _escape_literal(self.take(), in_class=True)
                 members.append(("range", c, hi))
             else:
                 members.append(("byte", c))

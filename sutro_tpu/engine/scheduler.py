@@ -25,11 +25,14 @@ from __future__ import annotations
 
 import dataclasses
 import inspect
+import logging
 import time
 from typing import Any, Callable, Dict, List, Optional, Protocol, Tuple
 
 import jax
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 from .kvcache import PageAllocator, pages_needed
 from .runner import ModelRunner
@@ -228,6 +231,8 @@ class ContinuousBatcher:
         # set when a speculative window rejected a token: the next
         # iteration runs one masked single-step to guarantee progress
         self._needs_mask = False
+        # penalty id-buffer growth events already logged (power-of-two K)
+        self._pk_grown: set = set()
         from .profiling import StepTimer
 
         self.timer = StepTimer()
@@ -504,6 +509,21 @@ class ContinuousBatcher:
         return bool(self._constraint_mask(c, remaining)[tok])
 
     def _release(self, i: int) -> GenResult:
+        """Free slot ``i``'s pages and emit its result.
+
+        ORDERING DEPENDENCY: a release can happen while pipelined
+        windows referencing this slot are still in flight; those stale
+        windows keep writing KV into the freed pages even though the
+        (slot, gen) check discards their *tokens*. If the pages are
+        reallocated to a newly admitted row, correctness rests on
+        per-device in-order execution of dispatched programs: the new
+        row's prefill + decode steps are dispatched AFTER the stale
+        window and rewrite every KV position they will ever read, so the
+        stale writes are dead stores. JAX/TPU executes one program at a
+        time per device, which guarantees this today; a multi-stream or
+        relaxed-ordering backend would need frees deferred until every
+        pipe entry referencing the slot has drained (see
+        ``_pipe_capacity_ok`` for the companion invariant)."""
         slot = self.slots[i]
         assert slot is not None
         if self.native is not None:
@@ -552,7 +572,12 @@ class ContinuousBatcher:
         """True when every active row's up-front page reservation covers
         ``K`` more steps BEYOND everything already in flight — the
         invariant that makes speculative window writes always land in
-        the row's own reserved pages."""
+        the row's own reserved pages.
+
+        Caveat: this invariant covers LIVE slots only. A slot released
+        mid-pipeline leaves stale in-flight windows writing into freed
+        pages; that case is safe only via the dispatch-order argument
+        documented on ``_release``."""
         if not active:
             return False
         PS = self.ecfg.kv_page_size
@@ -991,7 +1016,27 @@ class ContinuousBatcher:
                             allowed[i] = self._constraint_mask(c, rem)
                 penalties = None
                 if has_penalty:
-                    PK = 256  # distinct generated ids carried per row
+                    # Distinct generated ids carried per row. K is a jit
+                    # shape, so grow it in power-of-two buckets: exact
+                    # presence/frequency semantics at any generation
+                    # length, with at most log2 extra compiles.
+                    PK = 256
+                    max_distinct = max(
+                        (
+                            len(self.slots[i].counts)
+                            for i in active
+                            if self.slots[i].req.has_penalties()
+                        ),
+                        default=0,
+                    )
+                    while PK < max_distinct:
+                        PK *= 2
+                    if PK > 256 and PK not in self._pk_grown:
+                        self._pk_grown.add(PK)
+                        logger.info(
+                            "penalty id buffer grown to K=%d (a row has "
+                            "%d distinct generated ids)", PK, max_distinct,
+                        )
                     nb = (self.vocab + 7) // 8
                     seen_packed = np.zeros((self.B, nb), np.uint8)
                     ids_p = np.full((self.B, PK), -1, np.int32)
@@ -1008,7 +1053,8 @@ class ContinuousBatcher:
                         rep[i] = s.req.repetition_penalty
                         if s.seen_bits is not None:
                             seen_packed[i] = s.seen_bits  # memcpy
-                        for j, t in enumerate(list(s.counts)[:PK]):
+                        assert len(s.counts) <= PK  # growth loop above
+                        for j, t in enumerate(s.counts):
                             ids_p[i, j] = t
                             cnt_p[i, j] = s.counts[t]
                     penalties = (

@@ -389,6 +389,12 @@ def test_integer_exclusive_bounds():
         (r"^v\d+\.\d+\.\d+$", ["v1.20.3"], ["v1.2", "1.2.3"]),
         (r"^[^0-9]*$", ["abc", ""], ["a1"]),
         (r"^a{2,4}$", ["aa", "aaaa"], ["a", "aaaaa"]),
+        # class escapes: known literals map, punctuation stays literal
+        (r"^[a\-z]+$", ["a", "-", "z", "a-z"], ["b", "m"]),
+        (r"^[\t]$", ["\t"], [" ", "t"]),
+        # escaped range-high endpoint maps (\t-\n = 0x09-0x0A; wider
+        # ranges through 0x0B fall back — \v has no JSON short escape)
+        (r"^[\t-\n]$", ["\t", "\n"], [" ", "t", "n", "\r"]),
     ],
 )
 def test_string_pattern_enforced(pattern, good, bad):
@@ -417,6 +423,33 @@ def test_unsupported_pattern_falls_back_with_warning():
         )
         assert any("not enforced" in str(x.message) for x in w)
     assert accepts(nfa, '"anything"')
+
+
+@pytest.mark.parametrize(
+    "pattern",
+    [
+        r"^[\x41]$",        # hex escape in class (would wrongly match "x"/"4"/"1")
+        r"^[\x20-\x7E]+$",  # printable-ASCII idiom — hex range
+        r"^[a-\x]$",        # exotic escape as range-high endpoint
+        "^[\\u0041]$",      # unicode escape in class
+        r"^[\1]$",          # backref-looking digit escape in class
+    ],
+)
+def test_class_escape_exotic_falls_back(pattern):
+    """Unrecognized escapes inside character classes must raise
+    UnsupportedPattern (not silently degrade to the escape letter's
+    literal — advisor round-2 medium), which routes the whole pattern
+    into the documented warn-and-fallback path."""
+    import warnings
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        nfa = compile_schema({"type": "string", "pattern": pattern})
+        assert any("not enforced" in str(x.message) for x in w), pattern
+    # fallback accepts any string — crucially "x" is no longer wrongly
+    # privileged over "A" by a mis-compiled class
+    assert accepts(nfa, '"A"')
+    assert accepts(nfa, '"x"')
 
 
 def test_pattern_masks_drive_valid_generation():
