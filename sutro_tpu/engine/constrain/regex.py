@@ -88,7 +88,10 @@ def _escape_literal(e: int, *, in_class: bool) -> int:
         raise UnsupportedPattern("dangling escape")
     if e in _META or not _PLAIN[e]:
         return e
-    if in_class and not _WORD[e]:
+    # inside a class: escaped punctuation (\- \!) and \_ (underscore is
+    # the one _WORD member that is not alphanumeric; ECMA keeps it a
+    # literal) — alphanumerics (\x, \u, \A, backrefs) stay errors
+    if in_class and (not _WORD[e] or e == 0x5F):
         return e
     raise UnsupportedPattern(f"unsupported escape \\{chr(e)}")
 

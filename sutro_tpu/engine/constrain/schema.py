@@ -19,7 +19,12 @@ incl. STRICT real bounds via a decimal interval automaton — bounded
 numbers emit in plain positional form, no exponent), boolean, null,
 array (items, minItems/maxItems small; ``uniqueItems`` enforced for
 enum pools of <=5 distinct values), anyOf/oneOf, $ref/$defs (one level
-of indirection, as produced by Pydantic), additionalProperties ignored.
+of indirection, as produced by Pydantic), multi-element ``allOf``
+(intersection-merged over the supported feature set; inexpressible
+intersections hard-fail rather than silently widen), and
+``additionalProperties`` (declared-property objects never emit extras,
+so ``false`` closure holds by construction; property-less objects with
+a value schema compile to a free-form map).
 """
 
 from __future__ import annotations
@@ -64,6 +69,32 @@ _FORMAT_PATTERNS = {
     "email": r"^[A-Za-z0-9._%+-]{1,64}@[A-Za-z0-9.-]{1,63}\.[A-Za-z]{2,24}$",
     "ipv4": f"^({_IPV4_OCTET}\\.){{3}}{_IPV4_OCTET}$",
 }
+
+
+def _canon(x: Any) -> str:
+    """Canonical JSON text for value identity — distinguishes True from
+    1 (Python ``==`` does not) and ignores dict key order."""
+    return json.dumps(x, separators=(",", ":"), sort_keys=True)
+
+
+def _same(a: Any, b: Any) -> bool:
+    try:
+        return _canon(a) == _canon(b)
+    except (TypeError, ValueError):
+        return a is b
+
+
+def _integral(mod) -> Optional[int]:
+    """Positive-int view of a multipleOf value (2 or 2.0 -> 2), None if
+    it isn't integral — mirrors compile_node's normalization so merge
+    filtering and compilation agree."""
+    if isinstance(mod, bool) or mod is None:
+        return None
+    if isinstance(mod, int):
+        return mod
+    if isinstance(mod, float) and mod.is_integer():
+        return int(mod)
+    return None
 
 
 def _dec_digits(value) -> Tuple[str, str]:
@@ -669,12 +700,458 @@ class SchemaCompiler:
             if name not in self.defs:
                 raise ValueError(f"Unresolvable $ref: {schema['$ref']}")
             return self._resolve(self.defs[name])
-        if "allOf" in schema and len(schema["allOf"]) == 1:
-            # Pydantic emits single-element allOf around $refs with siblings
-            merged = dict(self._resolve(schema["allOf"][0]))
-            merged.update({k: v for k, v in schema.items() if k != "allOf"})
+        if "allOf" in schema:
+            merged = self._merge_allof(schema)
             return self._resolve(merged) if "$ref" in merged else merged
         return schema
+
+    # annotation-only keys: no validation semantics, last writer wins
+    _ANNOTATIONS = frozenset(
+        (
+            "title", "description", "default", "examples", "deprecated",
+            "readOnly", "writeOnly", "$schema", "$id", "$comment",
+            "discriminator",
+        )
+    )
+
+    def _merge_allof(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        """Intersection-merge an ``allOf`` (any number of branches, plus
+        sibling keys) into one equivalent schema over the compiler's
+        supported feature set.
+
+        Subset discipline (module docstring) forbids silently dropping a
+        conjunct — emitting a superset of the user's language breaks the
+        schema guarantee — so intersections this compiler cannot express
+        (two distinct ``pattern``s, ``oneOf`` conjuncts, mixed draft-4
+        boolean exclusive bounds, ...) raise ``ValueError`` with a clear
+        message instead. ``anyOf`` conjuncts distribute exactly:
+        allOf(anyOf(A,B), C) == anyOf(allOf(A,C), allOf(B,C))."""
+        from itertools import product as _product
+
+        parts = [dict(self._resolve(s)) for s in schema["allOf"]]
+        siblings = {k: v for k, v in schema.items() if k != "allOf"}
+        if siblings:
+            parts.append(dict(self._resolve(siblings)))
+
+        def constrains(p: Dict[str, Any]) -> bool:
+            return any(k not in self._ANNOTATIONS for k in p)
+
+        # distribute anyOf conjuncts (exact); oneOf's exactly-one
+        # semantics are NOT preserved by distribution — hard fail
+        choices: List[List[Dict[str, Any]]] = []
+        for p in parts:
+            if "oneOf" in p:
+                extra = [
+                    k
+                    for k in p
+                    if k != "oneOf" and k not in self._ANNOTATIONS
+                ]
+                others = [
+                    q for q in parts if q is not p and constrains(q)
+                ]
+                if extra or others:
+                    # distributing oneOf loses its exactly-one semantics
+                    # (a value matching two branches would be emitted) —
+                    # only a lone oneOf conjunct (modulo annotations)
+                    # passes through untouched
+                    raise ValueError(
+                        "allOf: a oneOf conjunct cannot be intersected "
+                        "exactly with other constraints"
+                    )
+            choices.append(self._expand_anyof(p))
+        n_combos = 1
+        for c in choices:
+            n_combos *= len(c)
+        if n_combos > 64:
+            raise ValueError(
+                f"allOf: anyOf distribution needs {n_combos} branches "
+                "(max 64)"
+            )
+        if n_combos > 1:
+            # merge each distributed branch eagerly so an unsatisfiable
+            # or inexpressible one is PRUNED (anyOf needs only one
+            # branch to hold; dropping a branch narrows, never widens) —
+            # raising only when every branch dies
+            branches: List[Dict[str, Any]] = []
+            errs: List[str] = []
+            for combo in _product(*choices):
+                try:
+                    branches.append(
+                        self._merge_allof({"allOf": list(combo)})
+                    )
+                except ValueError as e:
+                    errs.append(str(e))
+            if not branches:
+                raise ValueError(
+                    "allOf: every distributed anyOf branch is "
+                    "unsatisfiable: " + "; ".join(errs[:3])
+                )
+            if errs:
+                import warnings
+
+                warnings.warn(
+                    f"allOf: pruned {len(errs)} unsatisfiable anyOf "
+                    f"branch(es) (first: {errs[0]})",
+                    stacklevel=2,
+                )
+            return {"anyOf": branches}
+
+        out: Dict[str, Any] = {}
+        # (declared-property keyset, additionalProperties) per object
+        # part — needed after the union to honor each conjunct's own
+        # closure, which applies relative to ITS properties, not the
+        # merged set
+        part_objs: List[Tuple[set, Any]] = []
+        for p in (dict(self._resolve(c[0])) for c in choices):
+            # the compiler's object default is all-properties-required
+            # (_object_frag); make it explicit BEFORE the union so a
+            # part with implicit required doesn't lose it to a sibling
+            # part's explicit (smaller) required list. Runs here, after
+            # anyOf expansion, so expanded branches are covered too.
+            if "properties" in p and "required" not in p:
+                p["required"] = list(p["properties"])
+            # normalize draft-4 boolean exclusive bounds to the numeric
+            # draft-2020 form per part, BEFORE the union — a boolean
+            # flag surviving the merge would re-attach to a bound
+            # tightened by a different conjunct and change semantics
+            for bkey, xkey in (
+                ("minimum", "exclusiveMinimum"),
+                ("maximum", "exclusiveMaximum"),
+            ):
+                flag = p.get(xkey)
+                if isinstance(flag, bool):
+                    if flag and bkey in p:
+                        p[xkey] = p.pop(bkey)
+                    else:
+                        p.pop(xkey)
+            if "properties" in p or "additionalProperties" in p:
+                part_objs.append(
+                    (
+                        set(p.get("properties", {})),
+                        p.get("additionalProperties"),
+                    )
+                )
+            for k, v in p.items():
+                if k in ("$defs", "definitions"):
+                    continue  # hoisted into self.defs at construction
+                if k not in out:
+                    out[k] = v
+                    continue
+                out[k] = self._merge_key(k, out[k], v)
+        # each conjunct's additionalProperties closure applies to the
+        # properties IT declared: under `false`, merged extras must not
+        # be emitted (required extra -> unsatisfiable, optional extra ->
+        # dropped, which narrows); under a schema, merged extras must
+        # also satisfy the conjunct's value schema
+        props = out.get("properties")
+        if props and not set(out.get("required", [])) <= set(props):
+            # _object_frag can only emit declared properties — a
+            # required name with no schema would make every output fail
+            # the user's own validation
+            missing = sorted(set(out["required"]) - set(props))
+            raise ValueError(
+                f"allOf: required properties {missing} have no schema "
+                "in any conjunct"
+            )
+        if props and part_objs:
+            # copy before mutating — a single-part merge aliases the
+            # caller's schema dict
+            out["properties"] = props = dict(props)
+            required = set(out.get("required", []))
+            for keys, addl in part_objs:
+                if addl is False:
+                    extras = set(props) - keys
+                    bad = extras & required
+                    if bad:
+                        raise ValueError(
+                            "allOf: required properties "
+                            f"{sorted(bad)} violate a conjunct's "
+                            "additionalProperties: false"
+                        )
+                    for name in extras:
+                        del props[name]
+                elif isinstance(addl, dict):
+                    for name in set(props) - keys:
+                        props[name] = {"allOf": [props[name], addl]}
+        # compile_node prefers enum/const over sibling keywords, so a
+        # merged enum/const must be filtered against every conjunct
+        # constraint here or the merge silently widens (e.g.
+        # allOf([{enum:[1,20]}, {minimum:10}]) must not emit 1)
+        if "enum" in out:
+            vals = [
+                v for v in out["enum"] if self._value_satisfies(v, out)
+            ]
+            if not vals:
+                raise ValueError(
+                    "allOf: enum empty after applying conjunct "
+                    "constraints"
+                )
+            out["enum"] = vals
+        if "const" in out and not self._value_satisfies(
+            out["const"], out
+        ):
+            raise ValueError(
+                "allOf: const value violates conjunct constraints"
+            )
+        if "const" in out and "enum" in out:
+            # const must be a member, and then it subsumes the enum
+            if _canon(out["const"]) not in {
+                _canon(x) for x in out["enum"]
+            }:
+                raise ValueError(
+                    "allOf: const value not in intersected enum"
+                )
+            del out["enum"]
+        return out
+
+    def _value_satisfies(self, v: Any, out: Dict[str, Any]) -> bool:
+        """Check one enum/const value against the scalar constraints of
+        a merged schema (type, numeric bounds, multipleOf, string
+        length, pattern). Used only by the allOf merge — a single
+        schema's enum-beats-siblings precedence is compile_node's
+        long-standing behavior."""
+
+        if "enum" in out and _canon(v) not in {
+            _canon(x) for x in out["enum"]
+        }:
+            return False
+        if "const" in out and _canon(v) != _canon(out["const"]):
+            return False
+        if "anyOf" in out and not any(
+            self._value_satisfies(v, self._resolve(br))
+            for br in out["anyOf"]
+        ):
+            return False
+        if "oneOf" in out and sum(
+            self._value_satisfies(v, self._resolve(br))
+            for br in out["oneOf"]
+        ) != 1:
+            return False
+        t = out.get("type")
+        if t is not None:
+            types = t if isinstance(t, list) else [t]
+
+            def type_ok(tt: str) -> bool:
+                if tt == "string":
+                    return isinstance(v, str)
+                if tt == "boolean":
+                    return isinstance(v, bool)
+                if tt == "null":
+                    return v is None
+                if tt == "integer":
+                    return (
+                        isinstance(v, int) and not isinstance(v, bool)
+                    ) or (isinstance(v, float) and v.is_integer())
+                if tt == "number":
+                    return isinstance(v, (int, float)) and not isinstance(
+                        v, bool
+                    )
+                if tt == "array":
+                    return isinstance(v, list)
+                if tt == "object":
+                    return isinstance(v, dict)
+                return True
+
+            if not any(type_ok(tt) for tt in types):
+                return False
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            import decimal
+
+            d = decimal.Decimal(str(v))
+            lo, open_lo, hi, open_hi = _number_bounds(out)
+            if lo is not None and (d < lo or (open_lo and d == lo)):
+                return False
+            if hi is not None and (d > hi or (open_hi and d == hi)):
+                return False
+            mod = out.get("multipleOf")
+            if not isinstance(mod, bool) and isinstance(
+                mod, (int, float)
+            ) and mod > 0:
+                # Decimal modulo is exact for fractional divisors too,
+                # so the enum/const filter enforces what the non-enum
+                # compile path can only warn about
+                if d % decimal.Decimal(str(mod)) != 0:
+                    return False
+        if isinstance(v, str):
+            if len(v) < int(out.get("minLength", 0)):
+                return False
+            if "maxLength" in out and len(v) > int(out["maxLength"]):
+                return False
+            pat = out.get("pattern")
+            if pat is not None:
+                import re as _re
+
+                try:
+                    if not _re.search(pat, v):  # JSON Schema: unanchored
+                        return False
+                except _re.error:
+                    raise ValueError(
+                        f"allOf: cannot check enum/const against "
+                        f"pattern {pat!r}"
+                    )
+        if isinstance(v, list):
+            if len(v) < int(out.get("minItems", 0)):
+                return False
+            if "maxItems" in out and len(v) > int(out["maxItems"]):
+                return False
+            if out.get("uniqueItems"):
+                canon = [
+                    json.dumps(x, separators=(",", ":"), sort_keys=True)
+                    for x in v
+                ]
+                if len(set(canon)) != len(canon):
+                    return False
+            items = out.get("items")
+            if isinstance(items, dict) and not all(
+                self._value_satisfies(x, self._resolve(items)) for x in v
+            ):
+                return False
+        if isinstance(v, dict):
+            if len(v) < int(out.get("minProperties", 0)):
+                return False
+            if "maxProperties" in out and len(v) > int(
+                out["maxProperties"]
+            ):
+                return False
+            props = out.get("properties", {})
+            if props and not set(out.get("required", list(props))) <= set(
+                v
+            ):
+                return False
+            for name, sub in props.items():
+                if name in v and not self._value_satisfies(
+                    v[name], self._resolve(sub)
+                ):
+                    return False
+            if out.get("additionalProperties") is False and not set(
+                v
+            ) <= set(props):
+                return False
+        return True
+
+    def _expand_anyof(
+        self, p: Dict[str, Any], depth: int = 0
+    ) -> List[Dict[str, Any]]:
+        """Flatten a conjunct into its anyOf alternatives, recursively —
+        a nested anyOf must not survive to the key-merge loop, where a
+        leftover ``anyOf`` key would make compile_node silently ignore
+        every sibling constraint (widening)."""
+        if depth > 8:
+            raise ValueError("allOf: anyOf nesting too deep")
+        p = dict(self._resolve(p))
+        if "anyOf" not in p:
+            return [p]
+        rest = {k: v for k, v in p.items() if k != "anyOf"}
+        out: List[Dict[str, Any]] = []
+        for br in p["anyOf"]:
+            for q in self._expand_anyof(br, depth + 1):
+                out.append({"allOf": [q, rest]} if rest else q)
+            if len(out) > 64:
+                raise ValueError(
+                    "allOf: anyOf distribution too large (max 64)"
+                )
+        return out
+
+    def _merge_key(self, k: str, cur: Any, v: Any) -> Any:
+        """Conjunction of two values of schema keyword ``k``."""
+        if k in self._ANNOTATIONS:
+            return v
+        try:
+            # canonical-JSON equality, NOT ==: True == 1 in Python, but
+            # draft-4 boolean exclusiveMinimum and numeric 1 must not
+            # take the fast path together (silent bound widening)
+            if json.dumps(cur, sort_keys=True) == json.dumps(
+                v, sort_keys=True
+            ):
+                return v
+        except (TypeError, ValueError):
+            if cur is v:
+                return v
+        if k == "required":
+            return list(dict.fromkeys(list(cur) + list(v)))
+        if k == "properties":
+            merged = dict(cur)
+            for name, s in v.items():
+                if name in merged and not _same(merged[name], s):
+                    merged[name] = {"allOf": [merged[name], s]}
+                else:
+                    merged[name] = s
+            return merged
+        if k in ("items", "additionalProperties") and isinstance(
+            cur, dict
+        ) and isinstance(v, dict):
+            return {"allOf": [cur, v]}
+        if k == "additionalProperties":
+            # one side boolean: False wins (conjunction); True defers
+            if cur is False or v is False:
+                return False
+            return cur if v is True else v
+        if k == "type":
+            def tset(t):
+                return set(t) if isinstance(t, list) else {t}
+
+            a, b = tset(cur), tset(v)
+            # "number" admits integers: expand for the intersection,
+            # then keep "number" only if both sides allowed it
+            ea = a | ({"integer"} if "number" in a else set())
+            eb = b | ({"integer"} if "number" in b else set())
+            inter = ea & eb
+            if not ("number" in a and "number" in b):
+                inter.discard("number")
+            if "number" in inter:
+                inter.discard("integer")
+            if not inter:
+                raise ValueError(
+                    f"allOf: empty type intersection ({cur!r} & {v!r})"
+                )
+            ordered = sorted(inter)
+            return ordered[0] if len(ordered) == 1 else ordered
+        if k in (
+            "minimum", "minLength", "minItems", "minProperties",
+        ):
+            return max(cur, v)
+        if k in (
+            "maximum", "maxLength", "maxItems", "maxProperties",
+        ):
+            return min(cur, v)
+        if k in ("exclusiveMinimum", "exclusiveMaximum"):
+            if isinstance(cur, bool) or isinstance(v, bool):
+                raise ValueError(
+                    f"allOf: cannot intersect draft-4 boolean {k} "
+                    "across conjuncts"
+                )
+            return max(cur, v) if k == "exclusiveMinimum" else min(cur, v)
+        if k == "multipleOf":
+            import math
+
+            a, b = _integral(cur), _integral(v)
+            if a is not None and b is not None and a > 0 and b > 0:
+                return a * b // math.gcd(a, b)
+            raise ValueError(
+                f"allOf: cannot intersect multipleOf {cur!r} and {v!r} "
+                "(supported: positive integers, via lcm)"
+            )
+        if k == "enum":
+            have = {_canon(x) for x in cur}
+            inter = [x for x in v if _canon(x) in have]
+            if not inter:
+                raise ValueError("allOf: empty enum intersection")
+            return inter
+        if k == "const":
+            raise ValueError(
+                f"allOf: conflicting const values {cur!r} and {v!r}"
+            )
+        if k in ("pattern", "format"):
+            raise ValueError(
+                f"allOf: two distinct {k} conjuncts cannot be "
+                f"intersected ({cur!r} and {v!r})"
+            )
+        if k == "uniqueItems":
+            return bool(cur) or bool(v)
+        raise ValueError(
+            f"allOf: unsupported intersection for keyword {k!r} "
+            f"({cur!r} and {v!r})"
+        )
 
     def compile_node(self, schema: Dict[str, Any]) -> Frag:
         b = self.b
@@ -893,7 +1370,22 @@ class SchemaCompiler:
         props: Dict[str, Any] = schema.get("properties", {})
         required = set(schema.get("required", list(props)))
         if not props:
+            addl = schema.get("additionalProperties")
+            if isinstance(addl, dict) or addl is True:
+                # free-form map (Pydantic Dict[str, T]): generated keys
+                # with schema'd values. Declared-property objects never
+                # emit extras (closure by construction — see below), so
+                # this path only applies to pure maps.
+                return self._freeform_object_frag(
+                    schema, addl if isinstance(addl, dict) else {}
+                )
             return b.lit(b"{}")
+        # NOTE additionalProperties closure: this automaton emits ONLY
+        # the declared properties (canonical key order), so output can
+        # never contain an extra key — `additionalProperties: false` is
+        # enforced by construction, and any additionalProperties schema
+        # is trivially satisfied (subset discipline: omitting optional
+        # extras is always valid).
 
         # Emit keys in properties order. Optional properties branch.
         # Build right-to-left: frag(i) = rest of object from property i on,
@@ -928,6 +1420,101 @@ class SchemaCompiler:
             return b.alt(with_prop, tail(i + 1, emitted_before))
 
         return b.seq(b.lit(b"{"), tail(0, False))
+
+    def _freeform_object_frag(
+        self, schema: Dict[str, Any], value_schema: Dict[str, Any]
+    ) -> Frag:
+        """``{"<string>": <value>, ...}`` for property-less objects with
+        an ``additionalProperties`` schema. Key uniqueness is not
+        expressible in an NFA; duplicate keys are syntactically valid
+        JSON (parsers keep the last), so output still parses and the
+        parsed object validates against the value schema."""
+        b = self.b
+        min_p = int(schema.get("minProperties", 0))
+        max_p = schema.get("maxProperties")
+        req = list(schema.get("required", []))
+
+        def pair() -> Frag:
+            return b.seq(
+                self._string_frag(),
+                b.lit(b":"),
+                self.compile_node(value_schema),
+            )
+
+        if req:
+            # required keys on a property-less map: emit them literally
+            # (in order) before any free-form extras, so output always
+            # carries them
+            if max_p is not None and len(req) > int(max_p):
+                raise ValueError(
+                    "required keys exceed maxProperties on free-form map"
+                )
+            head: List[Frag] = []
+            for i, name in enumerate(req):
+                if i:
+                    head.append(b.lit(b","))
+                head.append(
+                    b.seq(
+                        b.lit(json.dumps(name).encode() + b":"),
+                        self.compile_node(value_schema),
+                    )
+                )
+            extras_min = max(min_p - len(req), 0)
+            if max_p is None:
+                tail: Frag = b.star(b.seq(b.lit(b","), pair()))
+                for _ in range(extras_min):
+                    head.append(b.seq(b.lit(b","), pair()))
+                head.append(tail)
+            else:
+                for _ in range(extras_min):
+                    head.append(b.seq(b.lit(b","), pair()))
+                opt_tail: Optional[Frag] = None
+                for _ in range(int(max_p) - len(req) - extras_min):
+                    piece = b.seq(b.lit(b","), pair())
+                    opt_tail = (
+                        b.opt(piece)
+                        if opt_tail is None
+                        else b.opt(b.seq(piece, opt_tail))
+                    )
+                if opt_tail is not None:
+                    head.append(opt_tail)
+            return b.seq(b.lit(b"{"), *head, b.lit(b"}"))
+
+        if max_p is not None:
+            max_p = int(max_p)
+            if min_p > max_p:
+                raise ValueError("minProperties exceeds maxProperties")
+            if max_p == 0:
+                return b.lit(b"{}")
+            # exact bound at any size: required head + nested optional
+            # tail (linear in max_p, same shape as bounded strings)
+            n_req = max(min_p, 1)
+            head: List[Frag] = [pair()]
+            for _ in range(n_req - 1):
+                head.append(b.seq(b.lit(b","), pair()))
+            opt_tail: Optional[Frag] = None
+            for _ in range(max_p - n_req):
+                piece = b.seq(b.lit(b","), pair())
+                opt_tail = (
+                    b.opt(piece)
+                    if opt_tail is None
+                    else b.opt(b.seq(piece, opt_tail))
+                )
+            if opt_tail is not None:
+                head.append(opt_tail)
+            nonempty = b.seq(b.lit(b"{"), *head, b.lit(b"}"))
+            if min_p > 0:
+                return nonempty
+            return b.alt(b.lit(b"{}"), nonempty)
+
+        head = [pair()]
+        for _ in range(max(min_p - 1, 0)):
+            head.append(b.seq(b.lit(b","), pair()))
+        rest = b.star(b.seq(b.lit(b","), pair()))
+        nonempty = b.seq(b.lit(b"{"), *head, rest, b.lit(b"}"))
+        if min_p > 0:
+            return nonempty
+        return b.alt(b.lit(b"{}"), nonempty)
 
     def compile(self) -> NFA:
         return self.b.build(self.compile_node(self.schema))

@@ -762,3 +762,543 @@ def test_unique_items_dedupes_enum_values():
     )
     assert accepts(nfa, '["a","b"]')
     assert not accepts(nfa, '["a","a"]')
+
+
+# ---------------------------------------------------------------------------
+# allOf intersection merge + additionalProperties
+# ---------------------------------------------------------------------------
+
+
+def test_allof_integer_bounds_brute_force():
+    """Conjoined bounds + multipleOf from separate branches accept
+    exactly their intersection — checked against int comparison."""
+    nfa = compile_schema(
+        {
+            "allOf": [
+                {"type": "integer", "minimum": -4},
+                {"maximum": 10},
+                {"multipleOf": 2},
+            ]
+        }
+    )
+    for v in range(-30, 31):
+        want = -4 <= v <= 10 and v % 2 == 0
+        assert accepts(nfa, str(v)) == want, v
+
+
+def test_allof_merges_object_branches():
+    """Properties and required sets union across branches; per-property
+    schemas intersect recursively; emission keeps first-seen key order."""
+    nfa = compile_schema(
+        {
+            "allOf": [
+                {
+                    "type": "object",
+                    "properties": {"a": {"type": "integer", "minimum": 0}},
+                    "required": ["a"],
+                },
+                {
+                    "type": "object",
+                    "properties": {
+                        "a": {"maximum": 5},
+                        "b": {"enum": ["x", "y"]},
+                    },
+                    "required": ["b"],
+                },
+            ]
+        }
+    )
+    assert accepts(nfa, '{"a":3,"b":"x"}')
+    assert accepts(nfa, '{"a":0,"b":"y"}')
+    assert not accepts(nfa, '{"a":6,"b":"x"}')   # a > merged maximum
+    assert not accepts(nfa, '{"a":-1,"b":"x"}')  # a < minimum
+    assert not accepts(nfa, '{"a":3}')           # b required via union
+    assert not accepts(nfa, '{"b":"x","a":3}')   # canonical key order
+
+
+def test_allof_enum_intersection_and_lcm():
+    nfa = compile_schema(
+        {"allOf": [{"enum": [1, 2, 3, "x"]}, {"enum": [2, "x", 9]}]}
+    )
+    for text, want in [("2", True), ('"x"', True), ("1", False),
+                       ("3", False), ("9", False)]:
+        assert accepts(nfa, text) == want, text
+    nfa = compile_schema(
+        {"type": "integer", "allOf": [{"multipleOf": 4}, {"multipleOf": 6}],
+         "minimum": 0, "maximum": 60}
+    )
+    for v in range(0, 61):
+        assert accepts(nfa, str(v)) == (v % 12 == 0), v
+
+
+def test_allof_type_intersection_number_integer():
+    nfa = compile_schema(
+        {"allOf": [{"type": "number"}, {"type": "integer"}]}
+    )
+    assert accepts(nfa, "7")
+    assert not accepts(nfa, "7.5")
+
+
+def test_allof_anyof_distribution():
+    """allOf(anyOf(A,B), C) == anyOf(allOf(A,C), allOf(B,C)) — exact."""
+    nfa = compile_schema(
+        {
+            "allOf": [
+                {"anyOf": [{"minimum": 0}, {"maximum": -10}]},
+                {"type": "integer", "maximum": 5},
+            ]
+        }
+    )
+    for v in range(-30, 31):
+        want = (0 <= v <= 5) or (v <= -10)
+        assert accepts(nfa, str(v)) == want, v
+
+
+def test_allof_string_length_conjunction():
+    nfa = compile_schema(
+        {
+            "allOf": [
+                {"type": "string", "minLength": 2},
+                {"maxLength": 4},
+            ]
+        }
+    )
+    for s, want in [("a", False), ("ab", True), ("abcd", True),
+                    ("abcde", False)]:
+        assert accepts(nfa, json.dumps(s)) == want, s
+
+
+@pytest.mark.parametrize(
+    "schema,msg",
+    [
+        ({"allOf": [{"type": "string"}, {"type": "integer"}]}, "type"),
+        ({"allOf": [{"enum": [1]}, {"enum": [2]}]}, "enum"),
+        ({"allOf": [{"const": 1}, {"const": 2}]}, "const"),
+        (
+            {"allOf": [{"type": "string", "pattern": "^a+$"},
+                       {"pattern": "^b+$"}]},
+            "pattern",
+        ),
+        (
+            {"allOf": [{"oneOf": [{"type": "integer"}]},
+                       {"minimum": 3}]},
+            "oneOf",
+        ),
+        (
+            {"allOf": [{"multipleOf": 2}, {"multipleOf": 0.5}]},
+            "multipleOf",
+        ),
+    ],
+)
+def test_allof_unsupported_intersections_hard_fail(schema, msg):
+    """Inexpressible conjunctions raise with a clear message instead of
+    silently widening the language (subset discipline)."""
+    with pytest.raises(ValueError, match=msg):
+        compile_schema(schema)
+
+
+def test_allof_pydantic_ref_with_siblings_still_works():
+    """Pydantic's single-element allOf around a $ref plus annotation
+    siblings (the pre-existing fast path) keeps working."""
+    from enum import Enum
+
+    from pydantic import Field
+
+    class Color(str, Enum):
+        red = "red"
+        blue = "blue"
+
+    class M(BaseModel):
+        color: Color = Field(description="paint")
+
+    nfa = compile_schema(normalize_output_schema(M))
+    assert accepts(nfa, '{"color":"red"}')
+    assert not accepts(nfa, '{"color":"green"}')
+
+
+def test_additional_properties_false_closed_by_construction():
+    """Declared-property objects never emit extra keys, so
+    additionalProperties: false holds structurally."""
+    nfa = compile_schema(
+        {
+            "type": "object",
+            "properties": {"a": {"type": "integer"}},
+            "required": ["a"],
+            "additionalProperties": False,
+        }
+    )
+    assert accepts(nfa, '{"a":1}')
+    assert not accepts(nfa, '{"a":1,"z":2}')
+    assert not accepts(nfa, '{"z":2,"a":1}')
+
+
+def test_freeform_map_additional_properties_schema():
+    """Property-less object with a value schema (Pydantic Dict[str, T])
+    compiles to a free-form map instead of the empty object."""
+    nfa = compile_schema(
+        {"type": "object", "additionalProperties": {"type": "integer"}}
+    )
+    assert accepts(nfa, "{}")
+    assert accepts(nfa, '{"k":1}')
+    assert accepts(nfa, '{"k":1,"other":-2}')
+    assert not accepts(nfa, '{"k":"s"}')
+    assert not accepts(nfa, '{"k":1,}')
+    bounded = compile_schema(
+        {
+            "type": "object",
+            "additionalProperties": {"type": "boolean"},
+            "minProperties": 1,
+            "maxProperties": 2,
+        }
+    )
+    assert not accepts(bounded, "{}")
+    assert accepts(bounded, '{"k":true}')
+    assert accepts(bounded, '{"k":true,"j":false}')
+    assert not accepts(bounded, '{"a":true,"b":false,"c":true}')
+
+
+def test_freeform_map_generation_completes():
+    """Token-FSM drive over a byte vocabulary: masked sampling on a
+    free-form map terminates with parseable, schema-valid JSON."""
+    schema = {
+        "type": "object",
+        "additionalProperties": {"type": "integer"},
+        "maxProperties": 2,
+    }
+    tok = ByteTokenizer()
+    fsm = schema_constraint_factory(schema, tok)()
+    rng = np.random.default_rng(3)
+    out = bytearray()
+    for _ in range(80):
+        if fsm.is_complete():
+            break
+        ids = np.flatnonzero(fsm.allowed_tokens(remaining=80 - len(out)))
+        assert len(ids), "dead state"
+        t = int(rng.choice(ids))
+        fsm.advance(t)
+        out += tok.token_bytes(t)
+    obj = json.loads(out.decode())
+    assert all(isinstance(v, int) for v in obj.values())
+
+
+def test_freeform_map_max_properties_above_16_enforced():
+    """maxProperties is exact at any size (no silent star fallback)."""
+    nfa = compile_schema(
+        {"type": "object", "additionalProperties": {"type": "integer"},
+         "maxProperties": 17}
+    )
+    ok = "{" + ",".join(f'"k{i}":1' for i in range(17)) + "}"
+    too_many = "{" + ",".join(f'"k{i}":1' for i in range(18)) + "}"
+    assert accepts(nfa, ok)
+    assert not accepts(nfa, too_many)
+    with pytest.raises(ValueError, match="minProperties"):
+        compile_schema(
+            {"type": "object", "additionalProperties": {},
+             "minProperties": 20, "maxProperties": 18}
+        )
+
+
+def test_allof_enum_const_filtered_by_conjunct_bounds():
+    """enum/const members violating a sibling conjunct's bounds are
+    dropped (or the schema hard-fails as unsatisfiable) — the merge must
+    never widen past the user's own validation."""
+    nfa = compile_schema({"allOf": [{"enum": [1, 20]}, {"minimum": 10}]})
+    assert accepts(nfa, "20")
+    assert not accepts(nfa, "1")
+    with pytest.raises(ValueError, match="const"):
+        compile_schema({"allOf": [{"const": 5}, {"minimum": 10}]})
+    nfa = compile_schema(
+        {"allOf": [{"enum": ["a", "bb", "ccc"]},
+                   {"type": "string", "minLength": 2, "maxLength": 2}]}
+    )
+    assert accepts(nfa, '"bb"')
+    assert not accepts(nfa, '"a"')
+    assert not accepts(nfa, '"ccc"')
+    nfa = compile_schema(
+        {"allOf": [{"enum": ["ab", "zz", 3]},
+                   {"type": "string", "pattern": "^a"}]}
+    )
+    assert accepts(nfa, '"ab"')
+    assert not accepts(nfa, '"zz"')
+    assert not accepts(nfa, "3")  # type-filtered too
+
+
+def test_allof_preserves_implicit_all_required():
+    """A branch without an explicit required list keeps the compiler's
+    all-properties-required default through the merge."""
+    nfa = compile_schema(
+        {
+            "allOf": [
+                {"type": "object", "properties": {"a": {"type": "integer"}}},
+                {"type": "object",
+                 "properties": {"b": {"type": "string"}},
+                 "required": ["b"]},
+            ]
+        }
+    )
+    assert accepts(nfa, '{"a":1,"b":"x"}')
+    assert not accepts(nfa, '{"b":"x"}')  # a implicitly required
+    assert not accepts(nfa, '{"a":1}')
+
+
+def test_allof_lone_oneof_with_annotation_siblings():
+    """Annotation-only siblings (description etc.) must not make a lone
+    oneOf conjunct 'inexpressible'."""
+    nfa = compile_schema(
+        {"allOf": [{"oneOf": [{"type": "integer"}]}],
+         "description": "annotated"}
+    )
+    assert accepts(nfa, "7")
+
+
+def test_allof_nested_anyof_does_not_leak():
+    """A single-branch (or nested) anyOf conjunct must still intersect
+    with its siblings instead of leaving an 'anyOf' key that makes
+    compile_node drop them."""
+    nfa = compile_schema(
+        {
+            "allOf": [
+                {"anyOf": [{"anyOf": [{"type": "integer"},
+                                      {"type": "string"}]}]},
+                {"minimum": 3},
+            ]
+        }
+    )
+    assert accepts(nfa, "5")
+    assert not accepts(nfa, "1")   # minimum survives the distribution
+    assert accepts(nfa, '"ok"')    # string branch unaffected by minimum
+
+
+def test_allof_composite_enum_filtered():
+    """Array/object enum members are validated against conjunct
+    composite constraints (recursively), not just scalar ones."""
+    nfa = compile_schema(
+        {"allOf": [{"enum": [[1], [1, 2, 3]]},
+                   {"type": "array", "maxItems": 2}]}
+    )
+    assert accepts(nfa, "[1]")
+    assert not accepts(nfa, "[1,2,3]")
+    nfa = compile_schema(
+        {"allOf": [{"enum": [{"a": 1}, {"a": 99}]},
+                   {"type": "object",
+                    "properties": {"a": {"maximum": 10}}}]}
+    )
+    assert accepts(nfa, '{"a":1}')
+    assert not accepts(nfa, '{"a":99}')
+
+
+def test_class_escaped_underscore_still_literal():
+    """[\\_] — underscore is the one word-set member ECMA keeps a
+    literal escape; must not fall back."""
+    nfa = compile_schema(
+        {"type": "object",
+         "properties": {"s": {"type": "string", "pattern": r"^[\_a]+$"}},
+         "required": ["s"]}
+    )
+    assert accepts(nfa, '{"s":"_a_"}')
+    assert not accepts(nfa, '{"s":"b"}')
+
+
+def test_allof_prunes_unsatisfiable_anyof_branches():
+    """Optional-narrowing: allOf(anyOf(int, null), int&minimum) must
+    keep the satisfiable branch, not fail the compile."""
+    import warnings
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        nfa = compile_schema(
+            {"allOf": [{"anyOf": [{"type": "integer"}, {"type": "null"}]},
+                       {"type": "integer", "minimum": 0}]}
+        )
+        assert any("pruned" in str(x.message) for x in w)
+    assert accepts(nfa, "3")
+    assert not accepts(nfa, "-1")
+    assert not accepts(nfa, "null")  # null branch correctly pruned
+    with pytest.raises(ValueError, match="every distributed"):
+        compile_schema(
+            {"allOf": [{"anyOf": [{"type": "null"}, {"type": "boolean"}]},
+                       {"type": "integer"}]}
+        )
+
+
+def test_allof_draft4_boolean_not_conflated_with_numeric():
+    """True == 1 in Python; draft-4 boolean exclusive bounds normalize
+    to the numeric form per conjunct, so (>5) ∧ (>1) merges to >5 —
+    neither conflated with the number 1 nor re-attached to a bound
+    tightened by a different conjunct."""
+    nfa = compile_schema(
+        {"type": "integer",
+         "allOf": [{"minimum": 5, "exclusiveMinimum": True},
+                   {"exclusiveMinimum": 1}]}
+    )
+    assert not accepts(nfa, "5")  # strict: 5 excluded
+    assert accepts(nfa, "6")
+    assert not accepts(nfa, "2")
+
+
+def test_allof_draft4_flag_does_not_reattach_to_tightened_bound():
+    """(>3) ∧ (>=5) must keep 5: the boolean flag from one conjunct may
+    not make a DIFFERENT conjunct's minimum exclusive."""
+    nfa = compile_schema(
+        {"type": "integer",
+         "allOf": [{"minimum": 3, "exclusiveMinimum": True},
+                   {"minimum": 5}]}
+    )
+    assert accepts(nfa, "5")
+    assert not accepts(nfa, "4")
+    # same shape with an enum at the boundary value
+    nfa = compile_schema(
+        {"allOf": [{"minimum": 3, "exclusiveMinimum": True},
+                   {"enum": [5], "minimum": 5}]}
+    )
+    assert accepts(nfa, "5")
+
+
+def test_allof_integral_float_multipleof():
+    nfa = compile_schema(
+        {"allOf": [{"enum": [2, 3]}, {"multipleOf": 2.0}]}
+    )
+    assert accepts(nfa, "2")
+    assert not accepts(nfa, "3")
+    nfa = compile_schema(
+        {"type": "integer", "minimum": 0, "maximum": 24,
+         "allOf": [{"multipleOf": 4.0}, {"multipleOf": 6}]}
+    )
+    for v in range(0, 25):
+        assert accepts(nfa, str(v)) == (v % 12 == 0), v
+
+
+def test_allof_anyof_branch_object_keeps_implicit_required():
+    """An object branch arriving through anyOf expansion keeps the
+    all-properties-required default."""
+    nfa = compile_schema(
+        {"allOf": [
+            {"anyOf": [{"type": "object",
+                        "properties": {"a": {"type": "integer"}}}]},
+            {"type": "object",
+             "properties": {"b": {"type": "string"}},
+             "required": ["b"]},
+        ]}
+    )
+    assert accepts(nfa, '{"a":1,"b":"x"}')
+    assert not accepts(nfa, '{"b":"x"}')
+
+
+def test_allof_additional_properties_closure_across_conjuncts():
+    """A conjunct's additionalProperties: false closes over ITS declared
+    properties: a required extra from another conjunct is unsatisfiable;
+    an optional extra is dropped (narrowing, never emitted)."""
+    with pytest.raises(ValueError, match="additionalProperties"):
+        compile_schema(
+            {"allOf": [
+                {"type": "object",
+                 "properties": {"a": {"type": "integer"}},
+                 "additionalProperties": False},
+                {"type": "object",
+                 "properties": {"b": {"type": "string"}},
+                 "required": ["b"]},
+            ]}
+        )
+    nfa = compile_schema(
+        {"allOf": [
+            {"type": "object",
+             "properties": {"a": {"type": "integer"}},
+             "additionalProperties": False},
+            {"type": "object",
+             "properties": {"b": {"type": "string"}},
+             "required": []},
+        ]}
+    )
+    assert accepts(nfa, '{"a":1}')
+    assert not accepts(nfa, '{"a":1,"b":"x"}')  # b dropped by closure
+
+
+def test_allof_map_value_schema_applies_to_merged_properties():
+    """A map conjunct's value schema must constrain properties declared
+    only by other conjuncts — string ∧ integer is unsatisfiable."""
+    with pytest.raises(ValueError):
+        compile_schema(
+            {"allOf": [
+                {"type": "object",
+                 "additionalProperties": {"type": "integer"}},
+                {"type": "object",
+                 "properties": {"a": {"type": "string"}},
+                 "required": ["a"]},
+            ]}
+        )
+    nfa = compile_schema(
+        {"allOf": [
+            {"type": "object",
+             "additionalProperties": {"minimum": 0}},
+            {"type": "object",
+             "properties": {"a": {"type": "integer", "maximum": 9}},
+             "required": ["a"]},
+        ]}
+    )
+    assert accepts(nfa, '{"a":5}')
+    assert not accepts(nfa, '{"a":-3}')  # map conjunct's minimum applies
+
+
+def test_allof_property_const_true_vs_1_not_conflated():
+    with pytest.raises(ValueError, match="const"):
+        compile_schema(
+            {"allOf": [
+                {"type": "object", "properties": {"a": {"const": True}},
+                 "required": ["a"]},
+                {"type": "object", "properties": {"a": {"const": 1}},
+                 "required": ["a"]},
+            ]}
+        )
+
+
+def test_allof_enum_dict_key_order_insensitive():
+    """JSON-equal dict members with different key order intersect (no
+    spurious empty-enum failure); the kept member emits in its own
+    declared key order."""
+    nfa = compile_schema(
+        {"allOf": [{"enum": [{"a": 1, "b": 2}, 7]},
+                   {"enum": [{"b": 2, "a": 1}]}]}
+    )
+    assert accepts(nfa, '{"b":2,"a":1}')
+    assert not accepts(nfa, "7")
+
+
+def test_allof_required_without_property_schema_hard_fails():
+    with pytest.raises(ValueError, match="required"):
+        compile_schema(
+            {"allOf": [
+                {"type": "object",
+                 "properties": {"a": {"type": "integer"}},
+                 "required": ["a"]},
+                {"required": ["b"]},
+            ]}
+        )
+
+
+def test_allof_fractional_multipleof_filters_enum():
+    nfa = compile_schema(
+        {"allOf": [{"enum": [1, 1.3]}, {"multipleOf": 0.5}]}
+    )
+    assert accepts(nfa, "1")
+    assert not accepts(nfa, "1.3")
+
+
+def test_freeform_map_honors_required_keys():
+    nfa = compile_schema(
+        {"type": "object", "additionalProperties": {"type": "integer"},
+         "required": ["k"]}
+    )
+    assert not accepts(nfa, "{}")
+    assert accepts(nfa, '{"k":1}')
+    assert accepts(nfa, '{"k":1,"extra":2}')
+    nfa = compile_schema(
+        {"type": "object", "additionalProperties": {"type": "integer"},
+         "required": ["k"], "maxProperties": 2}
+    )
+    assert accepts(nfa, '{"k":1,"x":2}')
+    assert not accepts(nfa, '{"k":1,"x":2,"y":3}')
+    with pytest.raises(ValueError, match="maxProperties"):
+        compile_schema(
+            {"type": "object", "additionalProperties": {},
+             "required": ["a", "b"], "maxProperties": 1}
+        )
