@@ -31,7 +31,7 @@ from ..models import transformer
 from ..models.configs import ModelConfig
 from .config import EngineConfig
 from .kvcache import KVCache, alloc_cache, write_kv
-from ..ops.sampling import sample, cumulative_logprob
+from ..ops.sampling import NEG_INF, sample, cumulative_logprob
 
 
 def next_bucket(n: int, lo: int = 16, hi: int = 1 << 20) -> int:
@@ -501,14 +501,20 @@ class ModelRunner:
     def _window_scan(
         self, params, cache: KVCache, last, past_len, page_table,
         rng, temperature, top_p, steps: int, top_k,
-        kv_chunk: int = 1,
+        kv_chunk: int = 1, allowed0=None,
     ):
         """The shared fused-window scan: ``steps`` trunk forwards over
         invariant pages + the carried window buffer, sampling on-device.
         Returns (toks [steps, B], logps [steps, B], wk, wv) with the
         window K/V NOT yet committed to pages — callers decide the
         commit (full window for unconstrained decode, verified prefix
-        for speculative constrained decode)."""
+        for speculative constrained decode).
+
+        ``allowed0`` ([B, V] bool, optional) masks the FIRST step's
+        logits only: a row whose previous window rejected a token takes
+        its FSM-masked step INSIDE the next window (crossing the
+        scaffold token), so one adversarial row no longer degrades the
+        whole batch to masked single-steps."""
         B = last.shape[0]
         L = self.mcfg.num_layers
         KVH, Dh = self.mcfg.num_kv_heads, self.mcfg.head_dim
@@ -536,6 +542,15 @@ class ModelRunner:
                 (0, 0, step_idx, 0),
             )
             step_logits = logits[:, 0]
+            if allowed0 is not None:
+                # masked sample == masked argmax for the greedy rows
+                # this path serves; logp then matches the masked
+                # single-step it replaces
+                step_logits = jnp.where(
+                    step_idx == 0,
+                    jnp.where(allowed0, step_logits, NEG_INF),
+                    step_logits,
+                )
             key = jax.random.fold_in(rng, step_idx)
             tok = sample(
                 step_logits, key,
@@ -628,7 +643,7 @@ class ModelRunner:
     def _decode_window_jit(
         self, params, cache: KVCache, last, past_len, page_table,
         rng, temperature, steps: int, top_p, top_k,
-        kv_chunk: int = 1,
+        kv_chunk: int = 1, allowed0=None,
     ):
         """Like ``_decode_multi_jit`` but WITHOUT the page commit: the
         sampled window and its K/V buffers return to the host, which
@@ -638,6 +653,7 @@ class ModelRunner:
         return self._window_scan(
             params, cache, last, past_len, page_table, rng,
             temperature, top_p, steps, top_k, kv_chunk,
+            allowed0=allowed0,
         )
 
     @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
@@ -659,11 +675,13 @@ class ModelRunner:
         top_p: np.ndarray,           # [B]
         steps: int,
         top_k: Optional[np.ndarray] = None,
+        allowed0: Optional[np.ndarray] = None,  # [B, V] bool, step 0 only
     ):
         """Speculative window: returns (tokens [steps, B], logprobs
         [steps, B], window_kv handle). Pages are NOT written — call
         ``commit_window(handle, accepted)`` with per-row accepted token
-        counts."""
+        counts. ``allowed0`` FSM-masks the first step for rows whose
+        previous window rejected a token (scheduler per-row recovery)."""
         B = len(last_tokens)
         if top_k is None:
             top_k = np.zeros((B,), np.int32)
@@ -679,6 +697,7 @@ class ModelRunner:
             jnp.asarray(top_p, jnp.float32),
             jnp.asarray(top_k, jnp.int32),
             self._chunk_for_table(page_table),
+            None if allowed0 is None else jnp.asarray(allowed0, bool),
         )
         # copy: callers may pass live views (native runtime) that mutate
         # during host-side verification before commit_window

@@ -228,9 +228,12 @@ class ContinuousBatcher:
         self._key = jax.random.PRNGKey(seed)
         self._fixed_key = jax.random.PRNGKey(seed)
         self._step = 0
-        # set when a speculative window rejected a token: the next
-        # iteration runs one masked single-step to guarantee progress
-        self._needs_mask = False
+        # slot indices whose speculative window rejected a token: each
+        # takes its FSM-masked step inside the NEXT window (allowed0),
+        # so one adversarial row doesn't degrade the batch to masked
+        # single-steps; only non-greedy constrained batches still fall
+        # back to the masked single-step path
+        self._needs_mask: set = set()
         # penalty id-buffer growth events already logged (power-of-two K)
         self._pk_grown: set = set()
         from .profiling import StepTimer
@@ -532,6 +535,7 @@ class ContinuousBatcher:
             self.allocator.free(slot.pages)
         self.slots[i] = None
         self._gen[i] += 1
+        self._needs_mask.discard(i)  # flag must not leak to a new occupant
         out = list(slot.out_ids)
         reason = "stop"
         if out and out[-1] in self.stop_ids:
@@ -913,7 +917,11 @@ class ContinuousBatcher:
                 self.ecfg.decode_multi_step > 1
                 and not has_row_seed
                 and not has_penalty  # counts update host-side per token
-                and not self._needs_mask
+                # flagged rows are fine here: the speculative window
+                # FSM-masks their first step (allowed0); only the
+                # non-greedy constrained fallback needs the masked
+                # single-step, and it clears the flags itself
+                and (not self._needs_mask or has_constraint)
                 and (
                     not has_constraint
                     or all(
@@ -941,11 +949,27 @@ class ContinuousBatcher:
             rng = self._fixed_key if has_row_seed else sub
             if K > 1 and has_constraint:
                 # speculative window: sample unmasked, verify host-side,
-                # commit only each row's FSM-valid prefix
+                # commit only each row's FSM-valid prefix. Rows whose
+                # previous window rejected take their FSM-masked step as
+                # the window's FIRST step (allowed0) — per-row recovery,
+                # full cadence for everyone else.
+                allowed0 = None
+                flagged: set = self._needs_mask & set(active)
+                if flagged:
+                    allowed0 = np.ones((self.B, self.vocab), bool)
+                    for i in flagged:
+                        s = self.slots[i]
+                        c = s.req.constraint
+                        if c is not None:
+                            rem = self._remaining(
+                                s.req, len(s.out_ids), s.pos
+                            )
+                            allowed0[i] = self._constraint_mask(c, rem)
+                    self._needs_mask -= flagged
                 with self.timer.time("decode"):
                     toks_w, logps_w, handle = self.runner.decode_window(
                         last, past_len, table, sub, temp, top_p, K,
-                        top_k=top_k,
+                        top_k=top_k, allowed0=allowed0,
                     )
                 self._step += K
                 accepted = np.zeros((self.B,), np.int32)
@@ -955,14 +979,25 @@ class ContinuousBatcher:
                     c = s.req.constraint
                     for j in range(K):
                         tok = int(toks_w[j][i])
-                        if c is not None:
+                        # a flagged row's step-0 token was chosen UNDER
+                        # its FSM mask — accept without re-verifying,
+                        # exactly like the masked single-step this
+                        # replaces. Re-checking would livelock in the
+                        # budget-infeasible corner where allowed_tokens
+                        # degrades to unfiltered but token_allowed still
+                        # returns False (fsm.py degrade semantics).
+                        if c is not None and not (
+                            j == 0 and i in flagged
+                        ):
                             rem = self._remaining(
                                 s.req, len(s.out_ids), s.pos
                             )
                             if not self._token_ok(c, tok, rem):
-                                # next iteration runs one masked step so
-                                # this row crosses its scaffold token
-                                self._needs_mask = True
+                                # this row's NEXT window opens with its
+                                # FSM-masked step (allowed0) so it
+                                # crosses the scaffold token; other rows
+                                # keep full window cadence
+                                self._needs_mask.add(i)
                                 break
                         accepted[i] += 1
                         output_tokens += 1
@@ -1068,8 +1103,9 @@ class ContinuousBatcher:
                         penalties=penalties,
                     )
                 self._step += 1
-                self._needs_mask = False  # masked step crossed the
-                #                           rejected scaffold token
+                # masked single-step crossed every flagged row's
+                # rejected scaffold token
+                self._needs_mask.clear()
                 for i in active:
                     output_tokens += 1
                     rows_done += self._accept_token(

@@ -433,3 +433,99 @@ def test_repetition_penalty_via_scheduler(tiny_runner, byte_tok):
     assert pen != base
     if len(base) > 4:
         assert max_run(pen) <= max_run(base)
+
+
+def test_speculative_rejection_is_per_row(tiny_runner, byte_tok, monkeypatch):
+    """One adversarial constrained row (scaffold-heavy const schema,
+    rejected nearly every window) must NOT degrade the batch to masked
+    single-steps: the rejecting row takes its FSM-masked step inside the
+    next window (allowed0) while other rows keep full window cadence."""
+    import json
+
+    from sutro_tpu.engine.constrain import schema_constraint_factory
+
+    calls = {"window": 0, "window_masked": 0, "single": 0}
+    orig_window = tiny_runner.decode_window
+    orig_step = tiny_runner.decode_step
+
+    def window(*a, **kw):
+        calls["window"] += 1
+        if kw.get("allowed0") is not None:
+            calls["window_masked"] += 1
+        return orig_window(*a, **kw)
+
+    def step(*a, **kw):
+        calls["single"] += 1
+        return orig_step(*a, **kw)
+
+    monkeypatch.setattr(tiny_runner, "decode_window", window)
+    monkeypatch.setattr(tiny_runner, "decode_step", step)
+    b = ContinuousBatcher(
+        tiny_runner, stop_ids=byte_tok.stop_ids(),
+        token_bytes=byte_tok.token_bytes,
+    )
+    fac = schema_constraint_factory({"const": "zqxzqxzqxzqx"}, byte_tok)
+    reqs = [
+        GenRequest(
+            row_id=0,
+            prompt_ids=np.array(byte_tok.encode("adv"), np.int32),
+            max_new_tokens=40, temperature=0.0, constraint=fac(),
+        ),
+        GenRequest(
+            row_id=1,
+            prompt_ids=np.array(byte_tok.encode("bystander"), np.int32),
+            max_new_tokens=24, temperature=0.0,
+        ),
+    ]
+    res = {}
+    b.run(reqs, on_result=lambda r: res.__setitem__(r.row_id, r))
+    out0 = b"".join(byte_tok.token_bytes(t) for t in res[0].token_ids)
+    assert json.loads(out0.decode()) == "zqxzqxzqxzqx"
+    assert res[0].finish_reason == "schema_complete"
+    assert len(res[1].token_ids) == 24  # bystander ran to its cap
+    # the invariant under test: rejections recovered inside windows,
+    # never by flipping the whole batch to masked single-steps
+    assert calls["single"] == 0, calls
+    assert calls["window_masked"] >= 1, calls
+    assert calls["window"] >= 2, calls
+
+
+def test_masked_window_step_trusts_mask_no_livelock(tiny_runner, byte_tok):
+    """Budget-infeasible corner: allowed_tokens degrades to unfiltered
+    while token_allowed still rejects. The flagged row's step-0 token is
+    mask-chosen, so it must be accepted WITHOUT re-verification (the old
+    masked single-step's semantics) — re-checking would reject it and
+    spin the scheduler forever at zero progress."""
+
+    class DivergentConstraint:
+        def __init__(self, vocab):
+            self.v = vocab
+
+        def allowed_tokens(self, remaining=None):
+            return np.ones(self.v, bool)  # degrade: unfiltered
+
+        def token_allowed(self, tok, remaining=None):
+            return False  # strict check: nothing fits
+
+        def advance(self, tok):
+            pass
+
+        def is_complete(self):
+            return False
+
+        def min_tokens(self):
+            return 1
+
+    b = ContinuousBatcher(tiny_runner, stop_ids=byte_tok.stop_ids())
+    reqs = [
+        GenRequest(
+            row_id=0,
+            prompt_ids=np.array(byte_tok.encode("x"), np.int32),
+            max_new_tokens=6, temperature=0.0,
+            constraint=DivergentConstraint(tiny_runner.mcfg.vocab_size),
+        )
+    ]
+    res = {}
+    b.run(reqs, on_result=lambda r: res.__setitem__(r.row_id, r))
+    # terminates (no livelock) and makes real progress via masked steps
+    assert len(res[0].token_ids) == 6
