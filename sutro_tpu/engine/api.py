@@ -325,8 +325,23 @@ class LocalEngine:
                     # SUCCEEDED job
                     status = self.jobs.status(job_id)
                     if status == JobStatus.SUCCEEDED:
-                        return {"status": status.value, "resumed": False,
-                                "detail": "job already succeeded"}
+                        from .dphost import DPWorld
+
+                        dp = DPWorld.from_env()
+                        if dp is None or dp.rank == 0:
+                            return {
+                                "status": status.value,
+                                "resumed": False,
+                                "detail": "job already succeeded",
+                            }
+                        # DP worker rank: its SUCCEEDED only means "my
+                        # shard streamed" — the authoritative state is
+                        # the coordinator's. A pod relaunch resumes
+                        # every rank; re-running here is idempotent
+                        # (the coordinator's resume set skips done
+                        # rows), and refusing would leave the
+                        # coordinator waiting for a worker that never
+                        # reconnects.
                     # fetch BEFORE registering as queued: a raise here
                     # must not leave the id poisoning _queued
                     rec = self.jobs.get(job_id)
@@ -566,7 +581,13 @@ class LocalEngine:
         pending_flush: List[Dict[str, Any]] = []
         import jax
 
-        n_chips = max(jax.device_count(), 1)
+        from .dphost import DPWorld
+
+        dp = DPWorld.from_env()
+        # under engine-level DP the merged progress stream carries POD
+        # throughput, so per-chip numbers divide by pod chips
+        # (homogeneous slices), not this rank's
+        n_chips = max(jax.device_count(), 1) * (dp.world if dp else 1)
         tput = Throughput(n_chips)
 
         requests = []
@@ -700,15 +721,79 @@ class LocalEngine:
         from .profiling import job_trace
 
         with job_trace(self.ecfg.profile_dir, job_id):
-            outcome = batcher.run(
-                requests,
-                on_result=on_result,
-                on_progress=on_progress,
-                should_cancel=should_cancel,
-                should_yield=lambda: self._higher_priority_waiting(
-                    rec.job_priority
-                ),
-            )
+            if dp is not None:
+                # engine-level multi-host DP (SURVEY §2.3 DP row): this
+                # process runs its strided row shard on slice-local
+                # devices; rank 0 merges every rank's stream through the
+                # jobstore (order-preserving by row_id). Priority
+                # preemption is per-slice-local and disabled for DP jobs
+                # — yielding one slice of a pod-spanning job would
+                # stall, not free, the pod.
+                from .dphost import (
+                    run_dp_coordinator,
+                    run_dp_worker,
+                    shard_requests,
+                )
+
+                import hashlib
+                import json as _json
+
+                # deterministic cross-rank job identity (job_ids are
+                # per-process): guards the channel against rank-queue
+                # divergence merging one job's rows into another
+                job_key = hashlib.sha256(
+                    _json.dumps(
+                        [
+                            rec.model,
+                            rec.num_rows,
+                            sampling,
+                            inputs[:2],
+                            inputs[-2:],
+                        ],
+                        sort_keys=True,
+                        default=str,
+                    ).encode()
+                ).hexdigest()[:16]
+                shard = shard_requests(requests, dp.rank, dp.world)
+                if dp.rank == 0:
+                    outcome = run_dp_coordinator(
+                        dp, batcher.run, shard,
+                        on_result=on_result,
+                        on_progress=on_progress,
+                        should_cancel=should_cancel,
+                        job_key=job_key,
+                        # the coordinator's partial store holds every
+                        # rank's flushed rows — ship the done set so
+                        # relaunched workers resume row-granularly
+                        done_rows=set(results),
+                    )
+                else:
+                    w_outcome = run_dp_worker(
+                        dp, batcher.run, shard,
+                        job_key=job_key,
+                        should_cancel=should_cancel,
+                    )
+                    # worker stores are not authoritative: results live
+                    # on rank 0; mark the local record terminal without
+                    # finalizing rows — honestly (a cancelled shard,
+                    # e.g. coordinator death, is not a success)
+                    self.jobs.set_status(
+                        job_id,
+                        JobStatus.SUCCEEDED
+                        if w_outcome == "completed"
+                        else JobStatus.CANCELLED,
+                    )
+                    return None
+            else:
+                outcome = batcher.run(
+                    requests,
+                    on_result=on_result,
+                    on_progress=on_progress,
+                    should_cancel=should_cancel,
+                    should_yield=lambda: self._higher_priority_waiting(
+                        rec.job_priority
+                    ),
+                )
         if pending_flush:
             self.jobs.flush_partial(job_id, list(pending_flush))
             pending_flush.clear()

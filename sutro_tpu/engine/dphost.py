@@ -1,0 +1,478 @@
+"""Engine-level multi-host data parallelism (SURVEY §2.3 DP row, §5.8).
+
+The reference scales batch jobs by row-sharding across pod slices behind
+its HTTPS control plane (the slice fleet is invisible to the SDK —
+/root/reference/sutro/sdk.py:331-367 only sees the merged progress
+stream). TPU-native equivalent: one ``LocalEngine`` process per pod
+slice, each computing with its slice-local devices (tp/sp/ep/pp shard
+WITHIN the slice via XLA collectives); a job's rows are strided across
+ranks, workers stream finished rows to the rank-0 coordinator over a
+TCP channel (the DCN analog), and the coordinator's jobstore performs
+the order-preserving merge keyed by ``row_id`` — execution order is
+whatever batching dictates on each slice, input order is reassembled at
+finalize exactly as in the single-host path.
+
+Results deliberately do NOT ride XLA collectives: rows are
+variable-length and the merge is control-plane work. Collectives stay
+reserved for the compute path.
+
+Protocol (newline-delimited JSON over one TCP connection per worker):
+
+  worker -> coord   {"t": "hello", "rank": N}
+  coord  -> worker  {"t": "resume", "rows": [row_id, ...]}   (reply)
+  worker -> coord   {"t": "res", "row_id", "token_ids", "logprob",
+                     "finish", "in_toks"}
+  worker -> coord   {"t": "prog", <scheduler progress fields>}
+  worker -> coord   {"t": "done", "outcome": "completed"}
+  worker -> coord   {"t": "err", "msg": "..."}
+  coord  -> worker  {"t": "cancel"}
+
+The ``resume`` reply carries the coordinator's already-done row_ids
+(its partial store holds EVERY rank's flushed rows), so a relaunched
+pod resumes row-granularly on worker shards too — workers have no
+authoritative store of their own.
+
+Configuration is per-process environment (set by the pod launcher):
+
+  SUTRO_DP_WORLD   number of engine processes (>1 enables the path)
+  SUTRO_DP_RANK    this process's rank; 0 is the coordinator
+  SUTRO_DP_COORD   host:port the coordinator listens on
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from .scheduler import GenRequest, GenResult
+
+# worker engines may still be initializing/compiling when the
+# coordinator starts listening — generous by design (a loaded CI box
+# runs several JAX processes; a pod slice cold-starts its runner)
+_ACCEPT_TIMEOUT_S = float(os.environ.get("SUTRO_DP_ACCEPT_TIMEOUT", "420"))
+
+
+@dataclass(frozen=True)
+class DPWorld:
+    rank: int
+    world: int
+    host: str
+    port: int
+
+    @classmethod
+    def from_env(cls) -> Optional["DPWorld"]:
+        world = int(os.environ.get("SUTRO_DP_WORLD", "1"))
+        if world <= 1:
+            return None
+        rank = int(os.environ["SUTRO_DP_RANK"])
+        host, port = os.environ["SUTRO_DP_COORD"].rsplit(":", 1)
+        return cls(rank=rank, world=world, host=host, port=int(port))
+
+
+def shard_requests(
+    requests: List[GenRequest], rank: int, world: int
+) -> List[GenRequest]:
+    """Strided row sharding: row_id % world == rank. Strided (not
+    blocked) so admission-order effects (shortest-prompt-first batched
+    prefill sorts within a shard) stay balanced across ranks when
+    callers submit length-sorted inputs."""
+    return [q for q in requests if q.row_id % world == rank]
+
+
+def _send(sock: socket.socket, msg: Dict) -> None:
+    sock.sendall(json.dumps(msg, separators=(",", ":")).encode() + b"\n")
+
+
+def _recv_lines(sock: socket.socket):
+    buf = b""
+    while True:
+        chunk = sock.recv(1 << 16)
+        if not chunk:
+            return
+        buf += chunk
+        while b"\n" in buf:
+            line, buf = buf.split(b"\n", 1)
+            if line:
+                yield json.loads(line)
+
+
+def _res_msg(res: GenResult) -> Dict:
+    return {
+        "t": "res",
+        "row_id": res.row_id,
+        "token_ids": [int(t) for t in res.token_ids],
+        "logprob": float(res.cumulative_logprob),
+        "finish": res.finish_reason,
+        "in_toks": int(res.input_tokens),
+    }
+
+
+def _msg_res(m: Dict) -> GenResult:
+    return GenResult(
+        row_id=int(m["row_id"]),
+        token_ids=[int(t) for t in m["token_ids"]],
+        cumulative_logprob=float(m["logprob"]),
+        finish_reason=str(m["finish"]),
+        input_tokens=int(m["in_toks"]),
+    )
+
+
+def run_dp_worker(
+    world: DPWorld,
+    run_shard: Callable[..., str],
+    shard: List[GenRequest],
+    *,
+    job_key: str = "",
+    should_cancel: Optional[Callable[[], bool]] = None,
+) -> str:
+    """Rank>0 execution: run the local shard, streaming every finished
+    row to the coordinator. The local jobstore is NOT authoritative —
+    the caller must skip its own flush/finalize for DP worker runs.
+
+    A coordinator-sent cancel message (or a dropped connection, e.g. the
+    coordinator's job failed) cancels the local run.
+
+    ``job_key`` guards against per-rank queue divergence: the
+    coordinator port is shared across jobs, so a worker that moved on to
+    a different job must not merge its rows into whatever job the
+    coordinator is currently serving — mismatched hellos are rejected
+    and the worker retries until the coordinator reaches ITS job (or the
+    deadline passes)."""
+    import time
+
+    remote_cancel = {"flag": False}
+    # retry until the coordinator binds AND serves this job: a worker
+    # with a hot compile cache can reach connect() before the
+    # coordinator's engine init finishes (refusal), and rank queues can
+    # diverge (reject) — both are ordering, not failure
+    deadline = time.monotonic() + _ACCEPT_TIMEOUT_S
+    sock = None
+    lines = None
+    while True:
+        try:
+            sock = socket.create_connection(
+                (world.host, world.port), timeout=10.0
+            )
+            sock.settimeout(30.0)  # handshake must be prompt
+            _send(
+                sock,
+                {"t": "hello", "rank": world.rank, "job": job_key},
+            )
+            # one generator for the whole connection: taking the resume
+            # reply from a separate generator would drop any bytes
+            # (e.g. an early cancel) already buffered behind it
+            lines = _recv_lines(sock)
+            first = next(lines, None)
+            if first and first.get("t") == "resume":
+                sock.settimeout(None)
+                break
+            sock.close()
+            if first is not None and first.get("t") != "reject":
+                raise RuntimeError(
+                    f"dp worker: expected resume reply, got {first!r}"
+                )
+        except OSError:
+            if sock is not None:
+                sock.close()
+        if time.monotonic() >= deadline:
+            raise RuntimeError(
+                "dp worker: coordinator never served job "
+                f"{job_key!r} within {_ACCEPT_TIMEOUT_S:.0f}s"
+            )
+        time.sleep(0.5)
+    already_done = set(first.get("rows", []))
+    shard = [q for q in shard if q.row_id not in already_done]
+
+    def read_control() -> None:
+        try:
+            for m in lines:
+                if m.get("t") == "cancel":
+                    remote_cancel["flag"] = True
+        except OSError:
+            pass
+        # EOF: coordinator went away — stop generating for a dead merge
+        remote_cancel["flag"] = True
+
+    reader = threading.Thread(target=read_control, daemon=True)
+    reader.start()
+
+    lock = threading.Lock()  # sendall is not atomic across messages
+
+    def on_result(res: GenResult) -> None:
+        with lock:
+            _send(sock, _res_msg(res))
+
+    def on_progress(p: Dict) -> None:
+        with lock:
+            _send(
+                sock,
+                {
+                    "t": "prog",
+                    "rank": world.rank,
+                    "input_tokens": p.get("input_tokens", 0),
+                    "output_tokens": p.get("output_tokens", 0),
+                    "rows_completed": p.get("rows_completed", 0),
+                    "tps": p.get(
+                        "total_tokens_processed_per_second", 0.0
+                    ),
+                },
+            )
+
+    def cancelled() -> bool:
+        if remote_cancel["flag"]:
+            return True
+        return bool(should_cancel and should_cancel())
+
+    try:
+        outcome = run_shard(
+            shard,
+            on_result=on_result,
+            on_progress=on_progress,
+            should_cancel=cancelled,
+        )
+        with lock:
+            _send(sock, {"t": "done", "outcome": outcome})
+        return outcome
+    except Exception as e:  # noqa: BLE001 — surface to the coordinator
+        try:
+            with lock:
+                _send(
+                    sock,
+                    {"t": "err", "msg": f"{type(e).__name__}: {e}"},
+                )
+        except OSError:
+            pass
+        raise
+    finally:
+        sock.close()
+
+
+def run_dp_coordinator(
+    world: DPWorld,
+    run_shard: Callable[..., str],
+    shard: List[GenRequest],
+    *,
+    on_result: Callable[[GenResult], None],
+    on_progress: Optional[Callable[[Dict], None]] = None,
+    job_key: str = "",
+    should_cancel: Optional[Callable[[], bool]] = None,
+    done_rows: Optional[set] = None,
+) -> str:
+    """Rank-0 execution: collect the local shard AND every worker's
+    stream through the same ``on_result`` (the jobstore's row_id-keyed
+    merge makes reassembly order-preserving), aggregating progress
+    across ranks. Raises if any worker reports an error or drops its
+    connection before ``done`` — partial rows stay in the partial store
+    for a row-granular resume, exactly like a single-host failure.
+
+    Connections greeting with a different ``job_key`` (a rank whose
+    queue diverged) are rejected and do not count toward the expected
+    worker set."""
+    listener = socket.create_server(
+        (world.host, world.port), reuse_port=False
+    )
+    listener.settimeout(_ACCEPT_TIMEOUT_S)
+    n_workers = world.world - 1
+    conns: List[socket.socket] = []
+    errs: List[str] = []
+    done = threading.Semaphore(0)
+    res_lock = threading.Lock()  # on_result mutates job state
+    emit_lock = threading.Lock()  # serialize on_progress callbacks
+    # per-rank progress snapshots, summed into one stream
+    prog: Dict[int, Dict] = {}
+    prog_lock = threading.Lock()
+    local_done = {"flag": False}
+    cancel_sent = {"flag": False}  # before acceptor: serve() reads it
+
+    def serve(conn: socket.socket, lines, rank: int) -> None:
+        ok = False
+        failed = False
+        try:
+            for m in lines:
+                t = m.get("t")
+                if t == "res":
+                    with res_lock:
+                        on_result(_msg_res(m))
+                elif t == "prog":
+                    with prog_lock:
+                        prog[m["rank"]] = m
+                    _emit_progress()
+                elif t == "done":
+                    # a worker shard that did not COMPLETE (e.g.
+                    # cancelled after the coordinator's own shard
+                    # finished clean) must not let the job finalize as
+                    # a clean success with silently-missing rows
+                    if m.get("outcome") == "completed":
+                        ok = True
+                    else:
+                        failed = True
+                        errs.append(
+                            f"worker rank={rank} outcome "
+                            f"{m.get('outcome')!r}"
+                        )
+                    break
+                elif t == "err":
+                    failed = True
+                    errs.append(str(m["msg"]))
+                    break
+        except OSError as e:
+            failed = True
+            errs.append(f"worker connection lost: {e}")
+        finally:
+            if not ok and not failed:
+                errs.append(
+                    f"worker rank={rank} disconnected before done"
+                )
+            done.release()
+
+    def _emit_progress() -> None:
+        if on_progress is None:
+            return
+        with prog_lock:
+            snaps = list(prog.values())
+        merged = {
+            "input_tokens": sum(s.get("input_tokens", 0) for s in snaps),
+            "output_tokens": sum(
+                s.get("output_tokens", 0) for s in snaps
+            ),
+            "rows_completed": sum(
+                s.get("rows_completed", 0) for s in snaps
+            ),
+            # pod throughput = sum of slice throughputs (each slice
+            # decodes independently)
+            "total_tokens_processed_per_second": sum(
+                s.get("tps", 0.0) for s in snaps
+            ),
+        }
+        with emit_lock:
+            on_progress(merged)
+
+    def accept_all() -> None:
+        # synchronous handshake per connection: only hellos carrying
+        # THIS job's key count toward the expected worker set; a rank
+        # whose queue diverged onto another job is rejected and will
+        # retry against the listener this coordinator binds for that
+        # job later (or its own coordinator's)
+        accepted = 0
+        try:
+            while accepted < n_workers:
+                conn, _ = listener.accept()
+                try:
+                    conn.settimeout(30.0)
+                    lines = _recv_lines(conn)
+                    first = next(lines, None)
+                    if (
+                        not first
+                        or first.get("t") != "hello"
+                        or first.get("job", "") != job_key
+                    ):
+                        try:
+                            _send(conn, {"t": "reject"})
+                        except OSError:
+                            pass
+                        conn.close()
+                        continue
+                    conn.settimeout(None)
+                    _send(
+                        conn,
+                        {
+                            "t": "resume",
+                            "rows": sorted(done_rows or ()),
+                        },
+                    )
+                    if cancel_sent["flag"]:
+                        # cancelled before this worker connected — it
+                        # would otherwise run its whole shard
+                        _send(conn, {"t": "cancel"})
+                except OSError:
+                    conn.close()
+                    continue
+                conns.append(conn)
+                accepted += 1
+                threading.Thread(
+                    target=serve,
+                    args=(conn, lines, int(first.get("rank", -1))),
+                    daemon=True,
+                ).start()
+        except OSError as e:
+            errs.append(f"worker accept failed: {e}")
+            # unblock the waiter for every connection never made
+            for _ in range(n_workers - accepted):
+                done.release()
+
+    acceptor = threading.Thread(target=accept_all, daemon=True)
+    acceptor.start()
+
+    def local_progress(p: Dict) -> None:
+        with prog_lock:
+            prog[0] = {
+                "rank": 0,
+                "input_tokens": p.get("input_tokens", 0),
+                "output_tokens": p.get("output_tokens", 0),
+                "rows_completed": p.get("rows_completed", 0),
+                "tps": p.get(
+                    "total_tokens_processed_per_second", 0.0
+                ),
+            }
+        _emit_progress()
+
+    def locked_result(res: GenResult) -> None:
+        with res_lock:
+            on_result(res)
+
+    def cancel_check() -> bool:
+        if should_cancel and should_cancel():
+            # broadcast once so workers stop burning chips on a dead job
+            if not cancel_sent["flag"]:
+                cancel_sent["flag"] = True
+                for c in conns:
+                    try:
+                        _send(c, {"t": "cancel"})
+                    except OSError:
+                        pass
+            return True
+        return False
+
+    try:
+        outcome = run_shard(
+            shard,
+            on_result=locked_result,
+            on_progress=local_progress,
+            should_cancel=cancel_check,
+        )
+        local_done["flag"] = True
+        # keep honoring cancellation while waiting on worker shards —
+        # the local shard may finish long before the slowest slice. A
+        # cancelled job waits a short grace for workers to drain, then
+        # stops waiting entirely: a hung or never-connecting worker
+        # must not wedge cancellation (closing conns in the finally
+        # unblocks their serve threads; stragglers see EOF and cancel
+        # locally).
+        import time
+
+        remaining = n_workers
+        cancel_deadline = None
+        while remaining:
+            if done.acquire(timeout=0.25):
+                remaining -= 1
+                continue
+            if cancel_check():
+                if outcome == "completed":
+                    outcome = "cancelled"
+                if cancel_deadline is None:
+                    cancel_deadline = time.monotonic() + 30.0
+                elif time.monotonic() >= cancel_deadline:
+                    break
+        if errs and outcome == "completed":
+            raise RuntimeError(
+                "dp job failed on a worker slice: " + "; ".join(errs)
+            )
+        return outcome
+    finally:
+        for c in conns:
+            c.close()
+        listener.close()

@@ -66,8 +66,13 @@ _DEFAULT_COST = {"input": 0.10, "output": 0.20}
 
 # Per-priority quotas (rows, tokens) — reference /get-quotas shape: a list
 # indexed by priority, each {row_quota, token_quota} (sdk.py:1547-1561,
-# cli.py:406-411). Priority maps to pod-slice size in the TPU build
-# (BASELINE.json): lower priority number = more interactive = smaller batch.
+# cli.py:406-411). NOTE on the BASELINE "priority -> pod-slice size"
+# mapping: in this build priority selects quota table + scheduling
+# precedence (p0 preempts running p1 jobs, tests/test_priority.py), NOT
+# engine/pod sizing. Slice-count selection per priority belongs to the
+# pod launcher, which sets SUTRO_DP_WORLD per engine process group
+# (engine/dphost.py); a single-host engine has nothing to size. Recorded
+# as out of scope in PARITY.md.
 DEFAULT_QUOTAS: List[Dict[str, int]] = [
     {"row_quota": 500_000, "token_quota": 500_000_000},
     {"row_quota": 5_000_000, "token_quota": 5_000_000_000},

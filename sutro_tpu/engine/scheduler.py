@@ -378,6 +378,20 @@ class ContinuousBatcher:
         m = bound(remaining=remaining) if takes_budget else bound()
         return self._pad_mask(m)
 
+    def _fsm_masks(self, rows) -> np.ndarray:
+        """[B, V] bool — each listed slot's FSM mask (all-True for
+        unconstrained slots). Single assembly path for BOTH the masked
+        single-step and the speculative window's allowed0 recovery, so
+        the two cannot drift."""
+        allowed = np.ones((self.B, self.vocab), bool)
+        for i in rows:
+            s = self.slots[i]
+            c = s.req.constraint
+            if c is not None:
+                rem = self._remaining(s.req, len(s.out_ids), s.pos)
+                allowed[i] = self._constraint_mask(c, rem)
+        return allowed
+
     def _remaining(self, req: GenRequest, emitted: int, pos: int) -> int:
         """Tokens of generation budget left: request cap and context room."""
         return max(
@@ -909,9 +923,10 @@ class ContinuousBatcher:
             # unmasked, the host verifies tokens against each row's FSM,
             # and only the longest valid prefix is committed to pages —
             # exact for greedy (masked argmax == unmasked argmax when
-            # the unmasked argmax is valid). A rejection forces one
-            # masked single-step so the stuck row crosses its scaffold
-            # token before the next window.
+            # the unmasked argmax is valid). A rejecting row takes its
+            # FSM-masked step as the FIRST step of its next window
+            # (allowed0) — per-row recovery; other rows keep full
+            # window cadence.
             K = 1
             if (
                 self.ecfg.decode_multi_step > 1
@@ -956,15 +971,7 @@ class ContinuousBatcher:
                 allowed0 = None
                 flagged: set = self._needs_mask & set(active)
                 if flagged:
-                    allowed0 = np.ones((self.B, self.vocab), bool)
-                    for i in flagged:
-                        s = self.slots[i]
-                        c = s.req.constraint
-                        if c is not None:
-                            rem = self._remaining(
-                                s.req, len(s.out_ids), s.pos
-                            )
-                            allowed0[i] = self._constraint_mask(c, rem)
+                    allowed0 = self._fsm_masks(flagged)
                     self._needs_mask -= flagged
                 with self.timer.time("decode"):
                     toks_w, logps_w, handle = self.runner.decode_window(
@@ -1038,17 +1045,10 @@ class ContinuousBatcher:
             else:
                 allowed = None
                 if has_constraint:
-                    # masked step: assemble the per-row FSM vocab masks
-                    # (only here — fused windows verify tokens instead)
-                    allowed = np.ones((self.B, self.vocab), bool)
-                    for i in active:
-                        s = self.slots[i]
-                        c = s.req.constraint
-                        if c is not None:
-                            rem = self._remaining(
-                                s.req, len(s.out_ids), s.pos
-                            )
-                            allowed[i] = self._constraint_mask(c, rem)
+                    # masked step: per-row FSM vocab masks (fused
+                    # windows verify tokens instead; their allowed0
+                    # recovery masks come from the same helper)
+                    allowed = self._fsm_masks(active)
                 penalties = None
                 if has_penalty:
                     # Distinct generated ids carried per row. K is a jit

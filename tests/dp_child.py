@@ -1,0 +1,61 @@
+"""Child process for tests/test_dphost.py.
+
+One LocalEngine process per "pod slice". With SUTRO_DP_WORLD=2 the
+engine row-shards the job across ranks (engine/dphost.py): rank 0
+coordinates (owns the authoritative jobstore, merges streams), rank 1
+streams its shard's results over the TCP channel. With SUTRO_DP_WORLD
+unset the same job runs single-host — the parent compares the two
+coordinators' outputs, which must match exactly (greedy decode is
+per-row deterministic, and the merge is order-preserving).
+
+Run via the parent test only — needs SUTRO_HOME (per-process store)
+and, for DP ranks, SUTRO_DP_WORLD/SUTRO_DP_RANK/SUTRO_DP_COORD.
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from sutro_tpu.engine.api import LocalEngine  # noqa: E402
+from sutro_tpu.engine.config import EngineConfig  # noqa: E402
+
+N_ROWS = 24
+
+
+def main() -> None:
+    rank = int(os.environ.get("SUTRO_DP_RANK", "0"))
+    ecfg = EngineConfig(
+        kv_page_size=8, max_pages_per_seq=16, decode_batch_size=4,
+        max_model_len=128, use_pallas=False, param_dtype="float32",
+        activation_dtype="float32",
+    )
+    eng = LocalEngine(ecfg)
+    jid = eng.submit_batch_inference(
+        {
+            "model": "tiny-dense",
+            "inputs": [f"dp row {i} text" for i in range(N_ROWS)],
+            "sampling_params": {"max_new_tokens": 6, "temperature": 0.0},
+        }
+    )
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        s = eng.job_status(jid)
+        if s in ("SUCCEEDED", "FAILED", "CANCELLED"):
+            break
+        time.sleep(0.05)
+    assert eng.job_status(jid) == "SUCCEEDED", eng.job_status(jid)
+    if rank == 0:
+        res = eng.job_results(jid)
+        assert len(res["outputs"]) == N_ROWS
+        assert all(o is not None for o in res["outputs"])
+        print("RESULTS " + json.dumps(res["outputs"]), flush=True)
+    print(f"DP_OK rank={rank}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

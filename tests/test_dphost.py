@@ -1,0 +1,216 @@
+"""Engine-level multi-host DP: a real job row-sharded across two
+LocalEngine processes (SURVEY §2.3 DP row, §5.8).
+
+Three OS processes run the SAME 24-row greedy job: a dp=2 pair
+(coordinator + worker, results merged over the TCP channel in
+engine/dphost.py) and a single-host reference. The coordinator's
+finalized outputs must equal the reference's exactly — proving the
+strided shard + cross-process stream + order-preserving merge changes
+nothing about results, only where rows execute."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(tmp_path, name, extra_env):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    # engine processes don't need the virtual multi-device mesh
+    env.pop("XLA_FLAGS", None)
+    home = tmp_path / name
+    home.mkdir()
+    env["SUTRO_HOME"] = str(home)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env)
+    return subprocess.Popen(
+        [sys.executable, str(REPO / "tests" / "dp_child.py")],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=env,
+    )
+
+
+def test_dp_job_across_two_engines_matches_single_host(tmp_path):
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    procs = {
+        "rank0": _spawn(
+            tmp_path, "rank0",
+            {"SUTRO_DP_WORLD": "2", "SUTRO_DP_RANK": "0",
+             "SUTRO_DP_COORD": coord},
+        ),
+        "rank1": _spawn(
+            tmp_path, "rank1",
+            {"SUTRO_DP_WORLD": "2", "SUTRO_DP_RANK": "1",
+             "SUTRO_DP_COORD": coord},
+        ),
+        "single": _spawn(tmp_path, "single", {}),
+    }
+    outs = {}
+    try:
+        for name, p in procs.items():
+            out, _ = p.communicate(timeout=420)
+            outs[name] = out
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+    all_logs = "\n".join(
+        f"--- {n} (rc={p.returncode}) ---\n{outs.get(n, '<no output>')}"
+        for n, p in procs.items()
+    )
+    for name, p in procs.items():
+        assert p.returncode == 0, f"{name} failed\n{all_logs}"
+    assert "DP_OK rank=0" in outs["rank0"], outs["rank0"]
+    assert "DP_OK rank=1" in outs["rank1"], outs["rank1"]
+
+    def results_of(out: str):
+        for line in out.splitlines():
+            if line.startswith("RESULTS "):
+                return json.loads(line[len("RESULTS "):])
+        raise AssertionError(f"no RESULTS line:\n{out}")
+
+    dp_outputs = results_of(outs["rank0"])
+    ref_outputs = results_of(outs["single"])
+    assert len(dp_outputs) == 24
+    # identical content AND order: the dp path changes where rows run,
+    # never what they produce
+    assert dp_outputs == ref_outputs
+
+
+# ---------------------------------------------------------------------------
+# channel-level tests (stub shards, no engines — fast)
+# ---------------------------------------------------------------------------
+
+
+def _world(port):
+    from sutro_tpu.engine.dphost import DPWorld
+
+    return (
+        DPWorld(rank=0, world=2, host="127.0.0.1", port=port),
+        DPWorld(rank=1, world=2, host="127.0.0.1", port=port),
+    )
+
+
+def _reqs(n):
+    import numpy as np
+
+    from sutro_tpu.engine.scheduler import GenRequest
+
+    return [
+        GenRequest(
+            row_id=i, prompt_ids=np.zeros(1, np.int32), max_new_tokens=1
+        )
+        for i in range(n)
+    ]
+
+
+def _res(row_id):
+    from sutro_tpu.engine.scheduler import GenResult
+
+    return GenResult(
+        row_id=row_id, token_ids=[7], cumulative_logprob=-0.5,
+        finish_reason="stop", input_tokens=1,
+    )
+
+
+def test_channel_resume_filter_and_merge():
+    """The coordinator ships its done-row set on hello; the worker
+    filters its shard so already-merged rows are not regenerated."""
+    import threading
+
+    from sutro_tpu.engine.dphost import (
+        run_dp_coordinator,
+        run_dp_worker,
+        shard_requests,
+    )
+
+    port = _free_port()
+    cw, ww = _world(port)
+    reqs = _reqs(8)
+    worker_ran = []
+
+    def coord_shard(shard, on_result, on_progress, should_cancel):
+        for q in shard:
+            on_result(_res(q.row_id))
+        return "completed"
+
+    def worker_shard(shard, on_result, on_progress, should_cancel):
+        worker_ran.extend(q.row_id for q in shard)
+        for q in shard:
+            on_result(_res(q.row_id))
+        return "completed"
+
+    def worker_main():
+        run_dp_worker(
+            ww, worker_shard, shard_requests(reqs, 1, 2)
+        )
+
+    t = threading.Thread(target=worker_main)
+    t.start()
+    merged = {}
+    outcome = run_dp_coordinator(
+        cw, coord_shard, shard_requests(reqs, 0, 2),
+        on_result=lambda r: merged.__setitem__(r.row_id, r),
+        done_rows={1, 3},  # worker rows already in the partial store
+    )
+    t.join(timeout=30)
+    assert outcome == "completed"
+    assert worker_ran == [5, 7]  # 1 and 3 filtered by the resume set
+    # coordinator merged its own shard + the worker's fresh rows
+    assert set(merged) == {0, 2, 4, 6, 5, 7}
+    assert merged[5].finish_reason == "stop"
+
+
+def test_channel_worker_failure_fails_job():
+    """A worker error (or non-completed outcome) must surface on the
+    coordinator instead of finalizing with silently-missing rows."""
+    import threading
+
+    import pytest
+
+    from sutro_tpu.engine.dphost import (
+        run_dp_coordinator,
+        run_dp_worker,
+        shard_requests,
+    )
+
+    port = _free_port()
+    cw, ww = _world(port)
+    reqs = _reqs(4)
+
+    def coord_shard(shard, on_result, on_progress, should_cancel):
+        for q in shard:
+            on_result(_res(q.row_id))
+        return "completed"
+
+    def worker_shard(shard, on_result, on_progress, should_cancel):
+        raise RuntimeError("slice OOM")
+
+    def worker_main():
+        try:
+            run_dp_worker(ww, worker_shard, shard_requests(reqs, 1, 2))
+        except RuntimeError:
+            pass  # the worker re-raises locally too
+
+    t = threading.Thread(target=worker_main)
+    t.start()
+    with pytest.raises(RuntimeError, match="slice OOM"):
+        run_dp_coordinator(
+            cw, coord_shard, shard_requests(reqs, 0, 2),
+            on_result=lambda r: None,
+        )
+    t.join(timeout=30)
