@@ -328,20 +328,23 @@ class LocalEngine:
                         from .dphost import DPWorld
 
                         dp = DPWorld.from_env()
-                        if dp is None or dp.rank == 0:
+                        if dp is None:
                             return {
                                 "status": status.value,
                                 "resumed": False,
                                 "detail": "job already succeeded",
                             }
-                        # DP worker rank: its SUCCEEDED only means "my
-                        # shard streamed" — the authoritative state is
-                        # the coordinator's. A pod relaunch resumes
-                        # every rank; re-running here is idempotent
-                        # (the coordinator's resume set skips done
-                        # rows), and refusing would leave the
-                        # coordinator waiting for a worker that never
-                        # reconnects.
+                        # Under DP, EVERY rank re-queues on resume —
+                        # including rank 0 and even when locally
+                        # SUCCEEDED. A worker's SUCCEEDED only means
+                        # "my shard streamed" (the authoritative state
+                        # is the coordinator's), and a refusing
+                        # coordinator would leave re-queued workers
+                        # retrying a port nobody serves until timeout.
+                        # The re-run is a cheap no-op round: the
+                        # coordinator's resume set already contains
+                        # every row, so all shards are empty and the
+                        # job re-finalizes identically.
                     # fetch BEFORE registering as queued: a raise here
                     # must not leave the id poisoning _queued
                     rec = self.jobs.get(job_id)
@@ -740,20 +743,21 @@ class LocalEngine:
 
                 # deterministic cross-rank job identity (job_ids are
                 # per-process): guards the channel against rank-queue
-                # divergence merging one job's rows into another
-                job_key = hashlib.sha256(
+                # divergence merging one job's rows into another. ALL
+                # inputs feed the hash (length-delimited) — two jobs
+                # differing only in middle rows must not share a key
+                h = hashlib.sha256(
                     _json.dumps(
-                        [
-                            rec.model,
-                            rec.num_rows,
-                            sampling,
-                            inputs[:2],
-                            inputs[-2:],
-                        ],
+                        [rec.model, rec.num_rows, sampling],
                         sort_keys=True,
                         default=str,
                     ).encode()
-                ).hexdigest()[:16]
+                )
+                for row in inputs:
+                    rb = str(row).encode()
+                    h.update(f"{len(rb)}:".encode())
+                    h.update(rb)
+                job_key = h.hexdigest()[:16]
                 shard = shard_requests(requests, dp.rank, dp.world)
                 if dp.rank == 0:
                     outcome = run_dp_coordinator(

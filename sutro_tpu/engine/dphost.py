@@ -153,6 +153,10 @@ def run_dp_worker(
     sock = None
     lines = None
     while True:
+        if should_cancel and should_cancel():
+            # cancelled before the coordinator ever served this job —
+            # don't burn the slice retrying a dead port
+            return "cancelled"
         try:
             sock = socket.create_connection(
                 (world.host, world.port), timeout=10.0
@@ -327,6 +331,13 @@ def run_dp_coordinator(
                 errs.append(
                     f"worker rank={rank} disconnected before done"
                 )
+            # a finished rank's token counts stay (cumulative) but its
+            # last RATE snapshot must not keep inflating the pod sum
+            # while stragglers run
+            with prog_lock:
+                if rank in prog:
+                    prog[rank] = {**prog[rank], "tps": 0.0}
+            _emit_progress()
             done.release()
 
     def _emit_progress() -> None:
@@ -445,6 +456,10 @@ def run_dp_coordinator(
             should_cancel=cancel_check,
         )
         local_done["flag"] = True
+        with prog_lock:  # same staleness rule for the local shard
+            if 0 in prog:
+                prog[0] = {**prog[0], "tps": 0.0}
+        _emit_progress()
         # keep honoring cancellation while waiting on worker shards —
         # the local shard may finish long before the slowest slice. A
         # cancelled job waits a short grace for workers to drain, then

@@ -66,3 +66,177 @@ def test_set_base_url_and_backend(runner, tmp_path):
 def test_jobs_list_empty(runner):
     res = runner.invoke(cli, ["jobs", "list"])
     assert res.exit_code == 0
+
+
+# ---------------------------------------------------------------------------
+# job lifecycle against the live local engine (reference
+# cli.py:204-273,344-360,419-435). Module-scoped home + tiny engine.json
+# so every `get_sdk()` the CLI constructs shares one tiny singleton.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def live(tmp_path_factory, monkeypatch_module):
+    import json
+
+    home = tmp_path_factory.mktemp("cli-home")
+    monkeypatch_module.setenv("SUTRO_HOME", str(home))
+    (home / "engine.json").write_text(
+        json.dumps(
+            dict(
+                kv_page_size=8, max_pages_per_seq=16,
+                decode_batch_size=4, max_model_len=128,
+                use_pallas=False, param_dtype="float32",
+                activation_dtype="float32", max_new_tokens=8,
+            )
+        )
+    )
+    from sutro_tpu.engine.api import reset_engine
+    from sutro_tpu.sdk import Sutro
+
+    reset_engine()
+    sdk = Sutro()
+    yield CliRunner(), sdk, home
+    reset_engine()
+
+
+def _submitted_job(sdk, n=2, await_done=True, **kw):
+    jid = sdk.infer(
+        [f"cli row {i}" for i in range(n)],
+        model="tiny-dense",
+        stay_attached=False,
+        sampling_params={"max_new_tokens": 4, "temperature": 0.0},
+        **kw,
+    )
+    if await_done:
+        sdk.await_job_completion(jid, unpack_json=False)
+    return jid
+
+
+def test_jobs_status_and_list_show_job(live):
+    runner, sdk, _ = live
+    jid = _submitted_job(sdk)
+    res = runner.invoke(cli, ["jobs", "status", jid])
+    assert res.exit_code == 0
+    assert "SUCCEEDED" in res.output
+    res = runner.invoke(cli, ["jobs", "list"])
+    assert res.exit_code == 0
+    assert jid in res.output
+
+
+def test_jobs_results_stdout_and_parquet(live, tmp_path):
+    import pandas as pd
+
+    runner, sdk, _ = live
+    jid = _submitted_job(sdk)
+    res = runner.invoke(cli, ["jobs", "results", jid])
+    assert res.exit_code == 0
+    assert "inference_result" in res.output
+    out = tmp_path / "res.parquet"
+    res = runner.invoke(
+        cli, ["jobs", "results", jid, "--output-path", str(out)]
+    )
+    assert res.exit_code == 0
+    df = pd.read_parquet(out)
+    assert len(df) == 2
+    assert "inference_result" in df.columns
+
+
+def test_jobs_results_unknown_id_exits_nonzero(live):
+    runner, _, _ = live
+    res = runner.invoke(cli, ["jobs", "results", "job-nonexistent"])
+    assert res.exit_code != 0
+
+
+def test_jobs_cancel_then_resume(live):
+    runner, sdk, _ = live
+    # enough rows that cancellation lands mid-flight or queued
+    jid = _submitted_job(sdk, n=6, await_done=False)
+    res = runner.invoke(cli, ["jobs", "cancel", jid])
+    assert res.exit_code == 0
+    assert "Status:" in res.output
+    # wait for the terminal state, then resume via the CLI
+    import time
+
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if sdk.get_job_status(jid) in ("CANCELLED", "SUCCEEDED"):
+            break
+        time.sleep(0.05)
+    status = sdk.get_job_status(jid)
+    res = runner.invoke(cli, ["jobs", "resume", jid])
+    assert res.exit_code == 0
+    if status == "CANCELLED":
+        assert "Resumed" in res.output
+        sdk.await_job_completion(jid, unpack_json=False)
+        assert sdk.get_job_status(jid) == "SUCCEEDED"
+    else:
+        # raced to completion before cancel landed — resume must refuse
+        assert "Not resumed" in res.output
+
+
+def test_jobs_resume_succeeded_refuses(live):
+    runner, sdk, _ = live
+    jid = _submitted_job(sdk)
+    res = runner.invoke(cli, ["jobs", "resume", jid])
+    assert res.exit_code == 0
+    assert "Not resumed" in res.output
+    assert "succeeded" in res.output
+
+
+def test_jobs_attach_latest_completed(live):
+    runner, sdk, _ = live
+    _submitted_job(sdk)
+    res = runner.invoke(cli, ["jobs", "attach", "--latest"])
+    assert res.exit_code == 0
+
+
+def test_jobs_attach_no_jobs_fails(runner):
+    # fresh empty home (the `runner` fixture) — no jobs to attach to.
+    # The engine is a process singleton, so drop any instance bound to
+    # another test's home before and after.
+    from sutro_tpu.engine.api import reset_engine
+
+    reset_engine()
+    try:
+        res = runner.invoke(cli, ["jobs", "attach", "--latest"])
+        assert res.exit_code == 1
+        assert "No jobs" in res.output
+    finally:
+        reset_engine()
+
+
+def test_login_local_backend_no_key(live):
+    runner, _, home = live
+    res = runner.invoke(cli, ["login"], input="\n")
+    assert res.exit_code == 0
+    assert "Logged in" in res.output
+
+
+def test_login_stores_key(live):
+    runner, _, home = live
+    res = runner.invoke(cli, ["login"], input="sk-test-123\n")
+    assert res.exit_code == 0
+    from sutro_tpu.validation import load_config
+
+    assert load_config().get("api_key") == "sk-test-123"
+
+
+def test_datasets_download(live, tmp_path):
+    runner, _, _ = live
+    res = runner.invoke(cli, ["datasets", "create"])
+    assert res.exit_code == 0
+    ds = res.output.strip().splitlines()[-1]
+    src = tmp_path / "up.txt"
+    src.write_text("hello\nworld\n")
+    res = runner.invoke(cli, ["datasets", "upload", ds, str(src)])
+    assert res.exit_code == 0
+    dest = tmp_path / "down"
+    dest.mkdir()
+    res = runner.invoke(
+        cli, ["datasets", "download", ds, "--output-path", str(dest)]
+    )
+    assert res.exit_code == 0
+    got = dest / "up.txt"
+    assert got.exists()
+    assert got.read_text() == "hello\nworld\n"
