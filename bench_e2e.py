@@ -148,6 +148,18 @@ def main() -> None:
             "backend": jax.default_backend(),
             "n_chips": n_chips,
             "rows": n_rows,
+            # artifacts must self-describe: a reader of the longgen row
+            # needs to see the 48-token CPU cap vs the 2048-token TPU
+            # config without opening this file
+            "max_new_tokens": ecfg.get("max_new_tokens"),
+            "engine_config": {
+                k: ecfg[k]
+                for k in (
+                    "decode_batch_size", "kv_page_size",
+                    "max_pages_per_seq", "max_model_len",
+                )
+                if k in ecfg
+            },
             "elapsed_s": round(elapsed, 2),
             "rows_per_hour": round(n_rows / elapsed * 3600, 1),
             "input_tokens": in_tok,

@@ -748,7 +748,13 @@ class LocalEngine:
                 # differing only in middle rows must not share a key
                 h = hashlib.sha256(
                     _json.dumps(
-                        [rec.model, rec.num_rows, sampling],
+                        [
+                            rec.model,
+                            rec.num_rows,
+                            sampling,
+                            rec.system_prompt,
+                            rec.output_schema,
+                        ],
                         sort_keys=True,
                         default=str,
                     ).encode()
@@ -760,23 +766,50 @@ class LocalEngine:
                 job_key = h.hexdigest()[:16]
                 shard = shard_requests(requests, dp.rank, dp.world)
                 if dp.rank == 0:
-                    outcome = run_dp_coordinator(
-                        dp, batcher.run, shard,
-                        on_result=on_result,
-                        on_progress=on_progress,
-                        should_cancel=should_cancel,
-                        job_key=job_key,
-                        # the coordinator's partial store holds every
-                        # rank's flushed rows — ship the done set so
-                        # relaunched workers resume row-granularly
-                        done_rows=set(results),
-                    )
+                    if len(results) >= rec.num_rows:
+                        # every row is already merged (a resume of a
+                        # fully-succeeded job, e.g. user-issued on the
+                        # coordinator alone): re-finalize WITHOUT a
+                        # coordinator round — binding the port and
+                        # waiting _ACCEPT_TIMEOUT_S for workers nobody
+                        # resumed would flip a SUCCEEDED job to FAILED
+                        outcome = "completed"
+                    else:
+                        outcome = run_dp_coordinator(
+                            dp, batcher.run, shard,
+                            on_result=on_result,
+                            on_progress=on_progress,
+                            should_cancel=should_cancel,
+                            job_key=job_key,
+                            # the coordinator's partial store holds
+                            # every rank's flushed rows — ship the done
+                            # set so relaunched workers resume
+                            # row-granularly
+                            done_rows=set(results),
+                        )
                 else:
-                    w_outcome = run_dp_worker(
-                        dp, batcher.run, shard,
-                        job_key=job_key,
-                        should_cancel=should_cancel,
-                    )
+                    try:
+                        w_outcome = run_dp_worker(
+                            dp, batcher.run, shard,
+                            job_key=job_key,
+                            should_cancel=should_cancel,
+                        )
+                    except RuntimeError as e:
+                        if "never served" not in str(e):
+                            raise
+                        # the coordinator never served this job — most
+                        # likely a resume of an already-complete pod
+                        # job where rank 0 (correctly) skipped its
+                        # round. CANCELLED, not FAILED: the shard ran
+                        # nothing, the record is non-authoritative, and
+                        # CANCELLED stays resumable if the pod really
+                        # does need this rank later.
+                        self.jobs.set_status(
+                            job_id,
+                            JobStatus.CANCELLED,
+                            failure_reason={"message": str(e)},
+                        )
+                        return None
                     # worker stores are not authoritative: results live
                     # on rank 0; mark the local record terminal without
                     # finalizing rows — honestly (a cancelled shard,
