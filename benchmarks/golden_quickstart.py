@@ -1,0 +1,139 @@
+"""Golden-label quickstart on REAL trained weights (verdict r2 item 4).
+
+Runs the reference README 3-row sentiment quickstart
+(/root/reference/README.md:124-160) through ``so.classify`` with a real
+trained checkpoint and asserts the actual labels, closing the only gap
+in the golden path: ``tests/test_golden.py`` proves exact logit/argmax
+parity vs ``transformers`` for every model family, but on random tiny
+checkpoints — this script proves real weights produce correct LABELS.
+
+Weights discovery (first hit wins):
+  1. ``SUTRO_GOLDEN_WEIGHTS`` — explicit HF-style checkpoint dir
+     (config.json + *.safetensors + tokenizer.json).
+  2. ``huggingface_hub.snapshot_download('Qwen/Qwen3-0.6B')`` — cache
+     hit, or a live download when the host has egress.
+
+When no weights are reachable the script exits 2 with a clear message —
+it never fabricates a result. The round-3 build environment has zero
+egress and no cached checkpoint (documented in PARITY.md), so this is
+committed ready-to-run for a host that has either.
+
+Writes GOLDEN.json: per-row review / expected / got plus pass/fail.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+ROWS = [
+    ("great product, works perfectly", "positive"),
+    ("broke after one day, do not buy", "negative"),
+    ("it's fine I guess", "neutral"),
+]
+
+
+def find_weights() -> str | None:
+    explicit = os.environ.get("SUTRO_GOLDEN_WEIGHTS")
+    if explicit and Path(explicit, "config.json").exists():
+        return explicit
+    try:
+        from huggingface_hub import snapshot_download
+
+        try:
+            return snapshot_download(
+                "Qwen/Qwen3-0.6B", local_files_only=True
+            )
+        except Exception:
+            return snapshot_download("Qwen/Qwen3-0.6B")
+    except Exception:
+        return None
+
+
+def main() -> int:
+    ckpt = find_weights()
+    if ckpt is None:
+        print(
+            json.dumps(
+                {
+                    "error": "no trained weights reachable: set "
+                    "SUTRO_GOLDEN_WEIGHTS to a Qwen3-0.6B checkpoint "
+                    "dir, or run on a host with a HF cache/egress"
+                }
+            )
+        )
+        return 2
+
+    import pandas as pd
+
+    os.environ.setdefault("SUTRO_HOME", "/tmp/sutro-golden")
+    from sutro_tpu.sdk import Sutro
+
+    import jax
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    # engine sized for 3 short rows; bf16 on chip, f32 on CPU hosts
+    so = Sutro(
+        engine_config=dict(
+            weights_dir=str(Path(ckpt).parent),
+            decode_batch_size=4,
+            kv_page_size=64 if on_tpu else 16,
+            max_pages_per_seq=16,
+            max_model_len=768,
+            max_new_tokens=64,
+            param_dtype="bfloat16" if on_tpu else "float32",
+            use_pallas=None if on_tpu else False,
+        )
+    )
+    # weights_dir expects <root>/<ENGINE_KEY> ("qwen3-0.6b", not the
+    # public "qwen-3-0.6b" — api.py:_weights_dir_for joins the engine
+    # key); accept a direct snapshot dir by symlinking it under a temp
+    # root, and HARD-FAIL if the engine still can't see it — silently
+    # falling back to random weights would fabricate the exact result
+    # this script exists to prove
+    root = Path(so.engine.ecfg.weights_dir or "")
+    if not (root / "qwen3-0.6b" / "config.json").exists():
+        import tempfile
+
+        tmp = Path(tempfile.mkdtemp(prefix="sutro-golden-w"))
+        (tmp / "qwen3-0.6b").symlink_to(ckpt)
+        so.engine.ecfg.weights_dir = str(tmp)
+    from sutro_tpu.engine.api import resolve_model
+
+    engine_key, _, _ = resolve_model("qwen-3-0.6b")
+    if so.engine._weights_dir_for(engine_key) is None:
+        raise SystemExit(
+            f"engine cannot resolve checkpoint for {engine_key!r} under "
+            f"{so.engine.ecfg.weights_dir!r} — refusing to run on random "
+            "weights"
+        )
+
+    df = pd.DataFrame({"review_text": [r for r, _ in ROWS]})
+    out = so.classify(
+        df, column="review_text",
+        classes=["positive", "negative", "neutral"],
+        model="qwen-3-0.6b",
+    )
+    got = list(out["classification"])
+    rows = [
+        {"review": r, "expected": want, "got": g, "ok": g == want}
+        for (r, want), g in zip(ROWS, got)
+    ]
+    rec = {
+        "model": "qwen-3-0.6b",
+        "backend": jax.default_backend(),
+        "checkpoint": str(ckpt),
+        "rows": rows,
+        "all_correct": all(r["ok"] for r in rows),
+    }
+    (REPO / "GOLDEN.json").write_text(json.dumps(rec, indent=2) + "\n")
+    print(json.dumps(rec))
+    return 0 if rec["all_correct"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
