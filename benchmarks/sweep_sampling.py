@@ -1,0 +1,86 @@
+"""Sampling microbench: isolate sample() + cumulative_logprob cost.
+
+PERF.md attributes ~2 ms of the 12.2 ms decode step (B=64) to sampling
+over the 151936-wide vocab — the logsumexp/scan passes, not the matmul.
+This sweep times the standalone jitted sampling path over random logits
+so chip time can A/B the levers quickly:
+
+  - dtype: float32 vs bfloat16 logits (SUTRO_LOGITS_BF16 candidate —
+    halves the HBM bytes of every full-vocab pass)
+  - batch: 64 / 128 / 256 (does sampling amortize with the wider
+    batches PERF.md targets?)
+  - mode: top-p sampling (approx head), greedy, and the
+    sample+logprob pair the decode program actually runs
+
+Prints one JSON line per (dtype, B, mode) with ms/call. Run on chip;
+on CPU it smokes the code path at tiny sizes.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from sutro_tpu.ops.sampling import cumulative_logprob, sample
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    V = 151936 if on_tpu else 1024
+    batches = (64, 128, 256) if on_tpu else (4,)
+    iters = 50 if on_tpu else 3
+
+    key = jax.random.PRNGKey(0)
+
+    def pair(logits, k, temp, top_p):
+        tok = sample(logits, k, temperature=temp, top_p=top_p)
+        return tok, cumulative_logprob(logits, tok)
+
+    pair_jit = jax.jit(pair)
+    sample_jit = jax.jit(
+        lambda lg, k, t, p: sample(lg, k, temperature=t, top_p=p)
+    )
+    greedy_jit = jax.jit(
+        lambda lg, k, t, p: sample(lg, k, temperature=t, top_p=p)
+    )
+
+    for dtype in (jnp.float32, jnp.bfloat16):
+        for B in batches:
+            logits = jax.random.normal(key, (B, V), dtype) * 4.0
+            logits = jax.block_until_ready(logits)
+            temp = jnp.full((B,), 0.7, jnp.float32)
+            temp0 = jnp.zeros((B,), jnp.float32)
+            top_p = jnp.full((B,), 0.95, jnp.float32)
+            for mode, fn, t in (
+                ("sample+logprob", pair_jit, temp),
+                ("sample", sample_jit, temp),
+                ("greedy", greedy_jit, temp0),
+            ):
+                out = fn(logits, key, t, top_p)  # compile
+                jax.block_until_ready(out)
+                t0 = time.monotonic()
+                for i in range(iters):
+                    out = fn(logits, jax.random.fold_in(key, i), t, top_p)
+                jax.block_until_ready(out)
+                ms = (time.monotonic() - t0) / iters * 1e3
+                print(
+                    json.dumps(
+                        {
+                            "dtype": jnp.dtype(dtype).name,
+                            "B": B,
+                            "V": V,
+                            "mode": mode,
+                            "ms_per_call": round(ms, 3),
+                        }
+                    ),
+                    flush=True,
+                )
+
+
+if __name__ == "__main__":
+    main()

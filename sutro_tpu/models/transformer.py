@@ -25,6 +25,8 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional, Tuple
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -358,7 +360,17 @@ def head_apply(
         lm_head = params["embed"].T
     else:
         lm_head = materialize(lm_head, h.dtype)
-    return (h @ lm_head.astype(h.dtype)).astype(jnp.float32), h
+    logits = h @ lm_head.astype(h.dtype)
+    # SUTRO_LOGITS_BF16=1 keeps the [*, V] logits in the activation
+    # dtype: sampling's full-vocab passes (ops/sampling.py) then read
+    # half the HBM bytes. Default OFF — bf16 argmax can flip near-ties
+    # vs the f32 head, so the exact-greedy-parity contract
+    # (tests/test_golden.py vs transformers) keeps f32 unless a chip
+    # A/B (benchmarks/sweep_sampling.py) justifies flipping it for
+    # throughput jobs.
+    if os.environ.get("SUTRO_LOGITS_BF16", "0") == "1":
+        return logits, h
+    return logits.astype(jnp.float32), h
 
 
 # ---------------------------------------------------------------------------

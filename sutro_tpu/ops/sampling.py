@@ -39,9 +39,14 @@ def apply_penalties(
     and frequency a count-proportional bias from GENERATED tokens only
     (both derived on-device from the sparse [B, K] id/count list —
     outputs rarely exceed K distinct ids; overflow ids keep the
-    repetition penalty via ``seen_rep`` but lose presence/frequency)."""
+    repetition penalty via ``seen_rep`` but lose presence/frequency).
+
+    Dtype-preserving: every [B, V] expression stays in ``logits.dtype``
+    (the count scatter accumulates in f32, then the bias casts back),
+    so bf16 logits keep their bandwidth saving through this path."""
     B, V = logits.shape
-    rep = repetition[:, None]
+    dt = logits.dtype
+    rep = repetition[:, None].astype(dt)
     rep_l = jnp.where(
         logits > 0, logits / rep, logits * rep
     )
@@ -50,12 +55,12 @@ def apply_penalties(
     counts = jnp.zeros((B, V), jnp.float32).at[
         jnp.arange(B)[:, None], ids
     ].add(jnp.where(pen_ids >= 0, pen_cnt, 0.0))
-    logits = logits - presence[:, None] * (counts > 0)
-    return logits - frequency[:, None] * counts
+    logits = logits - (presence[:, None] * (counts > 0)).astype(dt)
+    return logits - (frequency[:, None] * counts).astype(dt)
 
 
 def sample(
-    logits: jax.Array,                  # [B, V] float32
+    logits: jax.Array,                  # [B, V] float32 OR bfloat16
     key: jax.Array,
     *,
     temperature: jax.Array,             # scalar or [B]
@@ -69,16 +74,29 @@ def sample(
     ``row_seeds`` implements the reference's ``random_seed_per_input``
     (sdk.py payload): each row samples with a key folded from its own seed
     (gumbel-max, equivalent to categorical), so a row's output stream is
-    reproducible independent of batch composition."""
+    reproducible independent of batch composition.
+
+    bfloat16 logits are supported (SUTRO_LOGITS_BF16 keeps the LM-head
+    output in bf16, halving the HBM traffic of the full-vocab passes
+    here): the wide [B, V] scans (top-k head, greedy argmax, logsumexp
+    input) stay in the input dtype while every accumulation and the
+    small [B, K] head math upcast to float32 — the converts fuse into
+    the reduction loops. Two deliberate exceptions pay a full f32 pass
+    for unbiased gumbel noise: the unfiltered full-vocab categorical
+    (rare: top_k=0 AND top_p>=1) and the row-seeded full-vocab draw —
+    bf16 gumbel over 150k near-ties would resolve quantized ties toward
+    low token ids."""
     B, V = logits.shape
     if allowed is not None:
-        logits = jnp.where(allowed, logits, NEG_INF)
+        logits = jnp.where(allowed, logits, jnp.asarray(NEG_INF, logits.dtype))
 
     temperature = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (B,))
     top_p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (B,))
     top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (B,))
 
-    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None].astype(
+        logits.dtype
+    )
 
     # A full [B, V] argsort is pathologically slow on TPU (sorting networks
     # over 150k lanes). Filtered rows instead use the top NUCLEUS_CAP
@@ -116,7 +134,12 @@ def sample(
         )
     greedy_tok = jnp.argmax(scaled, axis=-1).astype(jnp.int32)
 
-    lse = jax.scipy.special.logsumexp(scaled, axis=-1, keepdims=True)
+    # f32 accumulation regardless of input dtype (a bf16 accumulator
+    # over 150k terms drifts); the convert fuses into the reduction
+    lse = jax.scipy.special.logsumexp(
+        scaled.astype(jnp.float32), axis=-1, keepdims=True
+    )
+    top_vals = top_vals.astype(jnp.float32)           # [B, K] — tiny
     probs = jnp.exp(top_vals - lse)                   # exact probabilities
 
     ranks = jnp.arange(K, dtype=jnp.int32)[None, :]
@@ -150,8 +173,15 @@ def sample(
         full_tok = jax.lax.cond(
             jnp.all(filtered | (temperature <= 0.0)),
             lambda: jnp.zeros((B,), jnp.int32),
+            # f32 ALWAYS: categorical draws gumbel in the logits dtype,
+            # and bf16 gumbel over 150k near-ties quantizes into mass
+            # exact ties resolved toward low token ids (biased). This
+            # rare branch (filters disabled) pays the f32 pass for
+            # unbiasedness.
             lambda: jax.random.categorical(
-                jax.random.fold_in(key, 1), scaled, axis=-1
+                jax.random.fold_in(key, 1),
+                scaled.astype(jnp.float32),
+                axis=-1,
             ).astype(jnp.int32),
         )
     head_tok = jnp.take_along_axis(top_idx, choice[:, None], axis=1)[:, 0]
@@ -165,5 +195,9 @@ def cumulative_logprob(
     """Per-step logprob of the chosen token (for ``include_cumulative_logprobs``,
     reference sdk.py:1138-1151). Gather-then-logsumexp so the full [B, V]
     log_softmax is never materialized."""
-    chosen = jnp.take_along_axis(logits, token[:, None], axis=-1)[:, 0]
-    return chosen - jax.scipy.special.logsumexp(logits, axis=-1)
+    chosen = jnp.take_along_axis(logits, token[:, None], axis=-1)[
+        :, 0
+    ].astype(jnp.float32)
+    return chosen - jax.scipy.special.logsumexp(
+        logits.astype(jnp.float32), axis=-1
+    )
