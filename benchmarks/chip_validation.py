@@ -8,11 +8,17 @@ Cases (in order):
   2. bench B=64  (baseline, then SUTRO_KV_XROW=1)
   3. bench B=128 (both xrow settings)
   4. bench B=256
-  5. MULTI sweep {8, 16} at the best batch so far
+  5. MULTI sweep {8} at the best batch so far
+  6. sampling sweep (sweep_sampling.py: f32 vs bf16 x batch x mode)
+  7. bench at the best batch with SUTRO_LOGITS_BF16=1 (A/B the gated
+     bf16 sampling path end-to-end)
+  8. bench_8b.py (qwen3-4b bf16/int8 + llama-3.1-8b int8, HBM
+     roofline fractions -> BENCH_8B.json)
 
 Writes CHIP_VALIDATION.json (list of case records incl. stdout tails)
 and prints one line per case. A dead tunnel shows up as rc=124
-timeouts on every case — rerun when the chip is back.
+timeouts on every case — rerun when the chip is back. After this,
+run bench_e2e.py at scale + cost_northstar.py (round-3 chip queue).
 """
 
 from __future__ import annotations
@@ -55,10 +61,11 @@ def run_case(name: str, argv: list, env: dict, timeout: int = 1500):
             except json.JSONDecodeError:
                 pass
     RESULTS.append(rec)
+    val = rec.get("bench", {}).get("value")  # absent for nested records
     print(
         json.dumps(
             {k: rec[k] for k in ("case", "rc", "elapsed_s")}
-            | ({"value": rec["bench"]["value"]} if "bench" in rec else {})
+            | ({"value": val} if val is not None else {})
         ),
         flush=True,
     )
@@ -95,6 +102,20 @@ def main() -> None:
     run_case(
         f"bench_b{best_b}_multi8", [py, "bench.py"],
         {"SUTRO_BENCH_BATCH": best_b, "SUTRO_BENCH_MULTI": "8"},
+    )
+    run_case(
+        "sweep_sampling", [py, "benchmarks/sweep_sampling.py"], {},
+        timeout=2400,
+    )
+    run_case(
+        f"bench_b{best_b}_logits_bf16", [py, "bench.py"],
+        {"SUTRO_BENCH_BATCH": best_b, "SUTRO_LOGITS_BF16": "1"},
+    )
+    # budget exceeds bench_8b's own worst case (3 configs x 3600s inner
+    # timeouts + param probes) so its per-config timeout handling — not
+    # an outer SIGKILL that discards collected records — decides
+    run_case(
+        "bench_8b", [py, "benchmarks/bench_8b.py"], {}, timeout=12000
     )
     print(json.dumps({"chip_validation": "written"}), flush=True)
 

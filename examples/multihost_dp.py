@@ -1,0 +1,95 @@
+"""Engine-level multi-host data parallelism: one job, two pod slices.
+
+Demonstrates `engine/dphost.py` on a single machine by treating two OS
+processes as the two pod slices: both submit the SAME job to their own
+`LocalEngine`; rows are strided across ranks, the worker streams its
+finished rows to the rank-0 coordinator over TCP, and the coordinator's
+jobstore produces the single, input-ordered result set.
+
+On a real pod, a launcher starts one engine process per slice with:
+
+    SUTRO_DP_WORLD=<slices> SUTRO_DP_RANK=<r> \
+    SUTRO_DP_COORD=<rank0-host>:<port>  python your_job.py
+
+Run: python examples/multihost_dp.py --cpu
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+from _common import example_client
+
+N_ROWS = 12
+
+
+def child() -> None:
+    import time
+
+    so, model, _ = example_client(__doc__)
+    jid = so.infer(
+        [f"review {i}: works great" for i in range(N_ROWS)],
+        model=model,
+        system_prompt="Summarize in three words.",
+        sampling_params={"max_new_tokens": 8, "temperature": 0.0},
+        stay_attached=False,
+    )
+    rank = os.environ["SUTRO_DP_RANK"]
+    if rank == "0":
+        df = so.await_job_completion(jid, unpack_json=False)
+        assert df is not None and len(df) == N_ROWS
+        print(f"[rank 0] merged {len(df)} rows, input order preserved:")
+        print(df.head(4).to_string())
+    else:
+        # worker stores are non-authoritative (results live on rank 0):
+        # await the STATUS only, never fetch results here
+        deadline = time.monotonic() + 600
+        while time.monotonic() < deadline:
+            s = so.get_job_status(jid)
+            if s in ("SUCCEEDED", "FAILED", "CANCELLED"):
+                break
+            time.sleep(0.2)
+        if s != "SUCCEEDED":
+            raise SystemExit(f"[rank {rank}] shard did not complete: {s}")
+        print(f"[rank {rank}] shard streamed to coordinator (status {s})")
+
+
+def main() -> None:
+    if os.environ.get("SUTRO_DP_WORLD"):
+        child()
+        return
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update(
+            SUTRO_DP_WORLD="2",
+            SUTRO_DP_RANK=str(rank),
+            SUTRO_DP_COORD=f"127.0.0.1:{port}",
+            # each "slice" needs its own store; rank 0's is authoritative
+            SUTRO_HOME=tempfile.mkdtemp(prefix=f"sutro-dp-ex-r{rank}-"),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, __file__, *sys.argv[1:]], env=env
+            )
+        )
+    try:
+        rcs = [p.wait(timeout=1200) for p in procs]
+    finally:
+        for p in procs:  # never orphan a rank holding the chip
+            if p.poll() is None:
+                p.kill()
+    if any(rcs):
+        raise SystemExit(f"ranks exited {rcs}")
+    print(json.dumps({"dp_example": "ok", "world": 2, "rows": N_ROWS}))
+
+
+if __name__ == "__main__":
+    main()
