@@ -732,11 +732,7 @@ class LocalEngine:
                 # preemption is per-slice-local and disabled for DP jobs
                 # — yielding one slice of a pod-spanning job would
                 # stall, not free, the pod.
-                from .dphost import (
-                    run_dp_coordinator,
-                    run_dp_worker,
-                    shard_requests,
-                )
+                from .dphost import shard_requests
 
                 import hashlib
                 import json as _json
@@ -765,61 +761,17 @@ class LocalEngine:
                     h.update(rb)
                 job_key = h.hexdigest()[:16]
                 shard = shard_requests(requests, dp.rank, dp.world)
-                if dp.rank == 0:
-                    if len(results) >= rec.num_rows:
-                        # every row is already merged (a resume of a
-                        # fully-succeeded job, e.g. user-issued on the
-                        # coordinator alone): re-finalize WITHOUT a
-                        # coordinator round — binding the port and
-                        # waiting _ACCEPT_TIMEOUT_S for workers nobody
-                        # resumed would flip a SUCCEEDED job to FAILED
-                        outcome = "completed"
-                    else:
-                        outcome = run_dp_coordinator(
-                            dp, batcher.run, shard,
-                            on_result=on_result,
-                            on_progress=on_progress,
-                            should_cancel=should_cancel,
-                            job_key=job_key,
-                            # the coordinator's partial store holds
-                            # every rank's flushed rows — ship the done
-                            # set so relaunched workers resume
-                            # row-granularly
-                            done_rows=set(results),
-                        )
-                else:
-                    try:
-                        w_outcome = run_dp_worker(
-                            dp, batcher.run, shard,
-                            job_key=job_key,
-                            should_cancel=should_cancel,
-                        )
-                    except RuntimeError as e:
-                        if "never served" not in str(e):
-                            raise
-                        # the coordinator never served this job — most
-                        # likely a resume of an already-complete pod
-                        # job where rank 0 (correctly) skipped its
-                        # round. CANCELLED, not FAILED: the shard ran
-                        # nothing, the record is non-authoritative, and
-                        # CANCELLED stays resumable if the pod really
-                        # does need this rank later.
-                        self.jobs.set_status(
-                            job_id,
-                            JobStatus.CANCELLED,
-                            failure_reason={"message": str(e)},
-                        )
-                        return None
-                    # worker stores are not authoritative: results live
-                    # on rank 0; mark the local record terminal without
-                    # finalizing rows — honestly (a cancelled shard,
-                    # e.g. coordinator death, is not a success)
-                    self.jobs.set_status(
-                        job_id,
-                        JobStatus.SUCCEEDED
-                        if w_outcome == "completed"
-                        else JobStatus.CANCELLED,
-                    )
+                outcome = self._dp_dispatch(
+                    dp, batcher.run, shard,
+                    job_id=job_id, job_key=job_key,
+                    on_result=on_result, on_progress=on_progress,
+                    should_cancel=should_cancel,
+                    # the coordinator's partial store holds every
+                    # rank's flushed rows — the done set lets
+                    # relaunched workers resume row-granularly
+                    done_rows=set(results), num_rows=rec.num_rows,
+                )
+                if outcome is None:  # worker rank: terminal status set
                     return None
             else:
                 outcome = batcher.run(
@@ -885,6 +837,62 @@ class LocalEngine:
         jm.progress(rec.num_rows)
         self.jobs.finalize_results(job_id, ordered)
 
+    def _dp_dispatch(
+        self, dp, run_shard, shard, *, job_id, job_key, on_result,
+        on_progress, should_cancel, done_rows, num_rows,
+    ) -> Optional[str]:
+        """Execute one rank's share of a DP job. Returns the outcome on
+        rank 0 (coordinator: merges every rank through ``on_result``),
+        or None on worker ranks after setting their terminal status —
+        single policy copy for the generation AND embedding paths
+        (never-served sentinel, CANCELLED-not-FAILED worker mapping,
+        full-resume round skip)."""
+        from .dphost import run_dp_coordinator, run_dp_worker
+
+        if dp.rank == 0:
+            if len(done_rows) >= num_rows:
+                # resume of a fully-merged job: re-finalize without a
+                # round — binding the port and waiting for workers
+                # nobody resumed would flip SUCCEEDED to FAILED
+                return "completed"
+            return run_dp_coordinator(
+                dp, run_shard, shard,
+                on_result=on_result,
+                on_progress=on_progress,
+                should_cancel=should_cancel,
+                job_key=job_key,
+                done_rows=done_rows,
+            )
+        try:
+            w_outcome = run_dp_worker(
+                dp, run_shard, shard,
+                job_key=job_key,
+                should_cancel=should_cancel,
+            )
+        except RuntimeError as e:
+            if "never served" not in str(e):
+                raise
+            # most likely a resume of an already-complete pod job where
+            # rank 0 (correctly) skipped its round. CANCELLED, not
+            # FAILED: nothing ran, the record is non-authoritative, and
+            # CANCELLED stays resumable.
+            self.jobs.set_status(
+                job_id,
+                JobStatus.CANCELLED,
+                failure_reason={"message": str(e)},
+            )
+            return None
+        # worker stores are not authoritative: results live on rank 0;
+        # mark the local record terminal honestly (a cancelled shard,
+        # e.g. coordinator death, is not a success)
+        self.jobs.set_status(
+            job_id,
+            JobStatus.SUCCEEDED
+            if w_outcome == "completed"
+            else JobStatus.CANCELLED,
+        )
+        return None
+
     def _run_embedding_job(
         self, job_id, rec, runner, tok, token_rows, jm
     ) -> Optional[int]:
@@ -920,28 +928,126 @@ class LocalEngine:
         # unaffected, reference 1:1 contract intact)
         todo.sort(key=lambda i: len(token_rows[i]))
         jm.progress(len(results))
-        for off in range(0, len(todo), bs):
-            if job_id in self._cancel:
-                flush()
-                self.jobs.set_status(job_id, JobStatus.CANCELLED)
-                return None
-            if self._higher_priority_waiting(rec.job_priority):
-                flush()
-                return rec.job_priority
-            idxs = todo[off : off + bs]
-            emb = runner.embed_batch(
-                [list(map(int, token_rows[i])) for i in idxs]
+
+        import jax
+
+        from .dphost import DPWorld, EmbResult
+
+        dp = DPWorld.from_env()
+        n_chips = max(jax.device_count(), 1) * (dp.world if dp else 1)
+        last_reported = {"n": len(results)}
+
+        def record_result(r: "EmbResult") -> None:
+            results[r.row_id] = r.vector
+            pending_flush.append(
+                {"row_id": r.row_id, "outputs": r.vector,
+                 "cumulative_logprobs": 0.0, "finish_reason": "stop"}
             )
-            for i, vec in zip(idxs, emb.tolist()):
-                results[i] = vec
-                pending_flush.append(
-                    {"row_id": i, "outputs": vec,
-                     "cumulative_logprobs": 0.0, "finish_reason": "stop"}
-                )
             if len(pending_flush) >= _PARTIAL_FLUSH_EVERY:
                 flush()
-            jm.progress(len(results))
+            # batch the progress bus (a 1M-row job would otherwise put
+            # one update per row on every subscriber queue)
+            if len(results) - last_reported["n"] >= bs:
+                last_reported["n"] = len(results)
+                jm.progress(len(results))
+
+        def embed_progress(p: Dict[str, Any]) -> None:
+            jm.tokens(
+                {
+                    "input_tokens": p.get("input_tokens", 0),
+                    "output_tokens": 0,
+                    "total_tokens_processed_per_second": p.get(
+                        "total_tokens_processed_per_second", 0.0
+                    ),
+                    "tokens_per_second_per_chip": p.get(
+                        "total_tokens_processed_per_second", 0.0
+                    )
+                    / n_chips,
+                }
+            )
+
+        def embed_rows(
+            pairs, *, on_result, on_progress=None, should_cancel=None,
+            should_yield=None,
+        ) -> str:
+            """Embed ``pairs`` [(row_id, ids), ...] batch-wise. The one
+            execution path for single-host, DP-coordinator-local, and
+            DP-worker shards (dphost run_shard signature)."""
+            done_n = 0
+            in_toks = 0
+            import time as _time
+
+            t0 = _time.monotonic()
+            for off in range(0, len(pairs), bs):
+                if should_cancel and should_cancel():
+                    return "cancelled"
+                if should_yield and should_yield():
+                    return "yielded"
+                grp = pairs[off : off + bs]
+                emb = runner.embed_batch(
+                    [list(map(int, ids)) for _, ids in grp]
+                )
+                for (i, ids), vec in zip(grp, emb.tolist()):
+                    on_result(EmbResult(row_id=i, vector=vec))
+                    done_n += 1
+                    in_toks += len(ids)
+                if on_progress:
+                    dt = max(_time.monotonic() - t0, 1e-9)
+                    on_progress(
+                        {
+                            "rows_completed": done_n,
+                            "input_tokens": in_toks,
+                            "output_tokens": 0,
+                            "total_tokens_processed_per_second":
+                                in_toks / dt,
+                        }
+                    )
+            return "completed"
+
+        if dp is not None:
+            import hashlib
+
+            # cross-rank identity from the tokenized rows (identical on
+            # every rank: same inputs, same tokenizer)
+            h = hashlib.sha256(f"embed:{rec.model}:{rec.num_rows}".encode())
+            for r in token_rows:
+                rb = np.asarray(r, np.int32).tobytes()
+                h.update(f"{len(rb)}:".encode())
+                h.update(rb)
+            shard = [
+                (i, token_rows[i])
+                for i in todo
+                if i % dp.world == dp.rank
+            ]
+            outcome = self._dp_dispatch(
+                dp, embed_rows, shard,
+                job_id=job_id, job_key=h.hexdigest()[:16],
+                on_result=record_result,
+                on_progress=embed_progress,
+                should_cancel=lambda: job_id in self._cancel,
+                done_rows=set(results), num_rows=rec.num_rows,
+            )
+            if outcome is None:  # worker rank: terminal status set
+                return None
+        else:
+            outcome = embed_rows(
+                [(i, token_rows[i]) for i in todo],
+                on_result=record_result,
+                on_progress=embed_progress,
+                should_cancel=lambda: job_id in self._cancel,
+                should_yield=lambda: self._higher_priority_waiting(
+                    rec.job_priority
+                ),
+            )
+        if outcome == "cancelled":
+            flush()
+            self.jobs.set_status(job_id, JobStatus.CANCELLED)
+            return None
+        if outcome == "yielded":
+            flush()
+            return rec.job_priority
         flush()
+        jm.progress(len(results))  # batched reporting: emit the final count
         input_tokens = int(sum(len(r) for r in token_rows))
         self.jobs.update(
             job_id,

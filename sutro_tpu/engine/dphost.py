@@ -22,6 +22,7 @@ Protocol (newline-delimited JSON over one TCP connection per worker):
   coord  -> worker  {"t": "resume", "rows": [row_id, ...]}   (reply)
   worker -> coord   {"t": "res", "row_id", "token_ids", "logprob",
                      "finish", "in_toks"}
+  worker -> coord   {"t": "emb", "row_id", "vec"}   (embedding jobs)
   worker -> coord   {"t": "prog", <scheduler progress fields>}
   worker -> coord   {"t": "done", "outcome": "completed"}
   worker -> coord   {"t": "err", "msg": "..."}
@@ -73,6 +74,13 @@ class DPWorld:
         return cls(rank=rank, world=world, host=host, port=int(port))
 
 
+def _row_id(item) -> int:
+    """Shard items are GenRequests (generation) or (row_id, ids) tuples
+    (embedding)."""
+    rid = getattr(item, "row_id", None)
+    return int(item[0]) if rid is None else int(rid)
+
+
 def shard_requests(
     requests: List[GenRequest], rank: int, world: int
 ) -> List[GenRequest]:
@@ -100,7 +108,18 @@ def _recv_lines(sock: socket.socket):
                 yield json.loads(line)
 
 
-def _res_msg(res: GenResult) -> Dict:
+@dataclass(frozen=True)
+class EmbResult:
+    """One embedded row crossing the channel (embedding jobs DP the
+    same way as generation: strided shards, coordinator merge)."""
+
+    row_id: int
+    vector: List[float]
+
+
+def _res_msg(res) -> Dict:
+    if isinstance(res, EmbResult):
+        return {"t": "emb", "row_id": res.row_id, "vec": res.vector}
     return {
         "t": "res",
         "row_id": res.row_id,
@@ -189,7 +208,7 @@ def run_dp_worker(
             )
         time.sleep(0.5)
     already_done = set(first.get("rows", []))
-    shard = [q for q in shard if q.row_id not in already_done]
+    shard = [q for q in shard if _row_id(q) not in already_done]
 
     def read_control() -> None:
         try:
@@ -301,6 +320,14 @@ def run_dp_coordinator(
                 if t == "res":
                     with res_lock:
                         on_result(_msg_res(m))
+                elif t == "emb":
+                    with res_lock:
+                        on_result(
+                            EmbResult(
+                                row_id=int(m["row_id"]),
+                                vector=[float(x) for x in m["vec"]],
+                            )
+                        )
                 elif t == "prog":
                     with prog_lock:
                         prog[m["rank"]] = m

@@ -42,18 +42,44 @@ def main() -> None:
             "sampling_params": {"max_new_tokens": 6, "temperature": 0.0},
         }
     )
-    deadline = time.monotonic() + 300
-    while time.monotonic() < deadline:
-        s = eng.job_status(jid)
-        if s in ("SUCCEEDED", "FAILED", "CANCELLED"):
-            break
-        time.sleep(0.05)
-    assert eng.job_status(jid) == "SUCCEEDED", eng.job_status(jid)
+    def await_done(job_id: str) -> None:
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            s = eng.job_status(job_id)
+            if s in ("SUCCEEDED", "FAILED", "CANCELLED"):
+                break
+            time.sleep(0.05)
+        assert eng.job_status(job_id) == "SUCCEEDED", eng.job_status(
+            job_id
+        )
+
+    await_done(jid)
     if rank == 0:
         res = eng.job_results(jid)
         assert len(res["outputs"]) == N_ROWS
         assert all(o is not None for o in res["outputs"])
         print("RESULTS " + json.dumps(res["outputs"]), flush=True)
+
+    # embedding job through the same DP path (EmbResult channel)
+    ejid = eng.submit_batch_inference(
+        {
+            "model": "tiny-emb",
+            "inputs": [f"embed row {i}" for i in range(N_ROWS)],
+        }
+    )
+    await_done(ejid)
+    if rank == 0:
+        res = eng.job_results(ejid)
+        assert len(res["outputs"]) == N_ROWS
+        dims = {len(v) for v in res["outputs"]}
+        assert len(dims) == 1, dims
+        print(
+            "EMB "
+            + json.dumps(
+                [[float(x) for x in v[:4]] for v in res["outputs"]]
+            ),
+            flush=True,
+        )
     print(f"DP_OK rank={rank}", flush=True)
 
 

@@ -90,6 +90,21 @@ def test_dp_job_across_two_engines_matches_single_host(tmp_path):
     # never what they produce
     assert dp_outputs == ref_outputs
 
+    def emb_of(out: str):
+        for line in out.splitlines():
+            if line.startswith("EMB "):
+                return json.loads(line[len("EMB "):])
+        raise AssertionError(f"no EMB line:\n{out}")
+
+    import numpy as np
+
+    dp_emb = np.array(emb_of(outs["rank0"]))
+    ref_emb = np.array(emb_of(outs["single"]))
+    assert dp_emb.shape == ref_emb.shape == (24, 4)
+    # per-row embeddings are batch-composition independent (masked
+    # attention; pooled head) — DP grouping must not change values
+    np.testing.assert_allclose(dp_emb, ref_emb, rtol=1e-4, atol=1e-5)
+
 
 # ---------------------------------------------------------------------------
 # channel-level tests (stub shards, no engines — fast)
