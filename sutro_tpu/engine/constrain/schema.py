@@ -18,8 +18,11 @@ remainder-tracking product automaton), number (exact minimum/maximum
 incl. STRICT real bounds via a decimal interval automaton — bounded
 numbers emit in plain positional form, no exponent), boolean, null,
 array (items, minItems/maxItems small; ``uniqueItems`` enforced for
-enum pools of <=5 distinct values), anyOf/oneOf, $ref/$defs (one level
-of indirection, as produced by Pydantic), multi-element ``allOf``
+enum pools of <=5 distinct values), anyOf/oneOf, $ref/$defs (incl.
+RECURSIVE models: unrolled to MAX_REF_DEPTH, then recursion-reaching
+branches are pruned subset-safely — Optional arms keep null, arrays
+close to []; structurally-required recursion hard-fails with a clear
+message instead of a RecursionError), multi-element ``allOf``
 (intersection-merged over the supported feature set; inexpressible
 intersections hard-fail rather than silently widen), and
 ``additionalProperties`` (declared-property objects never emit extras,
@@ -114,12 +117,20 @@ def _dec_digits(value) -> Tuple[str, str]:
 
 
 class SchemaCompiler:
+    # recursive $refs (self-referential Pydantic models) unroll to this
+    # depth, then recursion-reaching branches are PRUNED (subset-safe:
+    # Optional[Node] keeps its null arm, List[Node] closes to []);
+    # required unprunable recursion hard-fails with a clear message
+    # instead of a RecursionError
+    MAX_REF_DEPTH = 3
+
     def __init__(self, schema: Dict[str, Any]):
         self.b = Builder()
         self.defs: Dict[str, Any] = {}
         for key in ("$defs", "definitions"):
             self.defs.update(schema.get(key, {}))
         self.schema = schema
+        self._ref_stack: List[str] = []
 
     # -- JSON primitives -------------------------------------------------
     def _string_char(self) -> Frag:
@@ -1153,7 +1164,211 @@ class SchemaCompiler:
             f"({cur!r} and {v!r})"
         )
 
+    def _reaches_ref(self, schema: Any, target: str) -> bool:
+        """True when ``target``'s $ref is reachable anywhere under
+        ``schema`` WITHOUT passing through defs (the walk follows only
+        inline structure; refs to other defs are expanded once each —
+        cycles through intermediate defs count as reaching)."""
+
+        def walk(node: Any, seen: frozenset) -> bool:
+            if isinstance(node, dict):
+                r = node.get("$ref")
+                if isinstance(r, str):
+                    name = r.split("/")[-1]
+                    if name == target:
+                        return True
+                    if name in seen or name not in self.defs:
+                        return False
+                    return walk(self.defs[name], seen | {name})
+                return any(walk(v, seen) for v in node.values())
+            if isinstance(node, list):
+                return any(walk(v, seen) for v in node)
+            return False
+
+        return walk(schema, frozenset())
+
+    def _prune_recursion(
+        self, schema: Any, target: str, expanding: frozenset = frozenset()
+    ) -> Any:
+        """Copy of ``schema`` with every branch that reaches ``target``
+        removed (narrowing, never widening): optional properties drop,
+        arrays close to maxItems 0 (when minItems allows), anyOf/oneOf
+        keep their non-recursive arms. Intermediate defs on the way to
+        ``target`` are expanded inline (``expanding`` breaks def
+        cycles). Raises ValueError when recursion is structurally
+        required."""
+        if not isinstance(schema, dict):
+            return schema
+        if not self._reaches_ref(schema, target):
+            return schema
+        s = dict(schema)
+        r = s.get("$ref")
+        if isinstance(r, str):
+            name = r.split("/")[-1]
+            if name == target:
+                raise ValueError(
+                    f"recursive schema: $ref {target!r} is required at "
+                    f"depth {self.MAX_REF_DEPTH} with no finite "
+                    "alternative"
+                )
+            if name in expanding:
+                raise ValueError(
+                    f"recursive schema: def cycle through {name!r} "
+                    f"reaches {target!r} at the depth limit"
+                )
+            if name in self.defs:
+                # expand the intermediate def inline and prune the
+                # copy — a cycle back to target must terminate HERE,
+                # not spin through another round of compile_node
+                rest = {k: v for k, v in s.items() if k != "$ref"}
+                expanded = self._prune_recursion(
+                    self.defs[name], target, expanding | {name}
+                )
+                if rest:
+                    rest = self._prune_recursion(
+                        rest, target, expanding
+                    )
+                    return {"allOf": [expanded, rest]}
+                return expanded
+        for comb in ("anyOf", "oneOf"):
+            if comb in s:
+                kept = []
+                for br in s[comb]:
+                    try:
+                        kept.append(self._prune_recursion(br, target, expanding))
+                    except ValueError:
+                        continue
+                if not kept:
+                    raise ValueError(
+                        f"recursive schema: every {comb} arm reaches "
+                        f"{target!r} at the depth limit"
+                    )
+                s[comb] = kept
+        if "allOf" in s:
+            s["allOf"] = [
+                self._prune_recursion(br, target, expanding)
+                for br in s["allOf"]
+            ]
+        if isinstance(s.get("items"), dict) and self._reaches_ref(
+            s["items"], target
+        ):
+            try:
+                s["items"] = self._prune_recursion(
+                    s["items"], target, expanding
+                )
+            except ValueError:
+                if int(s.get("minItems", 0)) > 0:
+                    raise ValueError(
+                        f"recursive schema: array of {target!r} requires "
+                        "items at the depth limit"
+                    )
+                # close the array: [] stays valid; drop the item schema
+                # (never emitted at length 0) so the final
+                # reaches-check below doesn't see a ghost reference
+                s["maxItems"] = 0
+                s["items"] = {}
+        if "properties" in s:
+            props = dict(s["properties"])
+            required = set(s.get("required", list(props)))
+            for name in list(props):
+                if not self._reaches_ref(props[name], target):
+                    continue
+                try:
+                    props[name] = self._prune_recursion(
+                        props[name], target, expanding
+                    )
+                except ValueError:
+                    if name in required:
+                        raise ValueError(
+                            f"recursive schema: required property "
+                            f"{name!r} reaches {target!r} at the depth "
+                            "limit with no finite alternative"
+                        )
+                    del props[name]
+            s["properties"] = props
+            s["required"] = [n for n in required if n in props]
+        addl = s.get("additionalProperties")
+        if isinstance(addl, dict) and self._reaches_ref(addl, target):
+            try:
+                s["additionalProperties"] = self._prune_recursion(
+                    addl, target, expanding
+                )
+            except ValueError:
+                if int(s.get("minProperties", 0)) > 0:
+                    raise ValueError(
+                        f"recursive schema: map values reach {target!r} "
+                        "at the depth limit but minProperties > 0"
+                    )
+                # close the map: {} stays valid
+                s["additionalProperties"] = False
+        # termination guarantee: whatever keyword carried the recursion,
+        # a "pruned" schema that still reaches the target would send
+        # compile_node into the same loop this function exists to break
+        if self._reaches_ref(s, target):
+            raise ValueError(
+                f"recursive schema: cannot finitely unroll the "
+                f"reference to {target!r} (unsupported keyword carries "
+                "the recursion)"
+            )
+        return s
+
+    def _entering_refs(self, schema: Any) -> List[str]:
+        """Def names ``_resolve``/``_merge_allof`` will expand INLINE at
+        this node: a top-level $ref, and $refs anywhere in a top-level
+        allOf chain (the Pydantic field-metadata wrapper shape). These
+        are what the depth counter must track — deeper refs reach their
+        own compile_node call."""
+        names: List[str] = []
+        if not isinstance(schema, dict):
+            return names
+        r = schema.get("$ref")
+        if isinstance(r, str):
+            name = r.split("/")[-1]
+            if name in self.defs:
+                names.append(name)
+        for br in schema.get("allOf", []) or []:
+            names.extend(self._entering_refs(br))
+        return names
+
+    def _cap_refs(self, schema: Any, targets: set) -> Any:
+        """Replace top-level/allOf-chain $refs to ``targets`` with their
+        pruned (recursion-free) definitions."""
+        if not isinstance(schema, dict):
+            return schema
+        r = schema.get("$ref")
+        if isinstance(r, str) and r.split("/")[-1] in targets:
+            name = r.split("/")[-1]
+            pruned = self._prune_recursion(self.defs[name], name)
+            rest = {k: v for k, v in schema.items() if k != "$ref"}
+            if rest:
+                rest = self._prune_recursion(rest, name)
+                return {"allOf": [pruned, rest]}
+            return pruned
+        if "allOf" in schema:
+            schema = dict(schema)
+            schema["allOf"] = [
+                self._cap_refs(br, targets) for br in schema["allOf"]
+            ]
+        return schema
+
     def compile_node(self, schema: Dict[str, Any]) -> Frag:
+        # bounded unrolling for recursive $refs: track every def this
+        # node expands inline; at the cap, compile the pruned
+        # (recursion-free) variant instead of recursing forever
+        names = self._entering_refs(schema)
+        over = {n for n in names if
+                self._ref_stack.count(n) >= self.MAX_REF_DEPTH}
+        if over:
+            schema = self._cap_refs(schema, over)
+            names = [n for n in names if n not in over]
+        self._ref_stack.extend(names)
+        try:
+            return self._compile_node_inner(schema)
+        finally:
+            if names:
+                del self._ref_stack[-len(names):]
+
+    def _compile_node_inner(self, schema: Dict[str, Any]) -> Frag:
         b = self.b
         schema = self._resolve(schema)
 

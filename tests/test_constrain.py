@@ -1302,3 +1302,134 @@ def test_freeform_map_honors_required_keys():
             {"type": "object", "additionalProperties": {},
              "required": ["a", "b"], "maxProperties": 1}
         )
+
+
+# ---------------------------------------------------------------------------
+# recursive schemas (self-referential Pydantic models)
+# ---------------------------------------------------------------------------
+
+
+def test_recursive_model_bounded_unrolling():
+    """List['Node'] recursion compiles (no RecursionError): nesting
+    accepted to MAX_REF_DEPTH, the cutoff closes child arrays to []."""
+    from typing import List as TList
+
+    class Node(BaseModel):
+        name: str
+        children: TList["Node"] = []
+
+    nfa = compile_schema(normalize_output_schema(Node))
+    assert accepts(nfa, '{"name":"a","children":[]}')
+    assert accepts(
+        nfa, '{"name":"a","children":[{"name":"b","children":[]}]}'
+    )
+    deep = '{"name":"a","children":[]}'
+    for nm in ("b", "c", "d"):
+        deep = (
+            '{"name":"%s","children":[%s]}' % (nm, deep)
+        )
+    assert accepts(nfa, deep)  # depth == MAX_REF_DEPTH unrolls
+
+
+def test_recursive_optional_keeps_null_arm():
+    from typing import Optional as TOpt
+
+    class Cell(BaseModel):
+        v: int
+        nxt: TOpt["Cell"] = None
+
+    nfa = compile_schema(normalize_output_schema(Cell))
+    assert accepts(nfa, '{"v":1}')
+    assert accepts(nfa, '{"v":1,"nxt":{"v":2,"nxt":null}}')
+    assert accepts(nfa, '{"v":1,"nxt":{"v":2,"nxt":{"v":3}}}')
+
+
+def test_required_unbounded_recursion_hard_fails():
+    """A required self-reference with no finite alternative cannot be
+    finitely unrolled — clear ValueError, never a RecursionError."""
+    with pytest.raises(ValueError, match="recursive"):
+        compile_schema(
+            {
+                "$defs": {
+                    "A": {
+                        "type": "object",
+                        "properties": {"next": {"$ref": "#/$defs/A"}},
+                        "required": ["next"],
+                    }
+                },
+                "$ref": "#/$defs/A",
+            }
+        )
+
+
+def test_mutual_recursion_compiles_or_fails_cleanly():
+    """A <-> B mutual recursion through an optional arm terminates."""
+    schema = {
+        "$defs": {
+            "A": {
+                "type": "object",
+                "properties": {
+                    "name": {"type": "string"},
+                    "b": {"anyOf": [{"$ref": "#/$defs/B"},
+                                    {"type": "null"}]},
+                },
+                "required": ["name"],
+            },
+            "B": {
+                "type": "object",
+                "properties": {
+                    "a": {"anyOf": [{"$ref": "#/$defs/A"},
+                                    {"type": "null"}]},
+                },
+                "required": ["a"],
+            },
+        },
+        "$ref": "#/$defs/A",
+    }
+    nfa = compile_schema(schema)
+    assert accepts(nfa, '{"name":"x"}')
+    assert accepts(nfa, '{"name":"x","b":{"a":null}}')
+    assert accepts(nfa, '{"name":"x","b":{"a":{"name":"y"}}}')
+
+
+def test_recursive_ref_in_allof_wrapper():
+    """Pydantic's Field()-metadata shape wraps the recursive ref in a
+    single-element allOf — the depth counter must see through it."""
+    schema = {
+        "$defs": {
+            "A": {
+                "type": "object",
+                "properties": {
+                    "name": {"type": "string"},
+                    "child": {
+                        "anyOf": [
+                            {"allOf": [{"$ref": "#/$defs/A"}],
+                             "title": "Child"},
+                            {"type": "null"},
+                        ]
+                    },
+                },
+                "required": ["name"],
+            }
+        },
+        "$ref": "#/$defs/A",
+    }
+    nfa = compile_schema(schema)
+    assert accepts(nfa, '{"name":"x"}')
+    assert accepts(nfa, '{"name":"x","child":{"name":"y"}}')
+
+
+def test_recursive_freeform_map_values():
+    """Recursion through additionalProperties closes the map at the
+    depth limit instead of RecursionError."""
+    schema = {
+        "$defs": {
+            "A": {"type": "object",
+                  "additionalProperties": {"$ref": "#/$defs/A"}}
+        },
+        "$ref": "#/$defs/A",
+    }
+    nfa = compile_schema(schema)
+    assert accepts(nfa, "{}")
+    assert accepts(nfa, '{"k":{}}')
+    assert accepts(nfa, '{"k":{"j":{}}}')
