@@ -706,11 +706,20 @@ class SchemaCompiler:
 
     # -- schema nodes ------------------------------------------------------
     def _resolve(self, schema: Dict[str, Any]) -> Dict[str, Any]:
-        if "$ref" in schema:
+        # iterative ref-chain follow with a cycle guard: a def that IS
+        # a $ref back into the chain (alias cycle A -> B -> A) must be
+        # a clear error, not a RecursionError
+        seen: set = set()
+        while "$ref" in schema:
             name = schema["$ref"].split("/")[-1]
+            if name in seen:
+                raise ValueError(
+                    f"recursive $ref alias cycle through {name!r}"
+                )
+            seen.add(name)
             if name not in self.defs:
                 raise ValueError(f"Unresolvable $ref: {schema['$ref']}")
-            return self._resolve(self.defs[name])
+            schema = self.defs[name]
         if "allOf" in schema:
             merged = self._merge_allof(schema)
             return self._resolve(merged) if "$ref" in merged else merged
@@ -739,6 +748,26 @@ class SchemaCompiler:
         allOf(anyOf(A,B), C) == anyOf(allOf(A,C), allOf(B,C))."""
         from itertools import product as _product
 
+        # recursion guard: refs expanded inline here (and by _resolve)
+        # never pass through compile_node's MAX_REF_DEPTH counter, so a
+        # def cycle that lives entirely at allOf/anyOf level would
+        # otherwise recurse this method to a RecursionError. Real
+        # schemas nest allOf a handful deep; 32 is far above any
+        # legitimate structure.
+        self._merge_depth = getattr(self, "_merge_depth", 0) + 1
+        try:
+            if self._merge_depth > 32:
+                raise ValueError(
+                    "allOf: recursive $ref expansion exceeds the merge "
+                    "depth limit (def cycle through allOf/anyOf?)"
+                )
+            return self._merge_allof_impl(schema, _product)
+        finally:
+            self._merge_depth -= 1
+
+    def _merge_allof_impl(
+        self, schema: Dict[str, Any], _product
+    ) -> Dict[str, Any]:
         parts = [dict(self._resolve(s)) for s in schema["allOf"]]
         siblings = {k: v for k, v in schema.items() if k != "allOf"}
         if siblings:

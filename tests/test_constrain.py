@@ -1433,3 +1433,71 @@ def test_recursive_freeform_map_values():
     assert accepts(nfa, "{}")
     assert accepts(nfa, '{"k":{}}')
     assert accepts(nfa, '{"k":{"j":{}}}')
+
+
+def test_cpp_python_mask_parity_round3_features():
+    """Native masker parity over the round-3 schema features (allOf
+    merge, free-form map, recursion unrolling) — the NFA is the
+    interchange format, so every new compile feature must flow through
+    the C++ core bit-identically."""
+    pytest.importorskip("ctypes")
+    from sutro_tpu.engine.constrain.cpp import CppMasker
+
+    tok = ByteTokenizer()
+    schema = {
+        "$defs": {
+            "N": {
+                "type": "object",
+                "properties": {
+                    "v": {"allOf": [{"type": "integer", "minimum": 0},
+                                    {"maximum": 20}]},
+                    "kids": {"type": "array",
+                             "items": {"$ref": "#/$defs/N"}},
+                    "tags": {"type": "object",
+                             "additionalProperties": {"type": "boolean"},
+                             "maxProperties": 2},
+                },
+                "required": ["v"],
+            }
+        },
+        "$ref": "#/$defs/N",
+    }
+    nfa = compile_schema(schema)
+    table = TokenTable(tok)
+    try:
+        cpp = CppMasker(nfa, table)
+    except Exception:
+        pytest.skip("native toolchain unavailable")
+    py = MaskCache(nfa, table)
+    py._cpp = None
+    states = nfa.initial()
+    text = '{"v":7,"kids":[{"v":20,"tags":{"a":true}}],"tags":{}}'
+    for ch in text.encode():
+        pm, pd = py._compute(states)
+        cm, cd = cpp.mask(states)
+        np.testing.assert_array_equal(pm, cm)
+        np.testing.assert_array_equal(pd, cd)
+        states = nfa.step(states, ch)
+        assert states, chr(ch)
+    assert nfa.is_accepting(states)
+
+
+@pytest.mark.parametrize(
+    "schema",
+    [
+        # pure alias cycle: a def that IS a ref back to itself
+        {"$defs": {"A": {"$ref": "#/$defs/A"}}, "$ref": "#/$defs/A"},
+        # mutual alias cycle
+        {"$defs": {"A": {"$ref": "#/$defs/B"},
+                   "B": {"$ref": "#/$defs/A"}},
+         "$ref": "#/$defs/A"},
+        # cycle living entirely at allOf/anyOf level (bypasses
+        # compile_node's per-node ref counter)
+        {"$defs": {"U": {"anyOf": [{"allOf": [{"$ref": "#/$defs/U"}]},
+                                   {"type": "null"}]}},
+         "allOf": [{"$ref": "#/$defs/U"}]},
+    ],
+)
+def test_ref_cycles_clear_error_never_recursionerror(schema):
+    with pytest.raises(ValueError):
+        compile_schema(schema)

@@ -229,3 +229,58 @@ def test_channel_worker_failure_fails_job():
             on_result=lambda r: None,
         )
     t.join(timeout=30)
+
+
+def test_channel_cancel_propagates_to_worker():
+    """Coordinator-side cancellation reaches a still-running worker
+    shard through the channel, and both sides settle on 'cancelled'."""
+    import threading
+    import time
+
+    from sutro_tpu.engine.dphost import (
+        run_dp_coordinator,
+        run_dp_worker,
+        shard_requests,
+    )
+
+    port = _free_port()
+    cw, ww = _world(port)
+    reqs = _reqs(4)
+    cancel_at = {"t": None}
+    worker_outcome = {}
+
+    def coord_shard(shard, on_result, on_progress, should_cancel):
+        for q in shard:
+            on_result(_res(q.row_id))
+        cancel_at["t"] = time.monotonic()
+        return "completed"  # local shard done; cancel fires while waiting
+
+    def worker_shard(shard, on_result, on_progress, should_cancel):
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if should_cancel():
+                return "cancelled"
+            time.sleep(0.05)
+        return "completed"  # would time the test out
+
+    def worker_main():
+        worker_outcome["v"] = run_dp_worker(
+            ww, worker_shard, shard_requests(reqs, 1, 2)
+        )
+
+    t = threading.Thread(target=worker_main)
+    t.start()
+
+    def should_cancel():
+        # cancel as soon as the local shard has finished
+        return cancel_at["t"] is not None
+
+    outcome = run_dp_coordinator(
+        cw, coord_shard, shard_requests(reqs, 0, 2),
+        on_result=lambda r: None,
+        should_cancel=should_cancel,
+    )
+    t.join(timeout=60)
+    assert not t.is_alive()
+    assert outcome == "cancelled"
+    assert worker_outcome["v"] == "cancelled"
