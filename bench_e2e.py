@@ -214,6 +214,15 @@ def main() -> None:
                 },
                 "required": ["classification"],
             },
+            # greedy, like the classify template (templates/
+            # classification.py): labels want determinism AND greedy
+            # constrained rows take the speculative fused-window path —
+            # the engine-default 0.7 would silently bench the masked
+            # single-step path for the headline workload. The window
+            # path's win is amortized DISPATCH cost, so it shows on the
+            # chip (PERF.md RTT analysis), not necessarily in this CPU
+            # smoke where per-step dispatch is cheap.
+            sampling_params={"temperature": 0.0},
             stay_attached=False,
         )
         df = so.await_job_completion(jid, timeout=24 * 3600)

@@ -131,6 +131,7 @@ class SchemaCompiler:
             self.defs.update(schema.get(key, {}))
         self.schema = schema
         self._ref_stack: List[str] = []
+        self._merge_depth = 0
 
     # -- JSON primitives -------------------------------------------------
     def _string_char(self) -> Frag:
@@ -746,28 +747,26 @@ class SchemaCompiler:
         boolean exclusive bounds, ...) raise ``ValueError`` with a clear
         message instead. ``anyOf`` conjuncts distribute exactly:
         allOf(anyOf(A,B), C) == anyOf(allOf(A,C), allOf(B,C))."""
-        from itertools import product as _product
-
         # recursion guard: refs expanded inline here (and by _resolve)
         # never pass through compile_node's MAX_REF_DEPTH counter, so a
         # def cycle that lives entirely at allOf/anyOf level would
         # otherwise recurse this method to a RecursionError. Real
         # schemas nest allOf a handful deep; 32 is far above any
         # legitimate structure.
-        self._merge_depth = getattr(self, "_merge_depth", 0) + 1
+        self._merge_depth += 1
         try:
             if self._merge_depth > 32:
                 raise ValueError(
                     "allOf: recursive $ref expansion exceeds the merge "
                     "depth limit (def cycle through allOf/anyOf?)"
                 )
-            return self._merge_allof_impl(schema, _product)
+            return self._merge_allof_impl(schema)
         finally:
             self._merge_depth -= 1
 
-    def _merge_allof_impl(
-        self, schema: Dict[str, Any], _product
-    ) -> Dict[str, Any]:
+    def _merge_allof_impl(self, schema: Dict[str, Any]) -> Dict[str, Any]:
+        from itertools import product as _product
+
         parts = [dict(self._resolve(s)) for s in schema["allOf"]]
         siblings = {k: v for k, v in schema.items() if k != "allOf"}
         if siblings:

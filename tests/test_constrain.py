@@ -159,20 +159,13 @@ def test_mask_allows_stop_only_at_accept():
     assert fsm.allowed_tokens()[tok.eos_id]
 
 
-def test_cpp_python_mask_parity():
+def _assert_cpp_py_parity(schema, text: str, expect_accept=False):
+    """Walk ``text`` byte-wise asserting the C++ and Python maskers
+    agree at every state (shared harness for every parity case)."""
     pytest.importorskip("ctypes")
     from sutro_tpu.engine.constrain.cpp import CppMasker
 
     tok = ByteTokenizer()
-    schema = {
-        "type": "object",
-        "properties": {
-            "s": {"type": "string"},
-            "v": {"type": "number"},
-            "e": {"enum": ["aa", "ab", "b"]},
-        },
-        "required": ["s", "v", "e"],
-    }
     nfa = compile_schema(schema)
     table = TokenTable(tok)
     try:
@@ -182,13 +175,30 @@ def test_cpp_python_mask_parity():
     py = MaskCache(nfa, table)
     py._cpp = None
     states = nfa.initial()
-    for ch in '{"s":"x\\n","v":-1.5e2,"e":"ab"}'.encode():
+    for ch in text.encode():
         pm, pd = py._compute(states)
         cm, cd = cpp.mask(states)
         np.testing.assert_array_equal(pm, cm)
         np.testing.assert_array_equal(pd, cd)
         states = nfa.step(states, ch)
-        assert states
+        assert states, chr(ch)
+    if expect_accept:
+        assert nfa.is_accepting(states)
+
+
+def test_cpp_python_mask_parity():
+    _assert_cpp_py_parity(
+        {
+            "type": "object",
+            "properties": {
+                "s": {"type": "string"},
+                "v": {"type": "number"},
+                "e": {"enum": ["aa", "ab", "b"]},
+            },
+            "required": ["s", "v", "e"],
+        },
+        '{"s":"x\\n","v":-1.5e2,"e":"ab"}',
+    )
 
 
 def test_budget_aware_closure_always_completes():
@@ -1440,46 +1450,29 @@ def test_cpp_python_mask_parity_round3_features():
     merge, free-form map, recursion unrolling) — the NFA is the
     interchange format, so every new compile feature must flow through
     the C++ core bit-identically."""
-    pytest.importorskip("ctypes")
-    from sutro_tpu.engine.constrain.cpp import CppMasker
-
-    tok = ByteTokenizer()
-    schema = {
-        "$defs": {
-            "N": {
-                "type": "object",
-                "properties": {
-                    "v": {"allOf": [{"type": "integer", "minimum": 0},
-                                    {"maximum": 20}]},
-                    "kids": {"type": "array",
-                             "items": {"$ref": "#/$defs/N"}},
-                    "tags": {"type": "object",
-                             "additionalProperties": {"type": "boolean"},
-                             "maxProperties": 2},
-                },
-                "required": ["v"],
-            }
+    _assert_cpp_py_parity(
+        {
+            "$defs": {
+                "N": {
+                    "type": "object",
+                    "properties": {
+                        "v": {"allOf": [{"type": "integer", "minimum": 0},
+                                        {"maximum": 20}]},
+                        "kids": {"type": "array",
+                                 "items": {"$ref": "#/$defs/N"}},
+                        "tags": {"type": "object",
+                                 "additionalProperties":
+                                     {"type": "boolean"},
+                                 "maxProperties": 2},
+                    },
+                    "required": ["v"],
+                }
+            },
+            "$ref": "#/$defs/N",
         },
-        "$ref": "#/$defs/N",
-    }
-    nfa = compile_schema(schema)
-    table = TokenTable(tok)
-    try:
-        cpp = CppMasker(nfa, table)
-    except Exception:
-        pytest.skip("native toolchain unavailable")
-    py = MaskCache(nfa, table)
-    py._cpp = None
-    states = nfa.initial()
-    text = '{"v":7,"kids":[{"v":20,"tags":{"a":true}}],"tags":{}}'
-    for ch in text.encode():
-        pm, pd = py._compute(states)
-        cm, cd = cpp.mask(states)
-        np.testing.assert_array_equal(pm, cm)
-        np.testing.assert_array_equal(pd, cd)
-        states = nfa.step(states, ch)
-        assert states, chr(ch)
-    assert nfa.is_accepting(states)
+        '{"v":7,"kids":[{"v":20,"tags":{"a":true}}],"tags":{}}',
+        expect_accept=True,
+    )
 
 
 @pytest.mark.parametrize(
