@@ -130,6 +130,13 @@ def main() -> None:
                 param_dtype="float32",
             )
 
+    # scheduler-path window A/B (bench.py's lockstep loop favors 16,
+    # but the scheduler pays min-cap all-or-nothing tails): run the
+    # winner from chip_validation.py here before flipping the
+    # engine-wide default
+    if os.environ.get("SUTRO_E2E_MULTI"):
+        ecfg["decode_multi_step"] = int(os.environ["SUTRO_E2E_MULTI"])
+
     os.environ.setdefault("SUTRO_HOME", "/tmp/sutro-bench-e2e")
     from sutro_tpu.sdk import Sutro
 

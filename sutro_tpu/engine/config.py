@@ -52,7 +52,15 @@ class EngineConfig:
     decode_multi_step: int = 8      # decode steps fused into one device
                                     # program when no row needs host-side
                                     # FSM masks/seeds (runner.decode_multi);
-                                    # amortizes dispatch+fetch latency
+                                    # amortizes dispatch+fetch latency.
+                                    # NOTE: bench.py's lockstep loop
+                                    # measured MULTI=16 fastest (PERF.md),
+                                    # but the SCHEDULER pays min-cap
+                                    # all-or-nothing tails that grow with
+                                    # this value — flip only after the
+                                    # chip_validation.py sweep + a
+                                    # scheduler-path (bench_e2e
+                                    # SUTRO_E2E_MULTI) A/B agree
     decode_lookahead: int = 2       # fused windows in flight at once on the
                                     # unconstrained decode path: window k+1
                                     # chains off window k's device-resident

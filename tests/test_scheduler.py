@@ -474,7 +474,12 @@ def test_speculative_rejection_is_per_row(tiny_runner, byte_tok, monkeypatch):
         GenRequest(
             row_id=1,
             prompt_ids=np.array(byte_tok.encode("bystander"), np.int32),
-            max_new_tokens=24, temperature=0.0,
+            # window-aligned cap: a non-multiple of decode_multi_step
+            # would run its TAIL single-step by the documented
+            # all-or-nothing window rule, which is not what this test
+            # measures
+            max_new_tokens=2 * tiny_runner.ecfg.decode_multi_step,
+            temperature=0.0,
         ),
     ]
     res = {}
@@ -482,7 +487,7 @@ def test_speculative_rejection_is_per_row(tiny_runner, byte_tok, monkeypatch):
     out0 = b"".join(byte_tok.token_bytes(t) for t in res[0].token_ids)
     assert json.loads(out0.decode()) == "zqxzqxzqxzqx"
     assert res[0].finish_reason == "schema_complete"
-    assert len(res[1].token_ids) == 24  # bystander ran to its cap
+    assert len(res[1].token_ids) == 2 * tiny_runner.ecfg.decode_multi_step
     # the invariant under test: rejections recovered inside windows,
     # never by flipping the whole batch to masked single-steps
     assert calls["single"] == 0, calls
