@@ -4,7 +4,8 @@
 
 PY ?= python
 
-.PHONY: all native test test-fast compile-check bench bench-e2e dryrun clean
+.PHONY: all native test test-fast compile-check bench bench-e2e dryrun \
+	chip-validate bench-8b cost golden clean
 
 all: native compile-check
 
@@ -39,6 +40,23 @@ bench-e2e:
 # multi-chip sharding dry run on 8 virtual CPU devices
 dryrun:
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+# one-shot post-outage chip queue: numerics, batch/xrow/MULTI sweeps,
+# sampling sweep, bf16-logits A/B, 8B-class bench -> CHIP_VALIDATION.json
+chip-validate:
+	$(PY) benchmarks/chip_validation.py
+
+# realistically-sized models + HBM roofline fractions -> BENCH_8B.json
+bench-8b:
+	$(PY) benchmarks/bench_8b.py
+
+# north-star $/job vs OpenAI Batch from the latest BENCH_E2E record
+cost:
+	$(PY) benchmarks/cost_northstar.py
+
+# README 3-row quickstart on real trained weights -> GOLDEN.json
+golden:
+	$(PY) benchmarks/golden_quickstart.py
 
 clean:
 	$(MAKE) -C native clean
