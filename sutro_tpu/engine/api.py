@@ -741,8 +741,16 @@ class LocalEngine:
                 # per-process): guards the channel against rank-queue
                 # divergence merging one job's rows into another. ALL
                 # inputs feed the hash (length-delimited) — two jobs
-                # differing only in middle rows must not share a key
+                # differing only in middle rows must not share a key.
+                # SUTRO_DP_SECRET (optional, same value on every rank)
+                # seeds the hash so the key is not derivable from job
+                # content alone (dphost.py trust model).
+                import os as _os
+
                 h = hashlib.sha256(
+                    _os.environ.get("SUTRO_DP_SECRET", "").encode()
+                )
+                h.update(
                     _json.dumps(
                         [
                             rec.model,
@@ -851,9 +859,17 @@ class LocalEngine:
 
         if dp.rank == 0:
             if len(done_rows) >= num_rows:
-                # resume of a fully-merged job: re-finalize without a
-                # round — binding the port and waiting for workers
-                # nobody resumed would flip SUCCEEDED to FAILED
+                # resume of a fully-merged job: serve a TRIVIAL round
+                # (bind, send resume-all, drain dones briefly) so
+                # pod-wide re-queued workers finish as SUCCEEDED no-ops
+                # instead of spinning their full accept timeout against
+                # an unbound port; workers that were not re-queued are
+                # not expected and not errors
+                from .dphost import serve_resume_round
+
+                serve_resume_round(
+                    dp, job_key=job_key, done_rows=done_rows
+                )
                 return "completed"
             return run_dp_coordinator(
                 dp, run_shard, shard,
@@ -1008,8 +1024,14 @@ class LocalEngine:
             import hashlib
 
             # cross-rank identity from the tokenized rows (identical on
-            # every rank: same inputs, same tokenizer)
-            h = hashlib.sha256(f"embed:{rec.model}:{rec.num_rows}".encode())
+            # every rank: same inputs, same tokenizer); SUTRO_DP_SECRET
+            # seeds it like the generation path (dphost.py trust model)
+            import os as _os
+
+            h = hashlib.sha256(
+                _os.environ.get("SUTRO_DP_SECRET", "").encode()
+            )
+            h.update(f"embed:{rec.model}:{rec.num_rows}".encode())
             for r in token_rows:
                 rb = np.asarray(r, np.int32).tobytes()
                 h.update(f"{len(rb)}:".encode())

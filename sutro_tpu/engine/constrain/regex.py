@@ -388,6 +388,67 @@ class _Parser:
         return cs.frag(self.b)
 
 
+class _BoundsBuilder:
+    """Duck-typed ``Builder`` substitute whose "fragments" are
+    ``(lo, hi)`` CHARACTER-count bounds (``hi is None`` = unbounded).
+    Running :func:`compile_pattern` against it computes the min/max
+    match length of a pattern's language through the exact same parse
+    the NFA build uses — one grammar, no drift. Every ``lit`` the
+    pattern compiler emits is a single escaped character (``\\"``,
+    ``\\\\``, control escapes), so it counts as one unit — the same
+    escaped-chars-as-codepoints proxy ``_string_frag`` uses for
+    minLength/maxLength."""
+
+    @staticmethod
+    def char(bm) -> Frag:
+        return (1, 1)
+
+    @staticmethod
+    def lit(bs) -> Frag:
+        return (1, 1)
+
+    @staticmethod
+    def seq(*fs) -> Frag:
+        lo = sum(f[0] for f in fs)
+        hi: Optional[int] = 0
+        for f in fs:
+            if f[1] is None:
+                hi = None
+                break
+            hi += f[1]
+        return (lo, hi)
+
+    @staticmethod
+    def alt(*fs) -> Frag:
+        his = [f[1] for f in fs]
+        return (
+            min(f[0] for f in fs),
+            None if any(h is None for h in his) else max(his),
+        )
+
+    @staticmethod
+    def star(f) -> Frag:
+        return (0, 0 if f[1] == 0 else None)
+
+    @staticmethod
+    def plus(f) -> Frag:
+        return (f[0], 0 if f[1] == 0 else None)
+
+    @staticmethod
+    def opt(f) -> Frag:
+        return (0, f[1])
+
+
+def pattern_length_bounds(pattern: str) -> Tuple[int, Optional[int]]:
+    """(min, max) character-length bounds of the language the compiled
+    automaton for ``pattern`` matches; ``max is None`` = unbounded.
+    Unanchored ends contribute their star-wrapped prefix/suffix exactly
+    as the real compilation does (so an unanchored pattern is always
+    unbounded above). Raises :class:`UnsupportedPattern` for constructs
+    outside the subset — callers treat that as "cannot prove"."""
+    return compile_pattern(_BoundsBuilder(), pattern, lambda: (1, 1))
+
+
 def compile_pattern(
     b: Builder,
     pattern: str,

@@ -35,9 +35,25 @@ authoritative store of their own.
 
 Configuration is per-process environment (set by the pod launcher):
 
-  SUTRO_DP_WORLD   number of engine processes (>1 enables the path)
-  SUTRO_DP_RANK    this process's rank; 0 is the coordinator
-  SUTRO_DP_COORD   host:port the coordinator listens on
+  SUTRO_DP_WORLD    number of engine processes (>1 enables the path)
+  SUTRO_DP_RANK     this process's rank; 0 is the coordinator
+  SUTRO_DP_COORD    host:port the coordinator listens on
+  SUTRO_DP_SECRET   optional shared secret mixed into the job-key
+                    handshake (see trust model below)
+  SUTRO_DP_STALL_TIMEOUT  seconds of silence from a live worker
+                    connection (after the local shard finished) before
+                    the coordinator declares it stalled and fails the
+                    job resumably (default 600; 0 disables)
+
+Trust model: the channel is designed for a POD-INTERNAL network — the
+slices of one pod behind one job launcher, the same boundary the
+reference's fleet runs inside. The job key in the hello handshake is
+derived from job content, so any host that can reach SUTRO_DP_COORD and
+knows the job inputs could connect; on networks where that matters, set
+``SUTRO_DP_SECRET`` to the same random value on every rank — it is
+mixed into the key derivation (api.py), making the key underivable from
+job content alone. It is an authentication tag, not encryption: use an
+actually-private network (or tunnel) for confidential row data.
 """
 
 from __future__ import annotations
@@ -274,6 +290,89 @@ def run_dp_worker(
         sock.close()
 
 
+def serve_resume_round(
+    world: DPWorld, *, job_key: str, done_rows: set
+) -> None:
+    """Serve one trivial coordinator round for the resume of a job whose
+    rows are ALL already merged. Re-queued workers connect, receive the
+    full resume set (so their shard filters to empty), run nothing, and
+    report done — a pod-wide resume of a SUCCEEDED job is then a genuine
+    cheap no-op on every rank, instead of each worker spinning out its
+    accept timeout against an unbound port and flipping its local record
+    to CANCELLED. Workers that were NOT re-queued never connect; absence
+    is not an error here (unlike a real round — the authoritative
+    results already exist on this rank). The accept window is short
+    (``SUTRO_DP_RESUME_GRACE``, default 15s): a worker re-queued later
+    than that still times out as before."""
+    import time as _time
+
+    grace = float(os.environ.get("SUTRO_DP_RESUME_GRACE", "15"))
+    try:
+        listener = socket.create_server(
+            (world.host, world.port), reuse_port=False
+        )
+    except OSError:
+        return  # port busy (another job's round owns it): its key
+        #         check rejects our workers, which keep retrying
+    rows = sorted(done_rows or ())
+    threads: List[threading.Thread] = []
+    # OVERALL deadline, not per-accept: a foreign-job rank retrying
+    # every 0.5s would otherwise reset a per-accept timeout forever,
+    # keeping this port bound past the window
+    deadline = _time.monotonic() + grace
+
+    def drain(conn: socket.socket, lines) -> None:
+        try:
+            for m in lines:
+                if m.get("t") in ("done", "err"):
+                    break
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    try:
+        accepted = 0
+        while accepted < world.world - 1:
+            left = deadline - _time.monotonic()
+            if left <= 0:
+                break  # grace window over: whoever resumed has been served
+            listener.settimeout(left)
+            try:
+                conn, _ = listener.accept()
+            except OSError:
+                break  # grace window over: whoever resumed has been served
+            try:
+                conn.settimeout(30.0)
+                lines = _recv_lines(conn)
+                first = next(lines, None)
+                if (
+                    not first
+                    or first.get("t") != "hello"
+                    or first.get("job", "") != job_key
+                ):
+                    try:
+                        _send(conn, {"t": "reject"})
+                    except OSError:
+                        pass
+                    conn.close()
+                    continue
+                _send(conn, {"t": "resume", "rows": rows})
+            except OSError:
+                conn.close()
+                continue
+            accepted += 1
+            t = threading.Thread(
+                target=drain, args=(conn, lines), daemon=True
+            )
+            t.start()
+            threads.append(t)
+    finally:
+        for t in threads:
+            t.join(timeout=60.0)
+        listener.close()
+
+
 def run_dp_coordinator(
     world: DPWorld,
     run_shard: Callable[..., str],
@@ -301,8 +400,6 @@ def run_dp_coordinator(
     listener.settimeout(_ACCEPT_TIMEOUT_S)
     n_workers = world.world - 1
     conns: List[socket.socket] = []
-    errs: List[str] = []
-    done = threading.Semaphore(0)
     res_lock = threading.Lock()  # on_result mutates job state
     emit_lock = threading.Lock()  # serialize on_progress callbacks
     # per-rank progress snapshots, summed into one stream
@@ -311,11 +408,27 @@ def run_dp_coordinator(
     local_done = {"flag": False}
     cancel_sent = {"flag": False}  # before acceptor: serve() reads it
 
-    def serve(conn: socket.socket, lines, rank: int) -> None:
+    # Per-RANK connection state (not per-connection): a worker that
+    # retries after a handshake stall reconnects with the same rank, and
+    # the retry must REPLACE its abandoned first connection instead of
+    # consuming a second worker slot (and instead of that first
+    # connection's EOF failing an otherwise-successful job). ``gen``
+    # stamps each accepted connection; a serve thread whose stamp is no
+    # longer current exits without recording anything.
+    state_cv = threading.Condition()
+    rank_status: Dict[int, str] = {}  # rank -> "completed" | error text
+    rank_conn: Dict[int, socket.socket] = {}
+    rank_gen: Dict[int, int] = {}
+    last_msg: Dict[int, float] = {}  # rank -> monotonic of last message
+
+    def serve(conn: socket.socket, lines, rank: int, gen: int) -> None:
+        import time as _time
+
         ok = False
-        failed = False
+        err: Optional[str] = None
         try:
             for m in lines:
+                last_msg[rank] = _time.monotonic()
                 t = m.get("t")
                 if t == "res":
                     with res_lock:
@@ -340,24 +453,24 @@ def run_dp_coordinator(
                     if m.get("outcome") == "completed":
                         ok = True
                     else:
-                        failed = True
-                        errs.append(
+                        err = (
                             f"worker rank={rank} outcome "
                             f"{m.get('outcome')!r}"
                         )
                     break
                 elif t == "err":
-                    failed = True
-                    errs.append(str(m["msg"]))
+                    err = str(m["msg"])
                     break
         except OSError as e:
-            failed = True
-            errs.append(f"worker connection lost: {e}")
+            err = f"worker connection lost: {e}"
         finally:
-            if not ok and not failed:
-                errs.append(
-                    f"worker rank={rank} disconnected before done"
-                )
+            with state_cv:
+                if rank_gen.get(rank) != gen:
+                    return  # superseded by a retry: it owns this rank
+                if not ok and err is None:
+                    err = f"worker rank={rank} disconnected before done"
+                rank_status[rank] = "completed" if ok else err
+                state_cv.notify_all()
             # a finished rank's token counts stay (cumulative) but its
             # last RATE snapshot must not keep inflating the pod sum
             # while stragglers run
@@ -365,7 +478,6 @@ def run_dp_coordinator(
                 if rank in prog:
                     prog[rank] = {**prog[rank], "tps": 0.0}
             _emit_progress()
-            done.release()
 
     def _emit_progress() -> None:
         if on_progress is None:
@@ -394,19 +506,23 @@ def run_dp_coordinator(
         # THIS job's key count toward the expected worker set; a rank
         # whose queue diverged onto another job is rejected and will
         # retry against the listener this coordinator binds for that
-        # job later (or its own coordinator's)
-        accepted = 0
+        # job later (or its own coordinator's). The loop keeps accepting
+        # past n_workers so a retrying rank can replace its abandoned
+        # first connection; it ends when the listener times out or the
+        # job's finally closes it.
         try:
-            while accepted < n_workers:
+            while True:
                 conn, _ = listener.accept()
                 try:
                     conn.settimeout(30.0)
                     lines = _recv_lines(conn)
                     first = next(lines, None)
+                    rank = int(first.get("rank", -1)) if first else -1
                     if (
                         not first
                         or first.get("t") != "hello"
                         or first.get("job", "") != job_key
+                        or not (1 <= rank < world.world)
                     ):
                         try:
                             _send(conn, {"t": "reject"})
@@ -429,18 +545,44 @@ def run_dp_coordinator(
                 except OSError:
                     conn.close()
                     continue
+                import time as _time
+
+                with state_cv:
+                    prev = rank_conn.get(rank)
+                    gen = rank_gen.get(rank, 0) + 1
+                    rank_gen[rank] = gen
+                    rank_conn[rank] = conn
+                    # a retry re-opens the rank's slot (its abandoned
+                    # connection may already have recorded an EOF error)
+                    rank_status.pop(rank, None)
+                    # the stall clock starts at ACCEPT, not at the local
+                    # shard's finish — a worker that handshakes late
+                    # (slow compile, retry) must get the full stall
+                    # window before its first message
+                    last_msg[rank] = _time.monotonic()
+                    state_cv.notify_all()
+                if prev is not None:
+                    try:
+                        prev.close()
+                    except OSError:
+                        pass
                 conns.append(conn)
-                accepted += 1
                 threading.Thread(
                     target=serve,
-                    args=(conn, lines, int(first.get("rank", -1))),
+                    args=(conn, lines, rank, gen),
                     daemon=True,
                 ).start()
         except OSError as e:
-            errs.append(f"worker accept failed: {e}")
-            # unblock the waiter for every connection never made
-            for _ in range(n_workers - accepted):
-                done.release()
+            # listener timed out (a rank never connected) or was closed
+            # by the job's finally. Mark ranks that never connected so
+            # the waiter can finish.
+            with state_cv:
+                for r in range(1, world.world):
+                    if r not in rank_conn and r not in rank_status:
+                        rank_status[r] = (
+                            f"worker rank={r} never connected: {e}"
+                        )
+                state_cv.notify_all()
 
     acceptor = threading.Thread(target=accept_all, daemon=True)
     acceptor.start()
@@ -493,15 +635,26 @@ def run_dp_coordinator(
         # stops waiting entirely: a hung or never-connecting worker
         # must not wedge cancellation (closing conns in the finally
         # unblocks their serve threads; stragglers see EOF and cancel
-        # locally).
+        # locally). A LIVE connection that goes silent for
+        # SUTRO_DP_STALL_TIMEOUT after the local shard finished is
+        # declared stalled and fails the job resumably — a hung slice
+        # must not wedge the coordinator forever (EOF detection only
+        # covers DEAD connections).
         import time
 
-        remaining = n_workers
+        stall_s = float(os.environ.get("SUTRO_DP_STALL_TIMEOUT", "600"))
+        t_local_done = time.monotonic()
         cancel_deadline = None
-        while remaining:
-            if done.acquire(timeout=0.25):
-                remaining -= 1
-                continue
+        while True:
+            with state_cv:
+                if len(rank_status) >= n_workers:
+                    break
+                state_cv.wait(timeout=0.25)
+                pending = [
+                    r
+                    for r in range(1, world.world)
+                    if r not in rank_status
+                ]
             if cancel_check():
                 if outcome == "completed":
                     outcome = "cancelled"
@@ -509,6 +662,28 @@ def run_dp_coordinator(
                     cancel_deadline = time.monotonic() + 30.0
                 elif time.monotonic() >= cancel_deadline:
                     break
+            elif stall_s > 0:
+                now = time.monotonic()
+                for r in pending:
+                    seen = max(last_msg.get(r, 0.0), t_local_done)
+                    if r in rank_conn and now - seen > stall_s:
+                        with state_cv:
+                            if r in rank_status:
+                                continue  # terminal beat the timeout
+                            rank_gen[r] = rank_gen.get(r, 0) + 1
+                            rank_status[r] = (
+                                f"worker rank={r} stalled (no message "
+                                f"for {stall_s:.0f}s)"
+                            )
+                            state_cv.notify_all()
+                        try:
+                            rank_conn[r].close()
+                        except OSError:
+                            pass
+        with state_cv:
+            errs = [
+                s for s in rank_status.values() if s != "completed"
+            ]
         if errs and outcome == "completed":
             raise RuntimeError(
                 "dp job failed on a worker slice: " + "; ".join(errs)

@@ -11,8 +11,11 @@ remote service keeps behind ``POST /batch-inference`` (SURVEY §2.3 row 1,
   reserve the row's worst-case page count up front (prompt + max_new
   capped to context) — reservation up front makes mid-flight OOM
   impossible and keeps the loop deadlock-free.
-- Prefill is one row at a time into power-of-two buckets (compile-count
-  bounded); its last-position logits seed the slot's first sampled token.
+- Prefill is BATCHED, shortest-prompt-first: up to ``prefill_batch_size``
+  reserved rows share one device dispatch padded to a power-of-two
+  (batch x length) bucket (compile-count bounded); each row's
+  last-position logits seed its slot's first sampled token. Prompts
+  longer than ``prefill_chunk`` prefill alone via the chunked path.
 - Order-preserving results: completions are emitted keyed by ``row_id`` and
   re-assembled in input order by the jobstore, while execution order is
   whatever batching dictates (reference contract: README.md:221).

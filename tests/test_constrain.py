@@ -617,7 +617,15 @@ def test_number_bounds_exact(lo, hi):
     assert not accepts(nfa, "01.5")
     assert not accepts(nfa, "1.")
     assert not accepts(nfa, "+2")
-    assert not accepts(nfa, "2e0")  # no exponent form under bounds
+    # exponent form: accepted ONLY inside the safe box, so acceptance
+    # implies the value is in range (safety direction of the subset)
+    import itertools
+
+    for m, e in itertools.product(["1", "2", "9.5"], range(-3, 4)):
+        v = decimal.Decimal(m) * decimal.Decimal(10) ** e
+        ok = (dlo is None or v >= dlo) and (dhi is None or v <= dhi)
+        if accepts(nfa, f"{m}e{e}"):
+            assert ok, (m, e, lo, hi)
     # trailing zeros are fine when the value is in range
     mid = dlo if dlo is not None else dhi
     if mid is not None:
@@ -664,6 +672,52 @@ def test_number_negative_strict_zero():
         assert accepts(nfa, good), good
     for bad in ["0", "0.0", "-0", "-0.0", "-0.000", "0.001"]:
         assert not accepts(nfa, bad), bad
+
+
+def test_number_exponent_form_safe_box():
+    """Bounded numbers admit canonical scientific form inside the
+    exponent "safe box" (every mantissa in-range), so wide bounds don't
+    force 300-digit positional output; boundary-adjacent decades stay
+    positional-only (VERDICT r3 missing #7)."""
+    # [5, 500]: safe exponents are exactly E=1 (10^1 >= 5, 10^2 <= 500)
+    nfa = compile_schema({"type": "number", "minimum": 5, "maximum": 500})
+    for good in ["1e1", "5e1", "9.99e1"]:
+        assert accepts(nfa, good), good
+    # in-bounds but outside the box (some mantissa at E=2 would exceed
+    # 500) — positional still covers these values
+    assert not accepts(nfa, "1e2")
+    assert accepts(nfa, "100")
+    for bad in ["1e0", "1e3", "4.9e0"]:  # out of bounds entirely
+        assert not accepts(nfa, bad), bad
+
+    # wide upper bound: exponent form reaches the top decades
+    nfa = compile_schema({"type": "number", "minimum": 0, "maximum": 1e30})
+    for good in ["1e5", "9.9e29", "2.5e10"]:
+        assert accepts(nfa, good), good
+    assert not accepts(nfa, "1e30")  # boundary decade: positional only
+    assert accepts(nfa, "1" + "0" * 30)
+    assert not accepts(nfa, "2e30")
+
+    # negative side mirrors on magnitudes
+    nfa = compile_schema(
+        {"type": "number", "minimum": -1000, "maximum": -10}
+    )
+    for good in ["-1e1", "-9.9e2", "-2e2"]:
+        assert accepts(nfa, good), good
+    for bad in ["1e1", "-1e0", "-1e3", "-2e3"]:
+        assert not accepts(nfa, bad), bad
+
+    # strict bound at a power of ten excludes that exponent's floor
+    nfa = compile_schema({"type": "number", "exclusiveMinimum": 100})
+    assert accepts(nfa, "1e3")
+    assert not accepts(nfa, "1e2")  # == 100 at m=1: excluded
+    assert accepts(nfa, "100.5")
+
+    # unbounded-above side: any exponent >= the safe floor
+    nfa = compile_schema({"type": "number", "minimum": 10})
+    for good in ["1e1", "3e25", "1e100"]:
+        assert accepts(nfa, good), good
+    assert not accepts(nfa, "1e0")
 
 
 def test_number_bounds_edge_cases():
@@ -876,6 +930,120 @@ def test_allof_string_length_conjunction():
     for s, want in [("a", False), ("ab", True), ("abcd", True),
                     ("abcde", False)]:
         assert accepts(nfa, json.dumps(s)) == want, s
+
+
+def test_pattern_length_bounds():
+    """The bounds analyzer runs the real pattern compiler against a
+    counting builder — spot-check it against known languages."""
+    from sutro_tpu.engine.constrain.regex import (
+        UnsupportedPattern,
+        pattern_length_bounds,
+    )
+
+    assert pattern_length_bounds("^abc$") == (3, 3)
+    assert pattern_length_bounds("^[a-z]{2,5}$") == (2, 5)
+    assert pattern_length_bounds("^a+$") == (1, None)
+    assert pattern_length_bounds("^a?(bc|defg)$") == (2, 5)
+    assert pattern_length_bounds(r'^\d{4}-\d{2}$') == (7, 7)
+    # unanchored ends wrap with star(string_char): unbounded above
+    assert pattern_length_bounds("abc") == (3, None)
+    assert pattern_length_bounds("^ab") == (2, None)
+    with pytest.raises(UnsupportedPattern):
+        pattern_length_bounds("^a(?=b)$")  # lookahead: outside subset
+
+
+def test_allof_pattern_with_provable_length_bounds():
+    """pattern + length bounds from different conjuncts: bounds the
+    pattern provably satisfies are dropped as redundant; the pattern
+    compiles and its language is emitted."""
+    nfa = compile_schema(
+        {
+            "allOf": [
+                {"type": "string", "pattern": "^[a-z]{3}$"},
+                {"minLength": 2, "maxLength": 5},
+            ]
+        }
+    )
+    assert accepts(nfa, json.dumps("abc"))
+    assert not accepts(nfa, json.dumps("ab"))
+    assert not accepts(nfa, json.dumps("abcd"))
+
+
+def test_allof_pattern_vs_length_bounds_hard_fails():
+    """A pattern that cannot be proven to satisfy a length conjunct
+    hard-fails (the merge's no-silent-widening contract) instead of
+    letting compile_node drop the bounds."""
+    with pytest.raises(ValueError, match="pattern"):
+        compile_schema(
+            {
+                "allOf": [
+                    {"type": "string", "pattern": "^a+$"},
+                    {"maxLength": 4},
+                ]
+            }
+        )
+
+
+def test_allof_pattern_bounds_skipped_under_enum():
+    """A merged enum/const makes the pattern-vs-length check moot:
+    compile_node prefers the enum and the merge filters members against
+    pattern AND bounds exactly — the schema must still compile."""
+    nfa = compile_schema(
+        {
+            "allOf": [
+                {"enum": ["aa", "aaaaaa"]},
+                {"type": "string", "pattern": "^a+$"},
+                {"maxLength": 4},
+            ]
+        }
+    )
+    assert accepts(nfa, json.dumps("aa"))
+    assert not accepts(nfa, json.dumps("aaaaaa"))  # violates maxLength
+    assert not accepts(nfa, json.dumps("bb"))
+
+
+def test_allof_unsupported_pattern_keeps_length_bounds():
+    """A pattern outside the regex subset inside allOf must NOT
+    hard-fail against length conjuncts: compile_node's fallback
+    enforces the bounds and warns the pattern is unenforced — exactly
+    the non-allOf behavior, with no widening."""
+    import warnings as _w
+
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        nfa = compile_schema(
+            {
+                "allOf": [
+                    {"type": "string", "pattern": "^a(?=b)$"},
+                    {"maxLength": 4},
+                ]
+            }
+        )
+    assert any("not enforced" in str(r.message) for r in rec)
+    assert accepts(nfa, json.dumps("abcd"))
+    assert not accepts(nfa, json.dumps("abcde"))  # bounds enforced
+
+
+def test_direct_pattern_with_unprovable_bounds_warns():
+    """Directly-authored pattern + bounds keeps the documented
+    pattern-wins precedence but now warns when the bounds are not
+    provably satisfied (they were silently dropped before)."""
+    import warnings as _w
+
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        nfa = compile_schema(
+            {"type": "string", "pattern": "^a+$", "maxLength": 4}
+        )
+    assert any("precedence" in str(r.message) for r in rec)
+    assert accepts(nfa, json.dumps("aaaaaa"))  # pattern wins
+
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        compile_schema(
+            {"type": "string", "pattern": "^a{1,3}$", "maxLength": 4}
+        )
+    assert not any("precedence" in str(r.message) for r in rec)
 
 
 @pytest.mark.parametrize(

@@ -231,6 +231,171 @@ def test_channel_worker_failure_fails_job():
     t.join(timeout=30)
 
 
+def test_channel_worker_retry_replaces_connection():
+    """A worker that reconnects with the same rank (retry after a
+    handshake stall) must REPLACE its abandoned first connection, not
+    consume a second worker slot — and the abandoned connection's EOF
+    must not fail the otherwise-successful job."""
+    import threading
+
+    from sutro_tpu.engine.dphost import (
+        _recv_lines,
+        _send,
+        run_dp_coordinator,
+        run_dp_worker,
+        shard_requests,
+    )
+
+    port = _free_port()
+    cw, ww = _world(port)
+    reqs = _reqs(4)
+    merged = {}
+    worker_outcome = {}
+    stale_ready = threading.Event()
+
+    def coord_main():
+        worker_outcome["coord"] = run_dp_coordinator(
+            cw,
+            lambda shard, on_result, on_progress, should_cancel: (
+                [on_result(_res(q.row_id)) for q in shard],
+                "completed",
+            )[1],
+            shard_requests(reqs, 0, 2),
+            on_result=lambda r: merged.__setitem__(r.row_id, r),
+        )
+
+    ct = threading.Thread(target=coord_main)
+    ct.start()
+
+    # abandoned first connection: hello + resume handshake completes,
+    # then the socket goes quiet (still OPEN — the retry must supersede
+    # it, after which the coordinator closes it)
+    import time
+
+    deadline = time.monotonic() + 30
+    stale = None
+    while stale is None:
+        try:
+            stale = socket.create_connection(
+                ("127.0.0.1", port), timeout=5.0
+            )
+        except OSError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.05)
+    _send(stale, {"t": "hello", "rank": 1, "job": ""})
+    first = next(_recv_lines(stale), None)
+    assert first and first.get("t") == "resume"
+    stale_ready.set()
+
+    def worker_shard(shard, on_result, on_progress, should_cancel):
+        for q in shard:
+            on_result(_res(q.row_id))
+        return "completed"
+
+    worker_outcome["v"] = run_dp_worker(
+        ww, worker_shard, shard_requests(reqs, 1, 2)
+    )
+    ct.join(timeout=30)
+    assert not ct.is_alive()
+    stale.close()
+    assert worker_outcome["v"] == "completed"
+    assert worker_outcome["coord"] == "completed"
+    assert set(merged) == {0, 1, 2, 3}
+
+
+def test_channel_stalled_worker_fails_resumably(monkeypatch):
+    """A worker whose connection stays OPEN but never sends done must
+    not wedge the coordinator forever: after SUTRO_DP_STALL_TIMEOUT of
+    silence (post local-shard), the job fails with a stall error."""
+    import threading
+    import time
+
+    import pytest
+
+    from sutro_tpu.engine.dphost import (
+        _recv_lines,
+        _send,
+        run_dp_coordinator,
+        shard_requests,
+    )
+
+    monkeypatch.setenv("SUTRO_DP_STALL_TIMEOUT", "1")
+    port = _free_port()
+    cw, _ = _world(port)
+    reqs = _reqs(4)
+
+    def hung_worker():
+        deadline = time.monotonic() + 30
+        sock = None
+        while sock is None:
+            try:
+                sock = socket.create_connection(
+                    ("127.0.0.1", port), timeout=5.0
+                )
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.05)
+        _send(sock, {"t": "hello", "rank": 1, "job": ""})
+        next(_recv_lines(sock), None)  # resume reply
+        time.sleep(30)  # never send done (hung slice)
+        sock.close()
+
+    t = threading.Thread(target=hung_worker, daemon=True)
+    t.start()
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="stalled"):
+        run_dp_coordinator(
+            cw,
+            lambda shard, on_result, on_progress, should_cancel: "completed",
+            shard_requests(reqs, 0, 2),
+            on_result=lambda r: None,
+        )
+    # detected via the stall timeout (seconds), not the 420s accept path
+    assert time.monotonic() - t0 < 30
+
+
+def test_serve_resume_round_completes_requeued_workers(monkeypatch):
+    """Resume of a fully-merged DP job: the coordinator serves a trivial
+    round so re-queued workers finish as completed no-ops (their shard
+    filters to empty) instead of timing out against an unbound port."""
+    import threading
+
+    from sutro_tpu.engine.dphost import (
+        run_dp_worker,
+        serve_resume_round,
+        shard_requests,
+    )
+
+    monkeypatch.setenv("SUTRO_DP_RESUME_GRACE", "10")
+    port = _free_port()
+    cw, ww = _world(port)
+    reqs = _reqs(4)
+    worker_ran = []
+
+    def worker_shard(shard, on_result, on_progress, should_cancel):
+        worker_ran.extend(q.row_id for q in shard)
+        for q in shard:
+            on_result(_res(q.row_id))
+        return "completed"
+
+    outcome = {}
+
+    def worker_main():
+        outcome["v"] = run_dp_worker(
+            ww, worker_shard, shard_requests(reqs, 1, 2)
+        )
+
+    t = threading.Thread(target=worker_main)
+    t.start()
+    serve_resume_round(cw, job_key="", done_rows={0, 1, 2, 3})
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert outcome["v"] == "completed"
+    assert worker_ran == []  # every row was already merged
+
+
 def test_channel_cancel_propagates_to_worker():
     """Coordinator-side cancellation reaches a still-running worker
     shard through the channel, and both sides settle on 'cancelled'."""

@@ -26,14 +26,28 @@ def eng(tiny_ecfg, tmp_path, monkeypatch):
     return LocalEngine(tiny_ecfg)
 
 
-def _await(eng, jid, timeout=300):
+def _await(eng, jid, timeout=600):
+    """Wait for a terminal status. The generous timeout is deliberate:
+    this file's storm tests serialize many jobs through the single
+    engine worker on a possibly-loaded CI box, and the one observed
+    flake of this suite (round-3 post-mortem, memory races-test-flake)
+    was load-coincident — a timeout here must read as 'box overloaded',
+    with enough context to tell that apart from a real invariant
+    breach."""
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         s = eng.job_status(jid)
         if s in ("SUCCEEDED", "FAILED", "CANCELLED"):
             return s
         time.sleep(0.03)
-    raise TimeoutError(eng.job_status(jid))
+    rec = eng.get_job(jid)
+    raise TimeoutError(
+        f"job {jid} not terminal after {timeout}s: "
+        f"status={rec.get('status')!r} "
+        f"failure_reason={rec.get('failure_reason')!r} "
+        f"current={getattr(eng, '_current_job', None)!r} "
+        f"queued={len(getattr(eng, '_queued', ()))}"
+    )
 
 
 def test_concurrent_submits_all_complete_ordered(eng):
@@ -91,7 +105,12 @@ def test_concurrent_submits_all_complete_ordered(eng):
         assert res["inputs"] == rows  # order preserved
     stop.set()
     rthread.join()
-    assert not violations, violations[:5]
+    # a violation here is SERIOUS (results visible pre-terminal) — dump
+    # each offender's full record so a failure is diagnosable from the
+    # log alone (round-3 flake post-mortem lost the assertion text)
+    assert not violations, [
+        (jid, why, eng.get_job(jid)) for jid, why in violations[:5]
+    ]
 
 
 def test_resume_storm_runs_job_once(eng):
