@@ -35,6 +35,9 @@ struct Runtime {
     std::vector<int32_t> free_pages;          // SORTED ascending free set
     std::vector<std::vector<int32_t>> slot_pages;
     std::vector<int64_t> slot_total;          // reserved worst-case tokens
+    std::vector<int32_t> slot_npfx;           // shared-prefix pages at the
+                                              // head of the table row (not
+                                              // owned by the slot)
     std::vector<uint8_t> active;
 
     // dense per-step state, shared with Python as zero-copy views
@@ -65,6 +68,7 @@ Runtime* rt_create(
     for (int32_t p = 1; p < num_pages; ++p) rt->free_pages.push_back(p);
     rt->slot_pages.resize(num_slots);
     rt->slot_total.assign(num_slots, 0);
+    rt->slot_npfx.assign(num_slots, 0);
     rt->active.assign(num_slots, 0);
     rt->last.assign(num_slots, 0);
     rt->past_len.assign(num_slots, 0);
@@ -95,28 +99,12 @@ int32_t rt_active_count(Runtime* rt) {
     return n;
 }
 
-// Admission: returns the slot index, or -1 if the row cannot be admitted
-// now. On success the slot's page-table row is populated and reserved.
-int32_t rt_try_admit(Runtime* rt, int32_t prompt_len, int32_t max_new) {
-    int32_t slot = -1;
-    for (int32_t i = 0; i < rt->num_slots; ++i) {
-        if (!rt->active[i]) { slot = i; break; }
-    }
-    if (slot < 0) return -1;
-    int64_t total = (int64_t)prompt_len + max_new;
-    if (total > rt->max_context) total = rt->max_context;
-    int32_t need =
-        (int32_t)((total + rt->page_size - 1) / rt->page_size);
-    if (need > rt->max_pages_per_seq) return -1;
-    if (need > (int32_t)rt->free_pages.size()) return -1;
-    int64_t inflight = rt_inflight_tokens(rt);
-    if (inflight > 0 && inflight + total > rt->max_batch_tokens) return -1;
-
-    // contiguous-first allocation (mirrors engine/kvcache.PageAllocator):
-    // an ascending run lets the Pallas decode kernel fetch the row's
-    // context in chunked DMAs instead of one DMA per page
-    std::vector<int32_t>& pages = rt->slot_pages[slot];
-    pages.clear();
+// contiguous-first allocation (mirrors engine/kvcache.PageAllocator):
+// an ascending run lets the Pallas decode kernel fetch the row's
+// context in chunked DMAs instead of one DMA per page. Takes `need`
+// pages off the free list into `pages` (caller checked availability).
+static void alloc_block(
+    Runtime* rt, int32_t need, std::vector<int32_t>& pages) {
     std::vector<int32_t>& fp = rt->free_pages;
     size_t take = fp.size();  // sentinel: no run found
     size_t run_start = 0;
@@ -136,13 +124,78 @@ int32_t rt_try_admit(Runtime* rt, int32_t prompt_len, int32_t max_new) {
                                       // (ascending from the front)
     pages.assign(fp.begin() + take, fp.begin() + take + need);
     fp.erase(fp.begin() + take, fp.begin() + take + need);
+}
+
+// Shared admission core: `npfx` pages of a job-wide shared prefix
+// occupy the head of the table row (they are NOT owned or freed by the
+// slot); only the remainder of the row's worst case is allocated here.
+static int32_t try_admit_impl(
+    Runtime* rt, int32_t prompt_len, int32_t max_new,
+    int32_t npfx, const int32_t* pfx_pages) {
+    int32_t slot = -1;
+    for (int32_t i = 0; i < rt->num_slots; ++i) {
+        if (!rt->active[i]) { slot = i; break; }
+    }
+    if (slot < 0) return -1;
+    int64_t total = (int64_t)prompt_len + max_new;
+    if (total > rt->max_context) total = rt->max_context;
+    int32_t need =
+        (int32_t)((total + rt->page_size - 1) / rt->page_size);
+    if (need > rt->max_pages_per_seq) return -1;
+    int32_t own = need - npfx;
+    if (own < 1) own = 1;  // every row prefills >= 1 own token
+    if (own > (int32_t)rt->free_pages.size()) return -1;
+    int64_t inflight = rt_inflight_tokens(rt);
+    if (inflight > 0 && inflight + total > rt->max_batch_tokens) return -1;
+
+    std::vector<int32_t>& pages = rt->slot_pages[slot];
+    pages.clear();
+    alloc_block(rt, own, pages);
     int32_t* row = rt->table.data() + (size_t)slot * rt->max_pages_per_seq;
     std::memset(row, 0, sizeof(int32_t) * rt->max_pages_per_seq);
-    for (size_t k = 0; k < pages.size(); ++k) row[k] = pages[k];
+    for (int32_t k = 0; k < npfx; ++k) row[k] = pfx_pages[k];
+    for (size_t k = 0; k < pages.size(); ++k) row[npfx + k] = pages[k];
     rt->slot_total[slot] = total;
+    rt->slot_npfx[slot] = npfx;
     rt->active[slot] = 1;
     rt->emitted[slot] = 0;
     return slot;
+}
+
+// Admission: returns the slot index, or -1 if the row cannot be admitted
+// now. On success the slot's page-table row is populated and reserved.
+int32_t rt_try_admit(Runtime* rt, int32_t prompt_len, int32_t max_new) {
+    return try_admit_impl(rt, prompt_len, max_new, 0, nullptr);
+}
+
+// Admission with a job-wide shared KV prefix at the table head
+// (engine/scheduler._SharedPrefix): the prefix pages are referenced,
+// not owned — rt_release frees only the slot's own pages.
+int32_t rt_try_admit_pfx(
+    Runtime* rt, int32_t prompt_len, int32_t max_new,
+    int32_t npfx, const int32_t* pfx_pages) {
+    return try_admit_impl(rt, prompt_len, max_new, npfx, pfx_pages);
+}
+
+// Job-scoped page-block allocation (shared-prefix KV). Returns 0 and
+// writes `n` page ids into `out`, or -1 when the pool cannot supply
+// them. Freed with rt_free_pages, never by rt_release.
+int32_t rt_alloc_pages(Runtime* rt, int32_t n, int32_t* out) {
+    if (n < 1 || n > (int32_t)rt->free_pages.size()) return -1;
+    std::vector<int32_t> pages;
+    alloc_block(rt, n, pages);
+    for (int32_t i = 0; i < n; ++i) out[i] = pages[i];
+    return 0;
+}
+
+void rt_free_pages(Runtime* rt, int32_t n, const int32_t* pages) {
+    size_t mid = rt->free_pages.size();
+    for (int32_t i = 0; i < n; ++i)
+        if (pages[i] != 0) rt->free_pages.push_back(pages[i]);
+    std::sort(rt->free_pages.begin() + mid, rt->free_pages.end());
+    std::inplace_merge(
+        rt->free_pages.begin(), rt->free_pages.begin() + mid,
+        rt->free_pages.end());
 }
 
 // Post-prefill slot arming: position after the prompt, the first sampled
@@ -177,6 +230,7 @@ void rt_release(Runtime* rt, int32_t slot) {
         rt->free_pages.end());
     rt->slot_pages[slot].clear();
     rt->slot_total[slot] = 0;
+    rt->slot_npfx[slot] = 0;
     rt->active[slot] = 0;
     rt->last[slot] = 0;
     rt->past_len[slot] = 0;
@@ -189,6 +243,7 @@ void rt_release(Runtime* rt, int32_t slot) {
 }
 
 int32_t rt_emitted(Runtime* rt, int32_t slot) { return rt->emitted[slot]; }
+int32_t rt_slot_npfx(Runtime* rt, int32_t slot) { return rt->slot_npfx[slot]; }
 int32_t rt_pos(Runtime* rt, int32_t slot) { return rt->past_len[slot]; }
 int32_t rt_is_active(Runtime* rt, int32_t slot) { return rt->active[slot]; }
 

@@ -68,6 +68,14 @@ class EngineConfig:
                                     # trip is hidden behind device compute
                                     # (scheduler pipelined windows); 1 =
                                     # synchronous (process before dispatch)
+    prefix_cache: bool = True       # shared-prefix KV reuse: a job whose
+                                    # rows share a common token prefix
+                                    # (templates send one system prompt
+                                    # for every row) prefills that prefix
+                                    # ONCE into page-aligned shared pages;
+                                    # slots reference them read-only and
+                                    # prefill only their own suffix
+                                    # (scheduler._setup_prefix)
     # --- generation defaults ----------------------------------------------
     max_new_tokens: int = 1024
     temperature: float = 0.7

@@ -71,11 +71,18 @@ def _load_lib():
     lib.rt_active_count.argtypes = [c_rt]
     lib.rt_try_admit.restype = i32
     lib.rt_try_admit.argtypes = [c_rt, i32, i32]
+    lib.rt_try_admit_pfx.restype = i32
+    lib.rt_try_admit_pfx.argtypes = [c_rt, i32, i32, i32, p_i32]
+    lib.rt_alloc_pages.restype = i32
+    lib.rt_alloc_pages.argtypes = [c_rt, i32, p_i32]
+    lib.rt_free_pages.argtypes = [c_rt, i32, p_i32]
     lib.rt_arm_slot.argtypes = [c_rt, i32, i32, i32, f32, f32, i32]
     lib.rt_note_token.argtypes = [c_rt, i32, i32]
     lib.rt_release.argtypes = [c_rt, i32]
     lib.rt_emitted.restype = i32
     lib.rt_emitted.argtypes = [c_rt, i32]
+    lib.rt_slot_npfx.restype = i32
+    lib.rt_slot_npfx.argtypes = [c_rt, i32]
     lib.rt_pos.restype = i32
     lib.rt_pos.argtypes = [c_rt, i32]
     lib.rt_is_active.restype = i32
@@ -167,6 +174,37 @@ class NativeRuntime:
             self._lib.rt_try_admit(self._rt, prompt_len, max_new_tokens)
         )
 
+    def try_admit_pfx(
+        self, prompt_len: int, max_new_tokens: int, pfx_pages: List[int]
+    ) -> int:
+        """Admission with a job-wide shared KV prefix at the table head
+        (the pages are referenced, not owned: release frees only the
+        slot's own pages). Returns the slot index or -1."""
+        arr = np.asarray(pfx_pages, np.int32)
+        return int(
+            self._lib.rt_try_admit_pfx(
+                self._rt, prompt_len, max_new_tokens, len(arr),
+                arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            )
+        )
+
+    def alloc_pages(self, n: int) -> Optional[List[int]]:
+        """Job-scoped page block (shared-prefix KV); None when the pool
+        cannot supply it. Return with ``free_pages``."""
+        out = np.zeros((n,), np.int32)
+        rc = self._lib.rt_alloc_pages(
+            self._rt, n,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        return [int(p) for p in out] if rc == 0 else None
+
+    def free_pages(self, pages: List[int]) -> None:
+        arr = np.asarray(pages, np.int32)
+        self._lib.rt_free_pages(
+            self._rt, len(arr),
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+
     def arm_slot(
         self, slot: int, pos: int, first_token: int,
         temperature: float, top_p: float, top_k: int,
@@ -206,8 +244,13 @@ class NativeRuntime:
         return int(self._lib.rt_emitted(self._rt, slot))
 
     def slot_pages(self, slot: int) -> List[int]:
+        """Pages OWNED by this slot (freed by ``release``) — with a
+        shared prefix active, the job-owned prefix pages at the table
+        head are excluded (freeing them per slot would double-free job
+        pages into the pool)."""
+        npfx = int(self._lib.rt_slot_npfx(self._rt, slot))
         row = self.table[slot]
-        return [int(p) for p in row if p != 0]
+        return [int(p) for p in row[npfx:] if p != 0]
 
 
 def maybe_native_runtime(

@@ -238,7 +238,8 @@ class ModelRunner:
         return last_logits, cache
 
     def prefill(
-        self, token_ids: np.ndarray, page_table: np.ndarray
+        self, token_ids: np.ndarray, page_table: np.ndarray,
+        start: int = 0,
     ) -> np.ndarray:
         """One prompt ([T] int32) -> last-position logits [V]. ``page_table``
         is the slot's [MP] row.
@@ -247,10 +248,24 @@ class ModelRunner:
         chunks so attention transients stay O(chunk x ctx) instead of
         O(T^2) and one compile covers all lengths — except under
         sequence parallelism (sp > 1), where the ring path wants the full
-        sequence resident and sharded (ops/ring_attention.py)."""
+        sequence resident and sharded (ops/ring_attention.py).
+
+        ``start`` > 0 prefills a SUFFIX beginning at that global
+        position, attending over pages that already hold positions
+        < start (shared-prefix jobs: the common prefix was prefilled
+        once into pages at the head of ``page_table``)."""
         n = len(token_ids)
         C = self.ecfg.prefill_chunk
-        if n > C and self.sp == 1 and self.pp == 1:
+        # the chunked paged path does not route through the ring (sp) or
+        # pipeline (pp) wrappers — guard BEFORE any start>0 branch
+        assert start == 0 or (self.sp == 1 and self.pp == 1), (
+            "suffix prefill is unsupported under sp/pp"
+        )
+        if start > 0 and n <= C:
+            return self.prefill_batch_at(
+                [token_ids], page_table[None, :], [start]
+            )[0]
+        if (start > 0 or n > C) and self.sp == 1 and self.pp == 1:
             table_dev = jnp.asarray(page_table[None, :], jnp.int32)
             for off in range(0, n, C):
                 seg = token_ids[off : off + C]
@@ -262,7 +277,7 @@ class ModelRunner:
                     jnp.asarray(ids),
                     jnp.asarray([len(seg)], jnp.int32),
                     table_dev,
-                    jnp.asarray([off], jnp.int32),
+                    jnp.asarray([start + off], jnp.int32),
                 )
             return np.asarray(logits[0])
         T = next_bucket(max(n, 1), lo=16, hi=self.ecfg.max_context())
@@ -314,6 +329,39 @@ class ModelRunner:
             jnp.asarray(lens),
             jnp.asarray(tables),
             jnp.zeros((B,), jnp.int32),
+        )
+        return np.asarray(logits[:n])
+
+    def prefill_batch_at(
+        self, rows: list, page_tables: np.ndarray, starts
+    ) -> np.ndarray:
+        """Batched SUFFIX prefill: like ``prefill_batch`` but each row
+        begins at global position ``starts[i]``, attending over pages
+        that already hold its earlier positions — the per-row dispatch
+        for shared-prefix jobs (the common prefix occupies the head of
+        every row's table; only the suffix rides this program). Padding
+        rows carry ``valid_len`` 0, start 0 and an all-zero table, so
+        their K/V land on the garbage page."""
+        n = len(rows)
+        maxlen = max((len(r) for r in rows), default=1)
+        T = next_bucket(max(maxlen, 1), lo=16, hi=self.ecfg.max_context())
+        B = next_bucket(n, lo=1, hi=1 << 16)
+        ids = np.zeros((B, T), np.int32)
+        lens = np.zeros((B,), np.int32)
+        st = np.zeros((B,), np.int32)
+        tables = np.zeros((B, page_tables.shape[1]), np.int32)
+        for i, r in enumerate(rows):
+            ids[i, : len(r)] = r
+            lens[i] = len(r)
+            st[i] = starts[i]
+            tables[i] = page_tables[i]
+        logits, self.cache = self._prefill_chunk_jit(
+            self.params,
+            self.cache,
+            jnp.asarray(ids),
+            jnp.asarray(lens),
+            jnp.asarray(tables),
+            jnp.asarray(st),
         )
         return np.asarray(logits[:n])
 
