@@ -155,6 +155,9 @@ def main() -> None:
         # weight-only int8 (ops/quant.py) — lets 8B-class models fit a
         # single v5e chip (SUTRO_BENCH_QUANT=int8)
         quantize=os.environ.get("SUTRO_BENCH_QUANT") or None,
+        # int8 KV cache (kvcache.py): halves decode HBM traffic
+        # (SUTRO_BENCH_KV_QUANT=int8)
+        kv_quantize=os.environ.get("SUTRO_BENCH_KV_QUANT") or None,
     )
     runner = ModelRunner(mcfg, ecfg)
     MP = ecfg.max_pages_per_seq
@@ -269,7 +272,9 @@ def main() -> None:
             num_layers=mcfg.num_layers,
             kv_heads=mcfg.num_kv_heads,
             head_dim=mcfg.head_dim,
-            kv_dtype_bytes=2 if on_tpu else 4,
+            kv_dtype_bytes=(
+                1 if ecfg.kv_quantize == "int8" else (2 if on_tpu else 4)
+            ),
         ),
         device_kind=device_kind,
     )
@@ -289,6 +294,7 @@ def main() -> None:
         "model": model_key,
         "backend": jax.default_backend(),
         "quant": quant,
+        "kv_quant": ecfg.kv_quantize or "none",
         "batch": B,
         "steps": steps,
         "prompt_len": prompt_len,
@@ -303,9 +309,11 @@ def main() -> None:
             if (
                 base.get("model") == model_key
                 and base.get("backend") == jax.default_backend()
-                # legacy baselines predate the quant field: they were
+                # legacy baselines predate the quant fields: they were
                 # all unquantized
                 and base.get("quant", "none") == quant
+                and base.get("kv_quant", "none")
+                == (ecfg.kv_quantize or "none")
                 and base.get("decode_tok_s_per_chip", 0) > 0
             ):
                 vs = value / base["decode_tok_s_per_chip"]

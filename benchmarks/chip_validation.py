@@ -12,7 +12,9 @@ Cases (in order):
   6. sampling sweep (sweep_sampling.py: f32 vs bf16 x batch x mode)
   7. bench at the best batch with SUTRO_LOGITS_BF16=1 (A/B the gated
      bf16 sampling path end-to-end)
-  8. bench_8b.py (qwen3-4b bf16/int8 + llama-3.1-8b int8, HBM
+  8. bench at the best batch with SUTRO_BENCH_KV_QUANT=int8 (A/B the
+     int8 KV cache: halved decode HBM traffic)
+  9. bench_8b.py (qwen3-4b bf16/int8 + llama-3.1-8b int8, HBM
      roofline fractions -> BENCH_8B.json)
 
 Writes CHIP_VALIDATION.json (list of case records incl. stdout tails)
@@ -110,6 +112,12 @@ def main() -> None:
     run_case(
         f"bench_b{best_b}_logits_bf16", [py, "bench.py"],
         {"SUTRO_BENCH_BATCH": best_b, "SUTRO_LOGITS_BF16": "1"},
+    )
+    # int8 KV cache A/B (kvcache.py per-token scales): halves decode
+    # HBM traffic — the direct lever on the pct_hbm_roofline number
+    run_case(
+        f"bench_b{best_b}_kv_int8", [py, "bench.py"],
+        {"SUTRO_BENCH_BATCH": best_b, "SUTRO_BENCH_KV_QUANT": "int8"},
     )
     # budget exceeds bench_8b's own worst case (3 configs x 3600s inner
     # timeouts + param probes) so its per-config timeout handling — not

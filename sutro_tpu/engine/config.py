@@ -38,6 +38,15 @@ class EngineConfig:
     quantize: Optional[str] = None  # "int8" => weight-only per-channel
                                     # quantization of the projection
                                     # matrices (ops/quant.py)
+    kv_quantize: Optional[str] = None  # "int8" => KV cache pages stored
+                                    # int8 with per-token scales: halves
+                                    # decode HBM traffic and doubles
+                                    # page capacity (kvcache.write_kv
+                                    # quantizes, the paged kernel /
+                                    # gather fallback dequantize).
+                                    # Single-device only this round
+                                    # (runner warns+ignores under a
+                                    # multi-chip mesh)
     # --- KV cache / batching ----------------------------------------------
     kv_page_size: int = 64          # tokens per KV page
     max_pages_per_seq: int = 128    # => max context 8192 by default

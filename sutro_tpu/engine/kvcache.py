@@ -40,8 +40,15 @@ from .config import EngineConfig
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class KVCache:
-    k_pages: jax.Array  # [L, NP, PS, KVH*Dh]
+    k_pages: jax.Array  # [L, NP, PS, KVH*Dh] — bf16, or int8 quantized
     v_pages: jax.Array  # [L, NP, PS, KVH*Dh]
+    # int8 KV mode (EngineConfig.kv_quantize): per-TOKEN dequant scales,
+    # amax/127 over the fused KD axis. Per-token (not per-page) so a
+    # decode append quantizes exactly once — no page rescale, no
+    # clipping against a stale amax. Overhead: 4 bytes per token per
+    # layer vs KD int8 bytes (<1% at KD=1024).
+    k_scale: "jax.Array | None" = None  # [L, NP, PS] f32
+    v_scale: "jax.Array | None" = None
 
     @property
     def page_size(self) -> int:
@@ -50,6 +57,10 @@ class KVCache:
     @property
     def num_pages(self) -> int:
         return self.k_pages.shape[1]
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
 
 
 def alloc_cache(
@@ -62,7 +73,29 @@ def alloc_cache(
         ecfg.kv_page_size,
         mcfg.num_kv_heads * mcfg.head_dim,
     )
+    if getattr(ecfg, "kv_quantize", None) == "int8":
+        return KVCache(
+            k_pages=jnp.zeros(shape, jnp.int8),
+            v_pages=jnp.zeros(shape, jnp.int8),
+            k_scale=jnp.zeros(shape[:3], jnp.float32),
+            v_scale=jnp.zeros(shape[:3], jnp.float32),
+        )
+    if getattr(ecfg, "kv_quantize", None):
+        raise ValueError(
+            f"Unknown kv_quantize mode {ecfg.kv_quantize!r} (only 'int8')"
+        )
     return KVCache(k_pages=jnp.zeros(shape, dtype), v_pages=jnp.zeros(shape, dtype))
+
+
+def _quantize_tokens(x: jax.Array):
+    """[..., KD] float -> (int8 values, f32 per-token scales [...])."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax / 127.0, 1e-8)
+    q = jnp.clip(
+        jnp.round(xf / scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
 
 
 class PageAllocator:
@@ -125,6 +158,19 @@ def pages_needed(length: int, page_size: int) -> int:
     return (length + page_size - 1) // page_size
 
 
+def _flat_slots(
+    page_table: jax.Array, start: jax.Array, valid_len: jax.Array,
+    T: int, PS: int,
+) -> jax.Array:
+    """[B, T] flat pool positions for a chunk's tokens; padding tokens
+    route to garbage page 0. Single copy of the scatter index math for
+    the quantized AND unquantized write paths."""
+    pos = start[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    valid = jnp.arange(T, dtype=jnp.int32)[None, :] < valid_len[:, None]
+    page_idx = jnp.take_along_axis(page_table, pos // PS, axis=1)
+    return jnp.where(valid, page_idx * PS + pos % PS, 0)
+
+
 def write_kv(
     cache: KVCache,
     k_chunk: jax.Array,        # [L, B, T, KVH, Dh] or fused [L, B, T, KD]
@@ -144,6 +190,28 @@ def write_kv(
         KD = KVH * Dh
     PS = cache.page_size
     NP = cache.num_pages
+    if cache.quantized:
+        # int8 KV: quantize per token, then the SAME flat scatter as
+        # the unquantized fallback below (shared index helper), plus
+        # the scale scatter. The in-place Pallas write kernel is
+        # bf16-only — the XLA path serves the quantized cache.
+        kq, ks = _quantize_tokens(k_chunk.reshape(L, B, T, KD))
+        vq, vs = _quantize_tokens(v_chunk.reshape(L, B, T, KD))
+        flat = _flat_slots(page_table, start, valid_len, T, PS)
+        k_flat = cache.k_pages.reshape(L, NP * PS, KD)
+        v_flat = cache.v_pages.reshape(L, NP * PS, KD)
+        ks_flat = cache.k_scale.reshape(L, NP * PS)
+        vs_flat = cache.v_scale.reshape(L, NP * PS)
+        k_flat = k_flat.at[:, flat].set(kq)
+        v_flat = v_flat.at[:, flat].set(vq)
+        ks_flat = ks_flat.at[:, flat].set(ks)
+        vs_flat = vs_flat.at[:, flat].set(vs)
+        return KVCache(
+            k_pages=k_flat.reshape(L, NP, PS, KD),
+            v_pages=v_flat.reshape(L, NP, PS, KD),
+            k_scale=ks_flat.reshape(L, NP, PS),
+            v_scale=vs_flat.reshape(L, NP, PS),
+        )
     if use_pallas:
         from ..ops.pallas_kv import kv_write_pallas
 
@@ -158,10 +226,7 @@ def write_kv(
         )
         return KVCache(k_pages=k_pages, v_pages=v_pages)
 
-    pos = start[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]   # [B, T]
-    valid = jnp.arange(T, dtype=jnp.int32)[None, :] < valid_len[:, None]
-    page_idx = jnp.take_along_axis(page_table, pos // PS, axis=1)    # [B, T]
-    flat = jnp.where(valid, page_idx * PS + pos % PS, 0)             # [B, T]
+    flat = _flat_slots(page_table, start, valid_len, T, PS)          # [B, T]
 
     k_flat = cache.k_pages.reshape(L, NP * PS, KD)
     v_flat = cache.v_pages.reshape(L, NP * PS, KD)
@@ -183,15 +248,23 @@ def gather_kv_layer(
     v_pages_l: jax.Array,
     page_table: jax.Array,  # [B, MP] int32
     kv_heads: int,
+    k_scale_l: "jax.Array | None" = None,  # [NP, PS] (int8 KV mode)
+    v_scale_l: "jax.Array | None" = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Per-layer page gather: [B, MP] table -> ([B, CTX, KVH, Dh]) x2,
     CTX = MP * PS. Used inside the layer scan so only one layer's context
     view is ever live (the XLA fallback when the Pallas paged kernel does
-    not run — the kernel reads pages in place and skips this copy)."""
+    not run — the kernel reads pages in place and skips this copy).
+    With int8 KV scales the gathered pages are dequantized here."""
     NP, PS, KD = k_pages_l.shape
     B, MP = page_table.shape
     k = jnp.take(k_pages_l, page_table.reshape(-1), axis=0)
     v = jnp.take(v_pages_l, page_table.reshape(-1), axis=0)
+    if k_scale_l is not None:
+        ks = jnp.take(k_scale_l, page_table.reshape(-1), axis=0)
+        vs = jnp.take(v_scale_l, page_table.reshape(-1), axis=0)
+        k = k.astype(jnp.float32) * ks[..., None]
+        v = v.astype(jnp.float32) * vs[..., None]
     return (
         k.reshape(B, MP * PS, kv_heads, KD // kv_heads),
         v.reshape(B, MP * PS, kv_heads, KD // kv_heads),

@@ -81,6 +81,19 @@ class ModelRunner:
             if dp * pp * sp * ep * tp > 1:
                 mesh = auto_mesh(ecfg)
         self.mesh = mesh
+        if mesh is not None and getattr(ecfg, "kv_quantize", None):
+            # the scale pools don't carry the sharded KV-head axis;
+            # sharding them correctly under tp/pp is future work —
+            # run the quantized cache single-device only
+            import warnings
+
+            warnings.warn(
+                "kv_quantize is single-device only this round; "
+                "ignoring it under a multi-chip mesh"
+            )
+            import dataclasses as _dc
+
+            ecfg = self.ecfg = _dc.replace(ecfg, kv_quantize=None)
         # ring-attention sequence parallelism for prefill when the mesh
         # carries a non-trivial "seq" axis (SURVEY §5.7 TPU plan)
         self.sp = int(mesh.shape.get("seq", 1)) if mesh is not None else 1
@@ -138,7 +151,9 @@ class ModelRunner:
                 ecfg.max_pages_per_seq,
                 kv_heads=mcfg.num_kv_heads,
                 head_dim=mcfg.head_dim,
-                dtype_bytes=dtype.itemsize,
+                dtype_bytes=(
+                    1 if ecfg.kv_quantize == "int8" else dtype.itemsize
+                ),
             )
             if self.use_pallas
             and os.environ.get("SUTRO_KV_CHUNK", "1") != "0"
@@ -167,6 +182,17 @@ class ModelRunner:
                 k_pages=jax.device_put(self.cache.k_pages, self._cache_sharding),
                 v_pages=jax.device_put(self.cache.v_pages, self._cache_sharding),
             )
+
+    @staticmethod
+    def _paged(cache: KVCache, page_table):
+        """The ``paged_past`` tuple for transformer.forward: 3 elements
+        for a bf16 cache, 5 (with per-token dequant scales) for int8."""
+        if cache.quantized:
+            return (
+                cache.k_pages, cache.v_pages,
+                cache.k_scale, cache.v_scale, page_table,
+            )
+        return (cache.k_pages, cache.v_pages, page_table)
 
     @staticmethod
     def _resolve_pallas(ecfg: EngineConfig) -> bool:
@@ -222,7 +248,7 @@ class ModelRunner:
         positions = start[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
         logits, _, (k, v) = transformer.forward(
             self.mcfg, params, ids, positions, valid_len,
-            paged_past=(cache.k_pages, cache.v_pages, page_table),
+            paged_past=self._paged(cache, page_table),
             past_len=start,
             use_pallas=self.use_pallas,
             ep_mesh=self.ep_mesh,
@@ -389,7 +415,7 @@ class ModelRunner:
             )
         return transformer.forward(
             self.mcfg, params, ids, positions, ones,
-            paged_past=(cache.k_pages, cache.v_pages, page_table),
+            paged_past=self._paged(cache, page_table),
             past_len=past_len,
             window_past=window_past,
             use_pallas=self.use_pallas,
@@ -567,7 +593,14 @@ class ModelRunner:
         L = self.mcfg.num_layers
         KVH, Dh = self.mcfg.num_kv_heads, self.mcfg.head_dim
         KD = KVH * Dh
-        dtype = cache.k_pages.dtype
+        # window buffers hold UNQUANTIZED step K/V (they are read by
+        # attention before ever touching the pool; write_kv quantizes
+        # at commit) — under an int8 pool they stay in compute dtype
+        dtype = (
+            jnp.dtype(self.ecfg.activation_dtype)
+            if cache.quantized
+            else cache.k_pages.dtype
+        )
         # FUSED trailing axis (like the page pool, kvcache.py): the
         # unfused [.., KVH, Dh] form pads KVH up to a full sublane tile
         # on TPU — a 2x memory expansion on multi-GB buffers at large B

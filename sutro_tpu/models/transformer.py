@@ -244,6 +244,8 @@ def layer_apply(
     theta: jax.Array,            # scalar fp32 RoPE base
     kp_l: Optional[jax.Array] = None,   # this layer's K page pool
     vp_l: Optional[jax.Array] = None,
+    ks_l: Optional[jax.Array] = None,   # this layer's per-token dequant
+    vs_l: Optional[jax.Array] = None,   # scales [NP, PS] (int8 KV mode)
     page_table: Optional[jax.Array] = None,
     past_len: Optional[jax.Array] = None,
     use_pallas: bool = False,
@@ -279,6 +281,7 @@ def layer_apply(
         positions=positions,
         valid_len=valid_len,
         past_k_pages=kp_l, past_v_pages=vp_l,
+        past_k_scale=ks_l, past_v_scale=vs_l,
         page_table=page_table, past_len=past_len,
         window=window, sink=sink,
         use_pallas=use_pallas,
@@ -384,12 +387,14 @@ def forward(
     ids: jax.Array,                     # [B, T] int32
     positions: jax.Array,               # [B, T] int32 (global positions)
     valid_len: jax.Array,               # [B] int32 — tokens of chunk that are real
-    paged_past: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
-    # paged_past: (k_pages, v_pages, page_table) — pages [L, NP, PS,
-    # KVH*Dh] (FUSED trailing axis, engine/kvcache.py) scanned per
-    # layer, table [B, MP]. Attention reads pages directly (Pallas) or
-    # gathers one layer's view at a time (XLA fallback) — the full
-    # [L, B, CTX, ...] gather is never materialized.
+    paged_past: Optional[Tuple[jax.Array, ...]] = None,
+    # paged_past: (k_pages, v_pages, page_table), or with an int8 KV
+    # cache (k_pages, v_pages, k_scale, v_scale, page_table) — pages
+    # [L, NP, PS, KVH*Dh] (FUSED trailing axis, engine/kvcache.py)
+    # scanned per layer, per-token scales [L, NP, PS], table [B, MP].
+    # Attention reads pages directly (Pallas) or gathers one layer's
+    # view at a time (XLA fallback) — the full [L, B, CTX, ...] gather
+    # is never materialized.
     past_len: Optional[jax.Array] = None,  # [B] int32 — valid past tokens
     use_pallas: bool = False,
     ring_mesh=None,  # Mesh with "seq" axis > 1 => ring-attention prefill
@@ -412,9 +417,20 @@ def forward(
     thetas = rope_thetas(cfg)
 
     win_len = None if window_past is None else window_past[2]
+    quantized = False
     if paged_past is not None:
-        k_pages, v_pages, page_table = paged_past
-        xs = [params["layers"], windows, thetas, k_pages, v_pages]
+        if len(paged_past) == 5:
+            # int8 KV: (k_pages, v_pages, k_scale, v_scale, table) —
+            # per-token dequant scales scan with their layer's pages
+            k_pages, v_pages, k_scale, v_scale, page_table = paged_past
+            quantized = True
+            xs = [
+                params["layers"], windows, thetas, k_pages, v_pages,
+                k_scale, v_scale,
+            ]
+        else:
+            k_pages, v_pages, page_table = paged_past
+            xs = [params["layers"], windows, thetas, k_pages, v_pages]
         if window_past is not None:
             xs += [window_past[0], window_past[1]]
         xs = tuple(xs)
@@ -423,12 +439,17 @@ def forward(
         xs = (params["layers"], windows, thetas)
 
     def layer_step(h, xs_l):
-        wk_l = wv_l = None
+        wk_l = wv_l = ks_l = vs_l = None
         if paged_past is not None:
+            rest = list(xs_l[3:])
+            lp, window, theta = xs_l[:3]
+            kp_l, vp_l = rest[0], rest[1]
+            rest = rest[2:]
+            if quantized:
+                ks_l, vs_l = rest[0], rest[1]
+                rest = rest[2:]
             if window_past is not None:
-                lp, window, theta, kp_l, vp_l, wk_l, wv_l = xs_l
-            else:
-                lp, window, theta, kp_l, vp_l = xs_l
+                wk_l, wv_l = rest[0], rest[1]
         else:
             lp, window, theta = xs_l
             kp_l = vp_l = None
@@ -437,6 +458,7 @@ def forward(
             positions=positions, valid_len=valid_len,
             window=window, theta=theta,
             kp_l=kp_l, vp_l=vp_l,
+            ks_l=ks_l, vs_l=vs_l,
             page_table=page_table, past_len=past_len,
             use_pallas=use_pallas, ring_mesh=ring_mesh,
             wk_l=wk_l, wv_l=wv_l, win_len=win_len,

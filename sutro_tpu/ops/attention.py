@@ -43,6 +43,9 @@ def chunk_attention(
     # pages in place; the fallback gathers this layer's contiguous view.
     past_k_pages: Optional[jax.Array] = None,  # [NP, PS, KVH*Dh]
     past_v_pages: Optional[jax.Array] = None,
+    # int8 KV mode: per-token dequant scales for this layer's pages
+    past_k_scale: Optional[jax.Array] = None,  # [NP, PS] f32
+    past_v_scale: Optional[jax.Array] = None,
     page_table: Optional[jax.Array] = None,    # [B, MP] int32
     window: Optional[jax.Array] = None,    # scalar int32; 0 => full attention
     sink: Optional[jax.Array] = None,      # [NH] attention-sink logits
@@ -88,12 +91,14 @@ def chunk_attention(
                     past_len, k[:, 0], v[:, 0], win, sink,
                     win_k=win_k, win_v=win_v, win_len=win_len,
                     kv_chunk=kv_chunk,
+                    k_scale=past_k_scale, v_scale=past_v_scale,
                 )
                 return out[:, None]
         from ..engine.kvcache import gather_kv_layer
 
         past_k, past_v = gather_kv_layer(
-            past_k_pages, past_v_pages, page_table, k.shape[2]
+            past_k_pages, past_v_pages, page_table, k.shape[2],
+            k_scale_l=past_k_scale, v_scale_l=past_v_scale,
         )
 
     if use_pallas:

@@ -55,18 +55,37 @@ def _paged_decode_kernel(
     window_slots: int = 0,
     chunk_pages: int = 1,
     cross_row: bool = False,
+    quantized: bool = False,
 ):
-    if window_slots:
-        (page_table_ref, past_len_ref, window_ref, win_len_ref,
-         q_ref, k_pool_ref, v_pool_ref, k_cur_ref, v_cur_ref,
-         wk_ref, wv_ref, sink_ref,
-         out_ref, kbuf, vbuf, ksem, vsem, m_ref, l_ref, acc_ref) = refs
-    else:
-        (page_table_ref, past_len_ref, window_ref,
-         q_ref, k_pool_ref, v_pool_ref, k_cur_ref, v_cur_ref,
-         sink_ref,
-         out_ref, kbuf, vbuf, ksem, vsem, m_ref, l_ref, acc_ref) = refs
-        win_len_ref = wk_ref = wv_ref = None
+    # ref layout varies with (window_slots, quantized) — walk an index
+    # instead of a per-case tuple unpack
+    it = iter(refs)
+    page_table_ref = next(it)
+    past_len_ref = next(it)
+    window_ref = next(it)
+    win_len_ref = next(it) if window_slots else None
+    q_ref = next(it)
+    k_pool_ref = next(it)
+    v_pool_ref = next(it)
+    ks_pool_ref = next(it) if quantized else None
+    vs_pool_ref = next(it) if quantized else None
+    k_cur_ref = next(it)
+    v_cur_ref = next(it)
+    wk_ref = next(it) if window_slots else None
+    wv_ref = next(it) if window_slots else None
+    sink_ref = next(it)
+    out_ref = next(it)
+    kbuf = next(it)
+    vbuf = next(it)
+    ksem = next(it)
+    vsem = next(it)
+    ksbuf = next(it) if quantized else None
+    vsbuf = next(it) if quantized else None
+    kssem = next(it) if quantized else None
+    vssem = next(it) if quantized else None
+    m_ref = next(it)
+    l_ref = next(it)
+    acc_ref = next(it)
 
     b = pl.program_id(0)
     MP = max_pages_per_seq
@@ -155,6 +174,46 @@ def _paged_decode_kernel(
             vsem.at[slot],
         )
 
+    def _scale_dmas(row, i, slot):
+        # int8 KV: the per-token dequant scales ride their own (tiny)
+        # DMAs — pools arrive pre-shaped [NP, 1, PS] so the fetched
+        # chunk lands lane-major [CH, 1, PS] and each page's scale row
+        # is a legal [1, PS] broadcast against a score slice (merging
+        # sublanes into lanes in-kernel is unsupported)
+        if CH == 1:
+            return (
+                pltpu.make_async_copy(
+                    ks_pool_ref.at[page_table_ref[row * MP + i]],
+                    ksbuf.at[slot, 0],
+                    kssem.at[slot],
+                ),
+                pltpu.make_async_copy(
+                    vs_pool_ref.at[page_table_ref[row * MP + i]],
+                    vsbuf.at[slot, 0],
+                    vssem.at[slot],
+                ),
+            )
+        start = page_table_ref[row * MP] + i * CH
+        return (
+            pltpu.make_async_copy(
+                ks_pool_ref.at[pl.ds(start, CH)],
+                ksbuf.at[slot],
+                kssem.at[slot],
+            ),
+            pltpu.make_async_copy(
+                vs_pool_ref.at[pl.ds(start, CH)],
+                vsbuf.at[slot],
+                vssem.at[slot],
+            ),
+        )
+
+    def _start_chunk(row, i, slot):
+        k_dma(row, i, slot).start()
+        v_dma(row, i, slot).start()
+        if quantized:
+            for dma in _scale_dmas(row, i, slot):
+                dma.start()
+
     def _chunks_of(row):
         return (past_len_ref[row] + CT - 1) // CT
 
@@ -164,9 +223,7 @@ def _paged_decode_kernel(
 
     @pl.when(jnp.logical_and(self_warm, nchunks > 0))
     def _warmup():
-        s0 = _slot(b, 0)
-        k_dma(b, 0, s0).start()
-        v_dma(b, 0, s0).start()
+        _start_chunk(b, 0, _slot(b, 0))
 
     def page_step(i, _):
         slot = _slot(b, i)
@@ -174,11 +231,13 @@ def _paged_decode_kernel(
 
         @pl.when(i + 1 < nchunks)
         def _prefetch_next():
-            k_dma(b, i + 1, nxt).start()
-            v_dma(b, i + 1, nxt).start()
+            _start_chunk(b, i + 1, nxt)
 
         k_dma(b, i, slot).wait()
         v_dma(b, i, slot).wait()
+        if quantized:
+            for dma in _scale_dmas(b, i, slot):
+                dma.wait()
 
         chunk_start = i * CT
         tok = chunk_start + jax.lax.broadcasted_iota(
@@ -198,6 +257,17 @@ def _paged_decode_kernel(
             q_bd, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale                                        # [NH, CT]
+        if quantized:
+            # K dequant folds into the scores: q.(k_int*ks) = (q.k_int)*ks
+            # — one [1, PS] lane-broadcast multiply per page of the
+            # chunk (CH is static), lane-concatenated back to [NH, CT]
+            s = jnp.concatenate(
+                [
+                    s[:, pg * PS : (pg + 1) * PS] * ksbuf[slot, pg]
+                    for pg in range(CH)
+                ],
+                axis=1,
+            )
         s = jnp.where(ok, s, NEG_INF)
 
         m_prev = m_ref[:, 0]                             # [NH]
@@ -206,10 +276,23 @@ def _paged_decode_kernel(
         p = jnp.exp(s - m_new[:, None])                  # [NH, CT]
         l_new = l_ref[:, 0] * alpha + jnp.sum(p, axis=1)
         l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+        if quantized:
+            # V dequant folds into the probabilities for the value dot
+            # ONLY — the normalizer l above sums the true p:
+            # p.(v_int*vs) = (p*vs).v_int
+            pv = jnp.concatenate(
+                [
+                    p[:, pg * PS : (pg + 1) * PS] * vsbuf[slot, pg]
+                    for pg in range(CH)
+                ],
+                axis=1,
+            )
+        else:
+            pv = p
         # acc holds the full [NH, KVH*Dh] product; only each row's own
         # head block is meaningful (extracted at the end)
         acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            pv, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
@@ -229,9 +312,7 @@ def _paged_decode_kernel(
 
         @pl.when(jnp.logical_and(nb < pl.num_programs(0), _chunks_of(nb_c) > 0))
         def _handoff():
-            s0 = _slot(nb, 0)
-            k_dma(nb, 0, s0).start()
-            v_dma(nb, 0, s0).start()
+            _start_chunk(nb, 0, _slot(nb, 0))
 
     # finalize: fused-window tokens + current token + attention sink,
     # in the same block-diagonal space (2 dots total, not 2 per head)
@@ -362,6 +443,10 @@ def paged_decode_attention(
     kv_chunk: int = 1,  # pages per DMA (>1 requires contiguous runs)
     interpret: bool = False,
     cross_row: Optional[bool] = None,  # None => PALLAS_PAGED_XROW
+    # int8 KV mode: pages are int8 and these carry the per-token
+    # dequant scales [NP, PS] f32 (engine/kvcache.py)
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Returns [B, NH, Dh] attention outputs for one decode step.
 
@@ -390,6 +475,7 @@ def paged_decode_attention(
 
     if cross_row is None:
         cross_row = PALLAS_PAGED_XROW
+    quantized = k_scale is not None
     kernel = functools.partial(
         _paged_decode_kernel,
         max_pages_per_seq=MP,
@@ -399,6 +485,7 @@ def paged_decode_attention(
         window_slots=W,
         chunk_pages=kv_chunk,
         cross_row=cross_row,
+        quantized=quantized,
     )
 
     # index maps take *s so the scalar-prefetch arity (3 without a
@@ -407,8 +494,6 @@ def paged_decode_attention(
         pl.BlockSpec((1, NH, Dh), lambda b, *s: (b, 0, 0)),
         pl.BlockSpec(memory_space=pl.ANY),  # K pool stays in HBM
         pl.BlockSpec(memory_space=pl.ANY),  # V pool stays in HBM
-        pl.BlockSpec((1, 1, KD), lambda b, *s: (b, 0, 0)),
-        pl.BlockSpec((1, 1, KD), lambda b, *s: (b, 0, 0)),
     ]
     scalars = [
         page_table.reshape(-1).astype(jnp.int32),
@@ -419,6 +504,23 @@ def paged_decode_attention(
         q,
         k_pages,
         v_pages,
+    ]
+    if quantized:
+        # pre-shaped [NP, 1, PS]: the kernel's scale chunks land
+        # lane-major (see _scale_dmas)
+        in_specs += [
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ]
+        operands += [
+            k_scale.astype(jnp.float32).reshape(NP, 1, PS),
+            v_scale.astype(jnp.float32).reshape(NP, 1, PS),
+        ]
+    in_specs += [
+        pl.BlockSpec((1, 1, KD), lambda b, *s: (b, 0, 0)),
+        pl.BlockSpec((1, 1, KD), lambda b, *s: (b, 0, 0)),
+    ]
+    operands += [
         k_cur.reshape(B, 1, KD),
         v_cur.reshape(B, 1, KD),
     ]
@@ -432,21 +534,32 @@ def paged_decode_attention(
     in_specs.append(pl.BlockSpec((1, NH), lambda b, *s: (0, 0)))
     operands.append(sink_g)
 
+    scratch_shapes = [
+        # K/V double-buffers: [2, chunk, PS, KD]
+        pltpu.VMEM((2, kv_chunk, PS, KD), k_pages.dtype),
+        pltpu.VMEM((2, kv_chunk, PS, KD), v_pages.dtype),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+    ]
+    if quantized:
+        scratch_shapes += [
+            # per-token scale double-buffers, lane-major [.., 1, PS]
+            pltpu.VMEM((2, kv_chunk, 1, PS), jnp.float32),
+            pltpu.VMEM((2, kv_chunk, 1, PS), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ]
+    scratch_shapes += [
+        pltpu.VMEM((NH, 128), jnp.float32),          # m
+        pltpu.VMEM((NH, 128), jnp.float32),          # l
+        pltpu.VMEM((NH, KD), jnp.float32),           # block-diag acc
+    ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=len(scalars),
         grid=(B,),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, NH, Dh), lambda b, *s: (b, 0, 0)),
-        scratch_shapes=[
-            # K/V double-buffers: [2, chunk, PS, KD]
-            pltpu.VMEM((2, kv_chunk, PS, KD), k_pages.dtype),
-            pltpu.VMEM((2, kv_chunk, PS, KD), v_pages.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.VMEM((NH, 128), jnp.float32),          # m
-            pltpu.VMEM((NH, 128), jnp.float32),          # l
-            pltpu.VMEM((NH, KD), jnp.float32),           # block-diag acc
-        ],
+        scratch_shapes=scratch_shapes,
     )
     return pl.pallas_call(
         kernel,
