@@ -77,6 +77,14 @@ class EngineConfig:
                                     # trip is hidden behind device compute
                                     # (scheduler pipelined windows); 1 =
                                     # synchronous (process before dispatch)
+    prefill_piggyback: bool = True  # Sarathi-style chunked-prefill
+                                    # interleave: a long prompt admits as
+                                    # a PREFILLING slot that advances one
+                                    # prefill chunk per scheduler
+                                    # iteration while the active rows
+                                    # keep decoding — instead of the
+                                    # whole batch stalling for the full
+                                    # multi-chunk prefill
     prefix_cache: bool = True       # shared-prefix KV reuse: a job whose
                                     # rows share a common token prefix
                                     # (templates send one system prompt

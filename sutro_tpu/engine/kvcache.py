@@ -250,21 +250,26 @@ def gather_kv_layer(
     kv_heads: int,
     k_scale_l: "jax.Array | None" = None,  # [NP, PS] (int8 KV mode)
     v_scale_l: "jax.Array | None" = None,
+    out_dtype=None,  # dequant target (compute dtype); None => float32
 ) -> Tuple[jax.Array, jax.Array]:
     """Per-layer page gather: [B, MP] table -> ([B, CTX, KVH, Dh]) x2,
     CTX = MP * PS. Used inside the layer scan so only one layer's context
     view is ever live (the XLA fallback when the Pallas paged kernel does
     not run — the kernel reads pages in place and skips this copy).
-    With int8 KV scales the gathered pages are dequantized here."""
+    With int8 KV scales the gathered pages are dequantized here, INTO
+    the caller's compute dtype — a float32 view would quadruple the
+    gathered context's bytes and promote the whole fallback attention
+    to f32, doubling the HBM traffic the int8 cache exists to halve."""
     NP, PS, KD = k_pages_l.shape
     B, MP = page_table.shape
     k = jnp.take(k_pages_l, page_table.reshape(-1), axis=0)
     v = jnp.take(v_pages_l, page_table.reshape(-1), axis=0)
     if k_scale_l is not None:
+        dt = out_dtype or jnp.float32
         ks = jnp.take(k_scale_l, page_table.reshape(-1), axis=0)
         vs = jnp.take(v_scale_l, page_table.reshape(-1), axis=0)
-        k = k.astype(jnp.float32) * ks[..., None]
-        v = v.astype(jnp.float32) * vs[..., None]
+        k = (k.astype(jnp.float32) * ks[..., None]).astype(dt)
+        v = (v.astype(jnp.float32) * vs[..., None]).astype(dt)
     return (
         k.reshape(B, MP * PS, kv_heads, KD // kv_heads),
         v.reshape(B, MP * PS, kv_heads, KD // kv_heads),

@@ -230,6 +230,16 @@ class _Slot:
     job: Optional[JobCtx] = None
     shared_n: int = 0        # leading entries of ``pages`` owned by the
     #                          job's _SharedPrefix (not freed per slot)
+    # Sarathi-style piggybacked prefill (long prompts): the slot is
+    # reserved but advances one prefill chunk per scheduler iteration
+    # (_prefill_tick) while OTHER slots keep decoding; it joins the
+    # decode batch only once the whole prompt is in pages and its first
+    # token is sampled. While prefilling, the slot's dense decode state
+    # routes to the garbage page (its table row stays zero) so the
+    # discarded decode writes can never clobber prefilled positions.
+    prefilling: bool = False
+    prefill_pos: int = 0     # next global position to prefill
+    ptable: Optional[np.ndarray] = None  # real [MP] table for chunks
     out_ids: List[int] = dataclasses.field(default_factory=list)
     logprob_sum: float = 0.0
     # rolling decoded-byte tail for stop-sequence detection (window =
@@ -525,15 +535,7 @@ class ContinuousBatcher:
             ctx.n_slots += 1
             ctx.stats["in"] += len(req.prompt_ids)
             ctx.stats["out"] += 1  # the prefill-sampled first token
-            if req.has_penalties():
-                # repetition scope includes the PROMPT (vLLM/HF)
-                bits = np.zeros((self.vocab + 7) // 8, np.uint8)
-                ids = np.unique(np.asarray(req.prompt_ids, np.int64))
-                ids = ids[(ids >= 0) & (ids < self.vocab)]
-                np.bitwise_or.at(
-                    bits, ids // 8, (0x80 >> (ids % 8)).astype(np.uint8)
-                )
-                slot.seen_bits = bits
+            self._seed_penalty_bits(slot, req)
             self.slots[slot_idx] = slot
             if self.native is not None:
                 self.native.arm_slot(
@@ -541,6 +543,97 @@ class ContinuousBatcher:
                     req.temperature, req.top_p, req.top_k,
                 )
             self._record_token(slot, first, float(logp))
+
+    def _seed_penalty_bits(self, slot: _Slot, req: GenRequest) -> None:
+        if req.has_penalties():
+            # repetition scope includes the PROMPT (vLLM/HF)
+            bits = np.zeros((self.vocab + 7) // 8, np.uint8)
+            ids = np.unique(np.asarray(req.prompt_ids, np.int64))
+            ids = ids[(ids >= 0) & (ids < self.vocab)]
+            np.bitwise_or.at(
+                bits, ids // 8, (0x80 >> (ids % 8)).astype(np.uint8)
+            )
+            slot.seen_bits = bits
+
+    def _admit_prefilling(
+        self, req: GenRequest, ctx: JobCtx, slot_idx: int, pages, table
+    ) -> None:
+        """Arm a PREFILLING slot: pages reserved, no device work yet.
+        ``_prefill_tick`` advances it one chunk per scheduler iteration;
+        the decode batch keeps running in between (the Sarathi
+        observation: a long admit must degrade active rows' cadence by
+        a bounded fraction, not pause them for the whole prefill)."""
+        pfx = ctx.prefix
+        shared = pfx.tokens if pfx is not None else 0
+        full = np.array(table, np.int32, copy=True)
+        slot = _Slot(
+            req=req,
+            pages=(list(pfx.pages) + list(pages)) if pfx else pages,
+            pos=shared,
+            last_token=0,
+            job=ctx,
+            shared_n=pfx.n_pages if pfx else 0,
+            prefilling=True,
+            prefill_pos=shared,
+            ptable=full,
+        )
+        self.slots[slot_idx] = slot
+        ctx.n_slots += 1
+        if self.native is not None:
+            # while prefilling, the slot's DENSE table row routes the
+            # (discarded) decode writes to the garbage page — they must
+            # never clobber already-prefilled positions. The real table
+            # lives on slot.ptable for the chunk dispatches and is
+            # restored at activation.
+            self.native.table[slot_idx, :] = 0
+
+    def _prefill_tick(self) -> None:
+        """Advance the lowest-index prefilling slot by ONE chunk; on the
+        final chunk, sample its first token and join the decode batch."""
+        i = next(
+            (
+                j
+                for j, s in enumerate(self.slots)
+                if s is not None and s.prefilling
+            ),
+            None,
+        )
+        if i is None:
+            return
+        s = self.slots[i]
+        req = s.req
+        C = self.ecfg.prefill_chunk
+        seg = req.prompt_ids[s.prefill_pos : s.prefill_pos + C]
+        with self.timer.time("prefill"):
+            logits = self.runner.prefill_batch_at(
+                [np.asarray(seg, np.int32)],
+                s.ptable[None, :],
+                [s.prefill_pos],
+            )
+        self.prefill_tokens += len(seg)
+        s.prefill_pos += len(seg)
+        if s.prefill_pos < len(req.prompt_ids):
+            return
+        # last chunk: sample the first token and activate
+        toks, logps = self._sample_batch(logits, [req], [i])
+        first = int(toks[0])
+        if self.native is not None:
+            row = self.native.table[i]
+            row[:] = 0
+            row[: len(s.pages)] = s.pages
+            self.native.arm_slot(
+                i, len(req.prompt_ids), first,
+                req.temperature, req.top_p, req.top_k,
+            )
+        s.prefilling = False
+        s.ptable = None
+        s.pos = len(req.prompt_ids)
+        s.last_token = first
+        self._seed_penalty_bits(s, req)
+        if s.job is not None:
+            s.job.stats["in"] += len(req.prompt_ids)
+            s.job.stats["out"] += 1  # the prefill-sampled first token
+        self._record_token(s, first, float(logps[0]))
 
     def _pad_mask(self, mask: np.ndarray) -> np.ndarray:
         """Constraint masks are sized to the *tokenizer* vocab; pad to the
@@ -1054,6 +1147,33 @@ class ContinuousBatcher:
                     len(req.prompt_ids) - shared
                     > self.ecfg.prefill_chunk
                 )
+                if (
+                    is_long
+                    and getattr(self.ecfg, "prefill_piggyback", True)
+                    # the chunked paged-prefill program has no ring/
+                    # pipeline wrapper (same gate as _setup_prefix and
+                    # runner.prefill's start>0 assert) — under sp/pp,
+                    # long rows keep the stop-the-world full-sequence
+                    # path below
+                    and getattr(self.runner, "sp", 1) == 1
+                    and getattr(self.runner, "pp", 1) == 1
+                ):
+                    if batch:
+                        break  # flush the short-row batch first
+                    r = self._reserve(
+                        req, ctx, reserved=reserved_tokens,
+                        exclude=reserved_idxs,
+                    )
+                    if r is None:
+                        break
+                    ctx.pending.pop()
+                    # Sarathi-style: reserve now, prefill ONE chunk per
+                    # scheduler iteration (_prefill_tick) so active rows
+                    # keep decoding instead of stalling for the whole
+                    # multi-chunk prefill
+                    self._admit_prefilling(req, ctx, *r)
+                    admitted = True
+                    continue
                 if is_long and batch:
                     break  # flush the short-row batch first
                 r = self._reserve(
@@ -1130,25 +1250,37 @@ class ContinuousBatcher:
                     ajobs, key=lambda c: (c.priority, c.seq)
                 )
                 admitted = self._admit_pending(order)
+                # one chunk of piggybacked prefill per iteration: long
+                # admits advance while the decode batch below keeps its
+                # cadence (bounded degradation, never a pause)
+                self._prefill_tick()
                 # Immediately-finished rows (e.g. first token was stop).
                 for i, s in enumerate(self.slots):
-                    if s is not None and self._finish_reason(
-                        s, s.last_token
+                    if (
+                        s is not None
+                        and not s.prefilling
+                        and self._finish_reason(s, s.last_token)
                     ):
                         self._emit(i)
                 self._sweep_done(live, on_job_done)
                 active = [
-                    i for i, s in enumerate(self.slots) if s is not None
+                    i
+                    for i, s in enumerate(self.slots)
+                    if s is not None and not s.prefilling
                 ]
                 if not active:
                     ajobs = [c for c in live if not c.done]
                     if not ajobs:
                         break
-                    if not admitted:
+                    if not admitted and not any(
+                        s is not None for s in self.slots
+                    ):
                         # The head row can never fit an EMPTY machine
                         # (prompt+max_new exceeds total KV capacity).
                         # Fail that one row and keep the session going —
-                        # one bad row must not fail its whole job.
+                        # one bad row must not fail its whole job. (A
+                        # PREFILLING slot means the machine is NOT empty
+                        # — the row may fit once it completes.)
                         ctx = next(
                             (c for c in order if not c.done and c.pending),
                             None,

@@ -99,6 +99,7 @@ def chunk_attention(
         past_k, past_v = gather_kv_layer(
             past_k_pages, past_v_pages, page_table, k.shape[2],
             k_scale_l=past_k_scale, v_scale_l=past_v_scale,
+            out_dtype=q.dtype,
         )
 
     if use_pallas:

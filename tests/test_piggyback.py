@@ -1,0 +1,176 @@
+"""Sarathi-style piggybacked prefill (EngineConfig.prefill_piggyback,
+VERDICT r3 next-step 5): a long prompt admits as a PREFILLING slot that
+advances one chunk per scheduler iteration while active rows keep
+decoding — bounded cadence degradation instead of a full pause — and
+produces bit-identical outputs to the stop-the-world path."""
+
+import numpy as np
+import pytest
+
+from sutro_tpu.engine.config import EngineConfig
+from sutro_tpu.engine.runner import ModelRunner
+from sutro_tpu.engine.scheduler import ContinuousBatcher, GenRequest
+from sutro_tpu.models.configs import MODEL_CONFIGS
+
+
+def _ecfg(**kw):
+    base = dict(
+        kv_page_size=8, max_pages_per_seq=32, decode_batch_size=4,
+        max_model_len=256, use_pallas=False, param_dtype="float32",
+        activation_dtype="float32", prefill_chunk=16,
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+LONG = "this is a deliberately long prompt " * 4  # ~140 bytes > 8 chunks
+SHORTS = ["quick a", "quick b", "quick c"]
+
+
+def _reqs(tok, texts, **kw):
+    return [
+        GenRequest(
+            row_id=i,
+            prompt_ids=np.array(tok.encode(t), np.int32),
+            **kw,
+        )
+        for i, t in enumerate(texts)
+    ]
+
+
+def _run(ecfg, tok, reqs):
+    runner = ModelRunner(MODEL_CONFIGS["tiny-dense"], ecfg)
+    b = ContinuousBatcher(runner, stop_ids=tok.stop_ids())
+    res = {}
+    out = b.run(reqs, on_result=lambda r: res.__setitem__(r.row_id, r))
+    assert out == "completed"
+    return runner, b, res
+
+
+def test_decode_continues_while_long_prompt_prefills(byte_tok):
+    """The acceptance test the VERDICT asked for: decode dispatches for
+    active rows appear BETWEEN the long row's prefill chunks instead of
+    after all of them."""
+    runner = ModelRunner(MODEL_CONFIGS["tiny-dense"], _ecfg())
+    events = []
+
+    orig_chunk = runner.prefill_batch_at
+    orig_multi = runner.decode_multi_async
+    orig_window = runner.decode_window
+    orig_step = runner.decode_step
+
+    def spy(name, fn):
+        def wrapped(*a, **k):
+            events.append(name)
+            return fn(*a, **k)
+
+        return wrapped
+
+    runner.prefill_batch_at = spy("chunk", orig_chunk)
+    runner.decode_multi_async = spy("decode", orig_multi)
+    runner.decode_window = spy("decode", orig_window)
+    runner.decode_step = spy("decode", orig_step)
+
+    b = ContinuousBatcher(runner, stop_ids=byte_tok.stop_ids())
+    # shorts decode for a while; the long row admits alongside them
+    reqs = _reqs(
+        byte_tok, SHORTS + [LONG], max_new_tokens=30, temperature=0.0
+    )
+    res = {}
+    out = b.run(reqs, on_result=lambda r: res.__setitem__(r.row_id, r))
+    assert out == "completed"
+    assert set(res) == {0, 1, 2, 3}
+    chunk_idx = [i for i, e in enumerate(events) if e == "chunk"]
+    assert len(chunk_idx) >= 2, "long prompt did not chunk"
+    interleaved = [
+        e
+        for e in events[chunk_idx[0] : chunk_idx[-1]]
+        if e == "decode"
+    ]
+    assert interleaved, (
+        "no decode dispatch between prefill chunks — the batch stalled "
+        f"for the whole prefill: {events[:40]}"
+    )
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_outputs_identical_piggyback_on_off(byte_tok, monkeypatch, native):
+    """Greedy outputs are bit-identical with piggybacked and
+    stop-the-world prefill, on both runtime paths."""
+    from sutro_tpu.engine import native_runtime
+
+    if native and not native_runtime.is_available():
+        pytest.skip("native toolchain unavailable")
+    monkeypatch.setenv("SUTRO_NATIVE_RUNTIME", "1" if native else "0")
+    native_runtime._lib = None
+    native_runtime._lib_failed = False
+    try:
+        texts = SHORTS + [LONG, "middle sized prompt right here ok"]
+        kw = dict(max_new_tokens=12, temperature=0.0)
+        _, b_on, on = _run(
+            _ecfg(prefill_piggyback=True), byte_tok,
+            _reqs(byte_tok, texts, **kw),
+        )
+        assert (b_on.native is not None) == native
+        _, _, off = _run(
+            _ecfg(prefill_piggyback=False), byte_tok,
+            _reqs(byte_tok, texts, **kw),
+        )
+        assert set(on) == set(off)
+        for i in on:
+            assert on[i].token_ids == off[i].token_ids, i
+        assert b_on.free_page_count == (
+            b_on.native.free_count if native else b_on.allocator.free_count
+        )
+    finally:
+        native_runtime._lib = None
+        native_runtime._lib_failed = False
+
+
+def test_piggyback_with_shared_prefix(byte_tok):
+    """A job with a shared prefix AND long suffixes: chunks start at
+    the shared offset; outputs equal the non-piggyback run."""
+    prefix = "SHARED JOB SHELL PROMPT: analyse the following text: "
+    texts = [
+        prefix + "short tail",
+        prefix + "another short",
+        prefix + ("long tail segment " * 6),
+    ]
+    kw = dict(max_new_tokens=10, temperature=0.0)
+    _, b_on, on = _run(
+        _ecfg(prefill_piggyback=True), byte_tok,
+        _reqs(byte_tok, texts, **kw),
+    )
+    _, _, off = _run(
+        _ecfg(prefill_piggyback=False), byte_tok,
+        _reqs(byte_tok, texts, **kw),
+    )
+    for i in on:
+        assert on[i].token_ids == off[i].token_ids, i
+    # the shared prefix engaged (prefill accounting: prefix once)
+    assert b_on.prefill_tokens < sum(
+        len(byte_tok.encode(t)) for t in texts
+    )
+
+
+def test_cancel_while_prefilling_frees_pages(byte_tok):
+    """Cancelling mid-prefill releases the prefilling slot's pages and
+    emits the row as cancelled."""
+    runner = ModelRunner(MODEL_CONFIGS["tiny-dense"], _ecfg())
+    b = ContinuousBatcher(runner, stop_ids=byte_tok.stop_ids())
+    before = b.free_page_count
+    calls = [0]
+
+    def cancel():
+        calls[0] += 1
+        return calls[0] > 3
+
+    res = {}
+    out = b.run(
+        _reqs(byte_tok, [LONG, LONG + " two"], max_new_tokens=40),
+        on_result=lambda r: res.__setitem__(r.row_id, r),
+        should_cancel=cancel,
+    )
+    assert out == "cancelled"
+    assert b.free_page_count == before
+    assert all(r.finish_reason == "cancelled" for r in res.values())
