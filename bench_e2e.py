@@ -136,6 +136,10 @@ def main() -> None:
     # engine-wide default
     if os.environ.get("SUTRO_E2E_MULTI"):
         ecfg["decode_multi_step"] = int(os.environ["SUTRO_E2E_MULTI"])
+    # n-gram speculative decoding A/B (greedy workloads; scheduler
+    # path, so the A/B belongs here rather than bench.py's raw loop)
+    if os.environ.get("SUTRO_E2E_SPEC"):
+        ecfg["spec_ngram_draft"] = int(os.environ["SUTRO_E2E_SPEC"])
 
     os.environ.setdefault("SUTRO_HOME", "/tmp/sutro-bench-e2e")
     from sutro_tpu.sdk import Sutro

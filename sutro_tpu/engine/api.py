@@ -1432,6 +1432,16 @@ class _GenSession:
                 for o in ordered["outputs"]
             )
         )
+        perf = dict(batcher.timer.summary())
+        drafted = self.ctx.stats.get("spec_drafted", 0)
+        if drafted:
+            # n-gram speculative acceptance rate (the VERDICT's metric)
+            accepted = self.ctx.stats.get("spec_accepted", 0)
+            perf["spec_ngram"] = {
+                "drafted": drafted,
+                "accepted": accepted,
+                "acceptance_rate": round(accepted / drafted, 3),
+            }
         self.eng.jobs.update(
             self.job_id,
             input_tokens=self.input_tokens,
@@ -1439,7 +1449,7 @@ class _GenSession:
             job_cost=estimate_cost(
                 self.engine_key, self.input_tokens, output_tokens
             ),
-            perf=batcher.timer.summary(),
+            perf=perf,
         )
         self.jm.progress(rec.num_rows)
         self.eng.jobs.finalize_results(self.job_id, ordered)

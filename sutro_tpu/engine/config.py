@@ -77,6 +77,21 @@ class EngineConfig:
                                     # trip is hidden behind device compute
                                     # (scheduler pipelined windows); 1 =
                                     # synchronous (process before dispatch)
+    spec_ngram_draft: int = 0       # >0 enables prompt-lookup (n-gram)
+                                    # speculative decoding for plain
+                                    # GREEDY unconstrained rows: draft up
+                                    # to this many tokens from the row's
+                                    # own prompt/output history and
+                                    # verify them in ONE parallel forward
+                                    # (classify rationales echo prompt
+                                    # text heavily). Exact for greedy.
+                                    # Default OFF: the verify path is
+                                    # host-synchronous, so under a
+                                    # high-RTT tunnel the pipelined
+                                    # fused windows win unless the
+                                    # acceptance rate is high — flip
+                                    # per the chip A/B (bench_e2e
+                                    # SUTRO_E2E_SPEC)
     prefill_piggyback: bool = True  # Sarathi-style chunked-prefill
                                     # interleave: a long prompt admits as
                                     # a PREFILLING slot that advances one

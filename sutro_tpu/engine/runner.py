@@ -701,6 +701,69 @@ class ModelRunner:
         )
         return toks, logps
 
+    # ------------------------------------------------------------------
+    # n-gram speculative verification (greedy prompt-lookup decoding)
+    # ------------------------------------------------------------------
+
+    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+    def _verify_jit(
+        self, params, cache: KVCache, ids, valid_len, page_table, start
+    ):
+        """One parallel forward over ``[B, 1+K]`` tokens (each row's
+        last token + its n-gram draft) against the paged past: returns
+        the per-position GREEDY tokens and their logprobs. Device-side
+        argmax keeps the [B, C, V] logits tensor off the host link.
+        All input positions' K/V are written to pages — rejected
+        positions become dead stores beyond the row's accepted ``pos``
+        (masked by past_len, overwritten as decode proceeds)."""
+        B, C = ids.shape
+        positions = start[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
+        logits, _, (k, v) = transformer.forward(
+            self.mcfg, params, ids, positions, valid_len,
+            paged_past=self._paged(cache, page_table),
+            past_len=start,
+            use_pallas=self.use_pallas,
+            ep_mesh=self.ep_mesh,
+        )
+        cache = write_kv(
+            cache, k, v, page_table, start, valid_len,
+            use_pallas=self.use_pallas,
+        )
+        lg = logits.astype(jnp.float32)
+        toks = jnp.argmax(lg, axis=-1)                         # [B, C]
+        logp = jnp.take_along_axis(
+            jax.nn.log_softmax(lg, axis=-1), toks[..., None], axis=-1
+        )[..., 0]
+        return toks.astype(jnp.int32), logp, cache
+
+    def verify_greedy(
+        self,
+        last_tokens: np.ndarray,   # [B] int32
+        drafts: np.ndarray,        # [B, K] int32 (pad anything)
+        draft_len: np.ndarray,     # [B] int32 — valid draft tokens
+        past_len: np.ndarray,      # [B] int32
+        page_table: np.ndarray,    # [B, MP] int32
+    ):
+        """Greedy verification dispatch: row b's inputs are
+        ``[last, d0..d_{L-1}]`` (L = draft_len[b]); position t's output
+        is the model's next token AFTER input t. The scheduler accepts
+        the longest matching draft prefix plus the standard bonus token
+        at the first mismatch. Rows with draft_len 0 just take a plain
+        greedy step (their padding positions carry valid_len)."""
+        B, K = drafts.shape
+        ids = np.zeros((B, K + 1), np.int32)
+        ids[:, 0] = last_tokens
+        ids[:, 1:] = drafts
+        toks, logp, self.cache = self._verify_jit(
+            self.params,
+            self.cache,
+            jnp.asarray(ids),
+            jnp.asarray(draft_len + 1, jnp.int32),
+            jnp.asarray(page_table, jnp.int32),
+            jnp.asarray(past_len, jnp.int32),
+        )
+        return np.asarray(toks), np.asarray(logp)
+
     @functools.partial(jax.jit, static_argnums=(0,))
     def _merge_last_jit(self, prev_last, refresh_mask, refresh_vals):
         """Device-side merge for pipelined windows: rows whose slot was
