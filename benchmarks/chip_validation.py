@@ -39,6 +39,9 @@ RESULTS: list = []
 def run_case(name: str, argv: list, env: dict, timeout: int = 1500):
     t0 = time.monotonic()
     e = dict(os.environ)
+    # children under benchmarks/ get benchmarks/ as sys.path[0]; make
+    # the repo root importable regardless of how this queue was invoked
+    e["PYTHONPATH"] = str(REPO) + os.pathsep + e.get("PYTHONPATH", "")
     e.update(env)
     try:
         p = subprocess.run(
