@@ -317,6 +317,18 @@ class ContinuousBatcher:
         # prompt-lookup path; rate = accepted / drafted)
         self.spec_drafted = 0
         self.spec_accepted = 0
+        # next step at which the n-gram speculative path may probe;
+        # bumped with exponential backoff on failed probes / poor
+        # acceptance so the pipelined windows keep RTT hidden between
+        # attempts
+        self._spec_probe_step = 0
+        self._spec_backoff = 0
+        # rolling acceptance window: engagement is decided by draft
+        # COVERAGE, but staying engaged requires the accepted tokens to
+        # actually beat a plain step (exit when the window's acceptance
+        # rate drops below 1/SN, i.e. < ~1 extra token per row-step)
+        self._spec_win_drafted = 0
+        self._spec_win_accepted = 0
         # shared-prefix KV reuse (one per run; see _setup_prefix)
         self._prefix: Optional[_SharedPrefix] = None
         # tokens actually sent through a prefill program this run —
@@ -692,22 +704,57 @@ class ContinuousBatcher:
         d = h[j + 2 : j + 2 + K]
         return np.asarray(d, np.int32) if d else None
 
+    def _spec_fail_backoff(self) -> None:
+        """Push the next speculative probe out with exponential backoff
+        (4..64 window lengths): batches that never draft — or draft but
+        never accept — settle into long pipelined stretches with only
+        rare, cheap probes instead of paying a recurring drain bubble."""
+        KS = max(self.ecfg.decode_multi_step, 1)
+        self._spec_backoff = min(
+            max(self._spec_backoff * 2, 4 * KS), 64 * KS
+        )
+        self._spec_probe_step = self._step + self._spec_backoff
+        # a disengagement ends the acceptance window: the next
+        # engagement's exit decision must not be skewed by stale counts
+        self._spec_win_drafted = 0
+        self._spec_win_accepted = 0
+
+    def _spec_coverage_ok(self, active) -> bool:
+        """THE engagement rule (shared by the in-loop pre-check and
+        _spec_ngram_step so the threshold cannot drift between copies):
+        at least half the active rows can draft right now."""
+        SN = self.ecfg.spec_ngram_draft
+        n = sum(
+            1
+            for i in active
+            if self._ngram_draft(self.slots[i], SN) is not None
+        )
+        return 2 * n >= len(active)
+
     def _spec_ngram_step(self, active, last, past_len, table) -> bool:
         """One prompt-lookup speculative step for an all-greedy batch:
-        every active row drafted, so verify all drafts in ONE parallel
-        forward and accept each row's longest matching prefix plus the
-        standard bonus token at the first mismatch (>= 1 token per row,
-        up to K+1 — exact greedy either way). Returns False when some
-        row has no draft (caller falls through to fused windows)."""
+        verify every drafting row's tokens in ONE parallel forward and
+        accept each row's longest matching prefix plus the standard
+        bonus token at the first mismatch (>= 1 token per row, up to
+        K+1 — exact greedy either way). Rows with no draft this step
+        ride along as draft_len-0 plain greedy steps (verify_greedy
+        supports them natively), so one draftless row cannot disable
+        speculation for the rest of the batch. Returns False — caller
+        falls back to fused windows — only when fewer than half the
+        active rows draft: the verify dispatch is host-synchronous, so
+        at low draft coverage the RTT-hiding pipelined windows win."""
+        if not self._spec_coverage_ok(active):
+            return False
         SN = self.ecfg.spec_ngram_draft
         drafts = np.zeros((self.B, SN), np.int32)
         dlens = np.zeros((self.B,), np.int32)
         for i in active:
             d = self._ngram_draft(self.slots[i], SN)
             if d is None:
-                return False
+                continue
             drafts[i, : len(d)] = d
             dlens[i] = len(d)
+        d0, a0 = self.spec_drafted, self.spec_accepted
         with self.timer.time("decode"):
             toks_v, logp_v = self.runner.verify_greedy(
                 np.asarray(last, np.int32), drafts, dlens,
@@ -740,6 +787,19 @@ class ContinuousBatcher:
                     # mismatch was consumed — later positions are
                     # conditioned on a rejected prefix
                     break
+        # acceptance-based exit (coverage got us here; acceptance keeps
+        # us here): once the rolling window has seen enough drafts,
+        # leave the host-synchronous spec path unless it beats a plain
+        # step (>= 1 accepted token per SN drafted, i.e. rate >= 1/SN)
+        self._spec_win_drafted += self.spec_drafted - d0
+        self._spec_win_accepted += self.spec_accepted - a0
+        if self._spec_win_drafted >= 8 * SN:
+            if self._spec_win_accepted * SN < self._spec_win_drafted:
+                self._spec_fail_backoff()
+            else:
+                self._spec_backoff = 0
+            self._spec_win_drafted = 0
+            self._spec_win_accepted = 0
         return True
 
     def _pad_mask(self, mask: np.ndarray) -> np.ndarray:
@@ -1459,16 +1519,22 @@ class ContinuousBatcher:
 
                 # Prompt-lookup speculative decoding (opt-in,
                 # spec_ngram_draft > 0): when the whole batch is plain
-                # greedy, NO windows are in flight, and every row
-                # drafts from its own history, verify all drafts in one
-                # parallel forward — up to K+1 tokens per row per
-                # dispatch vs the fused window's K sequential steps.
-                # Host-synchronous, so the pipelined windows below win
-                # under a high-RTT tunnel unless acceptance is high
-                # (chip A/B: bench_e2e SUTRO_E2E_SPEC).
-                if (
+                # greedy and no windows are in flight, verify rows'
+                # n-gram drafts in one parallel forward — up to K+1
+                # tokens per row per dispatch vs the fused window's K
+                # sequential steps. Host-synchronous, so the pipelined
+                # windows below win under a high-RTT tunnel unless
+                # draft coverage is decent (chip A/B: bench_e2e
+                # SUTRO_E2E_SPEC). While a probe is pending the
+                # pipeline refill below is suspended so the pipe can
+                # DRAIN — a standing `not pipe` requirement against an
+                # always-refilled pipe would lock speculation out
+                # permanently after its first miss; a failed probe
+                # backs off a few window lengths and pipelining
+                # resumes at full lookahead in the meantime.
+                spec_probe = (
                     getattr(self.ecfg, "spec_ngram_draft", 0) > 0
-                    and not pipe
+                    and self._step >= self._spec_probe_step
                     and not has_constraint
                     and not has_row_seed
                     and not has_penalty
@@ -1480,15 +1546,27 @@ class ContinuousBatcher:
                         self.slots[i].req.temperature <= 0.0
                         for i in active
                     )
-                    and self._spec_ngram_step(
+                )
+                if spec_probe and pipe:
+                    # host-only coverage pre-check BEFORE paying the
+                    # pipeline drain: if the engagement rule fails right
+                    # now, fail the probe in place and keep the pipe
+                    # full — no drain bubble for batches that never
+                    # draft
+                    if not self._spec_coverage_ok(active):
+                        self._spec_fail_backoff()
+                        spec_probe = False
+                if spec_probe and not pipe:
+                    if self._spec_ngram_step(
                         active, last, past_len, table
-                    )
-                ):
-                    self._sweep_done(live, on_job_done)
-                    for ctx in live:
-                        if not ctx.done:
-                            self._job_progress(ctx)
-                    continue
+                    ):
+                        self._sweep_done(live, on_job_done)
+                        for ctx in live:
+                            if not ctx.done:
+                                self._job_progress(ctx)
+                        continue
+                    self._spec_fail_backoff()
+                    spec_probe = False
 
                 # Pipelined fused windows: when no row needs host work
                 # between steps, window k+1 is dispatched chained off
@@ -1510,7 +1588,10 @@ class ContinuousBatcher:
                     and not self._needs_mask
                 )
                 if pipe_ok or pipe:
-                    if pipe_ok:
+                    # a pending spec probe suspends refill so the pipe
+                    # drains (one window per iteration) and the probe
+                    # above gets its `not pipe` opening
+                    if pipe_ok and not spec_probe:
                         while len(pipe) < self.ecfg.decode_lookahead:
                             proj = self._pipe_projection(pipe)
                             if not self._pipe_capacity_ok(

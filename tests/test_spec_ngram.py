@@ -164,6 +164,105 @@ def test_mixed_draftless_rows_fall_through(byte_tok):
         assert on[i].token_ids == off[i].token_ids, i
 
 
+def test_partial_draft_coverage_engages(byte_tok, monkeypatch):
+    """One draftless row must NOT disable speculation for the batch:
+    with >= half the active rows drafting, the verify dispatch runs and
+    the draftless row rides along as a plain greedy step (draft_len 0).
+    Outputs stay bit-identical to the plain path either way."""
+    from sutro_tpu.engine.scheduler import ContinuousBatcher
+
+    def stub(self, s, K):
+        if s.req.row_id == 0:
+            return None  # permanently draftless row
+        cap = len(s.pages) * self.ecfg.kv_page_size - s.pos - 1
+        K = min(K, cap)
+        if K < 1:
+            return None
+        hist = list(s.req.prompt_ids) + list(s.out_ids)
+        return np.asarray(hist[-K:], np.int32)
+
+    monkeypatch.setattr(ContinuousBatcher, "_ngram_draft", stub)
+    kw = dict(max_new_tokens=16, temperature=0.0)
+    b_on, on = _run(
+        _ecfg(spec_ngram_draft=6), byte_tok, _reqs(byte_tok, **kw)
+    )
+    assert b_on.spec_drafted > 0, "2/3 drafting rows must engage"
+    monkeypatch.undo()
+    _, off = _run(_ecfg(), byte_tok, _reqs(byte_tok, **kw))
+    assert set(on) == set(off)
+    for i in on:
+        assert on[i].token_ids == off[i].token_ids, i
+        assert on[i].finish_reason == off[i].finish_reason
+
+
+def test_failed_first_probe_does_not_lock_out(byte_tok, monkeypatch):
+    """Regression: the pipelined-window queue refills to lookahead and
+    drains one per iteration, so a standing `not pipe` gate would never
+    re-open after one failed probe. The probe/backoff scheme must let a
+    later probe drain the pipe and engage once drafts appear."""
+    from sutro_tpu.engine.scheduler import ContinuousBatcher
+
+    def stub(self, s, K):
+        if self._step < 10:
+            return None  # no drafts early: first probe (step 0) fails
+        cap = len(s.pages) * self.ecfg.kv_page_size - s.pos - 1
+        K = min(K, cap)
+        if K < 1:
+            return None
+        hist = list(s.req.prompt_ids) + list(s.out_ids)
+        return np.asarray(hist[-K:], np.int32)
+
+    monkeypatch.setattr(ContinuousBatcher, "_ngram_draft", stub)
+    kw = dict(max_new_tokens=64, temperature=0.0)
+    ecfg = _ecfg(
+        spec_ngram_draft=6, decode_multi_step=4, decode_lookahead=2
+    )
+    b_on, on = _run(ecfg, byte_tok, _reqs(byte_tok, **kw))
+    assert b_on.spec_drafted > 0, (
+        "speculation locked out after a failed first probe"
+    )
+    monkeypatch.undo()
+    _, off = _run(
+        _ecfg(decode_multi_step=4, decode_lookahead=2),
+        byte_tok,
+        _reqs(byte_tok, **kw),
+    )
+    for i in on:
+        assert on[i].token_ids == off[i].token_ids, i
+
+
+def test_poor_acceptance_backs_off(byte_tok, monkeypatch):
+    """Coverage engages the spec path, but ACCEPTANCE keeps it there:
+    drafts that never match must trip the rolling-window exit (backoff
+    set) instead of pinning the run on the host-synchronous verify
+    dispatch, and outputs stay exact throughout."""
+    from sutro_tpu.engine.scheduler import ContinuousBatcher
+
+    def stub(self, s, K):
+        cap = len(s.pages) * self.ecfg.kv_page_size - s.pos - 1
+        K = min(K, cap)
+        if K < 1:
+            return None
+        rng = np.random.default_rng(s.req.row_id * 7919 + s.pos)
+        return rng.integers(
+            1, self.runner.mcfg.vocab_size - 1, K
+        ).astype(np.int32)
+
+    monkeypatch.setattr(ContinuousBatcher, "_ngram_draft", stub)
+    kw = dict(max_new_tokens=32, temperature=0.0)
+    b_on, on = _run(
+        _ecfg(spec_ngram_draft=6), byte_tok, _reqs(byte_tok, **kw)
+    )
+    assert b_on.spec_drafted > 0
+    assert b_on._spec_backoff > 0, (
+        "near-zero acceptance never triggered the exit"
+    )
+    monkeypatch.undo()
+    _, off = _run(_ecfg(), byte_tok, _reqs(byte_tok, **kw))
+    for i in on:
+        assert on[i].token_ids == off[i].token_ids, i
+
+
 def test_engine_perf_records_acceptance_rate(tiny_ecfg, tmp_path, monkeypatch):
     """Job metrics carry the acceptance counters (the VERDICT's ask)."""
     import dataclasses
