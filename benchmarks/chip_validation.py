@@ -4,20 +4,21 @@ point, each case in its own subprocess so a hang or OOM cannot take the
 whole queue down.
 
 Cases (in order — benches FIRST so a tunnel drop mid-queue still leaves
-the headline numbers; the compile-heavy numerics check runs after them
-with a budget that survives a loaded host):
+the headline numbers; the compile-heavy numerics check runs LAST
+because its SIGKILL-at-timeout once wedged the tunnel and aborted every
+case queued behind it):
   1. bench B=64  (baseline, then SUTRO_KV_XROW=1)
   2. bench B=128 (both xrow settings)
   3. bench B=256
   4. MULTI sweep {8} at the best batch so far
-  5. numerics  — chip_numerics_check.py (Pallas vs jnp greedy tokens)
-  6. sampling sweep (sweep_sampling.py: f32 vs bf16 x batch x mode)
-  7. bench at the best batch with SUTRO_LOGITS_BF16=1 (A/B the gated
+  5. sampling sweep (sweep_sampling.py: f32 vs bf16 x batch x mode)
+  6. bench at the best batch with SUTRO_LOGITS_BF16=1 (A/B the gated
      bf16 sampling path end-to-end)
-  8. bench at the best batch with SUTRO_BENCH_KV_QUANT=int8 (A/B the
+  7. bench at the best batch with SUTRO_BENCH_KV_QUANT=int8 (A/B the
      int8 KV cache: halved decode HBM traffic)
-  9. bench_8b.py (qwen3-4b bf16/int8 + llama-3.1-8b int8, HBM
+  8. bench_8b.py (qwen3-4b bf16/int8 + llama-3.1-8b int8, HBM
      roofline fractions -> BENCH_8B.json)
+  9. numerics — chip_numerics_check.py (Pallas vs jnp greedy tokens)
 
 Writes CHIP_VALIDATION.json (list of case records incl. stdout tails)
 and prints one line per case. A dead tunnel shows up as rc=124
@@ -118,8 +119,6 @@ def main() -> None:
         f"bench_b{best_b}_multi8", [py, "bench.py"],
         {"SUTRO_BENCH_BATCH": best_b, "SUTRO_BENCH_MULTI": "8"},
     )
-    run_case("numerics", [py, "benchmarks/chip_numerics_check.py"], {},
-             timeout=3000)
     run_case(
         "sweep_sampling", [py, "benchmarks/sweep_sampling.py"], {},
         timeout=2400,
@@ -140,6 +139,11 @@ def main() -> None:
     run_case(
         "bench_8b", [py, "benchmarks/bench_8b.py"], {}, timeout=12000
     )
+    # numerics LAST: the one observed tunnel-wedge came from this case's
+    # compile-heavy two-path run being SIGKILLed at timeout, which then
+    # aborted every case behind it — nothing may queue behind it now
+    run_case("numerics", [py, "benchmarks/chip_numerics_check.py"], {},
+             timeout=3000)
     print(json.dumps({"chip_validation": "written"}), flush=True)
 
 

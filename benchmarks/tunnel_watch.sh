@@ -1,8 +1,9 @@
 #!/bin/bash
 # Probe the axon TPU tunnel every 15 min; the moment it answers, run the
-# chip-validation queue once and exit. A downed tunnel makes the first
-# backend touch hang forever inside a C call, so each probe is hard-killed
-# on timeout (a killed probe holds no tunnel state — it never connected).
+# full chip-evidence day (benchmarks/chip_day.sh) once and exit. A downed
+# tunnel makes the first backend touch hang forever inside a C call, so
+# each probe is hard-killed on timeout (a killed probe holds no tunnel
+# state — it never connected).
 #
 # Usage: nohup bash benchmarks/tunnel_watch.sh >/dev/null 2>&1 &
 cd "$(dirname "$0")/.." || exit 1
@@ -11,9 +12,9 @@ while true; do
   if timeout -k 10 120 python -c \
     "import jax; jax.devices(); import jax.numpy as jnp; (jnp.ones((128,128),jnp.bfloat16)@jnp.ones((128,128),jnp.bfloat16)).block_until_ready()" \
     >/dev/null 2>&1; then
-    echo "$(date -u +%FT%TZ) tunnel UP - starting chip queue" >> "$LOG"
-    python benchmarks/chip_validation.py > chip_queue.log 2>&1
-    echo "$(date -u +%FT%TZ) queue finished rc=$?" >> "$LOG"
+    echo "$(date -u +%FT%TZ) tunnel UP - starting chip day" >> "$LOG"
+    bash benchmarks/chip_day.sh
+    echo "$(date -u +%FT%TZ) chip day finished rc=$?" >> "$LOG"
     exit 0
   fi
   echo "$(date -u +%FT%TZ) tunnel down" >> "$LOG"

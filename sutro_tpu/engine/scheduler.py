@@ -719,17 +719,29 @@ class ContinuousBatcher:
         self._spec_win_drafted = 0
         self._spec_win_accepted = 0
 
-    def _spec_coverage_ok(self, active) -> bool:
-        """THE engagement rule (shared by the in-loop pre-check and
-        _spec_ngram_step so the threshold cannot drift between copies):
-        at least half the active rows can draft right now."""
+    def _spec_enough(self, n_draft: int, active) -> bool:
+        """THE engagement threshold (one definition so the in-loop
+        pre-check and _spec_ngram_step cannot drift): at least half the
+        active rows draft."""
+        return 2 * n_draft >= len(active)
+
+    def _spec_drafts(self, active) -> dict:
+        """All active rows' n-gram drafts for this step ({slot: draft};
+        rows with none absent). Computed ONCE per engaged step and
+        reused for both the threshold and the verify operands."""
         SN = self.ecfg.spec_ngram_draft
-        n = sum(
-            1
-            for i in active
-            if self._ngram_draft(self.slots[i], SN) is not None
-        )
-        return 2 * n >= len(active)
+        out = {}
+        for i in active:
+            d = self._ngram_draft(self.slots[i], SN)
+            if d is not None:
+                out[i] = d
+        return out
+
+    def _spec_coverage_ok(self, active) -> bool:
+        """Engagement rule for the in-loop pre-check (drafts here are
+        throwaway: positions advance during the pipe drain, so the
+        engage-time drafts are recomputed by _spec_ngram_step)."""
+        return self._spec_enough(len(self._spec_drafts(active)), active)
 
     def _spec_ngram_step(self, active, last, past_len, table) -> bool:
         """One prompt-lookup speculative step for an all-greedy batch:
@@ -743,15 +755,13 @@ class ContinuousBatcher:
         falls back to fused windows — only when fewer than half the
         active rows draft: the verify dispatch is host-synchronous, so
         at low draft coverage the RTT-hiding pipelined windows win."""
-        if not self._spec_coverage_ok(active):
+        dmap = self._spec_drafts(active)
+        if not self._spec_enough(len(dmap), active):
             return False
         SN = self.ecfg.spec_ngram_draft
         drafts = np.zeros((self.B, SN), np.int32)
         dlens = np.zeros((self.B,), np.int32)
-        for i in active:
-            d = self._ngram_draft(self.slots[i], SN)
-            if d is None:
-                continue
+        for i, d in dmap.items():
             drafts[i, : len(d)] = d
             dlens[i] = len(d)
         d0, a0 = self.spec_drafted, self.spec_accepted
