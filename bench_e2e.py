@@ -140,6 +140,13 @@ def main() -> None:
     # path, so the A/B belongs here rather than bench.py's raw loop)
     if os.environ.get("SUTRO_E2E_SPEC"):
         ecfg["spec_ngram_draft"] = int(os.environ["SUTRO_E2E_SPEC"])
+    # Hydragen-style split decode over the job's shared prefix A/B
+    # (Pallas path only; templated workloads here all share a system
+    # prompt, which is exactly the case it accelerates)
+    if os.environ.get("SUTRO_PREFIX_SPLIT"):
+        ecfg["prefix_split"] = (
+            os.environ["SUTRO_PREFIX_SPLIT"] == "1"
+        )
 
     os.environ.setdefault("SUTRO_HOME", "/tmp/sutro-bench-e2e")
     from sutro_tpu.sdk import Sutro

@@ -100,6 +100,20 @@ class EngineConfig:
                                     # keep decoding — instead of the
                                     # whole batch stalling for the full
                                     # multi-chunk prefill
+    prefix_split: bool = False      # Hydragen-style split decode over
+                                    # the shared prefix (Pallas path
+                                    # only): member rows' prefix
+                                    # attention is computed ONCE per
+                                    # step for the whole batch (one HBM
+                                    # read of the shared pages per
+                                    # layer instead of one per row) and
+                                    # injected as the paged kernel's
+                                    # initial online-softmax carry
+                                    # (ops/pallas_paged.py). Same f32
+                                    # math, different summation order —
+                                    # last-ulp differences only.
+                                    # Default OFF until the chip A/B
+                                    # (bench_e2e SUTRO_PREFIX_SPLIT)
     prefix_cache: bool = True       # shared-prefix KV reuse: a job whose
                                     # rows share a common token prefix
                                     # (templates send one system prompt

@@ -397,11 +397,16 @@ class ModelRunner:
 
     def _trunk_decode(
         self, params, cache: KVCache, ids, positions, past_len,
-        page_table, window_past=None, kv_chunk: int = 1,
+        page_table, window_past=None, kv_chunk: int = 1, pfx=None,
     ):
         """One decode trunk forward over the paged past — the plain
         scanned forward, or the stage-local pipeline schedule under
-        ``pipe > 1`` (parallel/pipeline.pipeline_decode)."""
+        ``pipe > 1`` (parallel/pipeline.pipeline_decode).
+
+        ``pfx`` = (pfx_pages [Pp] int32, pfx_len [B] int32) enables
+        Hydragen-style split decode over a job-shared table-head prefix
+        (ops/attention.py); the prefix cache is disabled under pp, so
+        the pipeline path never sees one."""
         B = ids.shape[0]
         ones = jnp.ones((B,), jnp.int32)
         if self.pp > 1:
@@ -421,6 +426,8 @@ class ModelRunner:
             use_pallas=self.use_pallas,
             kv_chunk=kv_chunk,
             ep_mesh=self.ep_mesh,
+            pfx_pages=None if pfx is None else pfx[0],
+            pfx_len=None if pfx is None else pfx[1],
         )
 
     def _chunk_for_table(self, page_table: np.ndarray) -> int:
@@ -443,7 +450,7 @@ class ModelRunner:
     def _decode_jit(
         self, params, cache: KVCache, ids, past_len, page_table,
         rng, temperature, top_p, top_k, allowed_packed, row_seeds,
-        kv_chunk: int = 1, penalties=None,
+        kv_chunk: int = 1, penalties=None, pfx=None,
     ):
         B = ids.shape[0]
         allowed = None
@@ -456,7 +463,7 @@ class ModelRunner:
         positions = past_len[:, None]  # current token position == past length
         logits, _, (k, v) = self._trunk_decode(
             params, cache, ids, positions, past_len, page_table,
-            kv_chunk=kv_chunk,
+            kv_chunk=kv_chunk, pfx=pfx,
         )
         cache = write_kv(
             cache, k, v, page_table, past_len, jnp.ones((B,), jnp.int32),
@@ -499,6 +506,7 @@ class ModelRunner:
         #                   frequency [B], repetition [B]) — seen bits
         #                   arrive PRE-PACKED (scheduler maintains them
         #                   incrementally; no O(B*V) host work here)
+        pfx=None,  # (pfx_pages [Pp], pfx_len [B]) split-prefix decode
     ) -> Tuple[np.ndarray, np.ndarray]:
         B = len(last_tokens)
         if top_k is None:
@@ -529,8 +537,18 @@ class ModelRunner:
             None if row_seeds is None else jnp.asarray(row_seeds, jnp.int32),
             self._chunk_for_table(page_table),
             penalties,
+            self._pfx_jnp(pfx),
         )
         return np.asarray(tok), np.asarray(logp)
+
+    @staticmethod
+    def _pfx_jnp(pfx):
+        if pfx is None:
+            return None
+        return (
+            jnp.asarray(pfx[0], jnp.int32),
+            jnp.asarray(pfx[1], jnp.int32),
+        )
 
     # ------------------------------------------------------------------
     # multi-step decode
@@ -542,7 +560,7 @@ class ModelRunner:
     def _decode_multi_jit(
         self, params, cache: KVCache, last, past_len, page_table,
         rng, temperature, top_p, steps: int, top_k,
-        kv_chunk: int = 1,
+        kv_chunk: int = 1, pfx=None,
     ):
         """``steps`` decode iterations in ONE device program: the sampled
         token feeds the next step on-device, so the host pays one dispatch
@@ -563,7 +581,7 @@ class ModelRunner:
         B = last.shape[0]
         toks, logps, wk, wv = self._window_scan(
             params, cache, last, past_len, page_table, rng,
-            temperature, top_p, steps, top_k, kv_chunk,
+            temperature, top_p, steps, top_k, kv_chunk, pfx=pfx,
         )
         cache = write_kv(
             cache, wk, wv, page_table, past_len,
@@ -575,7 +593,7 @@ class ModelRunner:
     def _window_scan(
         self, params, cache: KVCache, last, past_len, page_table,
         rng, temperature, top_p, steps: int, top_k,
-        kv_chunk: int = 1, allowed0=None,
+        kv_chunk: int = 1, allowed0=None, pfx=None,
     ):
         """The shared fused-window scan: ``steps`` trunk forwards over
         invariant pages + the carried window buffer, sampling on-device.
@@ -613,6 +631,7 @@ class ModelRunner:
                 params, cache, last[:, None],
                 (past_len + step_idx)[:, None], past_len, page_table,
                 window_past=(wk, wv, step_idx), kv_chunk=kv_chunk,
+                pfx=pfx,
             )
             wk = jax.lax.dynamic_update_slice(
                 wk, k.astype(dtype).reshape(L, B, 1, KD),
@@ -657,11 +676,12 @@ class ModelRunner:
         top_p: np.ndarray,           # [B]
         steps: int,
         top_k: Optional[np.ndarray] = None,
+        pfx=None,
     ) -> Tuple[np.ndarray, np.ndarray]:
         """Returns (tokens [steps, B], logprobs [steps, B])."""
         toks, logps = self.decode_multi_async(
             last_tokens, past_len, page_table, rng, temperature, top_p,
-            steps, top_k=top_k,
+            steps, top_k=top_k, pfx=pfx,
         )
         return np.asarray(toks), np.asarray(logps)
 
@@ -675,6 +695,7 @@ class ModelRunner:
         top_p: np.ndarray,           # [B]
         steps: int,
         top_k: Optional[np.ndarray] = None,
+        pfx=None,  # (pfx_pages [Pp], pfx_len [B]) split-prefix decode
     ) -> Tuple[jax.Array, jax.Array]:
         """Like ``decode_multi`` but returns DEVICE arrays without
         blocking: dispatch is async, so callers can chain the next
@@ -698,6 +719,7 @@ class ModelRunner:
             steps,
             jnp.asarray(top_k, jnp.int32),
             self._chunk_for_table(page_table),
+            self._pfx_jnp(pfx),
         )
         return toks, logps
 
@@ -787,7 +809,7 @@ class ModelRunner:
     def _decode_window_jit(
         self, params, cache: KVCache, last, past_len, page_table,
         rng, temperature, steps: int, top_p, top_k,
-        kv_chunk: int = 1, allowed0=None,
+        kv_chunk: int = 1, allowed0=None, pfx=None,
     ):
         """Like ``_decode_multi_jit`` but WITHOUT the page commit: the
         sampled window and its K/V buffers return to the host, which
@@ -797,7 +819,7 @@ class ModelRunner:
         return self._window_scan(
             params, cache, last, past_len, page_table, rng,
             temperature, top_p, steps, top_k, kv_chunk,
-            allowed0=allowed0,
+            allowed0=allowed0, pfx=pfx,
         )
 
     @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
@@ -820,6 +842,7 @@ class ModelRunner:
         steps: int,
         top_k: Optional[np.ndarray] = None,
         allowed0: Optional[np.ndarray] = None,  # [B, V] bool, step 0 only
+        pfx=None,  # (pfx_pages [Pp], pfx_len [B]) split-prefix decode
     ):
         """Speculative window: returns (tokens [steps, B], logprobs
         [steps, B], window_kv handle). Pages are NOT written — call
@@ -842,6 +865,7 @@ class ModelRunner:
             jnp.asarray(top_k, jnp.int32),
             self._chunk_for_table(page_table),
             None if allowed0 is None else jnp.asarray(allowed0, bool),
+            self._pfx_jnp(pfx),
         )
         # copy: callers may pass live views (native runtime) that mutate
         # during host-side verification before commit_window

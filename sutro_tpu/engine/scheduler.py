@@ -719,6 +719,43 @@ class ContinuousBatcher:
         self._spec_win_drafted = 0
         self._spec_win_accepted = 0
 
+    def _split_pfx(self, active):
+        """Operands for Hydragen-style split decode (Pallas path,
+        EngineConfig.prefix_split): ``(pfx_pages [Pp] int32, pfx_len
+        [B] int32)`` for ONE shared-prefix group per dispatch — the
+        first active job that has one; rows of other jobs (including
+        other jobs' prefixes) keep walking their pages in-kernel.
+        ``None`` when disabled, on the fallback path, or when no
+        active row belongs to a prefix."""
+        if not getattr(self.ecfg, "prefix_split", False):
+            return None
+        if not getattr(self.runner, "use_pallas", False):
+            return None
+        grp = None
+        for i in active:
+            ctx = self.slots[i].job
+            if ctx is not None and ctx.prefix is not None:
+                grp = ctx
+                break
+        if grp is None:
+            return None
+        pfx_len = np.zeros((self.B,), np.int32)
+        for i in active:
+            if self.slots[i].job is grp:
+                pfx_len[i] = grp.prefix.tokens
+        # pad the page list to a power-of-two bucket so distinct
+        # template lengths don't each retrace the fused decode programs
+        # (the pad pages are the garbage page 0, fully masked by
+        # pfx_len in the carry; the kernel skips only the REAL
+        # pfx_len // PS pages)
+        pages = grp.prefix.pages
+        cap = 1
+        while cap < len(pages):
+            cap *= 2
+        padded = np.zeros((cap,), np.int32)
+        padded[: len(pages)] = pages
+        return padded, pfx_len
+
     def _spec_enough(self, n_draft: int, active) -> bool:
         """THE engagement threshold (one definition so the in-loop
         pre-check and _spec_ngram_step cannot drift): at least half the
@@ -1113,7 +1150,8 @@ class ContinuousBatcher:
         self._key, sub = jax.random.split(self._key)
         with self.timer.time("decode"):
             toks_dev, logps_dev = self.runner.decode_multi_async(
-                last_arg, past, table, sub, temp, top_p, K, top_k=top_k
+                last_arg, past, table, sub, temp, top_p, K, top_k=top_k,
+                pfx=self._split_pfx(active),
             )
         self._step += K
         pipe.append(
@@ -1691,6 +1729,7 @@ class ContinuousBatcher:
                             self.runner.decode_window(
                                 last, past_len, table, sub, temp, top_p,
                                 K, top_k=top_k, allowed0=allowed0,
+                                pfx=self._split_pfx(active),
                             )
                         )
                     self._step += K
@@ -1740,7 +1779,7 @@ class ContinuousBatcher:
                     with self.timer.time("decode"):
                         toks_w, logps_w = self.runner.decode_multi(
                             last, past_len, table, sub, temp, top_p, K,
-                            top_k=top_k,
+                            top_k=top_k, pfx=self._split_pfx(active),
                         )
                     self._step += K
                     for j in range(K):
@@ -1820,6 +1859,7 @@ class ContinuousBatcher:
                                 row_seeds if has_row_seed else None
                             ),
                             penalties=penalties,
+                            pfx=self._split_pfx(active),
                         )
                     self._step += 1
                     # masked single-step crossed every flagged row's

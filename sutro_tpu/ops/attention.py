@@ -61,6 +61,15 @@ def chunk_attention(
     win_len: Optional[jax.Array] = None,
     kv_chunk: int = 1,  # static: pages per decode-kernel DMA (>1 means
                         # the caller guarantees contiguous page runs)
+    # shared-prefix (Hydragen-style) decode: member rows' tables START
+    # with these shared pages; the Pallas path computes their attention
+    # once for the whole batch (one HBM read of the shared pages per
+    # layer-step instead of one per row) and injects it as the paged
+    # kernel's initial online-softmax carry. The fallback path ignores
+    # both (the tables still contain the prefix pages, so its full-table
+    # gather computes the identical function).
+    pfx_pages: Optional[jax.Array] = None,  # [Pp] int32 shared pages
+    pfx_len: Optional[jax.Array] = None,    # [B] int32 (0 = not member)
 ) -> jax.Array:
     """Returns [B, T, NH, Dh]."""
     B, T = q.shape[:2]
@@ -86,12 +95,29 @@ def chunk_attention(
                     jnp.asarray(0, jnp.int32) if window is None
                     else jnp.asarray(window, jnp.int32)
                 )
+                pfx_kw = {}
+                if pfx_pages is not None:
+                    from .pallas_paged import prefix_attention_carry
+
+                    PS = past_k_pages.shape[1]
+                    q_pos = past_len + (
+                        win_len if win_len is not None else 0
+                    )
+                    m0, l0, acc0 = prefix_attention_carry(
+                        q[:, 0], past_k_pages, past_v_pages,
+                        pfx_pages, pfx_len, q_pos, win,
+                        k_scale=past_k_scale, v_scale=past_v_scale,
+                    )
+                    pfx_kw = dict(
+                        pfx_cnt=pfx_len // PS, m0=m0, l0=l0, acc0=acc0
+                    )
                 out = paged_decode_attention(
                     q[:, 0], past_k_pages, past_v_pages, page_table,
                     past_len, k[:, 0], v[:, 0], win, sink,
                     win_k=win_k, win_v=win_v, win_len=win_len,
-                    kv_chunk=kv_chunk,
+                    kv_chunk=1 if pfx_pages is not None else kv_chunk,
                     k_scale=past_k_scale, v_scale=past_v_scale,
+                    **pfx_kw,
                 )
                 return out[:, None]
         from ..engine.kvcache import gather_kv_layer

@@ -45,8 +45,9 @@ NEG_INF = -1e30
 
 
 def _paged_decode_kernel(
-    # scalar prefetch: page_table [B*MP], past_len [B], window [1], and —
-    # when the caller carries a decode window buffer — win_len [1]
+    # scalar prefetch: page_table [B*MP], past_len [B], window [1],
+    # then — in shared-prefix (Hydragen-style) mode — pfx_pages_cnt [B],
+    # and — when the caller carries a decode window buffer — win_len [1]
     *refs,
     max_pages_per_seq: int,
     page_size: int,
@@ -56,13 +57,15 @@ def _paged_decode_kernel(
     chunk_pages: int = 1,
     cross_row: bool = False,
     quantized: bool = False,
+    prefix: bool = False,
 ):
-    # ref layout varies with (window_slots, quantized) — walk an index
-    # instead of a per-case tuple unpack
+    # ref layout varies with (window_slots, quantized, prefix) — walk an
+    # index instead of a per-case tuple unpack
     it = iter(refs)
     page_table_ref = next(it)
     past_len_ref = next(it)
     window_ref = next(it)
+    pfx_cnt_ref = next(it) if prefix else None
     win_len_ref = next(it) if window_slots else None
     q_ref = next(it)
     k_pool_ref = next(it)
@@ -73,6 +76,9 @@ def _paged_decode_kernel(
     v_cur_ref = next(it)
     wk_ref = next(it) if window_slots else None
     wv_ref = next(it) if window_slots else None
+    m0_ref = next(it) if prefix else None
+    l0_ref = next(it) if prefix else None
+    acc0_ref = next(it) if prefix else None
     sink_ref = next(it)
     out_ref = next(it)
     kbuf = next(it)
@@ -126,9 +132,27 @@ def _paged_decode_kernel(
     sel_d = jax.lax.broadcasted_iota(jnp.int32, (KD, Dh), 1)
     S = (sel_kd % Dh == sel_d).astype(jnp.float32)        # [KD, Dh]
 
-    m_ref[...] = jnp.full_like(m_ref, NEG_INF)
-    l_ref[...] = jnp.zeros_like(l_ref)
-    acc_ref[...] = jnp.zeros_like(acc_ref)
+    # Shared-prefix (Hydragen-style) mode: the first pfx_cnt pages of
+    # this row's table hold a prefix whose K/V is SHARED with other
+    # rows. Their attention was computed ONCE for the whole batch
+    # outside the kernel (prefix_attention_carry — the pages are read
+    # from HBM once instead of once per row) and arrives as the initial
+    # online-softmax carry; the page walk below starts AFTER them.
+    # Non-member rows carry (m=-inf, l=0, acc=0) — exactly the cold
+    # init — and start at page 0. Online softmax is associative, so the
+    # result is bit-comparable to walking the prefix pages in-row.
+    if prefix:
+        m_ref[...] = jnp.broadcast_to(
+            m0_ref[0][:, None].astype(jnp.float32), m_ref.shape
+        )
+        l_ref[...] = jnp.broadcast_to(
+            l0_ref[0][:, None].astype(jnp.float32), l_ref.shape
+        )
+        acc_ref[...] = acc0_ref[0].astype(jnp.float32)
+    else:
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
 
     # CH == 1: each chunk is one table-walked page (any layout).
     # CH > 1: the row's pages are one ascending run (contiguous-first
@@ -217,13 +241,18 @@ def _paged_decode_kernel(
     def _chunks_of(row):
         return (past_len_ref[row] + CT - 1) // CT
 
+    # shared-prefix mode: skip the prefix pages (their carry was
+    # injected above). Requires CH == 1 and no cross_row (wrapper
+    # enforces both), so chunk index == page index.
+    i0 = pfx_cnt_ref[b] if prefix else 0
+
     # warmup: row 0 fetches its own first chunk; under cross_row every
     # later row's first chunk was started by its predecessor
-    self_warm = (b == 0) if cross_row else (nchunks > 0)
+    self_warm = (b == 0) if cross_row else (nchunks > i0)
 
-    @pl.when(jnp.logical_and(self_warm, nchunks > 0))
+    @pl.when(jnp.logical_and(self_warm, nchunks > i0))
     def _warmup():
-        _start_chunk(b, 0, _slot(b, 0))
+        _start_chunk(b, i0, _slot(b, i0))
 
     def page_step(i, _):
         slot = _slot(b, i)
@@ -298,7 +327,7 @@ def _paged_decode_kernel(
         m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
         return 0
 
-    jax.lax.fori_loop(0, nchunks, page_step, 0)
+    jax.lax.fori_loop(i0, nchunks, page_step, 0)
 
     if cross_row:
         # hand off: start the NEXT row's first chunk now that every DMA
@@ -365,6 +394,82 @@ def _paged_decode_kernel(
     )                                                    # [NH, Dh]
     out = acc_bd / jnp.maximum(l, 1e-30)[:, None]
     out_ref[0] = out.astype(out_ref.dtype)
+
+
+def prefix_attention_carry(
+    q: jax.Array,            # [B, NH, Dh] current-step queries
+    k_pages: jax.Array,      # [NP, PS, KVH*Dh] one layer's page pool
+    v_pages: jax.Array,
+    pfx_pages: jax.Array,    # [Pp] int32 — the SHARED prefix's pages
+    pfx_len: jax.Array,      # [B] int32 — prefix tokens per row (0 for
+    #                          rows outside the prefix group)
+    q_pos: jax.Array,        # [B] int32 — each query's global position
+    window: jax.Array,       # scalar int32; 0 => full attention
+    k_scale: Optional[jax.Array] = None,  # [NP, PS] int8-KV scales
+    v_scale: Optional[jax.Array] = None,
+):
+    """Online-softmax carry ``(m0, l0, acc0)`` of attention over a
+    job-shared page-aligned prefix, computed ONCE for the whole batch
+    (Hydragen / cascade-inference decomposition: the prefix K/V is the
+    same physical pages for every member row, so one [Pp] gather reads
+    them from HBM once per layer per step instead of once per row
+    inside the paged kernel's per-row walk).
+
+    Returned in the paged kernel's spaces for direct carry injection
+    (``paged_decode_attention(..., pfx_cnt, m0, l0, acc0)``): m0/l0
+    ``[B, NH]`` f32, acc0 ``[B, NH, KVH*Dh]`` f32 block-diagonal (each
+    query row's accumulator sits in its own KV head's lane block).
+    Rows with ``pfx_len == 0`` get the cold carry (-inf, 0, 0) — inside
+    the kernel they are indistinguishable from non-prefix rows.
+    Softmax-associativity makes the final attention equal to walking
+    the prefix pages in-row (same f32 math, different summation order).
+    """
+    B, NH, Dh = q.shape
+    NP, PS, KD = k_pages.shape
+    KVH = KD // Dh
+    G = NH // KVH
+    scale = Dh ** -0.5
+    Pp = pfx_pages.shape[0]
+    Lp = Pp * PS
+
+    kp = k_pages[pfx_pages].astype(jnp.float32)      # [Pp, PS, KD]
+    vp = v_pages[pfx_pages].astype(jnp.float32)
+    if k_scale is not None:
+        kp = kp * k_scale[pfx_pages][..., None].astype(jnp.float32)
+        vp = vp * v_scale[pfx_pages][..., None].astype(jnp.float32)
+    kp = kp.reshape(Lp, KVH, Dh)
+    vp = vp.reshape(Lp, KVH, Dh)
+
+    qg = q.reshape(B, KVH, G, Dh).astype(jnp.float32)
+    s = jnp.einsum("bkgd,lkd->bkgl", qg, kp) * scale  # [B, KVH, G, Lp]
+    t = jnp.arange(Lp, dtype=jnp.int32)
+    ok = t[None, :] < pfx_len[:, None]                # [B, Lp]
+    win = jnp.asarray(window, jnp.int32)
+    ok = jnp.logical_and(
+        ok,
+        jnp.logical_or(
+            (q_pos[:, None] - t[None, :]) < win, win <= 0
+        ),
+    )
+    okb = ok[:, None, None, :]
+    s = jnp.where(okb, s, NEG_INF)
+    m = jnp.max(s, axis=-1)                           # [B, KVH, G]
+    # p computed under the mask, NOT as exp(s - m): an all-masked row
+    # has m = -inf and exp(-inf - -inf) would be 1, not 0
+    p = jnp.where(okb, jnp.exp(s - m[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bkgl,lkd->bkgd", p, vp)         # [B, KVH, G, Dh]
+
+    m0 = m.reshape(B, NH)
+    l0 = l.reshape(B, NH)
+    # block-diagonal fused space: query row i's accumulator goes into
+    # lane block i // G
+    head = jnp.arange(NH, dtype=jnp.int32) // G       # [NH]
+    onehot = jax.nn.one_hot(head, KVH, dtype=jnp.float32)  # [NH, KVH]
+    acc0 = jnp.einsum(
+        "bnd,nk->bnkd", acc.reshape(B, NH, Dh), onehot
+    ).reshape(B, NH, KD)
+    return m0, l0, acc0
 
 
 # Below this table capacity (tokens) the XLA gather fallback wins on
@@ -447,6 +552,16 @@ def paged_decode_attention(
     # dequant scales [NP, PS] f32 (engine/kvcache.py)
     k_scale: Optional[jax.Array] = None,
     v_scale: Optional[jax.Array] = None,
+    # shared-prefix (Hydragen-style) mode: rows whose table head holds a
+    # job-shared prefix skip those pages (pfx_cnt[b] of them) and start
+    # from the injected online-softmax carry (prefix_attention_carry) —
+    # the shared pages are then read from HBM once per step for the
+    # whole batch instead of once per row. Forces kv_chunk=1, no
+    # cross_row.
+    pfx_cnt: Optional[jax.Array] = None,   # [B] int32 pages to skip
+    m0: Optional[jax.Array] = None,        # [B, NH] f32
+    l0: Optional[jax.Array] = None,        # [B, NH] f32
+    acc0: Optional[jax.Array] = None,      # [B, NH, KVH*Dh] f32 (block-diag)
 ) -> jax.Array:
     """Returns [B, NH, Dh] attention outputs for one decode step.
 
@@ -476,6 +591,13 @@ def paged_decode_attention(
     if cross_row is None:
         cross_row = PALLAS_PAGED_XROW
     quantized = k_scale is not None
+    prefix = pfx_cnt is not None
+    if prefix:
+        # carry injection needs chunk index == page index, and the
+        # cross-row handoff fetches the next row's chunk 0 which a
+        # prefix row would skip
+        assert kv_chunk == 1, "shared-prefix mode requires kv_chunk=1"
+        cross_row = False
     kernel = functools.partial(
         _paged_decode_kernel,
         max_pages_per_seq=MP,
@@ -486,6 +608,7 @@ def paged_decode_attention(
         chunk_pages=kv_chunk,
         cross_row=cross_row,
         quantized=quantized,
+        prefix=prefix,
     )
 
     # index maps take *s so the scalar-prefetch arity (3 without a
@@ -500,6 +623,8 @@ def paged_decode_attention(
         past_len.astype(jnp.int32),
         jnp.asarray(window, jnp.int32).reshape(1),
     ]
+    if prefix:
+        scalars.append(pfx_cnt.astype(jnp.int32))
     operands = [
         q,
         k_pages,
@@ -531,6 +656,17 @@ def paged_decode_attention(
             pl.BlockSpec((1, W, KD), lambda b, *s: (b, 0, 0)),
         ]
         operands += [win_k, win_v]
+    if prefix:
+        in_specs += [
+            pl.BlockSpec((1, NH), lambda b, *s: (b, 0)),
+            pl.BlockSpec((1, NH), lambda b, *s: (b, 0)),
+            pl.BlockSpec((1, NH, KD), lambda b, *s: (b, 0, 0)),
+        ]
+        operands += [
+            m0.astype(jnp.float32),
+            l0.astype(jnp.float32),
+            acc0.astype(jnp.float32),
+        ]
     in_specs.append(pl.BlockSpec((1, NH), lambda b, *s: (0, 0)))
     operands.append(sink_g)
 

@@ -400,3 +400,88 @@ def test_kv_write_pallas_matches_scatter(starts, valids, tb):
     np.testing.assert_array_equal(
         np.asarray(got_v)[:, 1:], np.asarray(ref.v_pages)[:, 1:]
     )
+
+
+@pytest.mark.parametrize("window", [0, 7])
+@pytest.mark.parametrize("quantized", [False, True])
+def test_paged_decode_prefix_carry_injection(window, quantized):
+    """Shared-prefix (Hydragen-style) mode: computing the table-head
+    prefix's attention ONCE outside the kernel (prefix_attention_carry)
+    and injecting it as the online-softmax carry while the kernel skips
+    those pages must match the plain kernel walking the full table —
+    including rows OUTSIDE the prefix group (pfx_cnt 0, cold carry) and
+    under sliding windows that cut into or past the prefix."""
+    from sutro_tpu.ops.pallas_paged import prefix_attention_carry
+
+    rng = np.random.default_rng(7)
+    B, NH, KVH, Dh, PS, MP, NP = 4, 4, 2, 16, 8, 6, 40
+    n_pfx = 3  # 24 prefix tokens
+    q = jnp.asarray(rng.standard_normal((B, NH, Dh)), jnp.float32)
+    k_cur = jnp.asarray(rng.standard_normal((B, KVH, Dh)), jnp.float32)
+    v_cur = jnp.asarray(rng.standard_normal((B, KVH, Dh)), jnp.float32)
+    if quantized:
+        k_pages = jnp.asarray(
+            rng.integers(-127, 127, (NP, PS, KVH * Dh)), jnp.int8
+        )
+        v_pages = jnp.asarray(
+            rng.integers(-127, 127, (NP, PS, KVH * Dh)), jnp.int8
+        )
+        k_scale = jnp.asarray(
+            rng.uniform(0.005, 0.02, (NP, PS)), jnp.float32
+        )
+        v_scale = jnp.asarray(
+            rng.uniform(0.005, 0.02, (NP, PS)), jnp.float32
+        )
+    else:
+        k_pages = jnp.asarray(
+            rng.standard_normal((NP, PS, KVH * Dh)), jnp.float32
+        )
+        v_pages = jnp.asarray(
+            rng.standard_normal((NP, PS, KVH * Dh)), jnp.float32
+        )
+        k_scale = v_scale = None
+    # rows 0..2 share prefix pages [1, 2, 3]; row 3 is NOT in the group
+    pfx_pages = np.array([1, 2, 3], np.int32)
+    table = np.zeros((B, MP), np.int32)
+    next_p = 4
+    for b in range(B):
+        if b < 3:
+            table[b, :n_pfx] = pfx_pages
+            own = np.arange(next_p, next_p + (MP - n_pfx))
+            table[b, n_pfx:] = own
+            next_p += MP - n_pfx
+        else:
+            table[b] = np.arange(next_p, next_p + MP)
+            next_p += MP
+    # member rows: past spans prefix + some own tokens; non-member: own
+    past = np.array(
+        [n_pfx * PS + 5, n_pfx * PS + 11, n_pfx * PS + 2, 17], np.int32
+    )
+    table = jnp.asarray(table)
+    past_len = jnp.asarray(past)
+    win = jnp.asarray(window, jnp.int32)
+
+    ref = paged_decode_attention(
+        q, k_pages, v_pages, table, past_len, k_cur, v_cur, win, None,
+        interpret=True, cross_row=False,
+        k_scale=k_scale, v_scale=v_scale,
+    )
+
+    pfx_len = jnp.asarray(
+        [n_pfx * PS, n_pfx * PS, n_pfx * PS, 0], jnp.int32
+    )
+    pfx_cnt = jnp.asarray([n_pfx, n_pfx, n_pfx, 0], jnp.int32)
+    m0, l0, acc0 = prefix_attention_carry(
+        q, k_pages, v_pages, jnp.asarray(pfx_pages), pfx_len,
+        past_len,  # q_pos: no window buffer, query sits at past_len
+        win, k_scale=k_scale, v_scale=v_scale,
+    )
+    got = paged_decode_attention(
+        q, k_pages, v_pages, table, past_len, k_cur, v_cur, win, None,
+        interpret=True, cross_row=False,
+        k_scale=k_scale, v_scale=v_scale,
+        pfx_cnt=pfx_cnt, m0=m0, l0=l0, acc0=acc0,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )

@@ -1,0 +1,115 @@
+"""Hydragen-style split decode over the shared prefix
+(EngineConfig.prefix_split + ops/pallas_paged.prefix_attention_carry +
+paged-kernel carry injection).
+
+Op-level parity lives in tests/test_pallas_kernels.py
+(test_paged_decode_prefix_carry_injection). Here the FULL engine path
+runs with the real Pallas kernels in interpret mode on CPU: prefix
+cache detection -> split operands (_split_pfx) -> carry injection in
+every decode dispatch — outputs must match the same engine with the
+split disabled, and the carry helper must actually have been used."""
+
+import functools
+
+import numpy as np
+import pytest
+
+from sutro_tpu.engine.config import EngineConfig
+from sutro_tpu.engine.runner import ModelRunner
+from sutro_tpu.engine.scheduler import ContinuousBatcher, GenRequest
+from sutro_tpu.models.configs import MODEL_CONFIGS
+
+PREFIX = "system: classify the review. review: "  # 37 chars -> 4 pages
+SUFFIXES = ["good stuff", "bad stuff", "meh", "ok product arrived"]
+
+
+def _force_interpret(monkeypatch):
+    """Run the engine's Pallas path on CPU: kernels in interpret mode,
+    shape gates opened (tiny test heads fail the TPU-lane gates)."""
+    from sutro_tpu.ops import pallas_kv, pallas_paged
+
+    monkeypatch.setattr(
+        pallas_paged, "paged_decode_supported", lambda *a: True
+    )
+    monkeypatch.setattr(
+        pallas_paged,
+        "paged_decode_attention",
+        functools.partial(
+            pallas_paged.paged_decode_attention, interpret=True
+        ),
+    )
+    monkeypatch.setattr(
+        pallas_kv,
+        "kv_write_pallas",
+        functools.partial(pallas_kv.kv_write_pallas, interpret=True),
+    )
+    from sutro_tpu.ops import pallas_flash
+
+    monkeypatch.setattr(
+        pallas_flash, "flash_prefill_supported", lambda *a, **k: False
+    )
+
+
+def _run(tok, split: bool, monkeypatch):
+    _force_interpret(monkeypatch)
+    ecfg = EngineConfig(
+        kv_page_size=8,
+        max_pages_per_seq=10,
+        max_model_len=80,
+        decode_batch_size=4,
+        use_pallas=True,
+        param_dtype="float32",
+        activation_dtype="float32",
+        decode_multi_step=1,
+        decode_lookahead=1,
+        prefix_split=split,
+    )
+    b = ContinuousBatcher(
+        ModelRunner(MODEL_CONFIGS["tiny-dense"], ecfg),
+        stop_ids=tok.stop_ids(),
+    )
+    res = {}
+    out = b.run(
+        [
+            GenRequest(
+                row_id=i,
+                prompt_ids=np.array(tok.encode(PREFIX + s), np.int32),
+                max_new_tokens=5,
+                temperature=0.0,
+            )
+            for i, s in enumerate(SUFFIXES)
+        ],
+        on_result=lambda r: res.__setitem__(r.row_id, r),
+    )
+    assert out == "completed"
+    # the job's shared prefix must have been detected (split operands
+    # exist only when ctx.prefix does)
+    naive = sum(len(tok.encode(PREFIX + s)) for s in SUFFIXES)
+    assert b.prefill_tokens < naive
+    return {i: r.token_ids for i, r in res.items()}
+
+
+def test_engine_split_decode_matches_unsplit(byte_tok, monkeypatch):
+    from sutro_tpu.ops import pallas_paged
+
+    calls = []
+    real = pallas_paged.prefix_attention_carry
+
+    def record(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(
+        pallas_paged, "prefix_attention_carry", record
+    )
+    on = _run(byte_tok, True, monkeypatch)
+    assert calls, "split decode never used the carry helper"
+    n_split = len(calls)
+    calls.clear()
+    off = _run(byte_tok, False, monkeypatch)
+    assert not calls, "carry helper ran with prefix_split disabled"
+    assert on == off, "split decode changed greedy outputs"
+    # the carry is traced once per jit compilation (it sits inside the
+    # layer lax.scan, and later dispatches reuse the compiled program),
+    # so call COUNT is compilation count — n_split >= 1 is the signal
+    assert n_split >= 1
