@@ -156,11 +156,56 @@ class EngineConfig:
         return min(self.max_model_len, self.kv_page_size * self.max_pages_per_seq)
 
 
+def sutro_home() -> Path:
+    """THE resolution rule for the sutro state directory (one
+    definition: load_engine_config, validation.py, and the compile
+    cache must never disagree on where sutro-home is)."""
+    return Path(os.environ.get("SUTRO_HOME", Path.home() / ".sutro"))
+
+
+_CACHE_ENABLED = False
+
+
+def enable_compile_cache() -> None:
+    """Point JAX's persistent compilation cache at a durable directory
+    (idempotent; opt out with SUTRO_COMPILE_CACHE=0 — tests/conftest.py
+    does, so test runs neither pollute ~/.sutro nor latch the cache to
+    a soon-deleted pytest tmp dir).
+
+    Every engine process — the HTTP daemon, bench subprocesses, the
+    chip-validation queue's per-case isolation, DP workers — compiles
+    the same decode/prefill programs; on a TPU behind a slow tunnel
+    each first compile costs 20-120 s. The on-disk cache (content-
+    addressed, a stock JAX feature) makes every process after the
+    first load the executable in seconds. Respects an explicit
+    jax_compilation_cache_dir (set via jax config or the
+    JAX_COMPILATION_CACHE_DIR env var, which JAX binds at import)."""
+    global _CACHE_ENABLED
+    if _CACHE_ENABLED or os.environ.get("SUTRO_COMPILE_CACHE") == "0":
+        return
+    _CACHE_ENABLED = True
+    import jax
+
+    if jax.config.jax_compilation_cache_dir:
+        return  # user already chose a cache location
+    path = sutro_home() / "xla_cache"
+    try:
+        path.mkdir(parents=True, exist_ok=True)
+        # threshold FIRST: if the dir update below fails the config is
+        # untouched, and a retry can't mistake our half-applied state
+        # for a user-chosen cache location
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 2.0
+        )
+        jax.config.update("jax_compilation_cache_dir", str(path))
+    except Exception:
+        _CACHE_ENABLED = False  # cache is an optimization, never fatal
+
+
 def load_engine_config(**overrides: Any) -> EngineConfig:
     """defaults <- $SUTRO_HOME/engine.json <- explicit kwargs."""
     cfg: Dict[str, Any] = {}
-    home = Path(os.environ.get("SUTRO_HOME", Path.home() / ".sutro"))
-    path = home / "engine.json"
+    path = sutro_home() / "engine.json"
     if path.exists():
         try:
             cfg.update(json.loads(path.read_text()))

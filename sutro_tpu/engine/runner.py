@@ -54,6 +54,9 @@ class ModelRunner:
     ):
         self.mcfg = mcfg
         self.ecfg = ecfg
+        from .config import enable_compile_cache
+
+        enable_compile_cache()
         dtype = jnp.dtype(ecfg.param_dtype)
         if params is None:
             params = transformer.init_params(

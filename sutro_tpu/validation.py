@@ -16,12 +16,14 @@ import os
 from pathlib import Path
 from typing import Any, Dict, Optional
 
-CONFIG_DIR = Path(os.environ.get("SUTRO_HOME", Path.home() / ".sutro"))
+from .engine.config import sutro_home
+
+CONFIG_DIR = sutro_home()
 CONFIG_PATH = CONFIG_DIR / "config.json"
 
 
 def config_dir() -> Path:
-    d = Path(os.environ.get("SUTRO_HOME", Path.home() / ".sutro"))
+    d = sutro_home()
     d.mkdir(parents=True, exist_ok=True)
     return d
 

@@ -5,6 +5,11 @@ build'). Must run before jax is imported anywhere."""
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# tests must neither populate a persistent cache under the real
+# ~/.sutro nor latch the process-global cache dir to a pytest tmp
+# SUTRO_HOME that gets deleted at teardown (engine/config.py
+# enable_compile_cache; its own tests monkeypatch this off)
+os.environ.setdefault("SUTRO_COMPILE_CACHE", "0")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

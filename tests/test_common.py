@@ -87,3 +87,29 @@ def test_catalog_no_duplicates():
     # the reference's duplicate "llama-3.3-70b" literal is not reproduced
     names = list(MODEL_CATALOG)
     assert len(names) == len(set(names))
+
+
+def test_compile_cache_optout_and_respect(monkeypatch):
+    """enable_compile_cache: SUTRO_COMPILE_CACHE=0 disables; an
+    explicit user cache dir is respected (not overwritten)."""
+    import jax
+
+    from sutro_tpu.engine import config as cfgmod
+
+    monkeypatch.setattr(cfgmod, "_CACHE_ENABLED", False)
+    monkeypatch.setenv("SUTRO_COMPILE_CACHE", "0")
+    before = jax.config.jax_compilation_cache_dir
+    cfgmod.enable_compile_cache()
+    assert cfgmod._CACHE_ENABLED is False
+    assert jax.config.jax_compilation_cache_dir == before
+
+    monkeypatch.delenv("SUTRO_COMPILE_CACHE")
+    monkeypatch.setattr(cfgmod, "_CACHE_ENABLED", False)
+    jax.config.update("jax_compilation_cache_dir", "/tmp/user-chosen")
+    try:
+        cfgmod.enable_compile_cache()
+        assert (
+            jax.config.jax_compilation_cache_dir == "/tmp/user-chosen"
+        )
+    finally:
+        jax.config.update("jax_compilation_cache_dir", before)
