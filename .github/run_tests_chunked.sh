@@ -1,0 +1,26 @@
+#!/bin/bash
+# Full-suite runner that survives the environment's XLA CPU compile
+# segfault flake: two consecutive full-process runs this round died
+# inside jax backend_compile_and_load (different test files each time,
+# both pass in isolation; single-core host). Running per-file isolates
+# the blast radius and a crashed file retries up to 2x — a TEST failure
+# (rc 1) never retries, so real regressions still fail fast.
+# Usage: bash .github/run_tests_chunked.sh [pytest-args...]
+cd "$(dirname "$0")/.." || exit 1
+FAILED=()
+for f in tests/test_*.py; do
+  ok=""
+  for attempt in 1 2 3; do
+    python -m pytest "$f" -q "$@"
+    rc=$?
+    if [ "$rc" -eq 0 ]; then ok=1; break; fi
+    if [ "$rc" -eq 1 ]; then break; fi  # real test failure: no retry
+    echo "=== $f crashed (rc=$rc, attempt $attempt) - retrying"
+  done
+  [ -z "$ok" ] && FAILED+=("$f:rc$rc")
+done
+if [ "${#FAILED[@]}" -gt 0 ]; then
+  echo "CHUNKED SUITE FAILED: ${FAILED[*]}"
+  exit 1
+fi
+echo "CHUNKED SUITE GREEN (all files)"
