@@ -13,8 +13,13 @@ for f in tests/test_*.py; do
   for attempt in 1 2 3; do
     python -m pytest "$f" -q "$@"
     rc=$?
-    if [ "$rc" -eq 0 ]; then ok=1; break; fi
+    if [ "$rc" -eq 0 ] || [ "$rc" -eq 5 ]; then ok=1; break; fi
+    # rc 5 = no tests collected (filter args deselected this file)
     if [ "$rc" -eq 1 ]; then break; fi  # real test failure: no retry
+    if [ "$rc" -eq 2 ]; then            # interrupted (Ctrl-C): abort
+      echo "CHUNKED SUITE INTERRUPTED at $f"
+      exit 2
+    fi
     echo "=== $f crashed (rc=$rc, attempt $attempt) - retrying"
   done
   [ -z "$ok" ] && FAILED+=("$f:rc$rc")

@@ -188,6 +188,18 @@ def enable_compile_cache() -> None:
 
     if jax.config.jax_compilation_cache_dir:
         return  # user already chose a cache location
+    if (
+        jax.default_backend() in ("cpu",)
+        and os.environ.get("SUTRO_COMPILE_CACHE") != "1"
+    ):
+        # XLA:CPU AOT cache entries embed the compiling host's machine
+        # features, and feature detection can differ between processes
+        # on the same box (observed here: '+prefer-no-scatter ...
+        # could lead to execution errors such as SIGILL' on every
+        # cross-process load). CPU caching is therefore explicit
+        # opt-in (SUTRO_COMPILE_CACHE=1); TPU executables target the
+        # accelerator and don't carry host-CPU features.
+        return
     path = sutro_home() / "xla_cache"
     try:
         path.mkdir(parents=True, exist_ok=True)

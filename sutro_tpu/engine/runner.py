@@ -406,10 +406,10 @@ class ModelRunner:
         scanned forward, or the stage-local pipeline schedule under
         ``pipe > 1`` (parallel/pipeline.pipeline_decode).
 
-        ``pfx`` = (pfx_pages [Pp] int32, pfx_len [B] int32) enables
-        Hydragen-style split decode over a job-shared table-head prefix
-        (ops/attention.py); the prefix cache is disabled under pp, so
-        the pipeline path never sees one."""
+        ``pfx`` = tuple of (pages [Pp_g] int32, pfx_len [B] int32)
+        groups enabling Hydragen-style split decode over job-shared
+        table-head prefixes (ops/attention.py); the prefix cache is
+        disabled under pp, so the pipeline path never sees one."""
         B = ids.shape[0]
         ones = jnp.ones((B,), jnp.int32)
         if self.pp > 1:
@@ -429,8 +429,7 @@ class ModelRunner:
             use_pallas=self.use_pallas,
             kv_chunk=kv_chunk,
             ep_mesh=self.ep_mesh,
-            pfx_pages=None if pfx is None else pfx[0],
-            pfx_len=None if pfx is None else pfx[1],
+            pfx_groups=pfx,
         )
 
     def _chunk_for_table(self, page_table: np.ndarray) -> int:
@@ -509,7 +508,7 @@ class ModelRunner:
         #                   frequency [B], repetition [B]) — seen bits
         #                   arrive PRE-PACKED (scheduler maintains them
         #                   incrementally; no O(B*V) host work here)
-        pfx=None,  # (pfx_pages [Pp], pfx_len [B]) split-prefix decode
+        pfx=None,  # tuple of (pages [Pp_g], pfx_len [B]) split-prefix groups
     ) -> Tuple[np.ndarray, np.ndarray]:
         B = len(last_tokens)
         if top_k is None:
@@ -546,11 +545,11 @@ class ModelRunner:
 
     @staticmethod
     def _pfx_jnp(pfx):
-        if pfx is None:
+        if not pfx:
             return None
-        return (
-            jnp.asarray(pfx[0], jnp.int32),
-            jnp.asarray(pfx[1], jnp.int32),
+        return tuple(
+            (jnp.asarray(p, jnp.int32), jnp.asarray(n, jnp.int32))
+            for p, n in pfx
         )
 
     # ------------------------------------------------------------------
@@ -698,7 +697,7 @@ class ModelRunner:
         top_p: np.ndarray,           # [B]
         steps: int,
         top_k: Optional[np.ndarray] = None,
-        pfx=None,  # (pfx_pages [Pp], pfx_len [B]) split-prefix decode
+        pfx=None,  # tuple of (pages [Pp_g], pfx_len [B]) split-prefix groups
     ) -> Tuple[jax.Array, jax.Array]:
         """Like ``decode_multi`` but returns DEVICE arrays without
         blocking: dispatch is async, so callers can chain the next
@@ -845,7 +844,7 @@ class ModelRunner:
         steps: int,
         top_k: Optional[np.ndarray] = None,
         allowed0: Optional[np.ndarray] = None,  # [B, V] bool, step 0 only
-        pfx=None,  # (pfx_pages [Pp], pfx_len [B]) split-prefix decode
+        pfx=None,  # tuple of (pages [Pp_g], pfx_len [B]) split-prefix groups
     ):
         """Speculative window: returns (tokens [steps, B], logprobs
         [steps, B], window_kv handle). Pages are NOT written — call

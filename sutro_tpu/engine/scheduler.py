@@ -721,40 +721,60 @@ class ContinuousBatcher:
 
     def _split_pfx(self, active):
         """Operands for Hydragen-style split decode (Pallas path,
-        EngineConfig.prefix_split): ``(pfx_pages [Pp] int32, pfx_len
-        [B] int32)`` for ONE shared-prefix group per dispatch — the
-        first active job that has one; rows of other jobs (including
-        other jobs' prefixes) keep walking their pages in-kernel.
-        ``None`` when disabled, on the fallback path, or when no
-        active row belongs to a prefix."""
+        EngineConfig.prefix_split): a tuple of ``(pfx_pages [Pp_g]
+        int32, pfx_len [B] int32)`` groups, one per distinct
+        shared-prefix job among the active rows (co-batched templated
+        jobs each get their own group; member sets are disjoint, so
+        the carries combine exactly — ops/attention.py). ``None`` when
+        disabled, on the fallback path, or when no active row belongs
+        to a prefix."""
         if not getattr(self.ecfg, "prefix_split", False):
             return None
         if not getattr(self.runner, "use_pallas", False):
             return None
-        grp = None
+        groups = []
+        seen = set()
         for i in active:
             ctx = self.slots[i].job
-            if ctx is not None and ctx.prefix is not None:
-                grp = ctx
-                break
-        if grp is None:
+            if ctx is None or ctx.prefix is None or id(ctx) in seen:
+                continue
+            seen.add(id(ctx))
+            pfx_len = np.zeros((self.B,), np.int32)
+            for j in active:
+                if self.slots[j].job is ctx:
+                    pfx_len[j] = ctx.prefix.tokens
+            # pad the page list to a power-of-two bucket so distinct
+            # template lengths don't each retrace the fused decode
+            # programs (the pad pages are the garbage page 0, fully
+            # masked by pfx_len in the carry; the kernel skips only
+            # the REAL pfx_len // PS pages)
+            pages = ctx.prefix.pages
+            cap = 1
+            while cap < len(pages):
+                cap *= 2
+            padded = np.zeros((cap,), np.int32)
+            padded[: len(pages)] = pages
+            groups.append((padded, pfx_len))
+        if not groups:
             return None
-        pfx_len = np.zeros((self.B,), np.int32)
-        for i in active:
-            if self.slots[i].job is grp:
-                pfx_len[i] = grp.prefix.tokens
-        # pad the page list to a power-of-two bucket so distinct
-        # template lengths don't each retrace the fused decode programs
-        # (the pad pages are the garbage page 0, fully masked by
-        # pfx_len in the carry; the kernel skips only the REAL
-        # pfx_len // PS pages)
-        pages = grp.prefix.pages
-        cap = 1
-        while cap < len(pages):
-            cap *= 2
-        padded = np.zeros((cap,), np.int32)
-        padded[: len(pages)] = pages
-        return padded, pfx_len
+        # the tuple's pytree STRUCTURE is a jit trace key: bound the
+        # recompiles from varying group counts by (a) sorting groups by
+        # page-bucket size so (4,8) and (8,4) share a structure and
+        # (b) padding the count to a power of two with dummy groups
+        # (1 garbage page, all-zero pfx_len -> provably cold carry,
+        # an exact no-op costing one tiny masked gather+einsum)
+        groups.sort(key=lambda g: -len(g[0]))
+        n = 1
+        while n < len(groups):
+            n *= 2
+        while len(groups) < n:
+            groups.append(
+                (
+                    np.zeros((1,), np.int32),
+                    np.zeros((self.B,), np.int32),
+                )
+            )
+        return tuple(groups)
 
     def _spec_enough(self, n_draft: int, active) -> bool:
         """THE engagement threshold (one definition so the in-loop

@@ -255,8 +255,8 @@ def layer_apply(
     win_len: Optional[jax.Array] = None,
     kv_chunk: int = 1,
     ep_mesh=None,  # Mesh with "expert" axis > 1 => shard_map EP MLP
-    pfx_pages: Optional[jax.Array] = None,  # shared-prefix decode
-    pfx_len: Optional[jax.Array] = None,    # (ops/attention.py)
+    pfx_groups: Optional[tuple] = None,  # shared-prefix decode groups
+    #                                      (ops/attention.py)
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     """One decoder block. Shared by the scanned ``forward`` and the
     pipeline-parallel stage loop (parallel/pipeline.py). Returns
@@ -290,7 +290,7 @@ def layer_apply(
         ring_mesh=ring_mesh,
         win_k=wk_l, win_v=wv_l, win_len=win_len,
         kv_chunk=kv_chunk,
-        pfx_pages=pfx_pages, pfx_len=pfx_len,
+        pfx_groups=pfx_groups,
     )
     attn = attn.reshape(B, T, cfg.q_size) @ _w(lp, "wo", h.dtype)
     if cfg.attn_bias:
@@ -408,10 +408,10 @@ def forward(
     kv_chunk: int = 1,  # static: pages per decode-kernel DMA
     ep_mesh=None,  # Mesh with "expert" axis > 1 => shard_map EP MLP
     # shared-prefix decode (Hydragen-style carry injection, see
-    # ops/attention.py): the job-shared pages at member rows' table
-    # heads + per-row prefix token counts (0 = row not in the group)
-    pfx_pages: Optional[jax.Array] = None,  # [Pp] int32
-    pfx_len: Optional[jax.Array] = None,    # [B] int32
+    # ops/attention.py): tuple of (pages [Pp_g], pfx_len [B]) groups —
+    # the job-shared pages at member rows' table heads + per-row
+    # prefix token counts (0 = row not in that group)
+    pfx_groups: Optional[tuple] = None,
 ) -> Tuple[jax.Array, jax.Array, Tuple[jax.Array, jax.Array]]:
     """Run the trunk over a chunk.
 
@@ -471,7 +471,7 @@ def forward(
             use_pallas=use_pallas, ring_mesh=ring_mesh,
             wk_l=wk_l, wv_l=wv_l, win_len=win_len,
             kv_chunk=kv_chunk, ep_mesh=ep_mesh,
-            pfx_pages=pfx_pages, pfx_len=pfx_len,
+            pfx_groups=pfx_groups,
         )
 
     h, (k_all, v_all) = jax.lax.scan(layer_step, h, xs)

@@ -61,15 +61,18 @@ def chunk_attention(
     win_len: Optional[jax.Array] = None,
     kv_chunk: int = 1,  # static: pages per decode-kernel DMA (>1 means
                         # the caller guarantees contiguous page runs)
-    # shared-prefix (Hydragen-style) decode: member rows' tables START
-    # with these shared pages; the Pallas path computes their attention
-    # once for the whole batch (one HBM read of the shared pages per
-    # layer-step instead of one per row) and injects it as the paged
-    # kernel's initial online-softmax carry. The fallback path ignores
-    # both (the tables still contain the prefix pages, so its full-table
-    # gather computes the identical function).
-    pfx_pages: Optional[jax.Array] = None,  # [Pp] int32 shared pages
-    pfx_len: Optional[jax.Array] = None,    # [B] int32 (0 = not member)
+    # shared-prefix (Hydragen-style) decode: each group is a
+    # ``(pages [Pp_g] int32, pfx_len [B] int32)`` pair — member rows'
+    # tables START with the group's shared pages (pfx_len 0 = row not
+    # in that group; groups are disjoint). The Pallas path computes
+    # each group's prefix attention once for the whole batch (one HBM
+    # read of the shared pages per layer-step instead of one per row),
+    # combines the per-row carries exactly (max/sum/sum over disjoint
+    # groups), and injects them as the paged kernel's initial
+    # online-softmax carry. The fallback path ignores this (the tables
+    # still contain the prefix pages, so its full-table gather computes
+    # the identical function).
+    pfx_groups: Optional[tuple] = None,
 ) -> jax.Array:
     """Returns [B, T, NH, Dh]."""
     B, T = q.shape[:2]
@@ -96,26 +99,40 @@ def chunk_attention(
                     else jnp.asarray(window, jnp.int32)
                 )
                 pfx_kw = {}
-                if pfx_pages is not None:
+                if pfx_groups:
                     from .pallas_paged import prefix_attention_carry
 
                     PS = past_k_pages.shape[1]
                     q_pos = past_len + (
                         win_len if win_len is not None else 0
                     )
-                    m0, l0, acc0 = prefix_attention_carry(
-                        q[:, 0], past_k_pages, past_v_pages,
-                        pfx_pages, pfx_len, q_pos, win,
-                        k_scale=past_k_scale, v_scale=past_v_scale,
-                    )
+                    # groups have DISJOINT member rows, so per-row
+                    # carries combine exactly: cold rows contribute
+                    # (-inf, 0, 0) to max/sum/sum
+                    m0 = l0 = acc0 = None
+                    pfx_cnt = jnp.zeros_like(past_len)
+                    for pages_g, len_g in pfx_groups:
+                        mg, lg, ag = prefix_attention_carry(
+                            q[:, 0], past_k_pages, past_v_pages,
+                            pages_g, len_g, q_pos, win,
+                            k_scale=past_k_scale,
+                            v_scale=past_v_scale,
+                        )
+                        if m0 is None:
+                            m0, l0, acc0 = mg, lg, ag
+                        else:
+                            m0 = jnp.maximum(m0, mg)
+                            l0 = l0 + lg
+                            acc0 = acc0 + ag
+                        pfx_cnt = pfx_cnt + len_g // PS
                     pfx_kw = dict(
-                        pfx_cnt=pfx_len // PS, m0=m0, l0=l0, acc0=acc0
+                        pfx_cnt=pfx_cnt, m0=m0, l0=l0, acc0=acc0
                     )
                 out = paged_decode_attention(
                     q[:, 0], past_k_pages, past_v_pages, page_table,
                     past_len, k[:, 0], v[:, 0], win, sink,
                     win_k=win_k, win_v=win_v, win_len=win_len,
-                    kv_chunk=1 if pfx_pages is not None else kv_chunk,
+                    kv_chunk=1 if pfx_groups else kv_chunk,
                     k_scale=past_k_scale, v_scale=past_v_scale,
                     **pfx_kw,
                 )

@@ -89,6 +89,76 @@ def _run(tok, split: bool, monkeypatch):
     return {i: r.token_ids for i, r in res.items()}
 
 
+def test_two_prefix_groups_cobatched(byte_tok, monkeypatch):
+    """Two templated jobs with DIFFERENT shared prefixes co-batched:
+    each gets its own carry group (disjoint member sets combine by
+    max/sum/sum), and outputs stay identical to the unsplit kernel."""
+    from sutro_tpu.engine.scheduler import JobCtx
+
+    _force_interpret(monkeypatch)
+    tok = byte_tok
+    PFX2 = "system: extract the named entity. text: "
+
+    def run(split):
+        ecfg = EngineConfig(
+            kv_page_size=8,
+            max_pages_per_seq=10,
+            max_model_len=80,
+            decode_batch_size=4,
+            use_pallas=True,
+            param_dtype="float32",
+            activation_dtype="float32",
+            decode_multi_step=1,
+            decode_lookahead=1,
+            prefix_split=split,
+        )
+        b = ContinuousBatcher(
+            ModelRunner(MODEL_CONFIGS["tiny-dense"], ecfg),
+            stop_ids=tok.stop_ids(),
+        )
+
+        def reqs(texts, base):
+            return [
+                GenRequest(
+                    row_id=base + i,
+                    prompt_ids=np.array(tok.encode(t), np.int32),
+                    max_new_tokens=4,
+                    temperature=0.0,
+                )
+                for i, t in enumerate(texts)
+            ]
+
+        ga, gb = {}, {}
+        st = b.run_multi(
+            [
+                JobCtx(
+                    job_id="A",
+                    pending=reqs([PREFIX + s for s in SUFFIXES[:2]], 0),
+                    on_result=lambda r: ga.__setitem__(r.row_id, r),
+                    priority=1,
+                    seq=0,
+                ),
+                JobCtx(
+                    job_id="B",
+                    pending=reqs([PFX2 + s for s in ("alpha", "beta")], 100),
+                    on_result=lambda r: gb.__setitem__(r.row_id, r),
+                    priority=1,
+                    seq=1,
+                ),
+            ],
+            on_job_done=lambda c, o: None,
+        )
+        assert st == "completed"
+        return (
+            {i: r.token_ids for i, r in ga.items()},
+            {i: r.token_ids for i, r in gb.items()},
+        )
+
+    on_a, on_b = run(True)
+    off_a, off_b = run(False)
+    assert on_a == off_a and on_b == off_b
+
+
 def test_engine_split_decode_matches_unsplit(byte_tok, monkeypatch):
     from sutro_tpu.ops import pallas_paged
 
