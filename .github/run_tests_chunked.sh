@@ -7,19 +7,21 @@
 # (rc 1) never retries, so real regressions still fail fast.
 # Usage: bash .github/run_tests_chunked.sh [pytest-args...]
 cd "$(dirname "$0")/.." || exit 1
+trap 'echo "CHUNKED SUITE INTERRUPTED"; exit 130' INT
 FAILED=()
 for f in tests/test_*.py; do
   ok=""
   for attempt in 1 2 3; do
     python -m pytest "$f" -q "$@"
     rc=$?
-    if [ "$rc" -eq 0 ] || [ "$rc" -eq 5 ]; then ok=1; break; fi
-    # rc 5 = no tests collected (filter args deselected this file)
-    if [ "$rc" -eq 1 ]; then break; fi  # real test failure: no retry
-    if [ "$rc" -eq 2 ]; then            # interrupted (Ctrl-C): abort
-      echo "CHUNKED SUITE INTERRUPTED at $f"
-      exit 2
-    fi
+    if [ "$rc" -eq 0 ]; then ok=1; break; fi
+    # rc 5 = no tests collected: fine under filter args, a silent
+    # coverage hole otherwise
+    if [ "$rc" -eq 5 ] && [ "$#" -gt 0 ]; then ok=1; break; fi
+    # rc 1 = test failure, rc 2 = collection error (pytest also uses
+    # 2 for Ctrl-C, which the INT trap above handles): record, no
+    # retry, keep running the remaining files
+    if [ "$rc" -eq 1 ] || [ "$rc" -eq 2 ]; then break; fi
     echo "=== $f crashed (rc=$rc, attempt $attempt) - retrying"
   done
   [ -z "$ok" ] && FAILED+=("$f:rc$rc")

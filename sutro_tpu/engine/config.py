@@ -44,9 +44,11 @@ class EngineConfig:
                                     # page capacity (kvcache.write_kv
                                     # quantizes, the paged kernel /
                                     # gather fallback dequantize).
-                                    # Single-device only this round
-                                    # (runner warns+ignores under a
-                                    # multi-chip mesh)
+                                    # Works under dp/tp/sp/ep meshes
+                                    # (scales are full-KD amax, hence
+                                    # shard-invariant and replicated);
+                                    # pp only warns+ignores (pipeline
+                                    # decode carries no scale pools)
     # --- KV cache / batching ----------------------------------------------
     kv_page_size: int = 64          # tokens per KV page
     max_pages_per_seq: int = 128    # => max context 8192 by default

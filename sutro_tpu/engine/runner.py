@@ -84,15 +84,22 @@ class ModelRunner:
             if dp * pp * sp * ep * tp > 1:
                 mesh = auto_mesh(ecfg)
         self.mesh = mesh
-        if mesh is not None and getattr(ecfg, "kv_quantize", None):
-            # the scale pools don't carry the sharded KV-head axis;
-            # sharding them correctly under tp/pp is future work —
-            # run the quantized cache single-device only
+        if (
+            mesh is not None
+            and getattr(ecfg, "kv_quantize", None)
+            and int(mesh.shape.get("pipe", 1)) > 1
+        ):
+            # the pipeline decode path (parallel/pipeline.py) carries
+            # bare k/v page pools, no scale pools — quantized KV under
+            # pp stays unsupported. Under dp/tp/sp/ep it IS supported:
+            # per-token scales are computed over the FULL fused KD axis
+            # (a cross-shard reduce under GSPMD), so they are
+            # shard-invariant and the scale pools simply replicate.
             import warnings
 
             warnings.warn(
-                "kv_quantize is single-device only this round; "
-                "ignoring it under a multi-chip mesh"
+                "kv_quantize is not supported under pipeline "
+                "parallelism; ignoring it for this pp mesh"
             )
             import dataclasses as _dc
 
@@ -181,9 +188,21 @@ class ModelRunner:
         self.alloc_pages = num_pages - (self.kv_chunk - 1)
         self.cache = alloc_cache(mcfg, ecfg, num_pages, dtype=dtype)
         if self._cache_sharding is not None:
+            scale_kw = {}
+            if self.cache.quantized:
+                # per-token scales are shard-invariant (full-KD amax),
+                # so the scale pools replicate across the mesh
+                from ..parallel.sharding import replicated
+
+                rep = replicated(self.mesh)
+                scale_kw = dict(
+                    k_scale=jax.device_put(self.cache.k_scale, rep),
+                    v_scale=jax.device_put(self.cache.v_scale, rep),
+                )
             self.cache = KVCache(
                 k_pages=jax.device_put(self.cache.k_pages, self._cache_sharding),
                 v_pages=jax.device_put(self.cache.v_pages, self._cache_sharding),
+                **scale_kw,
             )
 
     @staticmethod

@@ -232,3 +232,65 @@ def test_engine_end_to_end_int8_kv(byte_tok):
     )
     total = sum(len(res2[i].token_ids) for i in res2)
     assert agree >= total * 0.5, f"{agree}/{total} tokens agree"
+
+
+def test_int8_kv_under_tp_mesh_matches_single_device(eight_devices):
+    """int8 KV under a dp x tp mesh: per-token scales are computed over
+    the FULL fused KD axis (a cross-shard reduce under GSPMD), so they
+    are shard-invariant and the scale pools replicate — greedy
+    generation must match the single-device int8 cache exactly."""
+    import jax
+
+    from sutro_tpu.engine.runner import ModelRunner
+    from sutro_tpu.parallel.mesh import make_mesh
+
+    cfg = MODEL_CONFIGS["tiny-dense"]
+    prompt = np.arange(11, dtype=np.int32) % 200
+
+    def run(mesh):
+        runner = ModelRunner(cfg, _ecfg(), mesh=mesh)
+        assert runner.ecfg.kv_quantize == "int8", "gate must not strip"
+        assert runner.cache.quantized
+        table = np.zeros((16,), np.int32)
+        table[:4] = [1, 2, 3, 4]
+        logits = runner.prefill(prompt, table)
+        tok = int(np.argmax(logits))
+        out = [tok]
+        pos = len(prompt)
+        for _ in range(4):
+            toks, _ = runner.decode_step(
+                np.array([tok, 0, 0, 0], np.int32),
+                np.array([pos, 0, 0, 0], np.int32),
+                np.stack([table] + [np.zeros((16,), np.int32)] * 3),
+                jax.random.PRNGKey(0),
+                np.zeros(4, np.float32),
+                np.ones(4, np.float32),
+            )
+            tok = int(toks[0])
+            out.append(tok)
+            pos += 1
+        return out
+
+    single = run(None)
+    sharded = run(make_mesh(2, 1, 2, eight_devices[:4]))
+    assert single == sharded
+
+
+def test_int8_kv_under_pp_mesh_warns_and_strips(eight_devices):
+    """Pipeline decode carries bare page pools (no scales): the gate
+    must warn and fall back to the bf16 cache under pp only."""
+    import warnings
+
+    from sutro_tpu.engine.runner import ModelRunner
+    from sutro_tpu.parallel.mesh import make_mesh
+
+    cfg = MODEL_CONFIGS["tiny-dense"]
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        runner = ModelRunner(
+            cfg, _ecfg(),
+            mesh=make_mesh(1, 1, 2, eight_devices[:4], pp=2),
+        )
+    assert runner.ecfg.kv_quantize is None
+    assert not runner.cache.quantized
+    assert any("pipeline" in str(x.message) for x in w)
