@@ -11,8 +11,9 @@ if str(_repo) not in sys.path:
     sys.path.insert(0, str(_repo))
 
 
-def example_client(description: str):
-    """Returns (Sutro client, generation model, embedding model)."""
+def example_client(description: str, engine_config: dict | None = None):
+    """Returns (Sutro client, generation model, embedding model).
+    ``engine_config`` entries are merged over the defaults."""
     ap = argparse.ArgumentParser(description=description)
     ap.add_argument(
         "--cpu", action="store_true",
@@ -29,19 +30,19 @@ def example_client(description: str):
 
         # context must cover template system prompts (~250 bytes through
         # the byte tokenizer) PLUS each schema's minimal JSON
-        client = Sutro(
-            engine_config=dict(
-                kv_page_size=8, max_pages_per_seq=48, decode_batch_size=4,
-                max_model_len=384, max_new_tokens=64, use_pallas=False,
-                param_dtype="float32",
-            )
+        ecfg = dict(
+            kv_page_size=8, max_pages_per_seq=48, decode_batch_size=4,
+            max_model_len=384, max_new_tokens=64, use_pallas=False,
+            param_dtype="float32",
         )
+        ecfg.update(engine_config or {})
+        client = Sutro(engine_config=ecfg)
         return client, args.model or "tiny-dense", "tiny-emb"
 
     from sutro_tpu.sdk import Sutro
 
     return (
-        Sutro(),
+        Sutro(engine_config=engine_config) if engine_config else Sutro(),
         args.model or "qwen-3-0.6b",
         "qwen-3-embedding-0.6b",
     )
