@@ -1442,6 +1442,11 @@ class _GenSession:
                 "accepted": accepted,
                 "acceptance_rate": round(accepted / drafted, 3),
             }
+        ff = self.ctx.stats.get("ff_forced", 0)
+        if ff:
+            # FSM fast-forward: scaffold tokens committed through
+            # parallel verify forwards instead of per-step windows
+            perf["fastforward"] = {"forced_tokens": ff}
         self.eng.jobs.update(
             self.job_id,
             input_tokens=self.input_tokens,

@@ -94,6 +94,21 @@ class EngineConfig:
                                     # acceptance rate is high — flip
                                     # per the chip A/B (bench_e2e
                                     # SUTRO_E2E_SPEC)
+    constrain_fastforward: int = 16  # FSM fast-forward ("jump
+                                    # decoding") width: when a schema's
+                                    # FSM forces exactly one next token
+                                    # (scaffold regions like
+                                    # '{"field": "'), peel up to this
+                                    # many forced tokens host-side and
+                                    # commit them through ONE parallel
+                                    # verify forward instead of
+                                    # step-by-step windows that reject
+                                    # their unmasked samples there.
+                                    # Exact for greedy constrained rows
+                                    # (forced tokens are
+                                    # model-independent; the bonus
+                                    # token follows the speculative
+                                    # window's accept rule). 0 = off
     prefill_piggyback: bool = True  # Sarathi-style chunked-prefill
                                     # interleave: a long prompt admits as
                                     # a PREFILLING slot that advances one

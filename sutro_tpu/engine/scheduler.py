@@ -317,6 +317,13 @@ class ContinuousBatcher:
         # prompt-lookup path; rate = accepted / drafted)
         self.spec_drafted = 0
         self.spec_accepted = 0
+        # FSM fast-forward ("jump decoding"): forced scaffold tokens
+        # committed through parallel verify forwards instead of
+        # step-by-step windows. The probe backoff bounds the O(B x V)
+        # singleton scan on batches sitting in free-text regions.
+        self.ff_forced = 0
+        self._ff_probe_step = 0
+        self._ff_backoff = 0
         # next step at which the n-gram speculative path may probe;
         # bumped with exponential backoff on failed probes / poor
         # acceptance so the pipelined windows keep RTT hidden between
@@ -704,6 +711,132 @@ class ContinuousBatcher:
         d = h[j + 2 : j + 2 + K]
         return np.asarray(d, np.int32) if d else None
 
+    def _fastforward_step(self, active, last, past_len, table) -> bool:
+        """FSM fast-forward ("jump decoding", cf. SGLang/guidance):
+        inside a schema's scaffold regions ('{"scratchpad": "' ...) the
+        FSM allows exactly ONE next token for long runs, and the
+        speculative window's unmasked samples reject there (PERF.md
+        round-3 note). Peel each such row's forced run host-side
+        (advancing its FSM — forced tokens are committed regardless of
+        model output), then ONE parallel verify forward writes the
+        run's K/V and yields every row's next-position greedy token as
+        the bonus, accepted iff FSM-valid (the speculative window's
+        exact rule). Rows without a forced run — including
+        unconstrained greedy rows — ride along as draft_len-0 plain
+        greedy steps.
+
+        Engagement is decided BEFORE any FSM is advanced (mask
+        singleton count over the active constrained rows): returning
+        False leaves every FSM untouched and the caller falls through
+        to the speculative window. Forced tokens record logp 0.0 —
+        probability 1 under the masked distribution, exactly what the
+        masked single-step they replace reports."""
+        FF = getattr(self.ecfg, "constrain_fastforward", 0)
+        if FF <= 0 or self._step < self._ff_probe_step:
+            return False
+        PS = self.ecfg.kv_page_size
+        flagged = self._needs_mask & set(active)
+        need = (len(active) + 1) // 2
+        con = [i for i in active if self.slots[i].req.constraint is not None]
+        cand = {}
+        left = len(con)
+        for i in con:
+            # early exit: even if every unscanned constrained row were
+            # a singleton, the engagement threshold is unreachable —
+            # don't pay the remaining O(V) mask builds
+            if len(cand) + left < need and not flagged:
+                break
+            left -= 1
+            s = self.slots[i]
+            c = s.req.constraint
+            rem = self._remaining(s.req, len(s.out_ids), s.pos)
+            m = self._constraint_mask(c, rem)
+            nz = np.flatnonzero(m)
+            if len(nz) == 1 and int(nz[0]) not in self.stop_ids:
+                cand[i] = (int(nz[0]), rem)
+            elif i in flagged:
+                # a flagged non-singleton row needs its allowed0 masked
+                # step (logits under mask) — the window path owns that
+                self._ff_fail_backoff()
+                return False
+        if len(cand) < need:
+            self._ff_fail_backoff()
+            return False
+        # a flagged SINGLETON row is itself a fast-forward candidate:
+        # the peel's first token IS the masked step its flag demands
+        self._needs_mask -= set(cand)
+        self._ff_backoff = 0
+        # committed from here: peeling advances the real FSMs
+        drafts = np.zeros((self.B, FF), np.int32)
+        dlens = np.zeros((self.B,), np.int32)
+        for i, (tok, rem) in cand.items():
+            s = self.slots[i]
+            c = s.req.constraint
+            cap = min(FF, len(s.pages) * PS - s.pos - 1, rem)
+            run = []
+            while len(run) < cap:
+                run.append(tok)
+                c.advance(tok)
+                rem -= 1
+                if c.is_complete() or rem <= 0:
+                    break
+                m = self._constraint_mask(c, rem)
+                nz = np.flatnonzero(m)
+                if len(nz) != 1 or int(nz[0]) in self.stop_ids:
+                    # stop tokens are never peeled: the normal accept
+                    # path owns stop semantics (incl. not advancing
+                    # the FSM on stops, _record_token)
+                    break
+                tok = int(nz[0])
+            drafts[i, : len(run)] = run
+            dlens[i] = len(run)
+        with self.timer.time("decode"):
+            toks_v, logp_v = self.runner.verify_greedy(
+                np.asarray(last, np.int32), drafts, dlens,
+                np.asarray(past_len, np.int32), table,
+            )
+        self._step += 1
+        for i in active:
+            s = self.slots[i]
+            ctx = s.job
+            L = int(dlens[i])
+            self.ff_forced += L
+            if ctx is not None and L:
+                ctx.stats["ff_forced"] = (
+                    ctx.stats.get("ff_forced", 0) + L
+                )
+            finished = False
+            for j in range(L):
+                if self._accept_token(
+                    i, int(drafts[i, j]), 0.0,
+                    advance_constraint=False,
+                    suppress_complete=j < L - 1,
+                ):
+                    finished = True
+                    break
+            if finished:
+                continue
+            tok = int(toks_v[i, L])
+            c = s.req.constraint
+            if c is not None:
+                rem = self._remaining(s.req, len(s.out_ids), s.pos)
+                if not self._token_ok(c, tok, rem):
+                    # next iteration's window opens with this row's
+                    # FSM-masked step (allowed0 recovery)
+                    self._needs_mask.add(i)
+                    continue
+            self._accept_token(i, tok, float(logp_v[i, L]))
+        return True
+
+    def _ff_fail_backoff(self) -> None:
+        """Exponential re-probe backoff (2..32 window lengths) after a
+        disengaged fast-forward scan: free-text regions (non-singleton
+        masks) would otherwise pay the O(rows x V) mask scan before
+        every window dispatch."""
+        KS = max(self.ecfg.decode_multi_step, 1)
+        self._ff_backoff = min(max(self._ff_backoff * 2, 2 * KS), 32 * KS)
+        self._ff_probe_step = self._step + self._ff_backoff
+
     def _spec_fail_backoff(self) -> None:
         """Push the next speculative probe out with exponential backoff
         (4..64 window lengths): batches that never draft — or draft but
@@ -962,12 +1095,21 @@ class ContinuousBatcher:
         logp = cumulative_logprob(jl, tok)
         return np.asarray(tok), np.asarray(logp)
 
-    def _record_token(self, slot: _Slot, tok: int, logp: float) -> None:
+    def _record_token(
+        self, slot: _Slot, tok: int, logp: float, advance: bool = True
+    ) -> None:
         slot.out_ids.append(tok)
         if slot.hist is not None:  # n-gram draft history (incremental)
             self._hist_push(slot, tok)
         slot.logprob_sum += float(logp)
-        if slot.req.constraint is not None and tok not in self.stop_ids:
+        # ``advance=False``: FSM fast-forward peels forced runs by
+        # advancing the constraint host-side BEFORE dispatch; accepting
+        # those tokens must not advance twice
+        if (
+            advance
+            and slot.req.constraint is not None
+            and tok not in self.stop_ids
+        ):
             slot.req.constraint.advance(tok)
         if slot.req.has_penalties() and tok not in self.stop_ids:
             slot.counts[tok] = slot.counts.get(tok, 0) + 1
@@ -988,13 +1130,21 @@ class ContinuousBatcher:
                     break
             slot.tail = grown[-(longest - 1):] if longest > 1 else b""
 
-    def _finish_reason(self, slot: _Slot, tok: int) -> Optional[str]:
+    def _finish_reason(
+        self, slot: _Slot, tok: int, suppress_complete: bool = False
+    ) -> Optional[str]:
         c = slot.req.constraint
         if slot.hit_stop_seq:
             return "stop"
         if tok in self.stop_ids:
             return "stop"
-        if c is not None and c.is_complete():
+        # suppress_complete: the FSM fast-forward peel advances the
+        # constraint through a whole forced run BEFORE tokens are
+        # accepted, so is_complete() reflects the END of the run —
+        # consulting it for earlier run tokens would truncate the row
+        # (the peel breaks on completion, so only the LAST forced
+        # token may legitimately finish by schema_complete)
+        if not suppress_complete and c is not None and c.is_complete():
             return "schema_complete"
         if len(slot.out_ids) >= slot.req.max_new_tokens:
             return "length"
@@ -1003,7 +1153,9 @@ class ContinuousBatcher:
         return None
 
     def _accept_token(
-        self, i: int, tok: int, logp: float, release: bool = True
+        self, i: int, tok: int, logp: float, release: bool = True,
+        advance_constraint: bool = True,
+        suppress_complete: bool = False,
     ) -> int:
         """Record one sampled token for slot ``i``; release on finish.
         Returns 1 if the row completed, else 0. ``release=False`` defers
@@ -1015,11 +1167,11 @@ class ContinuousBatcher:
         s.pos += 1  # last_token's KV is now cached
         if self.native is not None:
             self.native.note_token(i, tok)
-        self._record_token(s, tok, logp)
+        self._record_token(s, tok, logp, advance=advance_constraint)
         s.last_token = tok
         if s.job is not None:
             s.job.stats["out"] += 1
-        if self._finish_reason(s, tok):
+        if self._finish_reason(s, tok, suppress_complete):
             if release:
                 self._emit(i)
             return 1
@@ -1733,6 +1885,31 @@ class ContinuousBatcher:
                 # composition
                 rng = self._fixed_key if has_row_seed else sub
                 if K > 1 and has_constraint:
+                    # FSM fast-forward first: when enough rows sit in a
+                    # forced scaffold run, one parallel verify commits
+                    # the whole run per row — the speculative window
+                    # below would reject its unmasked samples there.
+                    # Flagged SINGLETON rows are candidates too (the
+                    # peel is their masked step); a flagged row in a
+                    # non-singleton state sends the batch to the
+                    # window's allowed0 recovery instead. The verify
+                    # forward has no ring/pipeline wrapper.
+                    if (
+                        getattr(self.runner, "sp", 1) == 1
+                        and getattr(self.runner, "pp", 1) == 1
+                        and all(
+                            self.slots[i].req.temperature <= 0.0
+                            for i in active
+                        )
+                        and self._fastforward_step(
+                            active, last, past_len, table
+                        )
+                    ):
+                        self._sweep_done(live, on_job_done)
+                        for ctx in live:
+                            if not ctx.done:
+                                self._job_progress(ctx)
+                        continue
                     # speculative window: sample unmasked, verify
                     # host-side, commit only each row's FSM-valid
                     # prefix. Rows whose previous window rejected take

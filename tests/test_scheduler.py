@@ -464,6 +464,13 @@ def test_speculative_rejection_is_per_row(tiny_runner, byte_tok, monkeypatch):
         tiny_runner, stop_ids=byte_tok.stop_ids(),
         token_bytes=byte_tok.token_bytes,
     )
+    # this test pins the WINDOW path's per-row rejection recovery; the
+    # FSM fast-forward would otherwise commit the const row's forced
+    # run without dispatching any window at all (its own invariant is
+    # pinned by tests/test_fastforward.py)
+    import dataclasses as _dc
+
+    b.ecfg = _dc.replace(b.ecfg, constrain_fastforward=0)
     fac = schema_constraint_factory({"const": "zqxzqxzqxzqx"}, byte_tok)
     reqs = [
         GenRequest(
