@@ -147,6 +147,11 @@ def main() -> None:
         ecfg["prefix_split"] = (
             os.environ["SUTRO_PREFIX_SPLIT"] == "1"
         )
+    # FSM fast-forward A/B (classify is schema-constrained, so its
+    # scaffold tokens ride parallel verifies by default — SUTRO_E2E_FF=0
+    # measures the pre-round-4 window path)
+    if os.environ.get("SUTRO_E2E_FF"):
+        ecfg["constrain_fastforward"] = int(os.environ["SUTRO_E2E_FF"])
 
     os.environ.setdefault("SUTRO_HOME", "/tmp/sutro-bench-e2e")
     from sutro_tpu.sdk import Sutro

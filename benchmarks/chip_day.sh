@@ -39,6 +39,9 @@ step "prefix-split A/B on" 3600 \
 step "spec + prefix-split stacked" 3600 \
   env SUTRO_E2E_ROWS=2000 SUTRO_E2E_WORKLOADS=classify \
   SUTRO_E2E_SPEC=6 SUTRO_PREFIX_SPLIT=1 python bench_e2e.py
+step "fastforward A/B off (pre-round-4 constrained path)" 3600 \
+  env SUTRO_E2E_ROWS=2000 SUTRO_E2E_WORKLOADS=classify \
+  SUTRO_E2E_FF=0 python bench_e2e.py
 step "cost_northstar" 1800 python benchmarks/cost_northstar.py
 step "golden_quickstart (needs weights)" 3600 \
   python benchmarks/golden_quickstart.py
