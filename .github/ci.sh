@@ -6,4 +6,4 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 make native
 make compile-check
-python -m pytest tests/ -q
+bash .github/run_tests_chunked.sh

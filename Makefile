@@ -4,7 +4,7 @@
 
 PY ?= python
 
-.PHONY: all native test test-fast compile-check bench bench-e2e dryrun \
+.PHONY: all native test test-oneshot test-fast compile-check bench bench-e2e dryrun \
 	chip-validate bench-8b cost golden clean
 
 all: native compile-check
@@ -12,8 +12,18 @@ all: native compile-check
 native:
 	$(MAKE) -C native
 
-# full suite (CPU, 8 virtual devices via tests/conftest.py)
+# full suite (CPU, 8 virtual devices via tests/conftest.py), run
+# per-file with crash-only retries: this build host's XLA:CPU compiler
+# segfaults rarely but nondeterministically inside
+# backend_compile_and_load under load (observed twice, different test
+# files, both pass in isolation) — a single-process run can die at ~60%
+# through no fault of the code. Real test failures still fail fast.
 test: native
+	bash .github/run_tests_chunked.sh
+
+# single-process run (faster when the host's XLA CPU compiler is
+# healthy; see `test` for why the chunked runner is the default)
+test-oneshot: native
 	$(PY) -m pytest tests/ -q
 
 # quick gate: everything except the slow multi-device / golden suites
