@@ -60,6 +60,12 @@ def make_reviews(n: int) -> list:
 def main() -> None:
     import jax
 
+    if os.environ.get("SUTRO_E2E_CPU") == "1":
+        # force the CPU smoke without touching the accelerator: with
+        # the axon tunnel DOWN the first backend probe hangs forever
+        # inside a C call (the sitecustomize pins the axon platform, so
+        # the env var alone cannot force CPU)
+        jax.config.update("jax_platforms", "cpu")
     on_tpu = jax.default_backend() not in ("cpu",)
     n_chips = max(jax.device_count(), 1)
     workloads = {
