@@ -39,6 +39,29 @@ class TokenTable:
         self.empty_ids = np.array(
             [i for i, b in enumerate(self.token_bytes) if not b], np.int64
         )
+        self._b2t: Optional[Dict[bytes, int]] = None
+        self._max_tok_len = 0
+
+    def matches_longest_first(self, data: bytes, start: int):
+        """Yield (token id, byte length) vocab matches at
+        ``data[start:]``, longest first. Built lazily (one dict over
+        the vocab); first-listed id wins among duplicate byte
+        strings."""
+        if self._b2t is None:
+            b2t: Dict[bytes, int] = {}
+            for tid, tb in enumerate(self.token_bytes):
+                if tb and tb not in b2t:
+                    b2t[tb] = tid
+            self._b2t = b2t
+            self._max_tok_len = max(
+                (len(b) for b in b2t), default=0
+            )
+        for ln in range(
+            min(self._max_tok_len, len(data) - start), 0, -1
+        ):
+            tid = self._b2t.get(data[start : start + ln])
+            if tid is not None:
+                yield tid, ln
 
 
 INF_DIST = np.int32(0x7FFFFFFF)
@@ -172,6 +195,79 @@ class TokenFSM:
             # budget was infeasible from the start (or non-byte stop path):
             # degrade to the unfiltered mask rather than dead-ending
         return m
+
+    def plan_fastforward(
+        self,
+        remaining: Optional[int],
+        max_tokens: int,
+        max_cand: int,
+    ):
+        """Plan a masked-verify jump (scheduler FSM fast-forward): walk
+        the FORCED byte path from the current state (exactly one
+        allowed byte per step, stopping at accepting states), tokenize
+        it greedy-longest, and collect the (small) budget-filtered
+        candidate mask at every token boundary — candidates are what
+        the device argmaxes over, so each planned position yields the
+        EXACT masked-path token. Under byte-level tokenization the
+        candidate sets are singletons; under BPE vocabs they are the
+        path's prefix tokenizations (plus boundary crossers), still
+        small. The final position is the first free choice point,
+        included while its mask also fits ``max_cand`` (enum leaves).
+
+        Returns ``(draft_ids, cand_sets)`` with ``len(cand_sets) in
+        (len(draft_ids), len(draft_ids) + 1)``, or ``None`` when
+        nothing is plannable. NEVER mutates FSM state (the NFA walk is
+        purely functional) — accepting planned tokens later advances
+        the FSM through the normal paths."""
+        if self._complete:
+            return None
+        nfa = self.nfa
+        # forced byte path
+        forced = bytearray()
+        cur = self.states
+        cap_bytes = 8 * max_tokens
+        while len(forced) < cap_bytes and not nfa.is_accepting(cur):
+            bo = np.flatnonzero(nfa.allowed_bytes(cur))
+            if len(bo) != 1:
+                break
+            forced.append(int(bo[0]))
+            cur = nfa.step(cur, int(bo[0]))
+        forced = bytes(forced)
+
+        draft: List[int] = []
+        cands: List[np.ndarray] = []
+        cur = self.states
+        i = 0
+        while len(draft) < max_tokens:
+            m, dist = self.masks.mask_and_dist(cur)
+            if remaining is not None:
+                rem_j = remaining - len(draft)
+                fits = m & (dist <= max(int(rem_j) - 1, 0))
+                mm = fits if fits.any() else m  # allowed_tokens degrade
+            else:
+                mm = m
+            cand = np.flatnonzero(mm)
+            if len(cand) == 0 or len(cand) > max_cand:
+                break
+            cands.append(cand.astype(np.int32))
+            if i >= len(forced):
+                break  # final free choice point planned; stop here
+            # draft continuation: longest vocab match along the forced
+            # path that the (filtered) mask admits
+            tid, ln = -1, 0
+            for t, L in self.table.matches_longest_first(forced, i):
+                if mm[t]:
+                    tid, ln = t, L
+                    break
+            if ln <= 0:
+                break  # boundary stays as this plan's final position
+            draft.append(int(tid))
+            for b in forced[i : i + ln]:
+                cur = nfa.step(cur, b)
+            i += ln
+        if not cands:
+            return None
+        return draft, cands
 
     def advance(self, token_id: int) -> None:
         if self._complete:

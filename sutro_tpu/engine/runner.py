@@ -748,18 +748,14 @@ class ModelRunner:
     # n-gram speculative verification (greedy prompt-lookup decoding)
     # ------------------------------------------------------------------
 
-    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
-    def _verify_jit(
+    def _verify_forward(
         self, params, cache: KVCache, ids, valid_len, page_table, start
     ):
-        """One parallel forward over ``[B, 1+K]`` tokens (each row's
-        last token + its n-gram draft) against the paged past: returns
-        the per-position GREEDY tokens and their logprobs. Device-side
-        argmax keeps the [B, C, V] logits tensor off the host link.
-        All input positions' K/V are written to pages — rejected
-        positions become dead stores beyond the row's accepted ``pos``
-        (masked by past_len, overwritten as decode proceeds)."""
-        B, C = ids.shape
+        """Shared verify trunk: one forward over [B, C] known tokens
+        against the paged past, K/V written for the inputs, plus the
+        plain greedy choice per position. Both verify jits build on
+        this so the dispatch wiring cannot drift between them."""
+        C = ids.shape[1]
         positions = start[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
         logits, _, (k, v) = transformer.forward(
             self.mcfg, params, ids, positions, valid_len,
@@ -772,12 +768,92 @@ class ModelRunner:
             cache, k, v, page_table, start, valid_len,
             use_pallas=self.use_pallas,
         )
-        lg = logits.astype(jnp.float32)
-        toks = jnp.argmax(lg, axis=-1)                         # [B, C]
-        logp = jnp.take_along_axis(
-            jax.nn.log_softmax(lg, axis=-1), toks[..., None], axis=-1
+        lg = logits.astype(jnp.float32)                       # [B, C, V]
+        plain = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        plain_lp = jnp.take_along_axis(
+            jax.nn.log_softmax(lg, axis=-1), plain[..., None], axis=-1
         )[..., 0]
-        return toks.astype(jnp.int32), logp, cache
+        return lg, plain, plain_lp, cache
+
+    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+    def _verify_cand_jit(
+        self, params, cache: KVCache, ids, valid_len, page_table, start,
+        cand, cand_n,
+    ):
+        """Masked-candidate verification (FSM fast-forward over BPE-style
+        vocabs): position (b, j)'s choice is the argmax over its SMALL
+        candidate id list — exactly the masked-path token, without
+        shipping [B, C, V] masks (the candidate operand is [B, C, M]
+        ids, ~KBs). Also returns the plain greedy tokens so rows
+        without a plan ride the dispatch as ordinary greedy steps.
+        logprobs for candidate positions are w.r.t. the candidate-set
+        softmax — the same masked distribution the single-step path
+        reports."""
+        lg, plain, plain_lp, cache = self._verify_forward(
+            params, cache, ids, valid_len, page_table, start
+        )
+        g = jnp.take_along_axis(lg, cand, axis=2)             # [B, C, M]
+        M = cand.shape[2]
+        ok = (
+            jnp.arange(M, dtype=jnp.int32)[None, None, :]
+            < cand_n[..., None]
+        )
+        g = jnp.where(ok, g, NEG_INF)
+        idx = jnp.argmax(g, axis=-1)                          # [B, C]
+        ctok = jnp.take_along_axis(cand, idx[..., None], axis=2)[..., 0]
+        lse = jax.scipy.special.logsumexp(g, axis=-1)
+        clp = jnp.take_along_axis(g, idx[..., None], axis=-1)[..., 0] - lse
+        return ctok.astype(jnp.int32), clp, plain, plain_lp, cache
+
+    def verify_candidates(
+        self,
+        last_tokens: np.ndarray,   # [B] int32
+        drafts: np.ndarray,        # [B, K] int32 (pad anything)
+        draft_len: np.ndarray,     # [B] int32
+        cand: np.ndarray,          # [B, K+1, M] int32 (pad id 0)
+        cand_n: np.ndarray,        # [B, K+1] int32 — 0 = unplanned pos
+        past_len: np.ndarray,      # [B] int32
+        page_table: np.ndarray,    # [B, MP] int32
+    ):
+        """Returns (cand_toks, cand_logps, plain_toks, plain_logps),
+        each [B, K+1]. Input row b is ``[last, d0..d_{L-1}]`` with
+        valid_len L+1 (K/V written for inputs; an accepted output
+        token's K/V is written by the next dispatch that consumes it,
+        as in verify_greedy)."""
+        B, K = drafts.shape
+        ids = np.zeros((B, K + 1), np.int32)
+        ids[:, 0] = last_tokens
+        ids[:, 1:] = drafts
+        ct, cl, pt, pl, self.cache = self._verify_cand_jit(
+            self.params,
+            self.cache,
+            jnp.asarray(ids),
+            jnp.asarray(draft_len + 1, jnp.int32),
+            jnp.asarray(page_table, jnp.int32),
+            jnp.asarray(past_len, jnp.int32),
+            jnp.asarray(cand, jnp.int32),
+            jnp.asarray(cand_n, jnp.int32),
+        )
+        return (
+            np.asarray(ct), np.asarray(cl),
+            np.asarray(pt), np.asarray(pl),
+        )
+
+    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+    def _verify_jit(
+        self, params, cache: KVCache, ids, valid_len, page_table, start
+    ):
+        """One parallel forward over ``[B, 1+K]`` tokens (each row's
+        last token + its n-gram draft) against the paged past: returns
+        the per-position GREEDY tokens and their logprobs. Device-side
+        argmax keeps the [B, C, V] logits tensor off the host link.
+        All input positions' K/V are written to pages — rejected
+        positions become dead stores beyond the row's accepted ``pos``
+        (masked by past_len, overwritten as decode proceeds)."""
+        _, toks, logp, cache = self._verify_forward(
+            params, cache, ids, valid_len, page_table, start
+        )
+        return toks, logp, cache
 
     def verify_greedy(
         self,

@@ -712,111 +712,116 @@ class ContinuousBatcher:
         return np.asarray(d, np.int32) if d else None
 
     def _fastforward_step(self, active, last, past_len, table) -> bool:
-        """FSM fast-forward ("jump decoding", cf. SGLang/guidance):
-        inside a schema's scaffold regions ('{"scratchpad": "' ...) the
-        FSM allows exactly ONE next token for long runs, and the
-        speculative window's unmasked samples reject there (PERF.md
-        round-3 note). Peel each such row's forced run host-side
-        (advancing its FSM — forced tokens are committed regardless of
-        model output), then ONE parallel verify forward writes the
-        run's K/V and yields every row's next-position greedy token as
-        the bonus, accepted iff FSM-valid (the speculative window's
-        exact rule). Rows without a forced run — including
-        unconstrained greedy rows — ride along as draft_len-0 plain
-        greedy steps.
+        """FSM fast-forward ("jump decoding") via masked-candidate
+        verification: each constrained row PLANS a jump along its
+        forced byte path (fsm.plan_fastforward — purely functional, no
+        FSM mutation): draft tokens plus the SMALL candidate mask at
+        every token boundary. One parallel forward then yields each
+        planned position's argmax over its candidates — the EXACT
+        masked-path token — so a whole scaffold commits per dispatch
+        and every planned position lands a valid token (no rejections;
+        a flagged row with a plan gets its masked step as the plan's
+        first position). Under byte tokenization candidates are
+        singletons; under BPE vocabs they are the path's prefix
+        tokenizations, still small. Unplanned rows ride as plain
+        greedy steps (constrained ones verified by ``token_allowed``,
+        the speculative window's rule).
 
-        Engagement is decided BEFORE any FSM is advanced (mask
-        singleton count over the active constrained rows): returning
-        False leaves every FSM untouched and the caller falls through
-        to the speculative window. Forced tokens record logp 0.0 —
-        probability 1 under the masked distribution, exactly what the
-        masked single-step they replace reports."""
+        Exact vs the every-step-masked path: each accepted token is the
+        argmax over the same budget-filtered mask, conditioned on the
+        same accepted prefix; acceptance stops at the first draft
+        divergence AFTER taking that position's masked token, and
+        logprobs come from the candidate-set softmax (== the masked
+        distribution). Plans never mutate FSMs, so returning False
+        leaves no trace."""
         FF = getattr(self.ecfg, "constrain_fastforward", 0)
         if FF <= 0 or self._step < self._ff_probe_step:
             return False
         PS = self.ecfg.kv_page_size
+        MAXC = 32
         flagged = self._needs_mask & set(active)
-        need = (len(active) + 1) // 2
-        con = [i for i in active if self.slots[i].req.constraint is not None]
-        cand = {}
-        left = len(con)
-        for i in con:
-            # early exit: even if every unscanned constrained row were
-            # a singleton, the engagement threshold is unreachable —
-            # don't pay the remaining O(V) mask builds
-            if len(cand) + left < need and not flagged:
-                break
-            left -= 1
+        plans = {}
+        total = 0
+        for i in active:
             s = self.slots[i]
             c = s.req.constraint
-            rem = self._remaining(s.req, len(s.out_ids), s.pos)
-            m = self._constraint_mask(c, rem)
-            nz = np.flatnonzero(m)
-            if len(nz) == 1 and int(nz[0]) not in self.stop_ids:
-                cand[i] = (int(nz[0]), rem)
-            elif i in flagged:
-                # a flagged non-singleton row needs its allowed0 masked
-                # step (logits under mask) — the window path owns that
-                self._ff_fail_backoff()
-                return False
-        if len(cand) < need:
+            plan_fn = getattr(c, "plan_fastforward", None)
+            p = None
+            if plan_fn is not None:
+                rem = self._remaining(s.req, len(s.out_ids), s.pos)
+                cap = min(FF, len(s.pages) * PS - s.pos - 1, rem)
+                if cap >= 1:
+                    p = plan_fn(rem, cap, MAXC)
+            if p is None:
+                if i in flagged:
+                    # ANY flagged row this dispatch cannot plan for
+                    # (no plan_fastforward, no capacity, or no
+                    # plannable masked step) must get the window's
+                    # allowed0 recovery — riding as an unmasked
+                    # greedy step would re-flag it forever
+                    self._ff_fail_backoff()
+                    return False
+                continue
+            plans[i] = p
+            total += len(p[1])
+        if total < 2 * len(active):
             self._ff_fail_backoff()
             return False
-        # a flagged SINGLETON row is itself a fast-forward candidate:
-        # the peel's first token IS the masked step its flag demands
-        self._needs_mask -= set(cand)
+        # a flagged row WITH a plan takes its masked step as the plan's
+        # first position
+        self._needs_mask -= set(plans)
         self._ff_backoff = 0
-        # committed from here: peeling advances the real FSMs
-        drafts = np.zeros((self.B, FF), np.int32)
+        # static shapes: pad to the configured width regardless of this
+        # step's plans — a data-dependent K would retrace the verify
+        # program per distinct length (the n-gram path pads the same way)
+        K = FF
+        C = K + 1
+        drafts = np.zeros((self.B, K), np.int32)
         dlens = np.zeros((self.B,), np.int32)
-        for i, (tok, rem) in cand.items():
-            s = self.slots[i]
-            c = s.req.constraint
-            cap = min(FF, len(s.pages) * PS - s.pos - 1, rem)
-            run = []
-            while len(run) < cap:
-                run.append(tok)
-                c.advance(tok)
-                rem -= 1
-                if c.is_complete() or rem <= 0:
-                    break
-                m = self._constraint_mask(c, rem)
-                nz = np.flatnonzero(m)
-                if len(nz) != 1 or int(nz[0]) in self.stop_ids:
-                    # stop tokens are never peeled: the normal accept
-                    # path owns stop semantics (incl. not advancing
-                    # the FSM on stops, _record_token)
-                    break
-                tok = int(nz[0])
-            drafts[i, : len(run)] = run
-            dlens[i] = len(run)
+        cand = np.zeros((self.B, C, MAXC), np.int32)
+        cand_n = np.zeros((self.B, C), np.int32)
+        for i, (draft, cands) in plans.items():
+            dlens[i] = len(draft)
+            if draft:
+                drafts[i, : len(draft)] = draft
+            for j, cs in enumerate(cands):
+                cand[i, j, : len(cs)] = cs
+                cand_n[i, j] = len(cs)
         with self.timer.time("decode"):
-            toks_v, logp_v = self.runner.verify_greedy(
+            ct, cl, pt, pl = self.runner.verify_candidates(
                 np.asarray(last, np.int32), drafts, dlens,
-                np.asarray(past_len, np.int32), table,
+                cand, cand_n, np.asarray(past_len, np.int32), table,
             )
         self._step += 1
         for i in active:
             s = self.slots[i]
             ctx = s.job
-            L = int(dlens[i])
-            self.ff_forced += L
-            if ctx is not None and L:
-                ctx.stats["ff_forced"] = (
-                    ctx.stats.get("ff_forced", 0) + L
-                )
-            finished = False
-            for j in range(L):
-                if self._accept_token(
-                    i, int(drafts[i, j]), 0.0,
-                    advance_constraint=False,
-                    suppress_complete=j < L - 1,
-                ):
-                    finished = True
-                    break
-            if finished:
+            if i in plans:
+                draft, cands = plans[i]
+                jumped = 0  # draft-matching accepts only: the final
+                #             free-choice/diverged token is an ordinary
+                #             masked step, not a jump — counting it
+                #             would overstate ff_forced
+                for j in range(len(cands)):
+                    tok = int(ct[i, j])
+                    matched = j < len(draft) and tok == draft[j]
+                    if matched:
+                        jumped += 1
+                    if self._accept_token(i, tok, float(cl[i, j])):
+                        break
+                    if not matched:
+                        # diverged from the draft (or the plan's final
+                        # free position): later positions are
+                        # conditioned on the draft, not on this token
+                        break
+                self.ff_forced += jumped
+                if ctx is not None and jumped:
+                    ctx.stats["ff_forced"] = (
+                        ctx.stats.get("ff_forced", 0) + jumped
+                    )
                 continue
-            tok = int(toks_v[i, L])
+            # unplanned rider: plain greedy step at position 0
+            tok = int(pt[i, 0])
             c = s.req.constraint
             if c is not None:
                 rem = self._remaining(s.req, len(s.out_ids), s.pos)
@@ -825,7 +830,7 @@ class ContinuousBatcher:
                     # FSM-masked step (allowed0 recovery)
                     self._needs_mask.add(i)
                     continue
-            self._accept_token(i, tok, float(logp_v[i, L]))
+            self._accept_token(i, tok, float(pl[i, 0]))
         return True
 
     def _ff_fail_backoff(self) -> None:
@@ -1095,21 +1100,12 @@ class ContinuousBatcher:
         logp = cumulative_logprob(jl, tok)
         return np.asarray(tok), np.asarray(logp)
 
-    def _record_token(
-        self, slot: _Slot, tok: int, logp: float, advance: bool = True
-    ) -> None:
+    def _record_token(self, slot: _Slot, tok: int, logp: float) -> None:
         slot.out_ids.append(tok)
         if slot.hist is not None:  # n-gram draft history (incremental)
             self._hist_push(slot, tok)
         slot.logprob_sum += float(logp)
-        # ``advance=False``: FSM fast-forward peels forced runs by
-        # advancing the constraint host-side BEFORE dispatch; accepting
-        # those tokens must not advance twice
-        if (
-            advance
-            and slot.req.constraint is not None
-            and tok not in self.stop_ids
-        ):
+        if slot.req.constraint is not None and tok not in self.stop_ids:
             slot.req.constraint.advance(tok)
         if slot.req.has_penalties() and tok not in self.stop_ids:
             slot.counts[tok] = slot.counts.get(tok, 0) + 1
@@ -1130,21 +1126,13 @@ class ContinuousBatcher:
                     break
             slot.tail = grown[-(longest - 1):] if longest > 1 else b""
 
-    def _finish_reason(
-        self, slot: _Slot, tok: int, suppress_complete: bool = False
-    ) -> Optional[str]:
+    def _finish_reason(self, slot: _Slot, tok: int) -> Optional[str]:
         c = slot.req.constraint
         if slot.hit_stop_seq:
             return "stop"
         if tok in self.stop_ids:
             return "stop"
-        # suppress_complete: the FSM fast-forward peel advances the
-        # constraint through a whole forced run BEFORE tokens are
-        # accepted, so is_complete() reflects the END of the run —
-        # consulting it for earlier run tokens would truncate the row
-        # (the peel breaks on completion, so only the LAST forced
-        # token may legitimately finish by schema_complete)
-        if not suppress_complete and c is not None and c.is_complete():
+        if c is not None and c.is_complete():
             return "schema_complete"
         if len(slot.out_ids) >= slot.req.max_new_tokens:
             return "length"
@@ -1153,9 +1141,7 @@ class ContinuousBatcher:
         return None
 
     def _accept_token(
-        self, i: int, tok: int, logp: float, release: bool = True,
-        advance_constraint: bool = True,
-        suppress_complete: bool = False,
+        self, i: int, tok: int, logp: float, release: bool = True
     ) -> int:
         """Record one sampled token for slot ``i``; release on finish.
         Returns 1 if the row completed, else 0. ``release=False`` defers
@@ -1167,11 +1153,11 @@ class ContinuousBatcher:
         s.pos += 1  # last_token's KV is now cached
         if self.native is not None:
             self.native.note_token(i, tok)
-        self._record_token(s, tok, logp, advance=advance_constraint)
+        self._record_token(s, tok, logp)
         s.last_token = tok
         if s.job is not None:
             s.job.stats["out"] += 1
-        if self._finish_reason(s, tok, suppress_complete):
+        if self._finish_reason(s, tok):
             if release:
                 self._emit(i)
             return 1

@@ -248,3 +248,94 @@ def test_mixed_freetext_scaffold_handoff(byte_tok):
     for toks, _ in ff.values():
         parsed = json.loads(byte_tok.decode(list(toks)))
         assert parsed["label"] in ("alpha", "beta")
+
+
+class _MergedTok:
+    """Synthetic BPE-style tokenizer: byte ids 0..255 + specials (as
+    ByteTokenizer) + MERGED multi-byte tokens for scaffold substrings.
+    A forced byte path then admits MANY tokenizations (every prefix
+    token is mask-legal), which is exactly the real-vocab regime the
+    masked-candidate verification handles token-exactly."""
+
+    def __init__(self, vocab_size):
+        from sutro_tpu.engine.tokenizer import ByteTokenizer
+
+        self._bt = ByteTokenizer(vocab_size=vocab_size)
+        self.vocab_size = vocab_size
+        base = 256 + len(self._bt.SPECIALS)
+        self.merged = {
+            base + 0: b'{"classification_result"',
+            base + 1: b'":"',
+            base + 2: b"positive",
+            base + 3: b"negative",
+            base + 4: b'","confidence_level":"',
+            base + 5: b'"}',
+            base + 6: b"classific",
+            base + 7: b"ation_result",
+        }
+        self.eos_id = self._bt.eos_id
+
+    def encode(self, text):
+        return self._bt.encode(text)
+
+    def decode(self, ids):
+        return b"".join(self.token_bytes(t) for t in ids).decode(
+            errors="replace"
+        )
+
+    def token_bytes(self, tid):
+        if tid in self.merged:
+            return self.merged[tid]
+        return self._bt.token_bytes(tid)
+
+    def stop_ids(self):
+        return self._bt.stop_ids()
+
+
+def test_fastforward_bpe_style_merged_vocab(byte_tok):
+    """Under a merged (BPE-style) vocab the forced byte path admits
+    every prefix tokenization, so masks are NOT singletons — the
+    masked-candidate verification must still produce tokens IDENTICAL
+    to the every-step-masked path, while committing multi-token jumps
+    (ff_forced > 0)."""
+    tok = _MergedTok(MODEL_CONFIGS["tiny-dense"].vocab_size)
+
+    def run(multi, ff):
+        ecfg = EngineConfig(
+            kv_page_size=8, max_pages_per_seq=32, max_model_len=256,
+            decode_batch_size=4, use_pallas=False,
+            param_dtype="float32", activation_dtype="float32",
+            decode_multi_step=multi, constrain_fastforward=ff,
+        )
+        runner = ModelRunner(MODEL_CONFIGS["tiny-dense"], ecfg)
+        factory = schema_constraint_factory(SCHEMA, tok)
+        reqs = [
+            GenRequest(
+                row_id=i,
+                prompt_ids=np.array(tok.encode(t), np.int32),
+                max_new_tokens=80,
+                temperature=0.0,
+                constraint=factory(),
+            )
+            for i, t in enumerate(["first row", "second", "third one"])
+        ]
+        b = ContinuousBatcher(runner, stop_ids=tok.stop_ids())
+        res = {}
+        assert (
+            b.run(reqs, on_result=lambda r: res.__setitem__(r.row_id, r))
+            == "completed"
+        )
+        return b, {
+            i: (tuple(r.token_ids), r.finish_reason)
+            for i, r in res.items()
+        }
+
+    b_ff, ff = run(8, 16)
+    assert b_ff.ff_forced > 0, "merged vocab never fast-forwarded"
+    _, masked = run(1, 0)
+    assert ff == masked, "BPE-style jump diverged from the masked path"
+    for toks, _ in ff.values():
+        parsed = json.loads(tok.decode(list(toks)))
+        assert parsed["classification_result"] in (
+            "positive", "negative",
+        )
