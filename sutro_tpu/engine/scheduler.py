@@ -787,6 +787,22 @@ class ContinuousBatcher:
             for j, cs in enumerate(cands):
                 cand[i, j, : len(cs)] = cs
                 cand_n[i, j] = len(cs)
+        # unconstrained greedy riders carry their own n-gram drafts
+        # when speculation is opted in (spec_ngram_draft > 0): verified
+        # against the PLAIN greedy outputs with the spec accept rule,
+        # they take up to K+1 tokens from the dispatch instead of 1
+        spec_riders = set()
+        SN = getattr(self.ecfg, "spec_ngram_draft", 0)
+        if SN > 0:
+            for i in active:
+                if i in plans or self.slots[i].req.constraint is not None:
+                    continue
+                d = self._ngram_draft(self.slots[i], min(SN, K))
+                if d is None:
+                    continue
+                spec_riders.add(i)
+                drafts[i, : len(d)] = d
+                dlens[i] = len(d)
         with self.timer.time("decode"):
             ct, cl, pt, pl = self.runner.verify_candidates(
                 np.asarray(last, np.int32), drafts, dlens,
@@ -820,6 +836,13 @@ class ContinuousBatcher:
                         ctx.stats.get("ff_forced", 0) + jumped
                     )
                 continue
+            if i in spec_riders:
+                # n-gram draft verified against the plain greedy
+                # outputs (shared spec accept rule)
+                self._spec_accept_row(
+                    i, int(dlens[i]), drafts[i], pt[i], pl[i]
+                )
+                continue
             # unplanned rider: plain greedy step at position 0
             tok = int(pt[i, 0])
             c = s.req.constraint
@@ -841,6 +864,37 @@ class ContinuousBatcher:
         KS = max(self.ecfg.decode_multi_step, 1)
         self._ff_backoff = min(max(self._ff_backoff * 2, 2 * KS), 32 * KS)
         self._ff_probe_step = self._step + self._ff_backoff
+
+    def _spec_accept_row(self, i, L, drafts_row, toks_row, logps_row):
+        """THE spec accept rule (one definition shared by the n-gram
+        step and fast-forward spec riders so it cannot drift): accept
+        the longest matching draft prefix plus the bonus token at the
+        first mismatch, maintaining the drafted/accepted counters and
+        per-job stats."""
+        s = self.slots[i]
+        ctx = s.job
+        self.spec_drafted += L
+        if ctx is not None and L:
+            ctx.stats["spec_drafted"] = (
+                ctx.stats.get("spec_drafted", 0) + L
+            )
+        for j in range(L + 1):
+            tok = int(toks_row[j])
+            matched = j < L and int(drafts_row[j]) == tok
+            if matched:
+                self.spec_accepted += 1
+                if ctx is not None:
+                    ctx.stats["spec_accepted"] = (
+                        ctx.stats.get("spec_accepted", 0) + 1
+                    )
+            if (
+                self._accept_token(i, tok, float(logps_row[j]))
+                or not matched
+            ):
+                # row finished, or the bonus token at the first
+                # mismatch was consumed — later positions are
+                # conditioned on a rejected prefix
+                break
 
     def _spec_fail_backoff(self) -> None:
         """Push the next speculative probe out with exponential backoff
@@ -967,31 +1021,9 @@ class ContinuousBatcher:
             )
         self._step += 1
         for i in active:
-            s = self.slots[i]
-            ctx = s.job
-            L = int(dlens[i])
-            self.spec_drafted += L
-            if ctx is not None:
-                ctx.stats["spec_drafted"] = (
-                    ctx.stats.get("spec_drafted", 0) + L
-                )
-            for j in range(L + 1):
-                tok = int(toks_v[i, j])
-                matched = j < L and int(drafts[i, j]) == tok
-                if matched:
-                    self.spec_accepted += 1
-                    if ctx is not None:
-                        ctx.stats["spec_accepted"] = (
-                            ctx.stats.get("spec_accepted", 0) + 1
-                        )
-                if (
-                    self._accept_token(i, tok, float(logp_v[i, j]))
-                    or not matched
-                ):
-                    # row finished, or the bonus token at the first
-                    # mismatch was consumed — later positions are
-                    # conditioned on a rejected prefix
-                    break
+            self._spec_accept_row(
+                i, int(dlens[i]), drafts[i], toks_v[i], logp_v[i]
+            )
         # acceptance-based exit (coverage got us here; acceptance keeps
         # us here): once the rolling window has seen enough drafts,
         # leave the host-synchronous spec path unless it beats a plain

@@ -339,3 +339,61 @@ def test_fastforward_bpe_style_merged_vocab(byte_tok):
         assert parsed["classification_result"] in (
             "positive", "negative",
         )
+
+
+def test_spec_riders_in_fastforward_dispatch(byte_tok):
+    """With n-gram speculation opted in, unconstrained greedy riders
+    carry their own drafts inside the fast-forward dispatch (verified
+    against the plain greedy outputs) — outputs must stay identical to
+    a run with both features off, and both counters must move."""
+
+    def run(ff, spec):
+        ecfg = EngineConfig(
+            kv_page_size=8, max_pages_per_seq=32, max_model_len=256,
+            decode_batch_size=4, use_pallas=False,
+            param_dtype="float32", activation_dtype="float32",
+            decode_multi_step=8, constrain_fastforward=ff,
+            spec_ngram_draft=spec,
+        )
+        runner = ModelRunner(MODEL_CONFIGS["tiny-dense"], ecfg)
+        factory = schema_constraint_factory(SCHEMA, byte_tok)
+        reqs = [
+            GenRequest(
+                row_id=i,
+                prompt_ids=np.array(byte_tok.encode(t), np.int32),
+                max_new_tokens=60,
+                temperature=0.0,
+                constraint=factory(),
+            )
+            for i, t in enumerate(["first row", "second"])
+        ]
+        # echo-heavy unconstrained riders so n-gram drafts fire
+        for j, t in enumerate(
+            ["abc abc abc abc abc", "the cat sat on the mat the cat"]
+        ):
+            reqs.append(
+                GenRequest(
+                    row_id=100 + j,
+                    prompt_ids=np.array(byte_tok.encode(t), np.int32),
+                    max_new_tokens=24,
+                    temperature=0.0,
+                )
+            )
+        b = ContinuousBatcher(runner, stop_ids=byte_tok.stop_ids())
+        res = {}
+        assert (
+            b.run(reqs, on_result=lambda r: res.__setitem__(r.row_id, r))
+            == "completed"
+        )
+        return b, {
+            i: (tuple(r.token_ids), r.finish_reason)
+            for i, r in res.items()
+        }
+
+    b_on, on = run(16, 6)
+    _, off = run(0, 0)
+    assert on == off, "spec riders changed outputs"
+    assert b_on.ff_forced > 0
+    assert b_on.spec_drafted > 0 and b_on.spec_accepted > 0, (
+        "rider drafting never engaged in the shared dispatch"
+    )
