@@ -8,9 +8,19 @@
 # Usage: bash .github/run_tests_chunked.sh [pytest-args...]
 cd "$(dirname "$0")/.." || exit 1
 trap 'echo "CHUNKED SUITE INTERRUPTED"; exit 130' INT
+# multi-process / thread-timing files that can fail (rc 1) under heavy
+# host load while passing in isolation — these get ONE failure retry;
+# every other file's failures are terminal on the first attempt
+LOAD_SENSITIVE="test_dphost test_multihost test_races"
 FAILED=()
 for f in tests/test_*.py; do
   ok=""
+  base=$(basename "$f" .py)
+  fail_budget=1
+  case " $LOAD_SENSITIVE " in
+    *" $base "*) fail_budget=2 ;;
+  esac
+  fails=0
   for attempt in 1 2 3; do
     python -m pytest "$f" -q "$@"
     rc=$?
@@ -19,9 +29,13 @@ for f in tests/test_*.py; do
     # coverage hole otherwise
     if [ "$rc" -eq 5 ] && [ "$#" -gt 0 ]; then ok=1; break; fi
     # rc 1 = test failure, rc 2 = collection error (pytest also uses
-    # 2 for Ctrl-C, which the INT trap above handles): record, no
-    # retry, keep running the remaining files
-    if [ "$rc" -eq 1 ] || [ "$rc" -eq 2 ]; then break; fi
+    # 2 for Ctrl-C, which the INT trap above handles)
+    if [ "$rc" -eq 1 ] || [ "$rc" -eq 2 ]; then
+      fails=$((fails + 1))
+      [ "$fails" -ge "$fail_budget" ] && break
+      echo "=== $f failed under load (attempt $attempt) - one retry"
+      continue
+    fi
     echo "=== $f crashed (rc=$rc, attempt $attempt) - retrying"
   done
   [ -z "$ok" ] && FAILED+=("$f:rc$rc")
