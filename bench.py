@@ -104,6 +104,11 @@ def _cpu_pinned() -> bool:
 
 
 def main() -> None:
+    # SUTRO_SOFT_DEADLINE_S: self-exit cleanly (tunnel-preserving)
+    # before any supervisor's kill can orphan a live connection
+    from sutro_tpu.engine.softdeadline import arm_from_env
+
+    arm_from_env()
     if not _cpu_pinned() and not _probe_backend_with_retry():
         print(
             json.dumps(

@@ -58,6 +58,9 @@ def make_reviews(n: int) -> list:
 
 
 def main() -> None:
+    from sutro_tpu.engine.softdeadline import arm_from_env
+
+    arm_from_env()  # clean self-exit before any outer kill (see module)
     import jax
 
     if os.environ.get("SUTRO_E2E_CPU") == "1":

@@ -69,6 +69,10 @@ def param_bytes(model_key: str, quant: str | None) -> int:
 
 
 def main() -> int:
+    sys.path.insert(0, str(REPO))
+    from sutro_tpu.engine.softdeadline import arm_from_env
+
+    arm_from_env()  # clean self-exit before any outer kill (see module)
     cfgs = DEFAULT_CONFIGS
     override = os.environ.get("SUTRO_8B_CONFIGS")
     if override:
@@ -86,6 +90,11 @@ def main() -> int:
             env["SUTRO_BENCH_QUANT"] = quant
         else:
             env.pop("SUTRO_BENCH_QUANT", None)
+        # the child must self-exit (clean PJRT teardown, tunnel
+        # preserved) before subprocess.run's timeout SIGKILLs it — an
+        # inherited parent-budget deadline would let the child outlive
+        # this inner timeout
+        env["SUTRO_SOFT_DEADLINE_S"] = "3420"
         print(
             f"== {model} quant={quant or 'bf16'} bs={batch}",
             file=sys.stderr, flush=True,

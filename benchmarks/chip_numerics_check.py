@@ -4,9 +4,16 @@ the same device with the same weights. Run on TPU; exits nonzero on
 mismatch."""
 import os
 import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import numpy as np
 import jax
+
+from sutro_tpu.engine.softdeadline import arm_from_env
+
+arm_from_env()  # clean self-exit before any outer kill (see module)
 
 from sutro_tpu.engine.config import EngineConfig
 from sutro_tpu.engine.runner import ModelRunner

@@ -164,6 +164,10 @@ that makes the comparison fair.
 
 
 def main() -> int:
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from sutro_tpu.engine.softdeadline import arm_from_env
+
+    arm_from_env()  # clean self-exit before any outer kill (see module)
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload", default="classify")
     ap.add_argument("--seconds", type=float)

@@ -55,6 +55,10 @@ def find_weights() -> str | None:
 
 
 def main() -> int:
+    sys.path.insert(0, str(REPO))
+    from sutro_tpu.engine.softdeadline import arm_from_env
+
+    arm_from_env()  # clean self-exit before any outer kill (see module)
     ckpt = find_weights()
     if ckpt is None:
         print(

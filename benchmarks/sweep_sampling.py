@@ -25,6 +25,13 @@ import numpy as np
 
 
 def main() -> None:
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from sutro_tpu.engine.softdeadline import arm_from_env
+
+    arm_from_env()  # clean self-exit before any outer kill (see module)
     import jax
     import jax.numpy as jnp
 

@@ -1,0 +1,35 @@
+"""Single source of truth for the axon-tunnel health probe.
+
+Exit 0 = backend answered a real matmul; nonzero = down/hung. Used by
+chip_validation.py (between cases), chip_day.sh (between steps), and
+tunnel_watch.sh (15-min poll) so the probe op and deadline margins
+cannot drift apart across three hand-synced copies.
+
+The sitecustomize pins the axon platform, so this dials the REAL
+tunnel regardless of JAX_PLATFORMS; SUTRO_SKIP_TUNNEL_PROBE=1
+short-circuits success for CPU smoke runs. The probe arms the soft
+deadline so even a half-up tunnel (connects, then hangs) gets a clean
+self-exit; callers add an outer ``timeout -k`` only as a backstop.
+Deadline: SUTRO_PROBE_DEADLINE_S (default 110s) + 20s grace — callers'
+outer timeout should exceed deadline + grace (150s covers the default).
+"""
+
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+if os.environ.get("SUTRO_SKIP_TUNNEL_PROBE") == "1":
+    sys.exit(0)
+
+from sutro_tpu.engine.softdeadline import arm  # noqa: E402
+
+arm(float(os.environ.get("SUTRO_PROBE_DEADLINE_S", 110)), 20)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+jax.devices()
+x = jnp.ones((128, 128), jnp.bfloat16)
+(x @ x).block_until_ready()

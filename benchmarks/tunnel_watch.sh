@@ -1,22 +1,29 @@
 #!/bin/bash
-# Probe the axon TPU tunnel every 15 min; the moment it answers, run the
-# full chip-evidence day (benchmarks/chip_day.sh) once and exit. A downed
-# tunnel makes the first backend touch hang forever inside a C call, so
-# each probe is hard-killed on timeout (a killed probe holds no tunnel
-# state — it never connected).
+# Probe the axon TPU tunnel every 15 min; whenever it answers, (re)run
+# the chip-evidence day (benchmarks/chip_day.sh). chip_day is resumable
+# (done-markers in .chipday/) and exits 75 when the tunnel drops
+# mid-run, so this loop keeps going until the day COMPLETES (rc!=75),
+# then exits. A downed tunnel makes the first backend touch hang
+# forever inside a C call, so each probe arms a soft deadline for a
+# clean self-exit and is hard-killed on timeout only as a backstop.
 #
 # Usage: nohup bash benchmarks/tunnel_watch.sh >/dev/null 2>&1 &
 cd "$(dirname "$0")/.." || exit 1
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 LOG=tunnel_watch.log
 while true; do
-  if timeout -k 10 120 python -c \
-    "import jax; jax.devices(); import jax.numpy as jnp; (jnp.ones((128,128),jnp.bfloat16)@jnp.ones((128,128),jnp.bfloat16)).block_until_ready()" \
-    >/dev/null 2>&1; then
+  if timeout -k 10 150 python benchmarks/tunnel_probe.py >/dev/null 2>&1
+  then
     echo "$(date -u +%FT%TZ) tunnel UP - starting chip day" >> "$LOG"
     bash benchmarks/chip_day.sh
-    echo "$(date -u +%FT%TZ) chip day finished rc=$?" >> "$LOG"
-    exit 0
+    rc=$?
+    echo "$(date -u +%FT%TZ) chip day rc=$rc" >> "$LOG"
+    if [ "$rc" -ne 75 ]; then
+      exit "$rc"       # day complete (clean or with real failures)
+    fi
+    sleep 300          # tunnel dropped mid-day: short retry cycle
+  else
+    echo "$(date -u +%FT%TZ) tunnel down" >> "$LOG"
+    sleep 900
   fi
-  echo "$(date -u +%FT%TZ) tunnel down" >> "$LOG"
-  sleep 900
 done

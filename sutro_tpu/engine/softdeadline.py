@@ -1,0 +1,165 @@
+"""Soft in-process deadline for chip-facing benchmark scripts.
+
+Round 4's tunnel wedge: a benchmark subprocess SIGKILLed at its outer
+timeout while holding a live axon-tunnel connection left the remote end
+wedged, and every case queued behind it aborted rc=3
+(CHIP_VALIDATION_HISTORY.jsonl, round-4 records). SIGKILL skips all
+teardown, and SIGTERM's *default* disposition also terminates without
+running atexit hooks or the PJRT client destructor. The only exit that
+reliably closes the tunnel connection is the interpreter unwinding
+normally — so the case must stop *itself* before any outer kill fires:
+
+    from sutro_tpu.engine.softdeadline import arm_from_env
+    arm_from_env()      # no-op unless SUTRO_SOFT_DEADLINE_S is set
+
+Mechanism, two stages:
+  1. At the deadline a daemon watchdog thread calls
+     ``_thread.interrupt_main()`` — KeyboardInterrupt is raised in the
+     main thread at the next bytecode boundary, the stack unwinds,
+     atexit runs, the PJRT client closes its connection, the tunnel
+     survives. Exit code 124 (timeout convention) via an installed
+     excepthook so supervisors can tell "deadline" from "crash".
+  2. If the main thread never reaches a bytecode boundary (stuck in an
+     uninterruptible C call — which in practice means the tunnel is
+     already dead, so there is nothing left to preserve), a second
+     stage ``os._exit(124)``s after ``grace`` more seconds so the
+     supervisor never needs SIGKILL.
+
+Additionally installs a SIGTERM handler taking the same clean path, so
+a supervisor's TERM (stage 1 of terminate-then-kill) also unwinds
+normally instead of dying teardown-less.
+"""
+
+from __future__ import annotations
+
+import _thread
+import os
+import signal
+import sys
+import threading
+import time
+
+
+_FIRED = threading.Event()
+# set once the main thread has started unwinding (excepthook/SIGTERM
+# path reached): the watchdog must stop re-signalling then, or the
+# repeated SIGINTs would abort the very teardown they exist to allow
+_UNWINDING = threading.Event()
+_ARMED = False
+
+
+def _watchdog(deadline_s: float, grace_s: float) -> None:
+    time.sleep(deadline_s)
+    _FIRED.set()
+    print(
+        f"[softdeadline] {deadline_s:.0f}s budget exhausted - "
+        "interrupting main thread for a clean (tunnel-preserving) exit",
+        file=sys.stderr,
+        flush=True,
+    )
+    # a REAL signal, not _thread.interrupt_main(): interrupt_main only
+    # marks a pending exception checked at bytecode boundaries, so a
+    # main thread blocked in a syscall (sleep, socket recv) never sees
+    # it; pthread_kill(SIGINT) EINTRs the syscall and the default SIGINT
+    # handler raises KeyboardInterrupt right there.
+    #
+    # Stage 2: a main thread inside a long C call (an XLA compile on a
+    # LIVE tunnel looks identical to a wedge on a dead one) cannot see
+    # the signal until the call returns — so keep re-signalling every
+    # 15 s for the whole grace window rather than hard-exiting at the
+    # first miss: if the compile finishes anytime within grace, the
+    # pending interrupt lands and the exit is still clean. Only after
+    # the full grace do we hard-exit — at that point the outer
+    # supervisor's SIGKILL is imminent anyway and exiting ourselves at
+    # least keeps the rc legible.
+    deadline = time.monotonic() + grace_s
+    while time.monotonic() < deadline:
+        if not _UNWINDING.is_set():
+            try:
+                signal.pthread_kill(
+                    threading.main_thread().ident, signal.SIGINT
+                )
+            except Exception:
+                _thread.interrupt_main()
+        time.sleep(min(15.0, max(0.1, deadline - time.monotonic())))
+    print(
+        "[softdeadline] main thread did not unwind within "
+        f"{grace_s:.0f}s grace (stuck in C call) - hard exit 124",
+        file=sys.stderr,
+        flush=True,
+    )
+    os._exit(124)
+
+
+def _excepthook(tp, val, tb):
+    if _FIRED.is_set() and issubclass(tp, KeyboardInterrupt):
+        _UNWINDING.set()
+        print(
+            "[softdeadline] clean exit after deadline interrupt (rc=124)",
+            file=sys.stderr,
+            flush=True,
+        )
+        # swallow the traceback and let interpreter shutdown proceed
+        # normally; the atexit hook registered in arm() sets rc=124
+        return
+    _orig_excepthook(tp, val, tb)
+
+
+_orig_excepthook = sys.excepthook
+
+
+def _sigterm(_sig, _frm):
+    _FIRED.set()
+    _UNWINDING.set()
+    print(
+        "[softdeadline] SIGTERM - raising for a clean exit",
+        file=sys.stderr,
+        flush=True,
+    )
+    raise SystemExit(124)
+
+
+def arm(deadline_s: float, grace_s: float = 120.0) -> None:
+    """Arm the two-stage watchdog. Idempotent (first call wins)."""
+    global _ARMED
+    if _ARMED or deadline_s <= 0:
+        return
+    _ARMED = True
+    sys.excepthook = _excepthook
+    try:
+        signal.signal(signal.SIGTERM, _sigterm)
+    except ValueError:
+        pass  # not the main thread; TERM keeps its default disposition
+    t = threading.Thread(
+        target=_watchdog, args=(deadline_s, grace_s), daemon=True
+    )
+    t.start()
+
+    # make the deadline path exit 124 (not 130/0): atexit hooks run
+    # LIFO, and jax registers its backend-teardown hook at first
+    # backend touch — AFTER this registration — so jax's hook (tunnel
+    # close) runs before this one; by the time we hard-set the exit
+    # code the connection is already down cleanly.
+    import atexit
+
+    def _exit_code():
+        if _FIRED.is_set():
+            os._exit(124)
+
+    atexit.register(_exit_code)
+
+
+def arm_from_env(default_grace_s: float = 120.0) -> None:
+    """Arm from SUTRO_SOFT_DEADLINE_S (seconds); no-op if unset/invalid."""
+    raw = os.environ.get("SUTRO_SOFT_DEADLINE_S", "")
+    try:
+        deadline = float(raw)
+    except ValueError:
+        return
+    try:
+        grace = float(
+            os.environ.get("SUTRO_SOFT_GRACE_S", default_grace_s)
+        )
+    except ValueError:
+        grace = default_grace_s  # a knob typo must not kill the case
+    arm(deadline, grace)
