@@ -99,21 +99,44 @@ def main() -> int:
             f"== {model} quant={quant or 'bf16'} bs={batch}",
             file=sys.stderr, flush=True,
         )
+        # Popen (not subprocess.run): run()'s exception path SIGKILLs
+        # the child — if this parent's own soft deadline interrupts a
+        # blocking wait, that would hard-kill a child actively holding
+        # the tunnel. TERM instead: the child's softdeadline handler
+        # exits cleanly.
+        proc = subprocess.Popen(
+            [sys.executable, str(REPO / "bench.py")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
         try:
-            proc = subprocess.run(
-                [sys.executable, str(REPO / "bench.py")],
-                env=env, capture_output=True, text=True, timeout=3600,
-            )
-            line = (proc.stdout.strip().splitlines() or [""])[-1]
+            out, err = proc.communicate(timeout=3600)
+            line = (out.strip().splitlines() or [""])[-1]
             try:
                 bench = json.loads(line)
             except json.JSONDecodeError:
                 bench = {"metric": "parse-error", "value": 0,
-                         "raw": proc.stdout[-500:] + proc.stderr[-500:]}
+                         "raw": out[-500:] + err[-500:]}
         except subprocess.TimeoutExpired:
-            # record the timeout and keep the configs already measured
+            # child's own 3420s soft deadline should have fired; TERM
+            # takes its clean path, record and keep measured configs
+            proc.terminate()
+            try:
+                proc.communicate(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.communicate()
             bench = {"metric": "bench-timeout (3600s)", "value": 0,
                      "unit": "error"}
+        except BaseException:
+            # parent interrupted (soft deadline / TERM): give the
+            # child its clean exit before propagating
+            proc.terminate()
+            try:
+                proc.communicate(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            raise
         rec = {
             "model": model,
             "quant": quant or "bf16",

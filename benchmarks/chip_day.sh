@@ -59,8 +59,11 @@ step() {
 }
 
 # chip_validation manages its own per-case budgets/deadlines + resume;
-# the blanket SUTRO_SOFT_DEADLINE_S is overridden per case inside
-step "chip_validation" 32000 python benchmarks/chip_validation.py
+# the blanket SUTRO_SOFT_DEADLINE_S is overridden per case inside.
+# Budget = ~29.5k case budgets + probes + one full tunnel-wait pause
+# (SUTRO_TUNNEL_WAIT_S=7200) so a mid-queue pause resolves inside the
+# budget instead of the step being TERMed mid-wait.
+step "chip_validation" 42000 python benchmarks/chip_validation.py
 step "e2e 20k classify + generate + embed" 14400 \
   env SUTRO_E2E_ROWS=20000 python bench_e2e.py
 step "e2e embed 100k (config-3 scale)" 10800 \

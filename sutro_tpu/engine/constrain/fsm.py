@@ -39,19 +39,21 @@ class TokenTable:
         self.empty_ids = np.array(
             [i for i, b in enumerate(self.token_bytes) if not b], np.int64
         )
-        self._b2t: Optional[Dict[bytes, int]] = None
+        self._b2t: Optional[Dict[bytes, List[int]]] = None
         self._max_tok_len = 0
 
     def matches_longest_first(self, data: bytes, start: int):
         """Yield (token id, byte length) vocab matches at
         ``data[start:]``, longest first. Built lazily (one dict over
-        the vocab); first-listed id wins among duplicate byte
-        strings."""
+        the vocab). ALL ids sharing a byte string are yielded — a
+        consumer filtering by an FSM mask may admit only a duplicate
+        id, and yielding just the first-listed one would truncate its
+        fast-forward plan early."""
         if self._b2t is None:
-            b2t: Dict[bytes, int] = {}
+            b2t: Dict[bytes, List[int]] = {}
             for tid, tb in enumerate(self.token_bytes):
-                if tb and tb not in b2t:
-                    b2t[tb] = tid
+                if tb:
+                    b2t.setdefault(tb, []).append(tid)
             self._b2t = b2t
             self._max_tok_len = max(
                 (len(b) for b in b2t), default=0
@@ -59,9 +61,10 @@ class TokenTable:
         for ln in range(
             min(self._max_tok_len, len(data) - start), 0, -1
         ):
-            tid = self._b2t.get(data[start : start + ln])
-            if tid is not None:
-                yield tid, ln
+            tids = self._b2t.get(data[start : start + ln])
+            if tids is not None:
+                for tid in tids:
+                    yield tid, ln
 
 
 INF_DIST = np.int32(0x7FFFFFFF)

@@ -663,18 +663,21 @@ class ModelRunner:
                 (0, 0, step_idx, 0),
             )
             step_logits = logits[:, 0]
+            sample_logits = step_logits
             if allowed0 is not None:
                 # masked sample == masked argmax for the greedy rows
-                # this path serves; logp then matches the masked
-                # single-step it replaces
-                step_logits = jnp.where(
+                # this path serves; logp stays over the UNMASKED
+                # logits — the same convention as the single-step path
+                # (sample under the mask, report full-vocab logprob),
+                # so cumulative_logprob is path-independent
+                sample_logits = jnp.where(
                     step_idx == 0,
                     jnp.where(allowed0, step_logits, NEG_INF),
                     step_logits,
                 )
             key = jax.random.fold_in(rng, step_idx)
             tok = sample(
-                step_logits, key,
+                sample_logits, key,
                 temperature=temperature, top_p=top_p, top_k=top_k,
             )
             logp = cumulative_logprob(step_logits, tok)
@@ -786,9 +789,11 @@ class ModelRunner:
         shipping [B, C, V] masks (the candidate operand is [B, C, M]
         ids, ~KBs). Also returns the plain greedy tokens so rows
         without a plan ride the dispatch as ordinary greedy steps.
-        logprobs for candidate positions are w.r.t. the candidate-set
-        softmax — the same masked distribution the single-step path
-        reports."""
+        Candidate logprobs are w.r.t. the FULL-vocab softmax — the same
+        distribution ``cumulative_logprob`` reports on the masked
+        single-step path (which samples under the mask but reports
+        unmasked logprobs), so a row's cumulative_logprob no longer
+        depends on which path committed each token."""
         lg, plain, plain_lp, cache = self._verify_forward(
             params, cache, ids, valid_len, page_table, start
         )
@@ -801,8 +806,11 @@ class ModelRunner:
         g = jnp.where(ok, g, NEG_INF)
         idx = jnp.argmax(g, axis=-1)                          # [B, C]
         ctok = jnp.take_along_axis(cand, idx[..., None], axis=2)[..., 0]
-        lse = jax.scipy.special.logsumexp(g, axis=-1)
-        clp = jnp.take_along_axis(g, idx[..., None], axis=-1)[..., 0] - lse
+        lse_v = jax.scipy.special.logsumexp(lg, axis=-1)      # [B, C]
+        clp = (
+            jnp.take_along_axis(lg, ctok[..., None], axis=-1)[..., 0]
+            - lse_v
+        )
         return ctok.astype(jnp.int32), clp, plain, plain_lp, cache
 
     def verify_candidates(

@@ -330,6 +330,12 @@ class ContinuousBatcher:
         # attempts
         self._spec_probe_step = 0
         self._spec_backoff = 0
+        # coverage pre-check result, computed ONCE per probe epoch
+        # (keyed by _spec_probe_step): while a probe waits for the pipe
+        # to drain, recomputing O(B*K) throwaway drafts every drain
+        # iteration would repeat up to decode_lookahead times per probe
+        self._spec_cov_key = -1
+        self._spec_cov_ok = False
         # rolling acceptance window: engagement is decided by draft
         # COVERAGE, but staying engaged requires the accepted tokens to
         # actually beat a plain step (exit when the window's acceptance
@@ -1790,8 +1796,16 @@ class ContinuousBatcher:
                     # pipeline drain: if the engagement rule fails right
                     # now, fail the probe in place and keep the pipe
                     # full — no drain bubble for batches that never
-                    # draft
-                    if not self._spec_coverage_ok(active):
+                    # draft. Computed once per probe epoch and cached
+                    # across the drain iterations (drafts advance
+                    # during the drain, but they are throwaway here —
+                    # _spec_ngram_step recomputes real ones at engage)
+                    if self._spec_cov_key != self._spec_probe_step:
+                        self._spec_cov_key = self._spec_probe_step
+                        self._spec_cov_ok = self._spec_coverage_ok(
+                            active
+                        )
+                    if not self._spec_cov_ok:
                         self._spec_fail_backoff()
                         spec_probe = False
                 if spec_probe and not pipe:

@@ -41,10 +41,14 @@ import time
 
 
 _FIRED = threading.Event()
-# set once the main thread has started unwinding (excepthook/SIGTERM
-# path reached): the watchdog must stop re-signalling then, or the
-# repeated SIGINTs would abort the very teardown they exist to allow
-_UNWINDING = threading.Event()
+# set the moment the Python-level SIGINT handler actually RUNS (i.e.
+# the interrupt was delivered at a bytecode boundary and the
+# KeyboardInterrupt is now unwinding): the watchdog must stop
+# re-signalling then — a second SIGINT would land inside a finally /
+# context-manager teardown frame and abort the very cleanup the clean
+# exit exists for. While the main thread is stuck in a C call the
+# handler has NOT run yet, so re-signalling remains correct there.
+_DELIVERED = threading.Event()
 _ARMED = False
 
 
@@ -74,7 +78,7 @@ def _watchdog(deadline_s: float, grace_s: float) -> None:
     # least keeps the rc legible.
     deadline = time.monotonic() + grace_s
     while time.monotonic() < deadline:
-        if not _UNWINDING.is_set():
+        if not _DELIVERED.is_set():
             try:
                 signal.pthread_kill(
                     threading.main_thread().ident, signal.SIGINT
@@ -93,7 +97,6 @@ def _watchdog(deadline_s: float, grace_s: float) -> None:
 
 def _excepthook(tp, val, tb):
     if _FIRED.is_set() and issubclass(tp, KeyboardInterrupt):
-        _UNWINDING.set()
         print(
             "[softdeadline] clean exit after deadline interrupt (rc=124)",
             file=sys.stderr,
@@ -108,9 +111,14 @@ def _excepthook(tp, val, tb):
 _orig_excepthook = sys.excepthook
 
 
+def _sigint(_sig, _frm):
+    _DELIVERED.set()
+    raise KeyboardInterrupt
+
+
 def _sigterm(_sig, _frm):
     _FIRED.set()
-    _UNWINDING.set()
+    _DELIVERED.set()
     print(
         "[softdeadline] SIGTERM - raising for a clean exit",
         file=sys.stderr,
@@ -128,8 +136,16 @@ def arm(deadline_s: float, grace_s: float = 120.0) -> None:
     sys.excepthook = _excepthook
     try:
         signal.signal(signal.SIGTERM, _sigterm)
+        # our own SIGINT handler, installed unconditionally: (a) a
+        # process launched from a non-interactive shell's async list
+        # inherits SIGINT=SIG_IGN, which Python preserves — the
+        # watchdog's pthread_kill would then be a silent no-op and the
+        # deadline would degrade to the teardown-less hard exit; (b)
+        # the handler records delivery so the watchdog stops
+        # re-signalling once the interrupt is actually unwinding
+        signal.signal(signal.SIGINT, _sigint)
     except ValueError:
-        pass  # not the main thread; TERM keeps its default disposition
+        pass  # not the main thread; keep default dispositions
     t = threading.Thread(
         target=_watchdog, args=(deadline_s, grace_s), daemon=True
     )
