@@ -1,44 +1,34 @@
 #!/bin/bash
-# Full-suite runner that survives the environment's XLA CPU compile
-# segfault flake: two consecutive full-process runs this round died
-# inside jax backend_compile_and_load (different test files each time,
-# both pass in isolation; single-core host). Running per-file isolates
-# the blast radius and a crashed file retries up to 2x — a TEST failure
-# (rc 1) never retries, so real regressions still fail fast.
+# Per-file suite runner. NO retry policy (VERDICT r4 item 5): every
+# file runs exactly once and any failure is terminal.
+#
+# Why per-file processes at all — the pinned cause: long-lived
+# many-compile pytest processes flakily segfault INSIDE XLA:CPU's
+# backend_compile_and_load on this host (fatal dumps in
+# pytest_full.log round 4 and the round-5 reproduction). The round-5
+# crash had only 73 extension modules loaded — torch NOT among them —
+# so the round-4 "torch._C + jaxlib co-residency" suspicion is
+# falsified; the trigger correlates with compile count / process
+# lifetime, not co-loaded libraries. Every crashed file passes in
+# isolation, the crash file differs run to run, and the persistent
+# compile cache is OFF under tests (conftest sets
+# SUTRO_COMPILE_CACHE=0), which rules out cache corruption. Upstream
+# XLA:CPU flake; per-file processes bound the blast radius so a
+# one-in-hundreds compile crash cannot take down the whole gate.
+# The former "load-sensitive retry" is retired: the multi-process
+# timing tests (test_dphost/test_multihost) now carry deadlines sized
+# for a loaded single-core host instead.
 # Usage: bash .github/run_tests_chunked.sh [pytest-args...]
 cd "$(dirname "$0")/.." || exit 1
 trap 'echo "CHUNKED SUITE INTERRUPTED"; exit 130' INT
-# multi-process / thread-timing files that can fail (rc 1) under heavy
-# host load while passing in isolation — these get ONE failure retry;
-# every other file's failures are terminal on the first attempt
-LOAD_SENSITIVE="test_dphost test_multihost test_races"
 FAILED=()
 for f in tests/test_*.py; do
-  ok=""
-  base=$(basename "$f" .py)
-  fail_budget=1
-  case " $LOAD_SENSITIVE " in
-    *" $base "*) fail_budget=2 ;;
-  esac
-  fails=0
-  for attempt in 1 2 3; do
-    python -m pytest "$f" -q "$@"
-    rc=$?
-    if [ "$rc" -eq 0 ]; then ok=1; break; fi
-    # rc 5 = no tests collected: fine under filter args, a silent
-    # coverage hole otherwise
-    if [ "$rc" -eq 5 ] && [ "$#" -gt 0 ]; then ok=1; break; fi
-    # rc 1 = test failure, rc 2 = collection error (pytest also uses
-    # 2 for Ctrl-C, which the INT trap above handles)
-    if [ "$rc" -eq 1 ] || [ "$rc" -eq 2 ]; then
-      fails=$((fails + 1))
-      [ "$fails" -ge "$fail_budget" ] && break
-      echo "=== $f failed under load (attempt $attempt) - one retry"
-      continue
-    fi
-    echo "=== $f crashed (rc=$rc, attempt $attempt) - retrying"
-  done
-  [ -z "$ok" ] && FAILED+=("$f:rc$rc")
+  python -m pytest "$f" -q "$@"
+  rc=$?
+  # rc 5 = no tests collected: fine under filter args, a silent
+  # coverage hole otherwise
+  if [ "$rc" -eq 5 ] && [ "$#" -gt 0 ]; then rc=0; fi
+  [ "$rc" -ne 0 ] && FAILED+=("$f:rc$rc")
 done
 if [ "${#FAILED[@]}" -gt 0 ]; then
   echo "CHUNKED SUITE FAILED: ${FAILED[*]}"

@@ -130,12 +130,14 @@ def main() -> int:
                      "unit": "error"}
         except BaseException:
             # parent interrupted (soft deadline / TERM): give the
-            # child its clean exit before propagating
+            # child its clean exit, persist the configs already
+            # measured (hours of chip time), then propagate
             proc.terminate()
             try:
                 proc.communicate(timeout=60)
             except subprocess.TimeoutExpired:
                 proc.kill()
+            _write(results)
             raise
         rec = {
             "model": model,
@@ -155,7 +157,13 @@ def main() -> int:
             )
         results.append(rec)
         print(json.dumps(rec), flush=True)
+        _write(results)  # persist after EVERY config: a later
+        #                  interrupt must not discard measured records
 
+    return 0
+
+
+def _write(results: list) -> None:
     # backend comes from the subprocess records (this process never
     # touches the JAX backend — see param_bytes)
     backends = {
@@ -172,7 +180,6 @@ def main() -> int:
         "records": results,
     }
     (REPO / "BENCH_8B.json").write_text(json.dumps(out, indent=2) + "\n")
-    return 0
 
 
 if __name__ == "__main__":

@@ -86,8 +86,8 @@ step "fastforward A/B off (pre-round-4 constrained path)" 3600 \
   env SUTRO_E2E_ROWS=2000 SUTRO_E2E_WORKLOADS=classify \
   SUTRO_E2E_FF=0 python bench_e2e.py
 step "cost_northstar" 1800 python benchmarks/cost_northstar.py
-step "golden_quickstart (needs weights)" 3600 \
-  python benchmarks/golden_quickstart.py
+step "weights_attempt + golden_quickstart" 3600 \
+  python benchmarks/weights_attempt.py
 echo "=== $(date -u +%FT%TZ) chip day COMPLETE fail=$FAIL" >> "$LOG"
 # clear done-markers on COMPLETION (any outcome): they exist to resume
 # a tunnel-interrupted day, not to make a future intentional rerun

@@ -1,0 +1,137 @@
+"""Scripted real-weights acquisition attempt (VERDICT r4 item 8).
+
+tests/test_golden.py proves exact torch decode parity for all four
+model families on random-init checkpoints; what it cannot show is a
+sensible sentiment label from TRAINED weights (the reference
+quickstart, /root/reference/README.md:124-160). This script attempts
+every channel that could yield a Qwen3-0.6B checkpoint in this
+environment and writes a dated, reproducible record of the outcome to
+WEIGHTS_ATTEMPT.json:
+
+  1. SUTRO_WEIGHTS / SUTRO_GOLDEN_WEIGHTS env (operator-provided dir)
+  2. the standard HF hub cache (local_files_only)
+  3. a filesystem scan of the usual mount points for safetensors
+  4. DNS + HTTPS reachability of huggingface.co (egress check)
+  5. a real snapshot_download attempt iff DNS resolved
+
+On success it execs benchmarks/golden_quickstart.py (which decodes the
+reference quickstart rows and commits labeled outputs); on failure the
+JSON record documents exactly which channel failed and how, so the
+blocked state is auditable rather than asserted.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+OUT = REPO / "WEIGHTS_ATTEMPT.json"
+
+
+def main() -> int:
+    rec: dict = {
+        "date_utc": datetime.datetime.now(
+            datetime.timezone.utc
+        ).isoformat(timespec="seconds"),
+        "target": "Qwen/Qwen3-0.6B",
+        "channels": [],
+    }
+    ckpt = None
+
+    # 1. operator-provided directory
+    for var in ("SUTRO_WEIGHTS", "SUTRO_GOLDEN_WEIGHTS"):
+        p = os.environ.get(var)
+        ok = bool(p) and Path(p, "config.json").exists()
+        rec["channels"].append(
+            {"channel": f"env:{var}", "value": p or None, "ok": ok}
+        )
+        if ok:
+            ckpt = p
+    # 2. HF hub cache, offline
+    if ckpt is None:
+        try:
+            from huggingface_hub import snapshot_download
+
+            ckpt = snapshot_download(
+                "Qwen/Qwen3-0.6B", local_files_only=True
+            )
+            rec["channels"].append({"channel": "hf-cache", "ok": True})
+        except Exception as e:
+            rec["channels"].append(
+                {"channel": "hf-cache", "ok": False,
+                 "error": f"{type(e).__name__}: {e}"[:300]}
+            )
+    # 3. filesystem scan
+    if ckpt is None:
+        hits: list = []
+        for root in ("/opt", "/srv", "/data", "/root", "/workspace"):
+            if not Path(root).exists():
+                continue
+            try:
+                out = subprocess.run(
+                    ["find", root, "-maxdepth", "5", "-name",
+                     "*.safetensors"],
+                    capture_output=True, text=True, timeout=120,
+                )
+                hits += [
+                    line for line in out.stdout.splitlines() if line
+                ][:5]
+            except subprocess.TimeoutExpired:
+                pass
+        rec["channels"].append(
+            {"channel": "fs-scan", "ok": bool(hits), "hits": hits}
+        )
+        if hits:
+            ckpt = str(Path(hits[0]).parent)
+    # 4. egress check
+    dns_ok = False
+    if ckpt is None:
+        try:
+            addr = socket.gethostbyname("huggingface.co")
+            dns_ok = True
+            rec["channels"].append(
+                {"channel": "dns:huggingface.co", "ok": True,
+                 "addr": addr}
+            )
+        except OSError as e:
+            rec["channels"].append(
+                {"channel": "dns:huggingface.co", "ok": False,
+                 "error": str(e)}
+            )
+    # 5. real download iff the name even resolves
+    if ckpt is None and dns_ok:
+        try:
+            from huggingface_hub import snapshot_download
+
+            ckpt = snapshot_download("Qwen/Qwen3-0.6B")
+            rec["channels"].append({"channel": "hf-download", "ok": True})
+        except Exception as e:
+            rec["channels"].append(
+                {"channel": "hf-download", "ok": False,
+                 "error": f"{type(e).__name__}: {e}"[:300]}
+            )
+
+    rec["checkpoint"] = ckpt
+    rec["blocked"] = ckpt is None
+    OUT.write_text(json.dumps(rec, indent=2) + "\n")
+    print(json.dumps({"weights_attempt": "blocked" if ckpt is None
+                      else "found", "checkpoint": ckpt}))
+    if ckpt is None:
+        return 2
+    env = dict(os.environ)
+    env["SUTRO_GOLDEN_WEIGHTS"] = ckpt
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, str(REPO / "benchmarks" / "golden_quickstart.py")],
+        env=env, cwd=REPO,
+    ).returncode
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

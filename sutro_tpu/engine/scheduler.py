@@ -1627,6 +1627,10 @@ class ContinuousBatcher:
         newly-submitted same-model jobs mid-session. ``should_yield``
         preempts the WHOLE session (returns "yielded"; non-done jobs'
         slots are dropped for row-granular resume)."""
+        # fresh session: a coverage verdict cached by a previous
+        # run()/run_multi() on this batcher must not gate this one's
+        # first spec probe
+        self._spec_cov_key = -1
         live: List[JobCtx] = []
         try:
             for ctx in jobs:

@@ -13,17 +13,25 @@ normally — so the case must stop *itself* before any outer kill fires:
     arm_from_env()      # no-op unless SUTRO_SOFT_DEADLINE_S is set
 
 Mechanism, two stages:
-  1. At the deadline a daemon watchdog thread calls
-     ``_thread.interrupt_main()`` — KeyboardInterrupt is raised in the
-     main thread at the next bytecode boundary, the stack unwinds,
-     atexit runs, the PJRT client closes its connection, the tunnel
-     survives. Exit code 124 (timeout convention) via an installed
-     excepthook so supervisors can tell "deadline" from "crash".
-  2. If the main thread never reaches a bytecode boundary (stuck in an
-     uninterruptible C call — which in practice means the tunnel is
-     already dead, so there is nothing left to preserve), a second
-     stage ``os._exit(124)``s after ``grace`` more seconds so the
-     supervisor never needs SIGKILL.
+  1. At the deadline a daemon watchdog thread sends the main thread a
+     real SIGINT (``pthread_kill`` — unlike ``interrupt_main`` it
+     EINTRs blocking syscalls). arm()'s own SIGINT handler raises
+     ``SystemExit(124)``: the stack unwinds (finally blocks and
+     context managers run), atexit runs, the PJRT client closes its
+     connection, and the interpreter exits 124 (timeout convention) —
+     no excepthook or exit-code games needed. The handler is installed
+     unconditionally because a process launched from a non-interactive
+     shell's async list inherits SIGINT=SIG_IGN, which Python
+     preserves, making the default-handler path a silent no-op.
+  2. A main thread inside a long C call (an XLA compile on a LIVE
+     tunnel looks identical to a wedge on a dead one) cannot see the
+     signal until the call returns — so the watchdog keeps
+     re-signalling every 15 s for the whole ``grace`` window (stopping
+     the moment the handler actually runs, so in-flight teardown is
+     never re-interrupted). Only after the full grace does it
+     ``os._exit(124)`` — at that point the outer supervisor's SIGKILL
+     is imminent anyway and self-exiting at least keeps the rc
+     legible.
 
 Additionally installs a SIGTERM handler taking the same clean path, so
 a supervisor's TERM (stage 1 of terminate-then-kill) also unwinds
@@ -41,13 +49,13 @@ import time
 
 
 _FIRED = threading.Event()
-# set the moment the Python-level SIGINT handler actually RUNS (i.e.
-# the interrupt was delivered at a bytecode boundary and the
-# KeyboardInterrupt is now unwinding): the watchdog must stop
-# re-signalling then — a second SIGINT would land inside a finally /
-# context-manager teardown frame and abort the very cleanup the clean
-# exit exists for. While the main thread is stuck in a C call the
-# handler has NOT run yet, so re-signalling remains correct there.
+# set the moment the Python-level SIGINT/SIGTERM handler actually RUNS
+# (the interrupt was delivered at a bytecode boundary and SystemExit is
+# now unwinding): the watchdog must stop re-signalling then — another
+# SIGINT would land inside a finally / context-manager teardown frame
+# and abort the very cleanup the clean exit exists for. While the main
+# thread is stuck in a C call the handler has NOT run yet, so
+# re-signalling remains correct there.
 _DELIVERED = threading.Event()
 _ARMED = False
 
@@ -61,31 +69,31 @@ def _watchdog(deadline_s: float, grace_s: float) -> None:
         file=sys.stderr,
         flush=True,
     )
-    # a REAL signal, not _thread.interrupt_main(): interrupt_main only
-    # marks a pending exception checked at bytecode boundaries, so a
-    # main thread blocked in a syscall (sleep, socket recv) never sees
-    # it; pthread_kill(SIGINT) EINTRs the syscall and the default SIGINT
-    # handler raises KeyboardInterrupt right there.
-    #
-    # Stage 2: a main thread inside a long C call (an XLA compile on a
-    # LIVE tunnel looks identical to a wedge on a dead one) cannot see
-    # the signal until the call returns — so keep re-signalling every
-    # 15 s for the whole grace window rather than hard-exiting at the
-    # first miss: if the compile finishes anytime within grace, the
-    # pending interrupt lands and the exit is still clean. Only after
-    # the full grace do we hard-exit — at that point the outer
-    # supervisor's SIGKILL is imminent anyway and exiting ourselves at
-    # least keeps the rc legible.
     deadline = time.monotonic() + grace_s
     while time.monotonic() < deadline:
-        if not _DELIVERED.is_set():
-            try:
-                signal.pthread_kill(
-                    threading.main_thread().ident, signal.SIGINT
-                )
-            except Exception:
-                _thread.interrupt_main()
+        if _DELIVERED.is_set():
+            # handler ran; the main thread is unwinding — let it finish
+            time.sleep(min(15.0, max(0.1, deadline - time.monotonic())))
+            continue
+        try:
+            signal.pthread_kill(
+                threading.main_thread().ident, signal.SIGINT
+            )
+        except Exception:
+            _thread.interrupt_main()
         time.sleep(min(15.0, max(0.1, deadline - time.monotonic())))
+    if _DELIVERED.is_set():
+        # the interrupt landed and teardown is in flight — hard-exiting
+        # now would kill the PJRT close mid-way, wedging the tunnel the
+        # clean path exists to protect; the outer supervisor's
+        # TERM->KILL remains the true backstop for a hung teardown
+        print(
+            "[softdeadline] grace expired but teardown is unwinding - "
+            "leaving it to finish",
+            file=sys.stderr,
+            flush=True,
+        )
+        return
     print(
         "[softdeadline] main thread did not unwind within "
         f"{grace_s:.0f}s grace (stuck in C call) - hard exit 124",
@@ -95,24 +103,20 @@ def _watchdog(deadline_s: float, grace_s: float) -> None:
     os._exit(124)
 
 
-def _excepthook(tp, val, tb):
-    if _FIRED.is_set() and issubclass(tp, KeyboardInterrupt):
+def _sigint(_sig, _frm):
+    if _FIRED.is_set():
+        # only a post-deadline interrupt counts as delivery — marking a
+        # genuine pre-deadline ^C would permanently disable the
+        # watchdog's re-signalling (the event is never cleared)
+        _DELIVERED.set()
         print(
-            "[softdeadline] clean exit after deadline interrupt (rc=124)",
+            "[softdeadline] deadline interrupt delivered - clean "
+            "unwind to exit 124",
             file=sys.stderr,
             flush=True,
         )
-        # swallow the traceback and let interpreter shutdown proceed
-        # normally; the atexit hook registered in arm() sets rc=124
-        return
-    _orig_excepthook(tp, val, tb)
-
-
-_orig_excepthook = sys.excepthook
-
-
-def _sigint(_sig, _frm):
-    _DELIVERED.set()
+        raise SystemExit(124)
+    # a genuine ^C while armed: preserve the usual semantics
     raise KeyboardInterrupt
 
 
@@ -133,16 +137,8 @@ def arm(deadline_s: float, grace_s: float = 120.0) -> None:
     if _ARMED or deadline_s <= 0:
         return
     _ARMED = True
-    sys.excepthook = _excepthook
     try:
         signal.signal(signal.SIGTERM, _sigterm)
-        # our own SIGINT handler, installed unconditionally: (a) a
-        # process launched from a non-interactive shell's async list
-        # inherits SIGINT=SIG_IGN, which Python preserves — the
-        # watchdog's pthread_kill would then be a silent no-op and the
-        # deadline would degrade to the teardown-less hard exit; (b)
-        # the handler records delivery so the watchdog stops
-        # re-signalling once the interrupt is actually unwinding
         signal.signal(signal.SIGINT, _sigint)
     except ValueError:
         pass  # not the main thread; keep default dispositions
@@ -150,19 +146,6 @@ def arm(deadline_s: float, grace_s: float = 120.0) -> None:
         target=_watchdog, args=(deadline_s, grace_s), daemon=True
     )
     t.start()
-
-    # make the deadline path exit 124 (not 130/0): atexit hooks run
-    # LIFO, and jax registers its backend-teardown hook at first
-    # backend touch — AFTER this registration — so jax's hook (tunnel
-    # close) runs before this one; by the time we hard-set the exit
-    # code the connection is already down cleanly.
-    import atexit
-
-    def _exit_code():
-        if _FIRED.is_set():
-            os._exit(124)
-
-    atexit.register(_exit_code)
 
 
 def arm_from_env(default_grace_s: float = 120.0) -> None:

@@ -182,7 +182,7 @@ def test_channel_resume_filter_and_merge():
         on_result=lambda r: merged.__setitem__(r.row_id, r),
         done_rows={1, 3},  # worker rows already in the partial store
     )
-    t.join(timeout=30)
+    t.join(timeout=120)
     assert outcome == "completed"
     assert worker_ran == [5, 7]  # 1 and 3 filtered by the resume set
     # coordinator merged its own shard + the worker's fresh rows
@@ -228,7 +228,7 @@ def test_channel_worker_failure_fails_job():
             cw, coord_shard, shard_requests(reqs, 0, 2),
             on_result=lambda r: None,
         )
-    t.join(timeout=30)
+    t.join(timeout=120)
 
 
 def test_channel_worker_retry_replaces_connection():
@@ -272,12 +272,12 @@ def test_channel_worker_retry_replaces_connection():
     # it, after which the coordinator closes it)
     import time
 
-    deadline = time.monotonic() + 30
+    deadline = time.monotonic() + 120
     stale = None
     while stale is None:
         try:
             stale = socket.create_connection(
-                ("127.0.0.1", port), timeout=5.0
+                ("127.0.0.1", port), timeout=15.0
             )
         except OSError:
             if time.monotonic() > deadline:
@@ -296,7 +296,7 @@ def test_channel_worker_retry_replaces_connection():
     worker_outcome["v"] = run_dp_worker(
         ww, worker_shard, shard_requests(reqs, 1, 2)
     )
-    ct.join(timeout=30)
+    ct.join(timeout=120)
     assert not ct.is_alive()
     stale.close()
     assert worker_outcome["v"] == "completed"
@@ -326,12 +326,12 @@ def test_channel_stalled_worker_fails_resumably(monkeypatch):
     reqs = _reqs(4)
 
     def hung_worker():
-        deadline = time.monotonic() + 30
+        deadline = time.monotonic() + 120
         sock = None
         while sock is None:
             try:
                 sock = socket.create_connection(
-                    ("127.0.0.1", port), timeout=5.0
+                    ("127.0.0.1", port), timeout=15.0
                 )
             except OSError:
                 if time.monotonic() > deadline:
@@ -390,7 +390,7 @@ def test_serve_resume_round_completes_requeued_workers(monkeypatch):
     t = threading.Thread(target=worker_main)
     t.start()
     serve_resume_round(cw, job_key="", done_rows={0, 1, 2, 3})
-    t.join(timeout=30)
+    t.join(timeout=120)
     assert not t.is_alive()
     assert outcome["v"] == "completed"
     assert worker_ran == []  # every row was already merged
@@ -421,7 +421,7 @@ def test_channel_cancel_propagates_to_worker():
         return "completed"  # local shard done; cancel fires while waiting
 
     def worker_shard(shard, on_result, on_progress, should_cancel):
-        deadline = time.monotonic() + 30
+        deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
             if should_cancel():
                 return "cancelled"
@@ -445,7 +445,7 @@ def test_channel_cancel_propagates_to_worker():
         on_result=lambda r: None,
         should_cancel=should_cancel,
     )
-    t.join(timeout=60)
+    t.join(timeout=180)
     assert not t.is_alive()
     assert outcome == "cancelled"
     assert worker_outcome["v"] == "cancelled"

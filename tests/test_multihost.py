@@ -51,7 +51,7 @@ def test_two_process_mesh_collectives():
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=600)
             outs.append(out)
     finally:
         for q in procs:
