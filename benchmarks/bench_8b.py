@@ -137,7 +137,8 @@ def main() -> int:
                 proc.communicate(timeout=60)
             except subprocess.TimeoutExpired:
                 proc.kill()
-            _write(results)
+            if results:  # never clobber a prior run's artifact with
+                _write(results)  # an empty record set
             raise
         rec = {
             "model": model,

@@ -1,0 +1,163 @@
+"""Host-side scheduler overhead per decode window, measured with a
+STUB runner (no device, no compiles — pure Python/numpy bookkeeping).
+
+Why it matters: on the tunneled chip a fused B=64 window computes in
+~10.9 ms (PERF.md round-4 measurement). The scheduler's host work
+between dispatches — admission checks, stop-sequence scans, n-gram
+bookkeeping, result assembly — happens on the critical path whenever
+the pipeline is not deep enough to hide it. This profile isolates that
+cost per (window, batch) so regressions in host bookkeeping are
+visible without chip access, and the number slots directly into the
+RTT/pipe-depth budget: host_ms must stay well under window_ms ×
+(lookahead-1).
+
+Stub semantics: decode_multi_async returns plausible token arrays
+instantly; rows run to max_new_tokens (no stops), so the loop executes
+the same bookkeeping the real engine would at steady state.
+
+Writes HOST_OVERHEAD.json and prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import numpy as np  # noqa: E402
+
+
+class _StubCfg:
+    def __init__(self, vocab):
+        self.vocab_size = vocab
+
+
+class _StubRunner:
+    """Looks enough like ModelRunner for ContinuousBatcher's
+    unconstrained pipelined path: returns device-free fake tokens."""
+
+    def __init__(self, ecfg, vocab=256):
+        self.ecfg = ecfg
+        self.mcfg = _StubCfg(vocab)
+        self.vocab = vocab
+        self.sp = 1
+        self.pp = 1
+        self.dp = 1
+        self.num_pages = (
+            1 + ecfg.decode_batch_size * ecfg.max_pages_per_seq
+        )
+        self._rng = np.random.default_rng(0)
+
+    def max_context(self) -> int:
+        return self.ecfg.max_pages_per_seq * self.ecfg.kv_page_size
+
+    def prefill_batch(self, prompts, tables):
+        B = len(prompts)
+        return np.zeros((B, self.vocab), np.float32)
+
+    def prefill_batch_at(self, rows, page_tables, starts):
+        return np.zeros((len(rows), self.vocab), np.float32)
+
+    def prefill(self, prompt, table):
+        return np.zeros((self.vocab,), np.float32)
+
+    def merge_last(self, prev_last, refresh_mask, refresh_vals):
+        return np.where(
+            np.asarray(refresh_mask, bool),
+            np.asarray(refresh_vals, np.int32),
+            np.asarray(prev_last, np.int32),
+        )
+
+    def decode_multi_async(
+        self, last, past_len, tables, rng, temp, top_p, steps,
+        top_k=None, pfx=None,
+    ):
+        B = last.shape[0]
+        toks = self._rng.integers(
+            1, self.vocab, (steps, B), dtype=np.int64
+        ).astype(np.int32)
+        logps = np.full((steps, B), -1.0, np.float32)
+        return toks, logps
+
+    decode_multi = None  # force the pipelined async path
+
+    def decode_step(
+        self, last, past_len, tables, rng, temp, top_p,
+        top_k=None, allowed=None, row_seeds=None, penalties=None,
+    ):
+        B = last.shape[0]
+        toks = self._rng.integers(
+            1, self.vocab, (B,), dtype=np.int64
+        ).astype(np.int32)
+        return toks, np.full((B,), -1.0, np.float32)
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # rng keys only
+
+    from sutro_tpu.engine.config import EngineConfig
+    from sutro_tpu.engine.scheduler import ContinuousBatcher, GenRequest
+
+    out = {}
+    for B in (16, 64, 128):
+        ecfg = EngineConfig(
+            kv_page_size=16,
+            max_pages_per_seq=32,
+            decode_batch_size=B,
+            max_model_len=512,
+            use_pallas=False,
+            param_dtype="float32",
+            decode_multi_step=16,
+            decode_lookahead=2,
+        )
+        runner = _StubRunner(ecfg)
+        b = ContinuousBatcher(runner, stop_ids=[0])
+        rng = np.random.default_rng(1)
+        new_tokens = 256
+        reqs = [
+            GenRequest(
+                row_id=i,
+                prompt_ids=rng.integers(1, 255, 64).astype(np.int32),
+                max_new_tokens=new_tokens,
+                temperature=0.7,
+            )
+            for i in range(B)
+        ]
+        # warm session first: jax.random key ops compile tiny CPU
+        # programs on first use — that one-time cost is not steady-state
+        # host bookkeeping and must stay out of the measurement
+        warm = {}
+        b.run(
+            [dataclasses.replace(r) for r in reqs],
+            on_result=lambda r: warm.__setitem__(r.row_id, r),
+        )
+        res = {}
+        t0 = time.perf_counter()
+        state = b.run(
+            reqs, on_result=lambda r: res.__setitem__(r.row_id, r)
+        )
+        dt = time.perf_counter() - t0
+        assert state == "completed" and len(res) == B
+        n_windows = B * new_tokens / (B * ecfg.decode_multi_step)
+        out[f"B{B}"] = {
+            "total_s": round(dt, 3),
+            "host_ms_per_window": round(dt / n_windows * 1e3, 3),
+            "host_us_per_row_token": round(
+                dt / (B * new_tokens) * 1e6, 2
+            ),
+        }
+    (REPO / "HOST_OVERHEAD.json").write_text(
+        json.dumps(out, indent=2) + "\n"
+    )
+    print(json.dumps({"host_overhead": out}))
+
+
+if __name__ == "__main__":
+    main()

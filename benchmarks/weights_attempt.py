@@ -79,8 +79,14 @@ def main() -> int:
                      "*.safetensors"],
                     capture_output=True, text=True, timeout=120,
                 )
+                # a stray safetensors (LoRA shard, fixture) is not a
+                # checkpoint: require the sibling config.json, same as
+                # the env channel
                 hits += [
-                    line for line in out.stdout.splitlines() if line
+                    line for line in out.stdout.splitlines()
+                    if line and Path(line).parent.joinpath(
+                        "config.json"
+                    ).exists()
                 ][:5]
             except subprocess.TimeoutExpired:
                 pass

@@ -144,6 +144,11 @@ static int32_t try_admit_impl(
     if (need > rt->max_pages_per_seq) return -1;
     int32_t own = need - npfx;
     if (own < 1) own = 1;  // every row prefills >= 1 own token
+    // the own-page clamp above can push past the table row when the
+    // prefix already fills it (npfx == MP): admission must fail, or
+    // row[npfx + own - 1] writes one int past the row — and past the
+    // whole table vector for the last slot (heap smash)
+    if (npfx + own > rt->max_pages_per_seq) return -1;
     if (own > (int32_t)rt->free_pages.size()) return -1;
     int64_t inflight = rt_inflight_tokens(rt);
     if (inflight > 0 && inflight + total > rt->max_batch_tokens) return -1;

@@ -40,6 +40,11 @@ def _load_lib():
     global _lib
     if _lib is not None:
         return _lib
+    if os.environ.get("SUTRO_NATIVE_FSM", "1") == "0":
+        # explicit opt-out (mirrors SUTRO_NATIVE_RUNTIME=0): lets a
+        # suite run discriminate whether a native translation unit is
+        # implicated in a memory-corruption symptom
+        raise RuntimeError("native FSM disabled via SUTRO_NATIVE_FSM=0")
     if not os.path.exists(os.path.join(_NATIVE_DIR, "fsm.cpp")):
         raise FileNotFoundError("native/fsm.cpp not present")
     # always run make: a no-op when the .so is fresh, a rebuild when

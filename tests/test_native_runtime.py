@@ -116,3 +116,27 @@ def test_batcher_native_vs_python_parity(tiny_ecfg, byte_tok, monkeypatch):
     assert py == nat
     native_runtime._lib = None
     native_runtime._lib_failed = False
+
+
+def test_admit_pfx_prefix_filling_whole_row_rejected():
+    """A shared prefix occupying the full table row leaves no room for
+    the slot's mandatory own page: admission must fail cleanly (the
+    round-5 C++ audit found row[npfx + own - 1] would otherwise write
+    one int past the row — past the whole table vector for the last
+    slot)."""
+    rt = _rt(num_pages=65, max_pages_per_seq=8, max_context=64)
+    pfx = rt.alloc_pages(8)          # prefix fills the whole row
+    assert pfx is not None
+    # probe every slot INCLUDING the last (the heap-smash position):
+    # occupy preceding slots with plain rows so each rejected pfx
+    # admission actually lands on a later free slot
+    for i in range(rt.num_slots):
+        assert rt.try_admit_pfx(60, 4, pfx) == -1
+        if i < rt.num_slots - 1:
+            assert rt.try_admit(8, 8) >= 0   # occupy this slot
+    # sanity: a prefix that leaves room still admits
+    rt.free_pages(pfx)
+    pfx7 = rt.alloc_pages(7)
+    slot = rt.try_admit_pfx(58, 6, pfx7)   # need=8, own=1 fits
+    assert slot >= 0
+    assert rt.slot_pages(slot)  # one own page at the tail
