@@ -2,19 +2,27 @@
 # Per-file suite runner. NO retry policy (VERDICT r4 item 5): every
 # file runs exactly once and any failure is terminal.
 #
-# Why per-file processes at all — the pinned cause: long-lived
-# many-compile pytest processes flakily segfault INSIDE XLA:CPU's
-# backend_compile_and_load on this host (fatal dumps in
-# pytest_full.log round 4 and the round-5 reproduction). The round-5
-# crash had only 73 extension modules loaded — torch NOT among them —
-# so the round-4 "torch._C + jaxlib co-residency" suspicion is
-# falsified; the trigger correlates with compile count / process
-# lifetime, not co-loaded libraries. Every crashed file passes in
-# isolation, the crash file differs run to run, and the persistent
-# compile cache is OFF under tests (conftest sets
-# SUTRO_COMPILE_CACHE=0), which rules out cache corruption. Upstream
-# XLA:CPU flake; per-file processes bound the blast radius so a
-# one-in-hundreds compile crash cannot take down the whole gate.
+# Why per-file processes at all — the pinned cause, from a round-5
+# discrimination matrix (4 reproductions, full dumps preserved):
+#   r4 full suite (torch loaded, 223 ext modules)      -> SIGSEGV in
+#     XLA:CPU backend_compile_and_load @ test_prefix_cache
+#   r5 suite minus test_golden (NO torch, 73 modules)  -> same site,
+#     test_prefix_cache (different test)  [torch EXONERATED]
+#   r5 + SUTRO_NATIVE_RUNTIME=0                        -> same
+#     [native runtime.cpp EXONERATED]
+#   r5 + SUTRO_NATIVE_RUNTIME=0 SUTRO_NATIVE_FSM=0     -> same
+#     [ALL in-repo C++ EXONERATED]
+#   2000 distinct fresh XLA:CPU compiles, one process  -> no crash
+#     [raw compile count EXONERATED]
+# Every crashed FILE passes in isolation; the victim test varies but
+# the crash file is test_prefix_cache 4/4 — i.e. the trigger is the
+# accumulated in-process state (live executables/threads/arenas) by
+# the time the suite reaches that point, not the test itself. The
+# persistent compile cache is OFF under tests (conftest sets
+# SUTRO_COMPILE_CACHE=0), ruling out cache corruption. Conclusion:
+# upstream XLA:CPU compiler flake in long-lived many-compile
+# processes; per-file processes bound the blast radius so it cannot
+# take down the whole gate.
 # The former "load-sensitive retry" is retired: the multi-process
 # timing tests (test_dphost/test_multihost) now carry deadlines sized
 # for a loaded single-core host instead.
