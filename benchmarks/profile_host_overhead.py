@@ -63,7 +63,7 @@ class _StubRunner:
     def prefill_batch_at(self, rows, page_tables, starts):
         return np.zeros((len(rows), self.vocab), np.float32)
 
-    def prefill(self, prompt, table):
+    def prefill(self, prompt, table, start=0):
         return np.zeros((self.vocab,), np.float32)
 
     def merge_last(self, prev_last, refresh_mask, refresh_vals):
