@@ -223,6 +223,15 @@ void rt_note_token(Runtime* rt, int32_t slot, int32_t tok) {
     rt->emitted[slot] += 1;
 }
 
+// Bulk form for window acceptance: `n` tokens accepted ending with
+// `last_tok` (equivalent to n rt_note_token calls whose final token is
+// last_tok — one ctypes crossing per window instead of one per token).
+void rt_note_bulk(Runtime* rt, int32_t slot, int32_t last_tok, int32_t n) {
+    rt->past_len[slot] += n;
+    rt->last[slot] = last_tok;
+    rt->emitted[slot] += n;
+}
+
 void rt_release(Runtime* rt, int32_t slot) {
     if (!rt->active[slot]) return;
     // slot_pages is ascending (assigned from the sorted free list):

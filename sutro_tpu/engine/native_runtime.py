@@ -78,6 +78,7 @@ def _load_lib():
     lib.rt_free_pages.argtypes = [c_rt, i32, p_i32]
     lib.rt_arm_slot.argtypes = [c_rt, i32, i32, i32, f32, f32, i32]
     lib.rt_note_token.argtypes = [c_rt, i32, i32]
+    lib.rt_note_bulk.argtypes = [c_rt, i32, i32, i32]
     lib.rt_release.argtypes = [c_rt, i32]
     lib.rt_emitted.restype = i32
     lib.rt_emitted.argtypes = [c_rt, i32]
@@ -216,6 +217,11 @@ class NativeRuntime:
 
     def note_token(self, slot: int, tok: int) -> None:
         self._lib.rt_note_token(self._rt, slot, int(tok))
+
+    def note_bulk(self, slot: int, last_tok: int, n: int) -> None:
+        """n accepted tokens ending with last_tok — one ctypes crossing
+        per window instead of one per token."""
+        self._lib.rt_note_bulk(self._rt, slot, int(last_tok), int(n))
 
     def release(self, slot: int) -> None:
         self._lib.rt_release(self._rt, slot)
