@@ -162,6 +162,20 @@ def main() -> None:
     if os.environ.get("SUTRO_E2E_FF"):
         ecfg["constrain_fastforward"] = int(os.environ["SUTRO_E2E_FF"])
 
+    # A/B legs must not CLOBBER the default entries in BENCH_E2E.json
+    # (workloads merge by name): suffix the workload key with the
+    # active lever flags so "classify" and "classify+ff0" coexist and
+    # the A/B delta is readable straight off the artifact
+    ab = ""
+    if os.environ.get("SUTRO_E2E_SPEC"):
+        ab += f"+spec{int(os.environ['SUTRO_E2E_SPEC'])}"
+    if os.environ.get("SUTRO_PREFIX_SPLIT") == "1":
+        ab += "+psplit"
+    if os.environ.get("SUTRO_E2E_FF"):
+        ab += f"+ff{int(os.environ['SUTRO_E2E_FF'])}"
+    if os.environ.get("SUTRO_E2E_MULTI"):
+        ab += f"+w{int(os.environ['SUTRO_E2E_MULTI'])}"
+
     os.environ.setdefault("SUTRO_HOME", "/tmp/sutro-bench-e2e")
     from sutro_tpu.sdk import Sutro
 
@@ -264,7 +278,7 @@ def main() -> None:
         )
         df = so.await_job_completion(jid, timeout=24 * 3600)
         assert df is not None and len(df) == long_rows
-        record("longgen", jid, long_rows, time.monotonic() - t0)
+        record("longgen" + ab, jid, long_rows, time.monotonic() - t0)
 
     # -- classify (schema-constrained; reference README.md:124-160) ----
     if "classify" in workloads:
@@ -299,7 +313,7 @@ def main() -> None:
         )
         df = so.await_job_completion(jid, timeout=24 * 3600)
         assert df is not None and len(df) == rows
-        record("classify", jid, rows, time.monotonic() - t0)
+        record("classify" + ab, jid, rows, time.monotonic() - t0)
 
     # -- generate (unconstrained, fused multi-step decode) --------------
     if "generate" in workloads:
@@ -312,7 +326,7 @@ def main() -> None:
         )
         df = so.await_job_completion(jid, timeout=24 * 3600)
         assert df is not None and len(df) == rows
-        record("generate", jid, rows, time.monotonic() - t0)
+        record("generate" + ab, jid, rows, time.monotonic() - t0)
 
     # -- embed (BASELINE config #3) --------------------------------------
     if "embed" in workloads:
@@ -321,7 +335,7 @@ def main() -> None:
         jid = so.infer(emb_reviews, model=emb_model, stay_attached=False)
         df = so.await_job_completion(jid, timeout=24 * 3600)
         assert df is not None and len(df) == emb_rows
-        record("embed", jid, emb_rows, time.monotonic() - t0)
+        record("embed" + ab, jid, emb_rows, time.monotonic() - t0)
 
     # merge into any existing BENCH_E2E.json so separately-invoked
     # workload sets (e.g. longgen) accumulate in one artifact; every

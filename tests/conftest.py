@@ -88,3 +88,26 @@ def make_requests(tok, texts, **kw):
         )
         for i, t in enumerate(texts)
     ]
+
+
+def free_low_port() -> int:
+    """A port OUTSIDE the kernel's ephemeral range (32768+ on this
+    host): bind-port-0 hands back an ephemeral port that any outgoing
+    TCP connection on the box (background probes, other tests) can be
+    assigned as its SOURCE port between our close() and the engine's
+    bind — an observed EADDRINUSE flake once the suite ran with no
+    retries. Low-range ports are never auto-assigned to clients, so
+    the only residual race is another caller, made unlikely by
+    randomization."""
+    import random
+    import socket
+
+    for _ in range(64):
+        cand = random.randrange(20000, 31000)
+        with socket.socket() as s:
+            try:
+                s.bind(("127.0.0.1", cand))
+            except OSError:
+                continue
+            return cand
+    raise RuntimeError("no free low-range port found")

@@ -19,9 +19,9 @@ REPO = Path(__file__).resolve().parent.parent
 
 
 def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    from tests.conftest import free_low_port
+
+    return free_low_port()
 
 
 def _spawn(tmp_path, name, extra_env):
