@@ -163,18 +163,31 @@ def main() -> None:
         ecfg["constrain_fastforward"] = int(os.environ["SUTRO_E2E_FF"])
 
     # A/B legs must not CLOBBER the default entries in BENCH_E2E.json
-    # (workloads merge by name): suffix the workload key with the
-    # active lever flags so "classify" and "classify+ff0" coexist and
-    # the A/B delta is readable straight off the artifact
-    ab = ""
-    if os.environ.get("SUTRO_E2E_SPEC"):
-        ab += f"+spec{int(os.environ['SUTRO_E2E_SPEC'])}"
-    if os.environ.get("SUTRO_PREFIX_SPLIT") == "1":
-        ab += "+psplit"
-    if os.environ.get("SUTRO_E2E_FF"):
-        ab += f"+ff{int(os.environ['SUTRO_E2E_FF'])}"
-    if os.environ.get("SUTRO_E2E_MULTI"):
-        ab += f"+w{int(os.environ['SUTRO_E2E_MULTI'])}"
+    # (workloads merge by name): suffix each workload's key with the
+    # active lever flags THAT AFFECT IT, so "classify" and
+    # "classify+ff0" coexist and the A/B delta is readable straight
+    # off the artifact — while e.g. SUTRO_E2E_GEN_TEMP never creates a
+    # spurious config-identical "classify+t0" duplicate.
+    def ab_for(workload: str) -> str:
+        decode = workload in ("classify", "generate", "longgen")
+        greedy_unconstrained = workload in ("generate", "longgen")
+        ab = ""
+        if os.environ.get("SUTRO_E2E_SPEC") and greedy_unconstrained:
+            ab += f"+spec{int(os.environ['SUTRO_E2E_SPEC'])}"
+        if os.environ.get("SUTRO_PREFIX_SPLIT") == "1" and decode:
+            ab += "+psplit"
+        if os.environ.get("SUTRO_E2E_FF") and workload == "classify":
+            ab += f"+ff{int(os.environ['SUTRO_E2E_FF'])}"
+        if os.environ.get("SUTRO_E2E_MULTI") and decode:
+            ab += f"+w{int(os.environ['SUTRO_E2E_MULTI'])}"
+        if os.environ.get("SUTRO_E2E_GEN_TEMP") and workload in (
+            "generate",
+        ):
+            ab += f"+t{os.environ['SUTRO_E2E_GEN_TEMP']}"
+        # free-form run tag (e.g. "@2k"): lets a matched-rows baseline
+        # coexist with a different-scale entry of the same workload
+        ab += os.environ.get("SUTRO_E2E_TAG", "")
+        return ab
 
     os.environ.setdefault("SUTRO_HOME", "/tmp/sutro-bench-e2e")
     from sutro_tpu.sdk import Sutro
@@ -229,7 +242,7 @@ def main() -> None:
         if cached is not None:
             params = cached[0].params
             device_kind = jax.devices()[0].device_kind
-            if name == "embed":
+            if name.split("+")[0].split("@")[0] == "embed":  # A/B- or tag-suffixed too
                 entry.update(
                     roofline.grade_prefill(
                         total / elapsed / n_chips,
@@ -278,7 +291,7 @@ def main() -> None:
         )
         df = so.await_job_completion(jid, timeout=24 * 3600)
         assert df is not None and len(df) == long_rows
-        record("longgen" + ab, jid, long_rows, time.monotonic() - t0)
+        record("longgen" + ab_for("longgen"), jid, long_rows, time.monotonic() - t0)
 
     # -- classify (schema-constrained; reference README.md:124-160) ----
     if "classify" in workloads:
@@ -313,20 +326,34 @@ def main() -> None:
         )
         df = so.await_job_completion(jid, timeout=24 * 3600)
         assert df is not None and len(df) == rows
-        record("classify" + ab, jid, rows, time.monotonic() - t0)
+        record("classify" + ab_for("classify"), jid, rows, time.monotonic() - t0)
 
     # -- generate (unconstrained, fused multi-step decode) --------------
     if "generate" in workloads:
         t0 = time.monotonic()
+        # SUTRO_E2E_GEN_TEMP=0 makes the batch all-greedy — REQUIRED
+        # for the n-gram spec-decode A/B (the spec gate sits out for
+        # sampled or constrained rows, so classify legs can't measure
+        # it); default keeps the engine's sampled path
+        gen_sp = {}
+        if os.environ.get("SUTRO_E2E_GEN_TEMP"):
+            gen_sp = {
+                "sampling_params": {
+                    "temperature": float(
+                        os.environ["SUTRO_E2E_GEN_TEMP"]
+                    )
+                }
+            }
         jid = so.infer(
             reviews,
             model=model,
             system_prompt="Summarize the review in one short sentence.",
             stay_attached=False,
+            **gen_sp,
         )
         df = so.await_job_completion(jid, timeout=24 * 3600)
         assert df is not None and len(df) == rows
-        record("generate" + ab, jid, rows, time.monotonic() - t0)
+        record("generate" + ab_for("generate"), jid, rows, time.monotonic() - t0)
 
     # -- embed (BASELINE config #3) --------------------------------------
     if "embed" in workloads:
@@ -335,7 +362,7 @@ def main() -> None:
         jid = so.infer(emb_reviews, model=emb_model, stay_attached=False)
         df = so.await_job_completion(jid, timeout=24 * 3600)
         assert df is not None and len(df) == emb_rows
-        record("embed" + ab, jid, emb_rows, time.monotonic() - t0)
+        record("embed" + ab_for("embed"), jid, emb_rows, time.monotonic() - t0)
 
     # merge into any existing BENCH_E2E.json so separately-invoked
     # workload sets (e.g. longgen) accumulate in one artifact; every

@@ -68,23 +68,34 @@ step "e2e 20k classify + generate + embed" 14400 \
   env SUTRO_E2E_ROWS=20000 python bench_e2e.py
 step "e2e embed 100k (config-3 scale)" 10800 \
   env SUTRO_E2E_WORKLOADS=embed SUTRO_E2E_EMBED_ROWS=100000 \
-  python bench_e2e.py
+  SUTRO_E2E_TAG=@100k python bench_e2e.py
 step "e2e longgen 2k tokens" 7200 \
   env SUTRO_E2E_WORKLOADS=longgen python bench_e2e.py
-step "spec A/B off" 3600 \
-  env SUTRO_E2E_ROWS=2000 SUTRO_E2E_WORKLOADS=classify python bench_e2e.py
-step "spec A/B on" 3600 \
-  env SUTRO_E2E_ROWS=2000 SUTRO_E2E_WORKLOADS=classify SUTRO_E2E_SPEC=6 \
-  python bench_e2e.py
+# matched-rows baseline for the classify A/B legs below: prefix-split
+# and fastforward deltas must compare 2000-row runs with 2000-row
+# runs (fixed costs amortize ~10x differently at 20k)
+step "classify 2000-row baseline" 3600 \
+  env SUTRO_E2E_ROWS=2000 SUTRO_E2E_WORKLOADS=classify \
+  SUTRO_E2E_TAG=@2k python bench_e2e.py
+# spec decode requires an all-greedy UNCONSTRAINED batch (the gate
+# sits out for constrained/sampled rows): A/B on greedy generate,
+# not classify
+step "spec A/B off (greedy generate)" 3600 \
+  env SUTRO_E2E_ROWS=2000 SUTRO_E2E_WORKLOADS=generate \
+  SUTRO_E2E_GEN_TEMP=0 SUTRO_E2E_TAG=@2k python bench_e2e.py
+step "spec A/B on (greedy generate)" 3600 \
+  env SUTRO_E2E_ROWS=2000 SUTRO_E2E_WORKLOADS=generate \
+  SUTRO_E2E_GEN_TEMP=0 SUTRO_E2E_SPEC=6 SUTRO_E2E_TAG=@2k python bench_e2e.py
 step "prefix-split A/B on" 3600 \
   env SUTRO_E2E_ROWS=2000 SUTRO_E2E_WORKLOADS=classify \
-  SUTRO_PREFIX_SPLIT=1 python bench_e2e.py
-step "spec + prefix-split stacked" 3600 \
-  env SUTRO_E2E_ROWS=2000 SUTRO_E2E_WORKLOADS=classify \
-  SUTRO_E2E_SPEC=6 SUTRO_PREFIX_SPLIT=1 python bench_e2e.py
+  SUTRO_PREFIX_SPLIT=1 SUTRO_E2E_TAG=@2k python bench_e2e.py
+step "spec + prefix-split stacked (greedy generate)" 3600 \
+  env SUTRO_E2E_ROWS=2000 SUTRO_E2E_WORKLOADS=generate \
+  SUTRO_E2E_GEN_TEMP=0 SUTRO_E2E_SPEC=6 SUTRO_PREFIX_SPLIT=1 \
+  SUTRO_E2E_TAG=@2k python bench_e2e.py
 step "fastforward A/B off (pre-round-4 constrained path)" 3600 \
   env SUTRO_E2E_ROWS=2000 SUTRO_E2E_WORKLOADS=classify \
-  SUTRO_E2E_FF=0 python bench_e2e.py
+  SUTRO_E2E_FF=0 SUTRO_E2E_TAG=@2k python bench_e2e.py
 step "cost_northstar" 1800 python benchmarks/cost_northstar.py
 step "weights_attempt + golden_quickstart" 3600 \
   python benchmarks/weights_attempt.py

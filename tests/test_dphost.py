@@ -18,10 +18,7 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 
 
-def _free_port() -> int:
-    from tests.conftest import free_low_port
-
-    return free_low_port()
+from tests.conftest import free_low_port as _free_port
 
 
 def _spawn(tmp_path, name, extra_env):

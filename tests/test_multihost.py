@@ -11,7 +11,6 @@ psum — executes, not just compiles.
 """
 
 import os
-import socket
 import subprocess
 import sys
 from pathlib import Path
@@ -19,10 +18,7 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 
 
-def _free_port() -> int:
-    from tests.conftest import free_low_port
-
-    return free_low_port()
+from tests.conftest import free_low_port as _free_port
 
 
 def test_two_process_mesh_collectives():
