@@ -541,3 +541,43 @@ def test_masked_window_step_trusts_mask_no_livelock(tiny_runner, byte_tok):
     b.run(reqs, on_result=lambda r: res.__setitem__(r.row_id, r))
     # terminates (no livelock) and makes real progress via masked steps
     assert len(res[0].token_ids) == 6
+
+
+def test_row_seed_independent_of_batch_composition(tiny_runner, byte_tok):
+    """The reference's random_seed_per_input contract (sample()
+    docstring): a seeded row's output stream is reproducible regardless
+    of batch composition — pinned across admission-group sizes (1-row
+    job vs 3-row job). The 3-row group pads to the 4-bucket in
+    round-5's bucketed admission sampling, so this also pins that a
+    padded group does not perturb real rows' draws."""
+    import numpy as np
+
+    from sutro_tpu.engine.scheduler import ContinuousBatcher, GenRequest
+
+    def run_job(reqs):
+        b = ContinuousBatcher(tiny_runner, stop_ids=byte_tok.stop_ids())
+        res = {}
+        out = b.run(reqs, on_result=lambda r: res.__setitem__(r.row_id, r))
+        assert out == "completed"
+        return res
+
+    def mk(i, txt, seed=None):
+        return GenRequest(
+            row_id=i,
+            prompt_ids=np.frombuffer(txt.encode(), np.uint8).astype(
+                np.int32
+            ),
+            max_new_tokens=10,
+            temperature=0.9,
+            row_seed=seed,
+        )
+
+    solo = run_job([mk(0, "the quick brown fox", seed=42)])
+    crowd = run_job(
+        [
+            mk(0, "alpha"),
+            mk(1, "much longer prompt here padding things"),
+            mk(2, "the quick brown fox", seed=42),
+        ]
+    )
+    assert solo[0].token_ids == crowd[2].token_ids
