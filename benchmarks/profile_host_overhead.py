@@ -89,12 +89,76 @@ class _StubRunner:
     def decode_step(
         self, last, past_len, tables, rng, temp, top_p,
         top_k=None, allowed=None, row_seeds=None, penalties=None,
+        pfx=None,
     ):
         B = last.shape[0]
         toks = self._rng.integers(
             1, self.vocab, (B,), dtype=np.int64
         ).astype(np.int32)
+        if allowed is not None:
+            a = np.asarray(allowed)
+            toks = np.argmax(a, axis=1).astype(np.int32)  # 1st admitted
         return toks, np.full((B,), -1.0, np.float32)
+
+    # --- constrained/speculative surface (classify-like profiling) ---
+
+    def decode_window(
+        self, last, past_len, tables, rng, temp, top_p, steps,
+        top_k=None, allowed0=None, pfx=None,
+    ):
+        B = last.shape[0]
+        toks = self._rng.integers(
+            1, self.vocab, (steps, B), dtype=np.int64
+        ).astype(np.int32)
+        if allowed0 is not None:
+            a = np.asarray(allowed0)
+            toks[0] = np.argmax(a, axis=1).astype(np.int32)
+        return toks, np.full((steps, B), -1.0, np.float32), None
+
+    def commit_window(self, handle, accepted):
+        pass
+
+    def verify_candidates(
+        self, last, drafts, draft_len, cand, cand_n, past_len, table
+    ):
+        # emulate the well-trained chip case: every planned position
+        # lands its draft token (scaffold runs accept fully), and the
+        # boundary position takes its first admitted candidate — this
+        # measures the HOST cost of planning/acceptance, not model
+        # quality
+        B, K = drafts.shape
+        ct = np.zeros((B, K + 1), np.int32)
+        ct[:, :K] = drafts
+        for b in range(B):
+            L = int(draft_len[b])
+            if L < K + 1 and cand_n[b, L] > 0:
+                ct[b, L] = cand[b, L, 0]  # boundary: 1st admitted
+        zeros = np.zeros((B, K + 1), np.float32)
+        return ct, zeros, ct.copy(), zeros.copy()
+
+    def verify_greedy(self, last, drafts, dlens, past_len, table):
+        B, K = drafts.shape
+        ct = np.zeros((B, K + 1), np.int32)
+        ct[:, :K] = drafts
+        return ct, np.zeros((B, K + 1), np.float32)
+
+
+def mk_ecfg(B):
+    """ONE config for both legs: the constrained-vs-unconstrained
+    comparison in PERF.md is apples-to-apples only while these stay in
+    lockstep."""
+    from sutro_tpu.engine.config import EngineConfig
+
+    return EngineConfig(
+        kv_page_size=16,
+        max_pages_per_seq=32,
+        decode_batch_size=B,
+        max_model_len=512,
+        use_pallas=False,
+        param_dtype="float32",
+        decode_multi_step=16,
+        decode_lookahead=2,
+    )
 
 
 def main() -> None:
@@ -107,16 +171,7 @@ def main() -> None:
 
     out = {}
     for B in (16, 64, 128):
-        ecfg = EngineConfig(
-            kv_page_size=16,
-            max_pages_per_seq=32,
-            decode_batch_size=B,
-            max_model_len=512,
-            use_pallas=False,
-            param_dtype="float32",
-            decode_multi_step=16,
-            decode_lookahead=2,
-        )
+        ecfg = mk_ecfg(B)
         runner = _StubRunner(ecfg)
         b = ContinuousBatcher(runner, stop_ids=[0])
         rng = np.random.default_rng(1)
@@ -157,6 +212,74 @@ def main() -> None:
                 dt / (B * new_tokens) * 1e6, 2
             ),
         }
+    # classify-shaped constrained leg: REAL FSM machinery (schema
+    # compile, mask cache, fast-forward planning, per-token verify
+    # acceptance) over the stub device — the host-side floor of the
+    # north-star constrained workload. The stub verify echoes each
+    # planned draft (full scaffold acceptance, the well-trained case),
+    # so the number isolates host bookkeeping, not model quality.
+    from sutro_tpu.engine.constrain.fsm import schema_constraint_factory
+    from sutro_tpu.engine.tokenizer import ByteTokenizer
+
+    schema = {
+        "type": "object",
+        "properties": {
+            "scratchpad": {"type": "string", "maxLength": 40},
+            "classification": {
+                "enum": ["positive", "negative", "neutral"]
+            },
+        },
+        "required": ["scratchpad", "classification"],
+        "additionalProperties": False,
+    }
+    for B in (16, 64):
+        ecfg = mk_ecfg(B)
+        runner = _StubRunner(ecfg, vocab=267)
+        tok = ByteTokenizer(vocab_size=267)
+        factory = schema_constraint_factory(schema, tok)
+        b = ContinuousBatcher(
+            runner,
+            stop_ids=tok.stop_ids(),
+            token_bytes=tok.token_bytes,
+        )
+        rng = np.random.default_rng(1)
+        new_tokens = 96
+
+        def mk_reqs():
+            return [
+                GenRequest(
+                    row_id=i,
+                    prompt_ids=rng.integers(1, 250, 64).astype(np.int32),
+                    max_new_tokens=new_tokens,
+                    temperature=0.0,
+                    constraint=factory(),
+                )
+                for i in range(B)
+            ]
+
+        for _ in range(2):
+            warm = {}
+            b.run(
+                mk_reqs(),
+                on_result=lambda r: warm.__setitem__(r.row_id, r),
+            )
+        res = {}
+        t0 = time.perf_counter()
+        state = b.run(
+            mk_reqs(), on_result=lambda r: res.__setitem__(r.row_id, r)
+        )
+        dt = time.perf_counter() - t0
+        assert state == "completed" and len(res) == B
+        toks_out = sum(len(r.token_ids) for r in res.values())
+        out[f"constrained_B{B}"] = {
+            "total_s": round(dt, 3),
+            "rows": B,
+            "tokens": toks_out,
+            "host_us_per_row_token": round(
+                dt / max(toks_out, 1) * 1e6, 2
+            ),
+        }
+
     (REPO / "HOST_OVERHEAD.json").write_text(
         json.dumps(out, indent=2) + "\n"
     )
