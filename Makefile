@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: all native test test-oneshot test-fast compile-check bench bench-e2e dryrun \
-	chip-validate bench-8b cost golden clean
+	chip-validate bench-8b cost golden host-profile clean
 
 all: native compile-check
 
@@ -63,6 +63,14 @@ bench-8b:
 # north-star $/job vs OpenAI Batch from the latest BENCH_E2E record
 cost:
 	$(PY) benchmarks/cost_northstar.py
+
+# host-side overhead profile (stub runner, no chip): per-window micro
+# legs + full-job-lifecycle e2e legs at 512/20k rows, with the
+# pipelined-decode budget (host_ms_per_window <= window_ms x
+# (lookahead-1)) and flat-scaling (20k <= 1.25x 512 per-row) asserted
+# in code — non-zero exit on regression
+host-profile:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/profile_host_overhead.py --e2e
 
 # README 3-row quickstart on real trained weights -> GOLDEN.json
 golden:

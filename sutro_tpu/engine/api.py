@@ -197,19 +197,19 @@ class LocalEngine:
         if quota_err is None:
             quota_err = self.jobs.check_quota(rec.job_priority, 0, bound)
             if quota_err:
+                from .tokenizer import encode_chat_batch
+
                 tok = self._get_tokenizer(engine_key, mcfg)
                 exact = (
                     sum(
-                        len(
-                            tok.encode(
-                                tok.render_chat(
-                                    r,
-                                    system=rec.system_prompt,
-                                    template=mcfg.chat_template,
-                                )
-                            )
+                        len(ids)
+                        for ids in encode_chat_batch(
+                            tok,
+                            inputs,
+                            rec.system_prompt,
+                            mcfg.chat_template,
+                            threads=self.ecfg.tokenize_threads,
                         )
-                        for r in inputs
                     )
                     + max_new_total
                 )
@@ -374,7 +374,10 @@ class LocalEngine:
     ) -> Dict[str, Any]:
         """POST /job-results equivalent: {outputs[, inputs,
         cumulative_logprobs]} aligned 1:1 with inputs, order-preserving."""
-        df = self.jobs.read_results(job_id).sort_values("row_id")
+        df = self.jobs.read_results(job_id)
+        if not df["row_id"].is_monotonic_increasing:
+            df = df.sort_values("row_id")  # streamed results are
+            #                                already row-ordered
         out: Dict[str, Any] = {"outputs": df["outputs"].tolist()}
         if include_inputs:
             out["inputs"] = self.jobs.read_inputs(job_id)
@@ -470,11 +473,12 @@ class LocalEngine:
                 self._queued_prio.pop(job_id, None)
             raise
         # mirror _run_job's resume filter: cancelled-truncated rows are
-        # regenerated, so they don't count as already done
+        # regenerated, so they don't count as already done (meta-only
+        # read: no output columns materialize)
         done = sum(
             1
-            for r in self.jobs.read_partial(job_id).values()
-            if r.get("finish_reason") != "cancelled"
+            for reason in self.jobs.read_partial_meta(job_id).values()
+            if reason != "cancelled"
         )
         return {
             "status": JobStatus.QUEUED.value,
@@ -599,16 +603,17 @@ class LocalEngine:
             max_new = int(
                 sampling.get("max_new_tokens", self.ecfg.max_new_tokens)
             )
-            prompts = [
-                tok.render_chat(
-                    row,
-                    system=rec.system_prompt,
-                    template=mcfg.chat_template,
-                )
-                for row in inputs
-            ]
+            from .tokenizer import encode_chat_batch
+
             token_rows = [
-                np.array(tok.encode(p), np.int32) for p in prompts
+                np.array(ids, np.int32)
+                for ids in encode_chat_batch(
+                    tok,
+                    inputs,
+                    rec.system_prompt,
+                    mcfg.chat_template,
+                    threads=self.ecfg.tokenize_threads,
+                )
             ]
             input_tokens = int(sum(len(r) for r in token_rows))
             if rec.dry_run:
@@ -696,7 +701,7 @@ class LocalEngine:
                     # the coordinator's partial store holds every
                     # rank's flushed rows — the done set lets
                     # relaunched workers resume row-granularly
-                    done_rows=set(sess.results), num_rows=rec.num_rows,
+                    done_rows=set(sess.done), num_rows=rec.num_rows,
                 )
                 if outcome is None:  # worker rank: terminal status set
                     return None
@@ -988,7 +993,11 @@ class LocalEngine:
 
         dp = DPWorld.from_env()
         n_chips = max(jax.device_count(), 1) * (dp.world if dp else 1)
-        last_reported = {"n": len(results)}
+        # batch the progress bus (a 1M-row job would otherwise pay one
+        # bus publish per row) — shared rule with the generation path
+        from .metrics import BatchedProgress
+
+        row_progress = BatchedProgress(jm, every_rows=bs)
 
         def record_result(r: "EmbResult") -> None:
             results[r.row_id] = r.vector
@@ -998,11 +1007,7 @@ class LocalEngine:
             )
             if len(pending_flush) >= _PARTIAL_FLUSH_EVERY:
                 flush()
-            # batch the progress bus (a 1M-row job would otherwise put
-            # one update per row on every subscriber queue)
-            if len(results) - last_reported["n"] >= bs:
-                last_reported["n"] = len(results)
-                jm.progress(len(results))
+            row_progress.update(len(results))
 
         def embed_progress(p: Dict[str, Any]) -> None:
             jm.tokens(
@@ -1106,7 +1111,7 @@ class LocalEngine:
             flush()
             return rec.job_priority
         flush()
-        jm.progress(len(results))  # batched reporting: emit the final count
+        row_progress.flush(len(results))  # terminal count always lands
         input_tokens = int(sum(len(r) for r in token_rows))
         self.jobs.update(
             job_id,
@@ -1140,12 +1145,17 @@ class _GenSession:
     ):
         from .scheduler import JobCtx
 
+        from .metrics import BatchedProgress
+
         self.eng = eng
         self.job_id = job_id
         self.rec = rec
         self.engine_key = engine_key
         self.tok = tok
         self.jm = eng.metrics.job(job_id)
+        self.row_progress = BatchedProgress(
+            self.jm, every_rows=eng.ecfg.decode_batch_size
+        )
         self.finalized = False
         self.thinking = bool(meta.get("thinking"))
         inputs = eng.jobs.read_inputs(job_id)
@@ -1199,17 +1209,21 @@ class _GenSession:
                 "truncate output, they cannot end generation early"
             )
 
-        # Prompt build: system prompt + chat template, then tokenize.
-        prompts = [
-            tok.render_chat(
-                row,
-                system=rec.system_prompt,
-                template=mcfg.chat_template,
-            )
-            for row in inputs
-        ]
+        # Prompt build: system prompt + chat template, then tokenize —
+        # ONE prefix-aware batched pass (tokenizer.encode_chat_batch):
+        # the shared template shell (chat scaffold + system prompt)
+        # encodes once, per-row suffixes in batch, bit-identical ids.
+        from .tokenizer import encode_chat_batch
+
         self.token_rows = [
-            np.array(tok.encode(p), np.int32) for p in prompts
+            np.array(ids, np.int32)
+            for ids in encode_chat_batch(
+                tok,
+                inputs,
+                rec.system_prompt,
+                mcfg.chat_template,
+                threads=eng.ecfg.tokenize_threads,
+            )
         ]
         self.input_tokens = int(sum(len(r) for r in self.token_rows))
 
@@ -1223,13 +1237,16 @@ class _GenSession:
             # (the schema-feasibility cap raise happens at submit time
             # so quota and dry-run cost account for the effective cap)
 
-        # cancelled rows carry truncated output — regenerate on resume
-        resume = {
-            i: r
-            for i, r in eng.jobs.read_partial(job_id).items()
-            if r.get("finish_reason") != "cancelled"
+        # cancelled rows carry truncated output — regenerate on resume.
+        # Only row ids + finish reasons are held in memory (the done
+        # set); row CONTENT lives in the partial chunk store and is
+        # merged back at finalize (write_results_streamed), so a
+        # 20k-row job's host memory stays O(flush chunk).
+        self.done: Dict[int, str] = {
+            i: reason
+            for i, reason in eng.jobs.read_partial_meta(job_id).items()
+            if reason != "cancelled"
         }
-        self.results: Dict[int, Dict[str, Any]] = dict(resume)
         self.pending_flush: List[Dict[str, Any]] = []
 
         import jax
@@ -1248,7 +1265,7 @@ class _GenSession:
 
         requests = []
         for i, ids in enumerate(self.token_rows):
-            if i in self.results:
+            if i in self.done:
                 continue
             requests.append(
                 GenRequest(
@@ -1264,11 +1281,10 @@ class _GenSession:
                         sampling.get("top_p", eng.ecfg.top_p)
                     ),
                     top_k=int(sampling.get("top_k", eng.ecfg.top_k)),
-                    constraint=(
-                        constraint_factory()
-                        if constraint_factory
-                        else None
-                    ),
+                    # lazy: the FSM instantiates at ADMISSION time, on
+                    # the batcher's prep thread while the device runs
+                    # (double-buffered admission) — not 20k up front
+                    constraint_factory=constraint_factory,
                     allow_truncate=rec.truncate_rows,
                     row_seed=(
                         i if rec.random_seed_per_input else None
@@ -1354,13 +1370,17 @@ class _GenSession:
             "gen_tokens": len(res.token_ids),
             "finish_reason": res.finish_reason,
         }
-        self.results[res.row_id] = row
+        self.done[res.row_id] = res.finish_reason
         self.pending_flush.append(row)
         if len(self.pending_flush) >= _PARTIAL_FLUSH_EVERY:
             self.flush()
+        # batched row progress (same rule as the embedding path): rows
+        # advance on the stream between the scheduler's 1 s ticks
+        # without a per-row bus publish
+        self.row_progress.update(len(self.done))
 
     def on_progress(self, p: Dict[str, Any]) -> None:
-        self.jm.progress(len(self.results))
+        self.row_progress.flush(len(self.done))
         self.tput.total = p["input_tokens"] + p["output_tokens"]
         self.jm.tokens(
             {
@@ -1397,41 +1417,28 @@ class _GenSession:
 
     def finalize_completed(self, batcher) -> None:
         """Order, account, and persist final results (the 1:1
-        input-order contract). ``batcher.timer`` is the SESSION's timer:
-        under co-batching the perf profile spans every job that shared
-        the batch."""
+        input-order contract) via the jobstore's merge-on-read streamed
+        writer — results assemble one chunk at a time from the partial
+        store, never materializing the whole job. Output-token
+        accounting rides the same pass (``on_chunk``). ``batcher.timer``
+        is the SESSION's timer: under co-batching the perf profile
+        spans every job that shared the batch."""
         self.flush()
         rec = self.rec
-        ordered = {
-            "row_id": [],
-            "outputs": [],
-            "cumulative_logprobs": [],
-            "gen_tokens": [],
-            "finish_reason": [],
-        }
-        for i in range(rec.num_rows):
-            row = self.results.get(i)
-            if row is None:  # cancelled rows that never ran
-                row = {
-                    "row_id": i,
-                    "outputs": None,
-                    "cumulative_logprobs": 0.0,
-                    "gen_tokens": 0,
-                    "finish_reason": "cancelled",
-                }
-            for k in ordered:
-                # default ONLY the gen_tokens backfill (pre-upgrade
-                # partial rows lack it); any other missing key is a bug
-                # and must raise, not record 0
-                ordered[k].append(
-                    row.get(k, 0) if k == "gen_tokens" else row[k]
+        counted = {"output_tokens": 0}
+
+        def _count_chunk(df) -> None:
+            counted["output_tokens"] += int(
+                sum(
+                    len(self.tok.encode(o)) if o else 0
+                    for o in df["outputs"].tolist()
                 )
-        output_tokens = int(
-            sum(
-                len(self.tok.encode(o)) if o else 0
-                for o in ordered["outputs"]
             )
+
+        self.eng.jobs.write_results_streamed(
+            self.job_id, rec.num_rows, on_chunk=_count_chunk
         )
+        output_tokens = counted["output_tokens"]
         perf = dict(batcher.timer.summary())
         drafted = self.ctx.stats.get("spec_drafted", 0)
         if drafted:
@@ -1457,7 +1464,10 @@ class _GenSession:
             perf=perf,
         )
         self.jm.progress(rec.num_rows)
-        self.eng.jobs.finalize_results(self.job_id, ordered)
+        # results.parquet is already fully written (atomic rename in
+        # write_results_streamed) — flipping to SUCCEEDED last keeps the
+        # results-before-status invariant
+        self.eng.jobs.set_status(self.job_id, JobStatus.SUCCEEDED)
 
 
 # ---------------------------------------------------------------------------

@@ -139,6 +139,12 @@ class EngineConfig:
                                     # slots reference them read-only and
                                     # prefill only their own suffix
                                     # (scheduler._setup_prefix)
+    tokenize_threads: int = 0       # >1 splits batched prompt encodes
+                                    # across a thread pool — only pays
+                                    # for tokenizers whose encode_batch
+                                    # releases the GIL (HF rust); the
+                                    # byte tokenizer ignores extra
+                                    # threads profitably at 0
     # --- generation defaults ----------------------------------------------
     max_new_tokens: int = 1024
     temperature: float = 0.7

@@ -6,9 +6,17 @@ SURVEY §3.6: /jobs/{id}, /job-status/{id}, /list-jobs, /job-results,
 
 - ``record.json``   — the job record (status, counters, timestamps, config)
 - ``inputs.parquet``  — materialized input rows (row_id, inputs)
-- ``partial.parquet`` — completed rows flushed during the run (row-granular
-  resume, SURVEY §5.3: a preempted run restarts at row granularity)
-- ``results.parquet`` — final ordered results
+- ``partial/``      — completed rows flushed during the run as immutable
+  chunk files ``b<bucket>-s<seq>.parquet`` (bucket = row_id //
+  chunk_rows, seq = per-flush monotonic counter). Each flush writes
+  ONLY its own rows — O(chunk) per flush instead of the old
+  read-concat-rewrite of ``partial.parquet`` (O(total), quadratic over
+  a job). A legacy ``partial.parquet`` is still read (seq −1) so
+  pre-upgrade jobs resume.
+- ``results.parquet`` — final ordered results. Generation jobs write it
+  with ``write_results_streamed``: a merge-on-read pass over the
+  partial buckets, one row-group per bucket, so peak host memory is
+  O(chunk_rows), not O(job).
 
 Invariants (SURVEY §5.2 — replace the reference's results-availability
 retry race, sdk.py:384-401, with real guarantees):
@@ -124,10 +132,24 @@ class JobRecord:
 
 
 class JobStore:
-    def __init__(self, root: Optional[Path] = None):
+    def __init__(
+        self, root: Optional[Path] = None, chunk_rows: Optional[int] = None
+    ):
+        import os
+
         self.root = Path(root) if root else (config_dir() / "jobs")
         self.root.mkdir(parents=True, exist_ok=True)
+        # result/partial chunk granularity: the unit of per-flush I/O
+        # AND the peak materialized row count during finalization
+        self.chunk_rows = int(
+            chunk_rows
+            if chunk_rows is not None
+            else os.environ.get("SUTRO_RESULT_CHUNK", "1024")
+        )
+        if self.chunk_rows < 1:
+            self.chunk_rows = 1
         self._lock = threading.Lock()
+        self._flush_seq: Dict[str, int] = {}  # job_id -> next chunk seq
 
     # -- paths -----------------------------------------------------------
     def _dir(self, job_id: str) -> Path:
@@ -204,34 +226,262 @@ class JobStore:
         df = pd.read_parquet(self._dir(job_id) / "inputs.parquet")
         return df.sort_values("row_id")["inputs"].tolist()
 
+    def _partial_dir(self, job_id: str) -> Path:
+        return self._dir(job_id) / "partial"
+
+    def _partial_chunks(self, job_id: str) -> List[tuple]:
+        """All partial chunk files as ``(bucket, seq, path)``, unsorted.
+        Filenames are ``b<bucket>-s<seq>.parquet``; later seq wins on
+        duplicate row_ids (a resumed run regenerating a cancelled row
+        flushes a fresh entry with a higher seq)."""
+        d = self._partial_dir(job_id)
+        if not d.is_dir():
+            return []
+        out = []
+        for p in d.iterdir():
+            name = p.name
+            if not (name.startswith("b") and name.endswith(".parquet")):
+                continue
+            try:
+                b_part, s_part = name[1 : -len(".parquet")].split("-s")
+                out.append((int(b_part), int(s_part), p))
+            except ValueError:
+                continue
+        return out
+
+    def _next_flush_seq(self, job_id: str) -> int:
+        with self._lock:
+            seq = self._flush_seq.get(job_id)
+            if seq is None:  # first flush this process: resume the count
+                seq = (
+                    max(
+                        (s for _, s, _ in self._partial_chunks(job_id)),
+                        default=-1,
+                    )
+                    + 1
+                )
+            self._flush_seq[job_id] = seq + 1
+            return seq
+
     def flush_partial(self, job_id: str, rows: List[Dict[str, Any]]) -> None:
-        """Append-flush completed rows for row-granular resume (§5.3)."""
+        """Append-flush completed rows for row-granular resume (§5.3).
+
+        O(len(rows)) per call: each flush lands as immutable chunk
+        files under ``partial/`` split by row_id bucket (the old
+        single-file scheme re-read and re-wrote the WHOLE partial store
+        every flush — quadratic over a long job)."""
         if not rows:
             return
-        path = self._dir(job_id) / "partial.parquet"
-        df = pd.DataFrame(rows)
-        if path.exists():
-            df = pd.concat([pd.read_parquet(path), df], ignore_index=True)
-        tmp = path.with_suffix(".parquet.tmp")
-        df.to_parquet(tmp)
-        tmp.replace(path)
+        d = self._partial_dir(job_id)
+        d.mkdir(parents=True, exist_ok=True)
+        seq = self._next_flush_seq(job_id)
+        by_bucket: Dict[int, List[Dict[str, Any]]] = {}
+        for r in rows:
+            by_bucket.setdefault(
+                int(r["row_id"]) // self.chunk_rows, []
+            ).append(r)
+        for bucket, rs in by_bucket.items():
+            df = pd.DataFrame(rs).sort_values("row_id")
+            path = d / f"b{bucket:08d}-s{seq:08d}.parquet"
+            tmp = path.with_suffix(".parquet.tmp")
+            df.to_parquet(tmp)
+            tmp.replace(path)  # atomic on POSIX
 
-    def read_partial(self, job_id: str) -> Dict[int, Dict[str, Any]]:
+    def _legacy_partial(self, job_id: str) -> Optional[pd.DataFrame]:
         path = self._dir(job_id) / "partial.parquet"
         if not path.exists():
-            return {}
-        df = pd.read_parquet(path)
-        return {int(r["row_id"]): dict(r) for _, r in df.iterrows()}
+            return None
+        return pd.read_parquet(path)
+
+    def read_partial(self, job_id: str) -> Dict[int, Dict[str, Any]]:
+        """Full partial rows (legacy file first, then chunks in seq
+        order, later writes winning). O(done rows) memory — callers
+        that only need row ids/reasons use ``read_partial_meta``."""
+        frames: List[pd.DataFrame] = []
+        legacy = self._legacy_partial(job_id)
+        if legacy is not None:
+            frames.append(legacy)
+        for _, _, p in sorted(
+            self._partial_chunks(job_id), key=lambda t: t[1]
+        ):
+            frames.append(pd.read_parquet(p))
+        out: Dict[int, Dict[str, Any]] = {}
+        for df in frames:
+            for _, r in df.iterrows():
+                out[int(r["row_id"])] = dict(r)
+        return out
+
+    def read_partial_meta(self, job_id: str) -> Dict[int, str]:
+        """row_id -> finish_reason for every flushed row (column
+        projection only — the resume filter and done-set bootstrap
+        never materialize outputs)."""
+        cols = ["row_id", "finish_reason"]
+        frames: List[pd.DataFrame] = []
+        legacy = self._legacy_partial(job_id)
+        if legacy is not None:
+            frames.append(legacy[cols])
+        for _, _, p in sorted(
+            self._partial_chunks(job_id), key=lambda t: t[1]
+        ):
+            frames.append(pd.read_parquet(p, columns=cols))
+        out: Dict[int, str] = {}
+        for df in frames:
+            ids = df["row_id"].to_numpy()
+            reasons = df["finish_reason"].tolist()
+            for i, reason in zip(ids, reasons):
+                out[int(i)] = reason
+        return out
 
     def finalize_results(
         self, job_id: str, results: Dict[str, List[Any]]
     ) -> None:
-        """Write final results THEN flip to SUCCEEDED (ordering invariant)."""
+        """Write final results THEN flip to SUCCEEDED (ordering invariant).
+        Materializes the whole frame — kept for the embedding path
+        (vector-valued outputs); generation jobs use
+        ``write_results_streamed``."""
         df = pd.DataFrame(results)
         tmp = self._dir(job_id) / "results.parquet.tmp"
         df.to_parquet(tmp)
         tmp.replace(self._dir(job_id) / "results.parquet")
         self.set_status(job_id, JobStatus.SUCCEEDED)
+
+    # generation result schema: one definition so every row-group of a
+    # streamed results.parquet agrees with what finalize_results used
+    # to produce via pandas
+    _GEN_COLS = (
+        "row_id",
+        "outputs",
+        "cumulative_logprobs",
+        "gen_tokens",
+        "finish_reason",
+    )
+
+    def write_results_streamed(
+        self,
+        job_id: str,
+        num_rows: int,
+        on_chunk=None,
+    ) -> None:
+        """Merge-on-read finalization: assemble ``results.parquet`` in
+        row_id order directly from the partial chunk store, one bucket
+        (= one parquet row-group) at a time. Peak memory is
+        O(chunk_rows + this bucket's duplicate entries), independent of
+        job size. Rows never flushed (cancelled before running) fill as
+        ``finish_reason="cancelled"`` with null outputs — same rule as
+        the old in-memory assembly. Does NOT flip job status: callers
+        update accounting first, then set SUCCEEDED (the
+        results-before-status invariant holds either way because the
+        final file only appears at the atomic rename below).
+
+        ``on_chunk(df)`` sees each ordered bucket frame — accounting
+        hooks (output-token counts) ride the same single pass.
+        """
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        schema = pa.schema(
+            [
+                ("row_id", pa.int64()),
+                ("outputs", pa.string()),
+                ("cumulative_logprobs", pa.float64()),
+                ("gen_tokens", pa.int64()),
+                ("finish_reason", pa.string()),
+            ]
+        )
+        import numpy as np
+
+        by_bucket: Dict[int, List[tuple]] = {}
+        for bucket, seq, p in self._partial_chunks(job_id):
+            by_bucket.setdefault(bucket, []).append((seq, p))
+        legacy = self._legacy_partial(job_id)  # compat: one old-format
+        #                                        file, loaded once
+        n_buckets = max(
+            1, (num_rows + self.chunk_rows - 1) // self.chunk_rows
+        )
+        tmp = self._dir(job_id) / "results.parquet.tmp"
+        writer = pq.ParquetWriter(tmp, schema)
+        try:
+            for bucket in range(n_buckets):
+                lo = bucket * self.chunk_rows
+                hi = min(lo + self.chunk_rows, num_rows)
+                frames: List[pd.DataFrame] = []
+                if legacy is not None and len(legacy):
+                    in_range = legacy[
+                        (legacy["row_id"] >= lo) & (legacy["row_id"] < hi)
+                    ]
+                    if len(in_range):
+                        frames.append(in_range)
+                for _seq, p in sorted(by_bucket.get(bucket, ())):
+                    frames.append(pd.read_parquet(p))
+                if frames:
+                    df = pd.concat(frames, ignore_index=True)
+                    missing = [
+                        c
+                        for c in self._GEN_COLS
+                        if c != "gen_tokens" and c not in df.columns
+                    ]
+                    if missing:
+                        # gen_tokens alone is backfillable (pre-upgrade
+                        # partial rows lack it); anything else missing
+                        # is a bug and must raise, not record nulls
+                        raise ValueError(
+                            f"partial rows for {job_id} lack columns "
+                            f"{missing}"
+                        )
+                    if "gen_tokens" not in df.columns:
+                        df = df.assign(gen_tokens=0)
+                    sub = df.drop_duplicates(
+                        subset="row_id", keep="last"
+                    ).set_index("row_id").reindex(range(lo, hi))
+                    never_ran = sub["finish_reason"].isna()
+                    outputs = [
+                        None if pd.isna(v) else v
+                        for v in sub["outputs"].tolist()
+                    ]
+                    reasons = [
+                        "cancelled" if m else r
+                        for m, r in zip(
+                            never_ran.tolist(),
+                            sub["finish_reason"].tolist(),
+                        )
+                    ]
+                    logps = (
+                        pd.to_numeric(
+                            sub["cumulative_logprobs"], errors="coerce"
+                        )
+                        .fillna(0.0)
+                        .to_numpy(np.float64)
+                    )
+                    gen_toks = (
+                        pd.to_numeric(sub["gen_tokens"], errors="coerce")
+                        .fillna(0)
+                        .to_numpy(np.int64)
+                    )
+                else:
+                    n = hi - lo
+                    outputs = [None] * n
+                    reasons = ["cancelled"] * n
+                    logps = np.zeros((n,), np.float64)
+                    gen_toks = np.zeros((n,), np.int64)
+                out = pd.DataFrame(
+                    {
+                        "row_id": np.arange(lo, hi, dtype=np.int64),
+                        "outputs": outputs,
+                        "cumulative_logprobs": logps,
+                        "gen_tokens": gen_toks,
+                        "finish_reason": reasons,
+                    }
+                )
+                if on_chunk is not None:
+                    on_chunk(out)
+                writer.write_table(
+                    pa.Table.from_pandas(
+                        out, schema=schema, preserve_index=False
+                    )
+                )
+        finally:
+            writer.close()
+        tmp.replace(self._dir(job_id) / "results.parquet")
 
     def read_results(self, job_id: str) -> pd.DataFrame:
         path = self._dir(job_id) / "results.parquet"

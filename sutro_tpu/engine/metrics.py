@@ -9,14 +9,33 @@ an in-process, thread-safe pub/sub keyed by job id, with history retained
 so a late ``attach`` (reference sdk.py:800-911) sees current totals
 immediately. Token updates may be partial dicts — consumers must merge
 monotonically (sdk.py:354-363) — and the bus preserves that contract.
+
+Delivery is CONFLATING: each subscriber holds at most one pending
+update per update_type (progress keeps the max, token dicts merge), so
+a producer's publish is O(subscribers) pointer work regardless of how
+far behind a consumer is, and a slow consumer's backlog is O(1) instead
+of an unbounded queue — a 1M-row job cannot out-produce its progress
+stream. Consumers see every MONOTONIC milestone coalesced, not every
+intermediate value, which is exactly the NDJSON progress contract.
 """
 
 from __future__ import annotations
 
-import queue
 import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional
+
+
+class _Sub:
+    """One subscriber's conflated mailbox (O(1) pending state)."""
+
+    __slots__ = ("cond", "progress", "tokens", "done")
+
+    def __init__(self, cond: threading.Condition) -> None:
+        self.cond = cond
+        self.progress: Optional[int] = None
+        self.tokens: Optional[Dict[str, Any]] = None
+        self.done = False
 
 
 class JobMetrics:
@@ -25,40 +44,46 @@ class JobMetrics:
         self.latest_tokens: Dict[str, Any] = {}
         self.rows_completed = 0
         self.done = False
-        self._subscribers: List[queue.Queue] = []
-
-    def _publish(self, update: Dict[str, Any]) -> None:
-        with self.lock:
-            subs = list(self._subscribers)
-        for q in subs:
-            q.put(update)
+        self._subscribers: List[_Sub] = []
 
     def progress(self, rows_completed: int) -> None:
         with self.lock:
             self.rows_completed = rows_completed
-        self._publish({"update_type": "progress", "result": rows_completed})
+            for s in self._subscribers:
+                # conflate: later counts replace (progress is monotonic)
+                if s.progress is None or rows_completed > s.progress:
+                    s.progress = rows_completed
+                s.cond.notify_all()
 
     def tokens(self, result: Dict[str, Any]) -> None:
         with self.lock:
             self.latest_tokens.update(result)
-        self._publish({"update_type": "tokens", "result": dict(result)})
+            for s in self._subscribers:
+                if s.tokens is None:
+                    s.tokens = dict(result)
+                else:  # partial dicts merge monotonically (contract)
+                    s.tokens.update(result)
+                s.cond.notify_all()
 
     def finish(self) -> None:
         with self.lock:
             self.done = True
-            subs = list(self._subscribers)
-        for q in subs:
-            q.put(None)  # sentinel
+            for s in self._subscribers:
+                s.done = True
+                s.cond.notify_all()
 
     def subscribe(self) -> Iterator[Dict[str, Any]]:
         """Yields updates until the job finishes. Starts with a snapshot of
-        current totals so mid-run attach shows correct state."""
-        q: queue.Queue = queue.Queue()
+        current totals so mid-run attach shows correct state. Pending
+        updates drain before the done sentinel is honored, so the final
+        progress count is always delivered."""
+        cond = threading.Condition(self.lock)
+        sub = _Sub(cond)
         with self.lock:
             snapshot_rows = self.rows_completed
             snapshot_tokens = dict(self.latest_tokens)
             already_done = self.done
-            self._subscribers.append(q)
+            self._subscribers.append(sub)
         try:
             yield {"update_type": "progress", "result": snapshot_rows}
             if snapshot_tokens:
@@ -66,14 +91,49 @@ class JobMetrics:
             if already_done:
                 return
             while True:
-                item = q.get()
-                if item is None:
+                with self.lock:
+                    while (
+                        sub.progress is None
+                        and sub.tokens is None
+                        and not sub.done
+                    ):
+                        cond.wait()
+                    prog, toks, done = sub.progress, sub.tokens, sub.done
+                    sub.progress = None
+                    sub.tokens = None
+                if prog is not None:
+                    yield {"update_type": "progress", "result": prog}
+                if toks is not None:
+                    yield {"update_type": "tokens", "result": toks}
+                if done:
                     return
-                yield item
         finally:
             with self.lock:
-                if q in self._subscribers:
-                    self._subscribers.remove(q)
+                if sub in self._subscribers:
+                    self._subscribers.remove(sub)
+
+
+class BatchedProgress:
+    """Row-progress publisher batched by completion count — THE one
+    batching rule for both the embedding and generation paths (the
+    embedding loop used to hand-roll this; a 1M-row job must not pay
+    one bus publish per row). ``update`` publishes at most once per
+    ``every_rows`` completions; ``flush`` publishes unconditionally
+    (terminal counts must always land)."""
+
+    def __init__(self, jm: JobMetrics, every_rows: int) -> None:
+        self.jm = jm
+        self.every = max(int(every_rows), 1)
+        self._last = -1
+
+    def update(self, rows_completed: int) -> None:
+        if rows_completed - self._last >= self.every:
+            self._last = rows_completed
+            self.jm.progress(rows_completed)
+
+    def flush(self, rows_completed: int) -> None:
+        self._last = rows_completed
+        self.jm.progress(rows_completed)
 
 
 class MetricsBus:

@@ -147,6 +147,18 @@ class GenRequest:
     top_p: float = 0.95
     top_k: int = 0
     constraint: Optional[TokenConstraint] = None
+    # Lazy constraint (double-buffered admission): when set (and
+    # ``constraint`` is None), the row's FSM is built at ADMISSION time
+    # — by the batcher's background prep thread while the device runs
+    # the previous window, falling back to an inline build. A 20k-row
+    # job stops instantiating 20k FSMs up front, and steady-state
+    # admission host time hides behind device windows.
+    constraint_factory: Optional[Callable[[], TokenConstraint]] = None
+    # written ONLY by the prep thread, consumed once by the scheduler
+    # thread at admission (single-assignment handoff; the scheduler
+    # never blocks on it)
+    prepped_constraint: Optional[TokenConstraint] = None
+    prep_queued: bool = False
     # Reference `truncate_rows` semantics (sdk.py:457,480): True => over-long
     # prompts are truncated to fit the context; False => the row fails.
     allow_truncate: bool = True
@@ -172,6 +184,7 @@ class GenRequest:
             or self.frequency_penalty != 0.0
             or self.repetition_penalty != 1.0
         )
+
 
 
 @dataclasses.dataclass
@@ -372,6 +385,17 @@ class ContinuousBatcher:
         # saving (input_tokens in progress streams stays the per-row
         # FULL prompt count: user-facing accounting is unchanged)
         self.prefill_tokens = 0
+        # Double-buffered admission prep: a background thread builds
+        # the NEXT admission group's lazy constraints while the device
+        # runs the current window, so FSM instantiation leaves the
+        # critical path. prep_overlap_s / prep_inline_s split the prep
+        # cost into hidden-behind-device vs paid-inline for the host
+        # overhead profile.
+        self._prep_thread: Optional[Any] = None
+        self._prep_q: Optional[Any] = None
+        self.prep_overlap_s = 0.0
+        self.prep_inline_s = 0.0
+        self.prep_rows_overlapped = 0
         from .profiling import StepTimer
 
         self.timer = StepTimer()
@@ -508,8 +532,16 @@ class ContinuousBatcher:
             need = pages_needed(total, self.ecfg.kv_page_size)
             if need > self.MP:
                 return None
-            own = need - (pfx.n_pages if pfx is not None else 0)
-            if own < 1 or own > self.allocator.free_count:
+            npfx = pfx.n_pages if pfx is not None else 0
+            # native-clamp parity (rt_try_admit_pfx): a prefix covering
+            # the whole need still allocates 1 own page (every row
+            # prefills >= 1 own token) and admits while the table row
+            # has room — the old `own < 1 -> reject` starved rows whose
+            # shared prefix was bigger than their worst case
+            own = max(need - npfx, 1)
+            if npfx + own > self.MP:
+                return None
+            if own > self.allocator.free_count:
                 return None
             inflight = self._inflight_tokens() + reserved
             if (
@@ -525,6 +557,95 @@ class ContinuousBatcher:
             else:
                 table[: len(pages)] = pages
         return free_idx, pages, table
+
+    # -- double-buffered admission prep --------------------------------
+
+    def _materialize_constraint(self, req: GenRequest) -> None:
+        """Resolve a lazy constraint at admission: take the prep
+        thread's handoff when ready, else build inline. Runs on the
+        scheduler thread only; after this, ``req.constraint`` never
+        changes again (slots rely on it)."""
+        if req.constraint is not None or req.constraint_factory is None:
+            return
+        c = req.prepped_constraint
+        if c is not None:
+            req.constraint = c
+            req.prepped_constraint = None
+            return
+        t0 = time.perf_counter()
+        req.constraint = req.constraint_factory()
+        self.prep_inline_s += time.perf_counter() - t0
+
+    def _prep_worker(self, q) -> None:
+        while True:
+            req = q.get()
+            if req is None:
+                return
+            t0 = time.perf_counter()
+            try:
+                if (
+                    req.constraint is None
+                    and req.prepped_constraint is None
+                    and req.constraint_factory is not None
+                ):
+                    # single-assignment handoff: only this thread
+                    # writes prepped_constraint, only the scheduler
+                    # consumes it (worst race: the scheduler admitted
+                    # the row mid-build and this FSM is dropped)
+                    req.prepped_constraint = req.constraint_factory()
+                    self.prep_rows_overlapped += 1
+            except Exception:
+                logger.exception("admission prep failed; admission "
+                                 "will rebuild inline")
+            self.prep_overlap_s += time.perf_counter() - t0
+
+    def _prep_pump(self, order: List["JobCtx"]) -> None:
+        """Queue the NEXT admission group's lazy constraints for the
+        background prep thread. Called once per scheduler iteration —
+        the builds overlap the device window dispatched below. Admission
+        pops from the TAIL of ``ctx.pending``, so the tail is what gets
+        prepped; the budget covers two groups (double buffering)."""
+        budget = 2 * self.ecfg.prefill_batch_size
+        want: List[GenRequest] = []
+        for ctx in order:
+            if ctx.done:
+                continue
+            for req in reversed(ctx.pending):
+                if budget == 0:
+                    break
+                budget -= 1
+                if (
+                    req.constraint is None
+                    and req.constraint_factory is not None
+                    and not req.prep_queued
+                ):
+                    want.append(req)
+            if budget == 0:
+                break
+        if not want:
+            return
+        if self._prep_thread is None or not self._prep_thread.is_alive():
+            import queue as _queue
+            import threading as _threading
+
+            self._prep_q = _queue.SimpleQueue()
+            self._prep_thread = _threading.Thread(
+                target=self._prep_worker, args=(self._prep_q,),
+                daemon=True, name="sutro-admit-prep",
+            )
+            self._prep_thread.start()
+        for req in want:
+            req.prep_queued = True
+            self._prep_q.put(req)
+
+    def _prep_stop(self) -> None:
+        """End-of-session shutdown: a long-lived engine runs one
+        session per job — leaking one thread per job would accumulate."""
+        t, self._prep_thread = self._prep_thread, None
+        if t is not None and t.is_alive():
+            self._prep_q.put(None)
+            t.join(timeout=30)
+        self._prep_q = None
 
     def _unreserve(self, slot_idx: int, pages) -> None:
         """Roll back a reservation whose prefill never armed a slot (a
@@ -1173,17 +1294,19 @@ class ContinuousBatcher:
                         jax.numpy.zeros((pad,), jax.numpy.int32),
                     ]
                 )
-        jl = jax.numpy.asarray(logits)
-        tok, logp = _admit_sample_jit(
-            jl,
-            sub,
-            temps,
-            top_p,
-            top_k,
-            None if allowed is None else jax.numpy.asarray(allowed),
-            row_seeds,
-        )
-        return np.asarray(tok[:n]), np.asarray(logp[:n])
+        with self.timer.time("admit_sample"):
+            jl = jax.numpy.asarray(logits)
+            tok, logp = _admit_sample_jit(
+                jl,
+                sub,
+                temps,
+                top_p,
+                top_k,
+                None if allowed is None else jax.numpy.asarray(allowed),
+                row_seeds,
+            )
+            out = np.asarray(tok[:n]), np.asarray(logp[:n])
+        return out
 
     def _record_token(self, slot: _Slot, tok: int, logp: float) -> None:
         slot.out_ids.append(tok)
@@ -1475,9 +1598,17 @@ class ContinuousBatcher:
             # mirrors _finish_reason's per-token checks
             stops = np.flatnonzero(is_stop[:, col])
             n_stop = int(stops[0]) + 1 if stops.size else INF
-            n_len = max(s.req.max_new_tokens - len(s.out_ids), 1)
-            n_ctx = max(self._max_ctx - 1 - s.pos, 1)
+            n_len = s.req.max_new_tokens - len(s.out_ids)
+            n_ctx = self._max_ctx - 1 - s.pos
             limit = min(n_stop, n_len, n_ctx)
+            if limit <= 0:
+                # budget already exhausted at window start (the row
+                # should have been emitted earlier; a stale window can
+                # still land here): finish NOW with zero tokens taken —
+                # the old max(..., 1) silently accepted one token past
+                # the cap
+                self._emit(i)
+                continue
             n_take = min(limit, wK)
             col_t = tw[:n_take, col]
             s.out_ids.extend(col_t.tolist())  # C-speed, yields ints
@@ -1537,6 +1668,9 @@ class ContinuousBatcher:
         shortest-first admission order, and the job's shared-prefix
         prefill."""
         pending = []
+        # lazy-constraint jobs share one factory: probe its room ONCE
+        # per job instead of instantiating an FSM per row here
+        factory_room: Dict[int, int] = {}
         for req in ctx.pending:
             # truncation must leave enough generation room to honor the
             # row's schema: a prompt that fills the context would leave
@@ -1547,6 +1681,15 @@ class ContinuousBatcher:
                 from .constrain.fsm import constraint_room
 
                 need = constraint_room(req.constraint)
+            elif req.constraint_factory is not None:
+                from .constrain.fsm import constraint_room
+
+                key = id(req.constraint_factory)
+                if key not in factory_room:
+                    factory_room[key] = constraint_room(
+                        req.constraint_factory()
+                    )
+                need = factory_room[key]
             max_prompt = self.ecfg.max_context() - need
             if len(req.prompt_ids) > max_prompt:
                 if req.allow_truncate and max_prompt > 0:
@@ -1695,6 +1838,7 @@ class ContinuousBatcher:
                     if r is None:
                         break
                     ctx.pending.pop()
+                    self._materialize_constraint(req)
                     # Sarathi-style: reserve now, prefill ONE chunk per
                     # scheduler iteration (_prefill_tick) so active rows
                     # keep decoding instead of stalling for the whole
@@ -1711,6 +1855,7 @@ class ContinuousBatcher:
                 if r is None:
                     break
                 ctx.pending.pop()
+                self._materialize_constraint(req)
                 batch.append((req, ctx) + r)
                 reserved_tokens += self._max_total(req)
                 reserved_idxs.add(r[0])
@@ -1782,6 +1927,10 @@ class ContinuousBatcher:
                     ajobs, key=lambda c: (c.priority, c.seq)
                 )
                 admitted = self._admit_pending(order)
+                # double-buffered admission: hand the NEXT group's lazy
+                # constraint builds to the prep thread now — they
+                # overlap the device window dispatched below
+                self._prep_pump(order)
                 # one chunk of piggybacked prefill per iteration: long
                 # admits advance while the decode batch below keeps its
                 # cadence (bounded degradation, never a pause)
@@ -2223,7 +2372,9 @@ class ContinuousBatcher:
         finally:
             # every exit path (completed / yielded / raise) returns any
             # live job's shared-prefix pages to the pool (_finish_job
-            # and _suspend_job already None the refs they freed)
+            # and _suspend_job already None the refs they freed) and
+            # parks the admission-prep thread
+            self._prep_stop()
             for ctx in live:
                 if ctx.prefix is not None:
                     self._free_prefix_pages(ctx.prefix.pages)

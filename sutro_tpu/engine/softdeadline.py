@@ -105,6 +105,13 @@ def _watchdog(deadline_s: float, grace_s: float) -> None:
 
 def _sigint(_sig, _frm):
     if _FIRED.is_set():
+        if _DELIVERED.is_set():
+            # already delivered: the stack is unwinding through finally
+            # blocks / context managers. A second SystemExit here (a
+            # watchdog re-signal racing the delivery, or a stray ^C)
+            # would abort the very teardown the clean exit exists to
+            # protect — swallow it.
+            return
         # only a post-deadline interrupt counts as delivery — marking a
         # genuine pre-deadline ^C would permanently disable the
         # watchdog's re-signalling (the event is never cleared)

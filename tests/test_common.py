@@ -113,3 +113,59 @@ def test_compile_cache_optout_and_respect(monkeypatch):
         )
     finally:
         jax.config.update("jax_compilation_cache_dir", before)
+
+
+def test_metrics_bus_conflates_slow_subscribers():
+    """The progress bus must hold O(1) pending state per subscriber: a
+    consumer that never drains cannot accumulate an unbounded queue,
+    and when it finally reads it sees the LATEST progress plus the
+    monotonically merged token totals, then the finish sentinel."""
+    from sutro_tpu.engine.metrics import JobMetrics
+
+    jm = JobMetrics()
+    it = jm.subscribe()
+    first = next(it)  # snapshot
+    assert first == {"update_type": "progress", "result": 0}
+    # thousands of producer updates while the consumer sleeps
+    for i in range(5000):
+        jm.progress(i)
+        jm.tokens({"output_tokens": i})
+    jm.tokens({"input_tokens": 77})
+    sub = jm._subscribers[0]
+    assert sub.progress == 4999  # conflated, not queued
+    assert sub.tokens["output_tokens"] == 4999
+    assert sub.tokens["input_tokens"] == 77  # partials merged
+    jm.finish()
+    updates = list(it)
+    kinds = [u["update_type"] for u in updates]
+    assert kinds.count("progress") == 1
+    assert updates[kinds.index("progress")]["result"] == 4999
+
+
+def test_metrics_bus_final_update_beats_sentinel():
+    """A progress update published just before finish must still be
+    delivered — pending state drains before the done flag is honored."""
+    from sutro_tpu.engine.metrics import JobMetrics
+
+    jm = JobMetrics()
+    it = jm.subscribe()
+    next(it)
+    jm.progress(41)
+    jm.progress(42)
+    jm.finish()
+    updates = list(it)
+    assert {"update_type": "progress", "result": 42} in updates
+
+
+def test_batched_progress_rule():
+    from sutro_tpu.engine.metrics import BatchedProgress, JobMetrics
+
+    jm = JobMetrics()
+    seen = []
+    orig = jm.progress
+    jm.progress = lambda n: (seen.append(n), orig(n))
+    bp = BatchedProgress(jm, every_rows=10)
+    for i in range(25):
+        bp.update(i)
+    bp.flush(25)
+    assert seen == [9, 19, 25]  # one publish per 10 rows + terminal

@@ -581,3 +581,180 @@ def test_row_seed_independent_of_batch_composition(tiny_runner, byte_tok):
         ]
     )
     assert solo[0].token_ids == crowd[2].token_ids
+
+
+class _AdmitStubRunner:
+    """Minimal runner surface for admission-only scheduler tests."""
+
+    def __init__(self, ecfg, vocab=300):
+        class _M:
+            vocab_size = vocab
+
+        self.ecfg = ecfg
+        self.mcfg = _M()
+        self.sp = 1
+        self.pp = 1
+        self.num_pages = 1 + ecfg.decode_batch_size * ecfg.max_pages_per_seq
+
+
+def _parity_ecfg():
+    from sutro_tpu.engine.config import EngineConfig
+
+    return EngineConfig(
+        kv_page_size=8, max_pages_per_seq=16, decode_batch_size=4,
+        max_model_len=128, use_pallas=False, param_dtype="float32",
+    )
+
+
+def test_admission_parity_prefix_covers_whole_need(monkeypatch):
+    """When the job's shared prefix already covers a row's worst-case
+    page need (own < 1 before clamping), BOTH admission paths must
+    clamp to 1 own page and admit while the table row has room —
+    native rt_try_admit_pfx always did; the Python fallback used to
+    reject (`own < 1 -> None`), diverging from the C++ verdict."""
+    import pytest
+
+    from sutro_tpu.engine import native_runtime as nr
+    from sutro_tpu.engine.scheduler import JobCtx, _SharedPrefix
+
+    verdicts = {}
+    for native in (False, True):
+        monkeypatch.setenv(
+            "SUTRO_NATIVE_RUNTIME", "1" if native else "0"
+        )
+        nr._lib = None
+        nr._lib_failed = False
+        if native and not nr.is_available():
+            nr._lib = None
+            nr._lib_failed = False
+            pytest.skip("native toolchain unavailable")
+        try:
+            ecfg = _parity_ecfg()
+            b = ContinuousBatcher(_AdmitStubRunner(ecfg), stop_ids=[0])
+            assert (b.native is not None) == native
+            # a prefix of 4 pages (32 tokens) while the row's whole
+            # worst case is 1 page: own = 1 - 4 < 1 before the clamp
+            if native:
+                pfx_pages = b.native.alloc_pages(4)
+            else:
+                pfx_pages = b.allocator.alloc(4)
+            ctx = JobCtx(
+                job_id="parity", pending=[], on_result=lambda r: None
+            )
+            ctx.prefix = _SharedPrefix(tokens=32, pages=list(pfx_pages))
+            req = GenRequest(
+                row_id=0,
+                prompt_ids=np.arange(3, dtype=np.int32),
+                max_new_tokens=2,
+            )
+            r = b._reserve(req, ctx)
+            assert r is not None, f"native={native} rejected"
+            slot_idx, own_pages, table = r
+            # table head carries the prefix, exactly one own page after
+            assert list(table[:4]) == list(pfx_pages)
+            assert len(list(own_pages)) == 1
+            assert table[4] == list(own_pages)[0]
+            verdicts[native] = True
+        finally:
+            nr._lib = None
+            nr._lib_failed = False
+    assert verdicts.get(False) == verdicts.get(True)
+
+
+def test_admission_parity_prefix_fills_table_row(monkeypatch):
+    """Companion bound: when the prefix already fills the whole table
+    row (npfx == MP), the clamped own page has nowhere to go — BOTH
+    paths must reject (the native side grew this guard for a heap
+    smash; the Python side must agree)."""
+    import pytest
+
+    from sutro_tpu.engine import native_runtime as nr
+    from sutro_tpu.engine.scheduler import JobCtx, _SharedPrefix
+
+    for native in (False, True):
+        monkeypatch.setenv(
+            "SUTRO_NATIVE_RUNTIME", "1" if native else "0"
+        )
+        nr._lib = None
+        nr._lib_failed = False
+        if native and not nr.is_available():
+            nr._lib = None
+            nr._lib_failed = False
+            pytest.skip("native toolchain unavailable")
+        try:
+            ecfg = _parity_ecfg()
+            b = ContinuousBatcher(_AdmitStubRunner(ecfg), stop_ids=[0])
+            MP = ecfg.max_pages_per_seq
+            if native:
+                pfx_pages = b.native.alloc_pages(MP)
+            else:
+                pfx_pages = b.allocator.alloc(MP)
+            ctx = JobCtx(
+                job_id="parity2", pending=[], on_result=lambda r: None
+            )
+            ctx.prefix = _SharedPrefix(
+                tokens=MP * ecfg.kv_page_size, pages=list(pfx_pages)
+            )
+            req = GenRequest(
+                row_id=0,
+                prompt_ids=np.arange(3, dtype=np.int32),
+                max_new_tokens=2,
+            )
+            assert b._reserve(req, ctx) is None, f"native={native}"
+        finally:
+            nr._lib = None
+            nr._lib_failed = False
+
+
+def test_plain_window_zero_budget_finishes_immediately(byte_tok):
+    """_accept_plain_window with a non-positive remaining budget must
+    emit the row with ZERO tokens taken — the old max(..., 1) silently
+    accepted one token past max_new_tokens / the context limit."""
+    from sutro_tpu.engine import native_runtime as nr
+    from sutro_tpu.engine.scheduler import _Slot
+
+    ecfg = _parity_ecfg()
+    import sutro_tpu.engine.scheduler as sched
+
+    b = ContinuousBatcher.__new__(ContinuousBatcher)
+    # hand-build just enough batcher state for the unit call
+    b.ecfg = ecfg
+    b.vocab = 300
+    b.stop_ids = {0}
+    b._stop_arr = np.array([0], np.int64)
+    b._max_ctx = ecfg.max_context()
+    b.native = None
+    from sutro_tpu.engine.kvcache import PageAllocator
+
+    b.allocator = PageAllocator(16)
+    b.slots = [None] * 4
+    b._gen = [0] * 4
+    b._needs_mask = set()
+    from sutro_tpu.engine.profiling import StepTimer
+
+    b.timer = StepTimer()
+
+    req = GenRequest(
+        row_id=7, prompt_ids=np.arange(4, dtype=np.int32),
+        max_new_tokens=3,
+    )
+    pages = b.allocator.alloc(2)
+    slot = _Slot(req=req, pages=pages, pos=7, last_token=5)
+    slot.out_ids = [5, 6, 9]  # already AT the max_new cap
+    results = {}
+    ctx = sched.JobCtx(
+        job_id="zb", pending=[],
+        on_result=lambda r: results.setdefault(r.row_id, r),
+    )
+    slot.job = ctx
+    ctx.n_slots = 1
+    b.slots[1] = slot
+    wK = 4
+    toks = np.full((wK, 4), 9, np.int32)
+    logps = np.full((wK, 4), -1.0, np.float32)
+    b._accept_plain_window([1], toks, logps, wK)
+    assert 7 in results, "row must finish"
+    assert len(results[7].token_ids) == 3  # nothing accepted past cap
+    assert results[7].finish_reason == "length"
+    assert b.slots[1] is None
+    assert b.allocator.free_count == 15  # PageAllocator(16): page 0 reserved

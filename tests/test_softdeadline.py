@@ -103,6 +103,27 @@ def test_slow_finally_teardown_not_aborted():
     assert "TEARDOWN-DONE" in r.stdout, (r.stdout, r.stderr)
 
 
+def test_second_sigint_during_teardown_is_swallowed():
+    # _sigint must be idempotent after delivery: a re-signal (or stray
+    # ^C) landing INSIDE a finally-block teardown must not raise a
+    # second SystemExit and abort the cleanup the clean exit protects
+    r = run_child(
+        "from sutro_tpu.engine import softdeadline as sd\n"
+        "sd.arm(1, 40)\n"
+        "import os, signal, time\n"
+        "try:\n"
+        "    time.sleep(60)\n"
+        "finally:\n"
+        "    time.sleep(0.2)\n"
+        "    os.kill(os.getpid(), signal.SIGINT)  # mid-teardown\n"
+        "    time.sleep(0.5)\n"
+        "    print('TEARDOWN-DONE', flush=True)\n"
+    )
+    assert r.returncode == 124, (r.returncode, r.stderr)
+    assert "TEARDOWN-DONE" in r.stdout, (r.stdout, r.stderr)
+    assert "ATEXIT-RAN" in r.stdout
+
+
 def test_env_arming_and_bad_grace_fallback():
     r = run_child(
         "import os\n"

@@ -49,3 +49,57 @@ def test_stop_ids():
     tok = ByteTokenizer()
     assert tok.eos_id in tok.stop_ids()
     assert tok.im_end_id in tok.stop_ids()
+
+
+def test_encode_batch_matches_per_row():
+    tok = ByteTokenizer()
+    texts = ["hello", "", "<|im_start|>user\nhey<|im_end|>", "é¿"]
+    assert tok.encode_batch(texts) == [tok.encode(t) for t in texts]
+
+
+def test_concat_safe_boundaries():
+    tok = ByteTokenizer()
+    # plain text tails cannot start a special
+    assert tok.concat_safe("<|im_start|>user\n")
+    assert tok.concat_safe("classify this:")
+    # a tail that is a proper prefix of a special could merge across
+    # the boundary — must be declared unsafe
+    assert not tok.concat_safe("text<")
+    assert not tok.concat_safe("x<|im_end")
+    assert not tok.concat_safe("<|begin_of_")
+
+
+def test_encode_chat_batch_bit_identical_all_templates():
+    """The prefix-aware batched encode must produce EXACTLY the ids of
+    per-row render_chat + encode — including rows that poke at the
+    shell boundary (leading '<', empty row, specials inside)."""
+    from sutro_tpu.engine.tokenizer import encode_chat_batch
+
+    tok = ByteTokenizer()
+    rows = [
+        "plain row",
+        "",
+        "<|im_end|> sneaky",
+        "<partial special tail<|im_en",
+        "unicode ✓ row",
+    ]
+    for system in (None, "You are a terse classifier."):
+        for template in ("chatml", "plain", "gemma", "llama3"):
+            want = [
+                tok.encode(
+                    tok.render_chat(r, system=system, template=template)
+                )
+                for r in rows
+            ]
+            got = encode_chat_batch(tok, rows, system, template)
+            assert got == want, (template, system)
+
+
+def test_encode_chat_batch_threads_match_serial():
+    from sutro_tpu.engine.tokenizer import encode_chat_batch
+
+    tok = ByteTokenizer()
+    rows = [f"row {i}" for i in range(64)]
+    a = encode_chat_batch(tok, rows, "sys", "chatml")
+    b = encode_chat_batch(tok, rows, "sys", "chatml", threads=4)
+    assert a == b
