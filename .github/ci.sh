@@ -6,4 +6,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 make native
 make compile-check
+# tier-1 gate: graftlint static analysis vs the committed baseline —
+# any new lock-discipline / jit-purity / hygiene finding fails CI
+make lint
 bash .github/run_tests_chunked.sh

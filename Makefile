@@ -4,8 +4,8 @@
 
 PY ?= python
 
-.PHONY: all native test test-oneshot test-fast compile-check bench bench-e2e dryrun \
-	chip-validate bench-8b cost golden host-profile clean
+.PHONY: all native test test-oneshot test-fast compile-check lint lint-baseline \
+	bench bench-e2e dryrun chip-validate bench-8b cost golden host-profile clean
 
 all: native compile-check
 
@@ -38,6 +38,17 @@ test-fast: native
 # the cheapest smoke layer
 compile-check:
 	$(PY) -m compileall -q sutro_tpu tests bench.py bench_e2e.py
+
+# graftlint: engine-aware static analysis (lock discipline, jit purity,
+# thread/exception hygiene) gated against the committed baseline —
+# non-zero exit on any NEW finding (README "Static analysis")
+lint:
+	$(PY) -m sutro_tpu.analysis sutro_tpu
+
+# accept the current findings as the new baseline (review the diff of
+# sutro_tpu/analysis/baseline.json before committing!)
+lint-baseline:
+	$(PY) -m sutro_tpu.analysis sutro_tpu --write-baseline
 
 # raw decode microbench (one JSON line; driver contract)
 bench:

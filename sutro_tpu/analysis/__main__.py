@@ -1,0 +1,143 @@
+"""graftlint CLI: ``python -m sutro_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean (vs baseline unless ``--no-baseline``), 1 new
+findings, 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from . import core
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m sutro_tpu.analysis",
+        description=(
+            "graftlint: engine-aware static analysis (lock discipline, "
+            "jit purity, thread/exception hygiene)"
+        ),
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        default=["sutro_tpu"],
+        help="files or directories to scan (default: sutro_tpu)",
+    )
+    ap.add_argument(
+        "--format", choices=("text", "json"), default="text"
+    )
+    ap.add_argument(
+        "--baseline",
+        default=str(core.DEFAULT_BASELINE),
+        help="baseline file (default: sutro_tpu/analysis/baseline.json)",
+    )
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every finding; exit 1 if any",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write the current findings as the new baseline and exit 0",
+    )
+    ap.add_argument(
+        "--rules",
+        default="",
+        help="comma-separated rule subset (default: all)",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    ap.add_argument(
+        "-v",
+        "--verbose",
+        action="store_true",
+        help="also print baselined (non-new) findings",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(core.RULES.items()):
+            print(f"{rule:24s} {desc}")
+        return 0
+
+    rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    for r in rules:
+        if r not in core.RULES:
+            print(f"graftlint: unknown rule {r!r}", file=sys.stderr)
+            return 2
+    paths = args.paths or ["sutro_tpu"]
+    for p in paths:
+        if not Path(p).exists():
+            print(f"graftlint: no such path {p!r}", file=sys.stderr)
+            return 2
+
+    try:
+        active, suppressed, _index = core.analyze(paths, rules or None)
+    except SyntaxError as e:
+        print(f"graftlint: parse error: {e}", file=sys.stderr)
+        return 2
+
+    baseline_path = Path(args.baseline)
+    if args.write_baseline:
+        core.write_baseline(baseline_path, active)
+        print(
+            f"graftlint: wrote {len(active)} finding(s) to "
+            f"{baseline_path}"
+        )
+        return 0
+
+    if args.no_baseline or not baseline_path.exists():
+        if args.format == "json":
+            print(
+                core.render_json(
+                    active, suppressed_count=len(suppressed)
+                )
+            )
+        else:
+            print(
+                core.render_text(
+                    active, suppressed_count=len(suppressed)
+                )
+            )
+        if not args.no_baseline and not baseline_path.exists():
+            print(
+                f"graftlint: no baseline at {baseline_path} "
+                "(create one with --write-baseline)",
+                file=sys.stderr,
+            )
+        return 1 if active else 0
+
+    baseline = core.load_baseline(baseline_path)
+    new, stale = core.compare_baseline(active, baseline)
+    if args.format == "json":
+        print(
+            core.render_json(
+                active if args.verbose else new,
+                new=new,
+                stale=stale,
+                suppressed_count=len(suppressed),
+            )
+        )
+    else:
+        if args.verbose:
+            for f in active:
+                print(f.render())
+        print(
+            core.render_text(
+                active,
+                new=new,
+                stale=stale,
+                suppressed_count=len(suppressed),
+            )
+        )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,371 @@
+"""Package-wide AST index and best-effort call resolution.
+
+The passes (locks, jitpure, hygiene) share one parsed view of the
+scanned tree: per-module import maps, function/method tables with
+lexical nesting (closures), and per-function records of the objects the
+rules care about — locks, threads, queues, ``functools.partial``
+bindings. Resolution is deliberately *best effort*: ``self.method()``
+resolves within the enclosing class, bare names through the lexical
+chain then module then imports, ``alias.func()`` through the import
+map. Attribute chains on arbitrary objects (``self.eng.jobs.flush``)
+do not resolve — the passes treat unresolvable calls as opaque.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition"}
+QUEUE_CTORS = {
+    "queue.Queue",
+    "queue.PriorityQueue",
+    "queue.LifoQueue",
+    "queue.SimpleQueue",
+}
+
+# names that look like a lock when we cannot see the constructor
+# (e.g. ``with self._queue.mutex:`` — queue.Queue's internal lock)
+_LOCKISH = ("lock", "mutex", "cond", "_cv", "condition")
+
+
+def looks_like_lock(name: str) -> bool:
+    low = name.lower()
+    return any(low == t or low.endswith(t) or t in low for t in _LOCKISH)
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """Name/Attribute chain as ``a.b.c`` text, else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return None if base is None else f"{base}.{node.attr}"
+    return None
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    module: "ModuleInfo"
+    qualname: str  # dotted; classes and nested functions included
+    node: ast.AST
+    class_name: Optional[str]
+    parent: Optional["FunctionInfo"]
+    params: Tuple[str, ...]
+    local_locks: Dict[str, str] = dataclasses.field(default_factory=dict)
+    thread_vars: Set[str] = dataclasses.field(default_factory=set)
+    queue_vars: Set[str] = dataclasses.field(default_factory=set)
+    partial_targets: Dict[str, str] = dataclasses.field(
+        default_factory=dict
+    )  # var -> function name it wraps via functools.partial
+
+    @property
+    def label(self) -> str:
+        return f"{self.module.name}:{self.qualname}"
+
+    def all_params(self) -> Set[str]:
+        """Own params plus every lexically-enclosing function's (a
+        closure calling an outer callback param counts)."""
+        out: Set[str] = set()
+        f: Optional[FunctionInfo] = self
+        while f is not None:
+            out.update(f.params)
+            f = f.parent
+        return out
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    path: str  # as reported in findings (posix-relative)
+    name: str  # dotted module name, best effort
+    tree: ast.Module
+    lines: List[str]
+    imports: Dict[str, str] = dataclasses.field(default_factory=dict)
+    functions: Dict[str, FunctionInfo] = dataclasses.field(
+        default_factory=dict
+    )
+    attr_locks: Dict[str, str] = dataclasses.field(default_factory=dict)
+    module_locks: Dict[str, str] = dataclasses.field(default_factory=dict)
+    rlock_ids: Set[str] = dataclasses.field(default_factory=set)
+    classes: Dict[str, List[str]] = dataclasses.field(
+        default_factory=dict
+    )  # class name -> method qualnames
+
+    def expand(self, text: str) -> str:
+        """Rewrite the first segment through the import map so curated
+        pattern lists match regardless of local aliases (``_time.sleep``
+        -> ``time.sleep``, ``pd.read_parquet`` -> ``pandas.read_parquet``)."""
+        head, sep, rest = text.partition(".")
+        target = self.imports.get(head)
+        if target is None:
+            return text
+        return f"{target}{sep}{rest}" if rest else target
+
+
+def module_name_for(path: Path) -> str:
+    parts = [] if path.stem == "__init__" else [path.stem]
+    d = path.parent
+    while (d / "__init__.py").exists():
+        parts.insert(0, d.name)
+        parent = d.parent
+        if parent == d:
+            break
+        d = parent
+    return ".".join(parts) or path.stem
+
+
+def _collect_imports(mod: ModuleInfo) -> None:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    mod.imports[a.asname] = a.name
+                else:
+                    head = a.name.split(".")[0]
+                    mod.imports[head] = head
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                base_parts = mod.name.split(".")
+                base_parts = base_parts[: -node.level] if node.level <= len(
+                    base_parts
+                ) else []
+                base = ".".join(base_parts)
+                if node.module:
+                    base = f"{base}.{node.module}" if base else node.module
+            else:
+                base = node.module or ""
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                mod.imports[a.asname or a.name] = (
+                    f"{base}.{a.name}" if base else a.name
+                )
+
+
+class _Indexer(ast.NodeVisitor):
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        self.class_stack: List[str] = []
+        self.func_stack: List[FunctionInfo] = []
+
+    # -- helpers -------------------------------------------------------
+    def _qual(self, name: str) -> str:
+        parts = self.class_stack + [
+            f.qualname.split(".")[-1] for f in self.func_stack
+        ]
+        # func_stack entries already carry full quals; rebuild from the
+        # innermost enclosing scope instead
+        if self.func_stack:
+            return f"{self.func_stack[-1].qualname}.{name}"
+        if self.class_stack:
+            return f"{'.'.join(self.class_stack)}.{name}"
+        return name
+
+    def _ctor_of(self, value: ast.AST) -> Optional[str]:
+        if isinstance(value, ast.Call):
+            text = dotted(value.func)
+            if text:
+                return self.mod.expand(text)
+        return None
+
+    def _lock_id_for_expr(self, node: ast.AST) -> Optional[str]:
+        """Resolve an expression naming an existing lock (for Condition
+        aliasing): ``self.lock`` or a bare name."""
+        text = dotted(node)
+        if text is None:
+            return None
+        if text.startswith("self.") and self.class_stack:
+            return self.mod.attr_locks.get(
+                f"{self.class_stack[-1]}.{text[5:]}"
+            )
+        f = self.func_stack[-1] if self.func_stack else None
+        while f is not None:
+            if text in f.local_locks:
+                return f.local_locks[text]
+            f = f.parent
+        return self.mod.module_locks.get(text)
+
+    def _record_assign(self, target: ast.AST, value: ast.AST) -> None:
+        ctor = self._ctor_of(value)
+        func = self.func_stack[-1] if self.func_stack else None
+        name = dotted(target)
+        if name is None:
+            return
+        if ctor in LOCK_CTORS:
+            lock_id: Optional[str] = None
+            if ctor == "threading.Condition" and isinstance(
+                value, ast.Call
+            ) and value.args:
+                lock_id = self._lock_id_for_expr(value.args[0])
+            final_id: Optional[str] = None
+            if name.startswith("self.") and self.class_stack:
+                attr = name[5:]
+                key = f"{self.class_stack[-1]}.{attr}"
+                final_id = lock_id or f"{self.mod.name}:{key}"
+                self.mod.attr_locks[key] = final_id
+            elif "." not in name:
+                if func is not None:
+                    final_id = (
+                        lock_id
+                        or f"{self.mod.name}:{func.qualname}.{name}"
+                    )
+                    func.local_locks[name] = final_id
+                else:
+                    final_id = lock_id or f"{self.mod.name}:{name}"
+                    self.mod.module_locks[name] = final_id
+            if ctor == "threading.RLock" and final_id is not None:
+                self.mod.rlock_ids.add(final_id)
+        elif ctor == "threading.Thread" and func is not None:
+            if "." not in name:
+                func.thread_vars.add(name)
+            elif name.startswith("self.") and self.class_stack:
+                # attribute-held thread: track under its attr text so
+                # ``self._worker.join(...)`` anywhere in the class counts
+                func.thread_vars.add(name)
+        elif ctor in QUEUE_CTORS and func is not None and "." not in name:
+            func.queue_vars.add(name)
+        elif ctor == "functools.partial" and func is not None:
+            if isinstance(value, ast.Call) and value.args:
+                tgt = dotted(value.args[0])
+                if tgt and "." not in name:
+                    func.partial_targets[name] = tgt
+
+    # -- visitors ------------------------------------------------------
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.class_stack.append(node.name)
+        self.mod.classes.setdefault(node.name, [])
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _visit_func(self, node) -> None:
+        qual = self._qual(node.name)
+        args = node.args
+        params = tuple(
+            a.arg
+            for a in (
+                list(args.posonlyargs)
+                + list(args.args)
+                + list(args.kwonlyargs)
+            )
+            if a.arg not in ("self", "cls")
+        )
+        info = FunctionInfo(
+            module=self.mod,
+            qualname=qual,
+            node=node,
+            class_name=self.class_stack[-1] if self.class_stack else None,
+            parent=self.func_stack[-1] if self.func_stack else None,
+            params=params,
+        )
+        self.mod.functions[qual] = info
+        if self.class_stack:
+            self.mod.classes[self.class_stack[-1]].append(qual)
+        self.func_stack.append(info)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._record_assign(t, node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record_assign(node.target, node.value)
+        self.generic_visit(node)
+
+
+class PackageIndex:
+    """All scanned modules plus cross-module lookup."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModuleInfo] = {}
+
+    def add_source(self, path: str, source: str, name: str) -> ModuleInfo:
+        tree = ast.parse(source, filename=path)
+        mod = ModuleInfo(
+            path=path, name=name, tree=tree, lines=source.splitlines()
+        )
+        _collect_imports(mod)
+        # two indexing passes: locks discovered in ``__init__`` must be
+        # visible when other (earlier) methods resolve them
+        _Indexer(mod).visit(mod.tree)
+        _Indexer(mod).visit(mod.tree)
+        self.modules[name] = mod
+        return mod
+
+    def add_file(self, path: Path, report_path: str) -> ModuleInfo:
+        return self.add_source(
+            report_path,
+            path.read_text(encoding="utf-8"),
+            module_name_for(path),
+        )
+
+    def find_module(self, dotted_name: str) -> Optional[ModuleInfo]:
+        m = self.modules.get(dotted_name)
+        if m is not None:
+            return m
+        for name, mod in self.modules.items():
+            if name.endswith(f".{dotted_name}") or dotted_name.endswith(
+                f".{name}"
+            ):
+                return mod
+        return None
+
+    # -- call resolution ----------------------------------------------
+    def resolve_call(
+        self, func: FunctionInfo, call: ast.Call
+    ) -> Tuple[str, Optional[FunctionInfo]]:
+        """Returns ``(expanded_text, target)`` where target is the
+        package-local FunctionInfo when resolvable, else None."""
+        text = dotted(call.func)
+        if text is None:
+            return "", None
+        mod = func.module
+        expanded = mod.expand(text)
+        # self.method() -> same-class method
+        if text.startswith("self.") and func.class_name:
+            rest = text[5:]
+            if "." not in rest:
+                tgt = mod.functions.get(f"{func.class_name}.{rest}")
+                return f"{mod.name}:{func.class_name}.{rest}", tgt
+            return expanded, None
+        if "." not in text:
+            # nested def in the lexical chain
+            f: Optional[FunctionInfo] = func
+            while f is not None:
+                tgt = mod.functions.get(f"{f.qualname}.{text}")
+                if tgt is not None:
+                    return tgt.label, tgt
+                f = f.parent
+            # module-level function or class-level sibling
+            tgt = mod.functions.get(text)
+            if tgt is None and func.class_name:
+                tgt = mod.functions.get(f"{func.class_name}.{text}")
+            if tgt is not None:
+                return tgt.label, tgt
+            # imported symbol: from pkg.mod import fn
+            imp = mod.imports.get(text)
+            if imp and "." in imp:
+                owner, _, sym = imp.rpartition(".")
+                target_mod = self.find_module(owner)
+                if target_mod is not None:
+                    tgt = target_mod.functions.get(sym)
+                    if tgt is not None:
+                        return tgt.label, tgt
+            return expanded, None
+        # alias.func() where alias maps to a scanned module
+        head, _, rest = text.partition(".")
+        imp = mod.imports.get(head)
+        if imp and "." not in rest:
+            target_mod = self.find_module(imp)
+            if target_mod is not None:
+                tgt = target_mod.functions.get(rest)
+                if tgt is not None:
+                    return tgt.label, tgt
+        return expanded, None

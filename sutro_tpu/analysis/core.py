@@ -1,0 +1,265 @@
+"""graftlint core: findings, suppressions, baseline, reports.
+
+Engine-aware static analysis for this codebase (ISSUE 2). Three pass
+families over a shared AST index:
+
+- ``locks``    lock-order inversions, blocking calls under a lock,
+               externally-supplied callbacks invoked under a lock,
+               same-lock re-acquisition (non-reentrant deadlock)
+- ``jitpure``  host-sync and nondeterminism inside jit/Pallas entry
+               points; wall-clock/nondeterminism in the scheduler's
+               decode window
+- ``hygiene``  threads that are neither daemon nor joined with a
+               bounded timeout; silently swallowed exceptions
+
+Findings are fingerprinted by (rule, path, enclosing symbol, stable
+detail key) — NOT by line number — so unrelated edits don't invalidate
+the baseline. The committed baseline (``baseline.json``) holds a count
+per fingerprint; the gate fails only on findings *exceeding* their
+baselined count. Inline suppression::
+
+    something_flagged()  # graftlint: disable=lock-blocking-call
+
+(on the finding's line or the line above; comma-separate several rules,
+or ``disable=all``.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from .callgraph import PackageIndex
+
+RULES = {
+    "lock-order": "inconsistent pairwise lock acquisition order "
+    "(deadlock risk)",
+    "lock-reentrant": "non-reentrant lock re-acquired while already "
+    "held on the same call path (self-deadlock)",
+    "lock-blocking-call": "blocking call (I/O, sleep, join, socket) "
+    "made while holding a lock",
+    "lock-callback": "externally-supplied callback invoked while "
+    "holding a lock",
+    "jit-host-sync": "host-synchronizing operation reachable from a "
+    "jit/Pallas entry point",
+    "jit-nondeterminism": "wall clock or unseeded randomness inside a "
+    "jit/Pallas entry point",
+    "sched-nondeterminism": "wall clock or unseeded randomness in the "
+    "scheduler decode window",
+    "thread-unjoined": "thread is neither daemon nor joined",
+    "thread-unbounded-join": "thread joined without a bounded timeout",
+    "silent-except": "broad except swallows the exception without "
+    "logging or re-raising",
+}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\- ]+)"
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+    symbol: str = ""
+    key: str = ""
+    fp: Optional[str] = None  # explicit fingerprint override
+
+    def fingerprint(self) -> str:
+        if self.fp is not None:
+            return self.fp
+        return f"{self.rule}|{self.path}|{self.symbol}|{self.key}"
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "symbol": self.symbol,
+            "message": self.message,
+            "fingerprint": self.fingerprint(),
+        }
+
+    def render(self) -> str:
+        where = f" [in {self.symbol}]" if self.symbol else ""
+        return (
+            f"{self.path}:{self.line}: {self.rule}{where} {self.message}"
+        )
+
+
+def _suppressed_rules(lines: Sequence[str], line: int) -> set:
+    """Rules disabled at 1-based ``line`` (same line or the line above)."""
+    out: set = set()
+    for ln in (line, line - 1):
+        if 1 <= ln <= len(lines):
+            m = _SUPPRESS_RE.search(lines[ln - 1])
+            if m:
+                out.update(
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                )
+    return out
+
+
+def apply_suppressions(
+    index: PackageIndex, findings: Iterable[Finding]
+) -> "tuple[List[Finding], List[Finding]]":
+    """Split findings into (active, suppressed) per inline pragmas."""
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    by_path = {m.path: m.lines for m in index.modules.values()}
+    for f in findings:
+        rules = _suppressed_rules(by_path.get(f.path, ()), f.line)
+        if "all" in rules or f.rule in rules:
+            suppressed.append(f)
+        else:
+            active.append(f)
+    return active, suppressed
+
+
+# -- scanning ----------------------------------------------------------
+
+
+def build_index(paths: Sequence[str]) -> PackageIndex:
+    index = PackageIndex()
+    files: List[Path] = []
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            files.append(p)
+    seen = set()
+    for f in files:
+        if "__pycache__" in f.parts:
+            continue
+        rp = f.as_posix()
+        if rp in seen:
+            continue
+        seen.add(rp)
+        index.add_file(f, rp)
+    return index
+
+
+def run_passes(
+    index: PackageIndex, rules: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    from . import hygiene, jitpure, locks
+
+    findings: List[Finding] = []
+    findings.extend(locks.run(index))
+    findings.extend(jitpure.run(index))
+    findings.extend(hygiene.run(index))
+    if rules:
+        keep = set(rules)
+        findings = [f for f in findings if f.rule in keep]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.key))
+    return findings
+
+
+def analyze(
+    paths: Sequence[str], rules: Optional[Sequence[str]] = None
+) -> "tuple[List[Finding], List[Finding], PackageIndex]":
+    """Scan ``paths``; returns (active, suppressed, index)."""
+    index = build_index(paths)
+    findings = run_passes(index, rules)
+    active, suppressed = apply_suppressions(index, findings)
+    return active, suppressed, index
+
+
+# -- baseline ----------------------------------------------------------
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def baseline_counts(findings: Iterable[Finding]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.fingerprint()] = counts.get(f.fingerprint(), 0) + 1
+    return counts
+
+
+def write_baseline(path: Path, findings: Sequence[Finding]) -> None:
+    payload = {
+        "version": 1,
+        "tool": "graftlint",
+        "counts": dict(sorted(baseline_counts(findings).items())),
+    }
+    path.write_text(json.dumps(payload, indent=2) + "\n")
+
+
+def load_baseline(path: Path) -> Dict[str, int]:
+    data = json.loads(path.read_text())
+    counts = data.get("counts", {})
+    return {str(k): int(v) for k, v in counts.items()}
+
+
+def compare_baseline(
+    findings: Sequence[Finding], baseline: Dict[str, int]
+) -> "tuple[List[Finding], Dict[str, int]]":
+    """Returns (new_findings, stale) where ``new`` are findings beyond
+    their baselined count and ``stale`` maps fingerprints whose current
+    count dropped below baseline (fixed findings — regenerate)."""
+    remaining = dict(baseline)
+    new: List[Finding] = []
+    for f in findings:
+        fp = f.fingerprint()
+        if remaining.get(fp, 0) > 0:
+            remaining[fp] -= 1
+        else:
+            new.append(f)
+    stale = {fp: n for fp, n in remaining.items() if n > 0}
+    return new, stale
+
+
+# -- reports -----------------------------------------------------------
+
+
+def render_text(
+    findings: Sequence[Finding],
+    new: Optional[Sequence[Finding]] = None,
+    stale: Optional[Dict[str, int]] = None,
+    suppressed_count: int = 0,
+) -> str:
+    out: List[str] = []
+    if new is None:
+        for f in findings:
+            out.append(f.render())
+        out.append(
+            f"graftlint: {len(findings)} finding(s), "
+            f"{suppressed_count} suppressed"
+        )
+        return "\n".join(out)
+    for f in new:
+        out.append("NEW " + f.render())
+    out.append(
+        f"graftlint: {len(findings)} finding(s) "
+        f"({len(new)} new vs baseline, {suppressed_count} suppressed)"
+    )
+    if stale:
+        out.append(
+            f"graftlint: {sum(stale.values())} baselined finding(s) no "
+            "longer present — regenerate with --write-baseline"
+        )
+    return "\n".join(out)
+
+
+def render_json(
+    findings: Sequence[Finding],
+    new: Optional[Sequence[Finding]] = None,
+    stale: Optional[Dict[str, int]] = None,
+    suppressed_count: int = 0,
+) -> str:
+    payload: Dict[str, object] = {
+        "tool": "graftlint",
+        "findings": [f.to_dict() for f in findings],
+        "suppressed": suppressed_count,
+    }
+    if new is not None:
+        payload["new"] = [f.to_dict() for f in new]
+        payload["stale_baseline"] = stale or {}
+    return json.dumps(payload, indent=2)

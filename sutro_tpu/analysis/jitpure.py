@@ -1,0 +1,271 @@
+"""Jit-purity and scheduler-determinism pass.
+
+Entry points ("roots"):
+
+- functions decorated with ``jax.jit`` (directly or through
+  ``functools.partial(jax.jit, ...)``),
+- Pallas kernel bodies — the callable handed to ``pl.pallas_call``
+  (directly, via ``functools.partial(kernel, ...)`` inline, or via a
+  local ``kernel = functools.partial(...)`` binding),
+- the scheduler decode window: ``run`` / ``run_multi`` methods of a
+  ``*Batcher`` class in a ``*scheduler`` module.
+
+From each root the pass walks the package-reachable call set and
+reports:
+
+- ``jit-host-sync``        ``.item()`` / ``np.asarray`` / ``np.array``
+                           / ``jax.device_get`` / ``.block_until_ready``
+                           / ``float()``/``int()`` on a traced
+                           parameter inside a jit root
+- ``jit-nondeterminism``   wall clocks or Python/global-numpy RNG in a
+                           jit/Pallas root
+- ``sched-nondeterminism`` ``time.time()`` / ``random.*`` /
+                           ``np.random.*`` / ``uuid.uuid4`` /
+                           ``os.urandom`` reachable from the decode
+                           window (``time.monotonic`` and
+                           ``time.perf_counter`` stay legal: elapsed-
+                           time measurement, not wall-clock decisions;
+                           ``jax.random`` is keyed and legal)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import FunctionInfo, ModuleInfo, PackageIndex, dotted
+from .core import Finding
+
+_MAX_DEPTH = 8
+
+HOST_SYNC_EXACT = {"numpy.asarray", "numpy.array", "jax.device_get"}
+HOST_SYNC_SUFFIX = (".item", ".block_until_ready", ".copy_to_host")
+JIT_NONDET_EXACT = {"time.time", "time.monotonic", "time.perf_counter"}
+SCHED_NONDET_EXACT = {"time.time", "os.urandom", "uuid.uuid4"}
+_RNG_PREFIXES = ("random.", "numpy.random.")
+
+
+def _decorator_is_jit(mod: ModuleInfo, dec: ast.AST) -> bool:
+    for sub in ast.walk(dec):
+        text = dotted(sub)
+        if text is None:
+            continue
+        expanded = mod.expand(text)
+        if expanded == "jax.jit" or expanded.endswith(".jit") or text == "jit":
+            return True
+    return False
+
+
+def _static_params(mod: ModuleInfo, func: FunctionInfo) -> Set[str]:
+    """Params named in static_argnames (static under jit: python values,
+    so float()/int() on them is fine)."""
+    out: Set[str] = set()
+    node = func.node
+    for dec in getattr(node, "decorator_list", []):
+        for sub in ast.walk(dec):
+            if isinstance(sub, ast.keyword) and sub.arg in (
+                "static_argnames",
+            ):
+                for c in ast.walk(sub.value):
+                    if isinstance(c, ast.Constant) and isinstance(
+                        c.value, str
+                    ):
+                        out.add(c.value)
+            if isinstance(sub, ast.keyword) and sub.arg in (
+                "static_argnums",
+            ):
+                nums = [
+                    c.value
+                    for c in ast.walk(sub.value)
+                    if isinstance(c, ast.Constant)
+                    and isinstance(c.value, int)
+                ]
+                args = getattr(node, "args", None)
+                if args is not None:
+                    all_names = [
+                        a.arg
+                        for a in list(args.posonlyargs) + list(args.args)
+                    ]
+                    for n in nums:
+                        if 0 <= n < len(all_names):
+                            out.add(all_names[n])
+    return out
+
+
+def _find_roots(
+    index: PackageIndex,
+) -> List[Tuple[FunctionInfo, str]]:
+    """(function, kind) with kind in {jit, pallas, sched}."""
+    roots: List[Tuple[FunctionInfo, str]] = []
+    for mod in index.modules.values():
+        for func in mod.functions.values():
+            decs = getattr(func.node, "decorator_list", [])
+            if any(_decorator_is_jit(mod, d) for d in decs):
+                roots.append((func, "jit"))
+        # pallas kernel bodies
+        for func in list(mod.functions.values()):
+            for node in ast.walk(func.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                text = dotted(node.func)
+                if not text or not mod.expand(text).endswith(
+                    "pallas_call"
+                ):
+                    continue
+                if not node.args:
+                    continue
+                kernel_name = _kernel_target(mod, func, node.args[0])
+                if kernel_name is None:
+                    continue
+                tgt = mod.functions.get(kernel_name) or mod.functions.get(
+                    f"{func.qualname}.{kernel_name}"
+                )
+                if tgt is not None:
+                    roots.append((tgt, "pallas"))
+        # scheduler decode window
+        if mod.name.split(".")[-1].endswith("scheduler"):
+            for cls, quals in mod.classes.items():
+                if "Batcher" not in cls:
+                    continue
+                for q in quals:
+                    if q.split(".")[-1] in ("run", "run_multi"):
+                        roots.append((mod.functions[q], "sched"))
+    # dedupe, jit/pallas kinds win over sched for the same function
+    seen: Dict[str, Tuple[FunctionInfo, str]] = {}
+    for func, kind in roots:
+        seen.setdefault(f"{func.label}|{kind}", (func, kind))
+    return list(seen.values())
+
+
+def _kernel_target(
+    mod: ModuleInfo, func: FunctionInfo, arg: ast.AST
+) -> Optional[str]:
+    if isinstance(arg, ast.Name):
+        return func.partial_targets.get(arg.id, arg.id)
+    if isinstance(arg, ast.Call):
+        text = dotted(arg.func)
+        if text and mod.expand(text) == "functools.partial" and arg.args:
+            return dotted(arg.args[0])
+    return None
+
+
+class _PurityWalker:
+    def __init__(self, index: PackageIndex):
+        self.index = index
+        self.findings: List[Finding] = []
+        self._seen: Set[str] = set()
+
+    def _emit(self, f: Finding) -> None:
+        fp = f.fingerprint() + f"@{f.line}"
+        if fp in self._seen:
+            return
+        self._seen.add(fp)
+        self.findings.append(f)
+
+    def _flag_call(
+        self,
+        func: FunctionInfo,
+        call: ast.Call,
+        kind: str,
+        root: FunctionInfo,
+        traced_params: Set[str],
+    ) -> None:
+        raw = dotted(call.func) or ""
+        text = func.module.expand(raw) if raw else ""
+        via = (
+            "" if func is root else f" (reached from {root.qualname})"
+        )
+        if kind in ("jit", "pallas"):
+            sync = text in HOST_SYNC_EXACT or any(
+                text.endswith(s) for s in HOST_SYNC_SUFFIX
+            )
+            if (
+                not sync
+                and isinstance(call.func, ast.Name)
+                and call.func.id in ("float", "int")
+                and len(call.args) == 1
+                and isinstance(call.args[0], ast.Name)
+                and call.args[0].id in traced_params
+            ):
+                sync = True
+                raw = f"{call.func.id}({call.args[0].id})"
+            if sync:
+                self._emit(
+                    Finding(
+                        rule="jit-host-sync",
+                        path=func.module.path,
+                        line=call.lineno,
+                        symbol=func.label,
+                        key=f"{root.qualname}|{text or raw}",
+                        message=(
+                            f"host-sync `{raw}` inside jit entry point "
+                            f"`{root.qualname}`{via}"
+                        ),
+                    )
+                )
+                return
+            if text in JIT_NONDET_EXACT or any(
+                text.startswith(p) for p in _RNG_PREFIXES
+            ):
+                self._emit(
+                    Finding(
+                        rule="jit-nondeterminism",
+                        path=func.module.path,
+                        line=call.lineno,
+                        symbol=func.label,
+                        key=f"{root.qualname}|{text}",
+                        message=(
+                            f"nondeterministic `{raw}` inside jit entry "
+                            f"point `{root.qualname}`{via}"
+                        ),
+                    )
+                )
+        else:  # sched
+            if text in SCHED_NONDET_EXACT or any(
+                text.startswith(p) for p in _RNG_PREFIXES
+            ):
+                self._emit(
+                    Finding(
+                        rule="sched-nondeterminism",
+                        path=func.module.path,
+                        line=call.lineno,
+                        symbol=func.label,
+                        key=f"{root.qualname}|{text}",
+                        message=(
+                            f"`{raw}` reachable from the scheduler "
+                            f"decode window `{root.qualname}` — decode "
+                            "decisions must be deterministic and "
+                            "host-clock-free"
+                        ),
+                    )
+                )
+
+    def walk_root(self, root: FunctionInfo, kind: str) -> None:
+        traced = set(root.params) - _static_params(root.module, root)
+        visited: Set[str] = set()
+        stack: List[Tuple[FunctionInfo, int]] = [(root, 0)]
+        while stack:
+            func, depth = stack.pop()
+            if func.label in visited:
+                continue
+            visited.add(func.label)
+            for node in ast.walk(func.node):
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and node is not func.node:
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                self._flag_call(func, node, kind, root, traced)
+                if depth < _MAX_DEPTH:
+                    _, target = self.index.resolve_call(func, node)
+                    if target is not None:
+                        stack.append((target, depth + 1))
+
+
+def run(index: PackageIndex) -> List[Finding]:
+    w = _PurityWalker(index)
+    for func, kind in _find_roots(index):
+        w.walk_root(func, kind)
+    w.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return w.findings

@@ -18,6 +18,7 @@ a *new* process can still see and resume finished/partial work
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import warnings
@@ -25,6 +26,8 @@ import traceback
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 from ..common import MODEL_CATALOG
 from ..interfaces import JobStatus
@@ -38,6 +41,11 @@ from .scheduler import ContinuousBatcher, GenRequest, GenResult
 from .tokenizer import BaseTokenizer, load_tokenizer
 
 _PARTIAL_FLUSH_EVERY = 256
+
+# close() sentinel: sorts ahead of every real queue entry (priorities
+# are small non-negative ints), and its job_id None is never compared
+# because the (priority, seq) prefix is unique
+_WORKER_STOP = (-(1 << 60), -1, None)
 
 
 def _read_url_rows(url: str, column: "str | None") -> list:
@@ -157,7 +165,14 @@ class LocalEngine:
                 if int(sampling["max_new_tokens"]) < room:
                     sampling["max_new_tokens"] = room
             except Exception:
-                pass
+                # deliberate: a schema that fails to compile here fails
+                # the JOB with a real error when it runs; the submit
+                # path only loses the feasibility cap raise
+                logger.debug(
+                    "schema feasibility probe failed at submit; "
+                    "surfacing when the job runs",
+                    exc_info=True,
+                )
         rec = self.jobs.create(
             name=payload.get("name"),
             description=payload.get("description"),
@@ -542,9 +557,24 @@ class LocalEngine:
         self._runner_cache[engine_key] = (runner, tok)
         return runner, tok
 
+    def close(self, timeout: float = 10.0) -> bool:
+        """Stop the worker thread with a bounded join (thread-hygiene
+        teardown: the worker must not outlive the engine unobserved).
+        The sentinel sorts ahead of every real job, so an idle worker
+        exits immediately; a worker mid-job finishes that job first and
+        the join may time out — the thread is daemonic either way.
+        Returns True when the worker actually exited. A closed engine
+        no longer runs queued jobs (their records stay resumable by a
+        fresh engine process)."""
+        self._queue.put(_WORKER_STOP)
+        self._worker.join(timeout=timeout)
+        return not self._worker.is_alive()
+
     def _worker_loop(self) -> None:
         while True:
             _, _, job_id = self._queue.get()
+            if job_id is None:  # close() sentinel
+                return
             with self._lock:
                 self._queued.discard(job_id)
                 self._queued_prio.pop(job_id, None)
@@ -1487,7 +1517,12 @@ def get_engine(ecfg: Optional[EngineConfig] = None) -> LocalEngine:
 
 
 def reset_engine() -> None:
-    """Test hook: drop the singleton (its worker thread is daemonic)."""
+    """Test hook: drop the singleton. The outgoing worker gets a
+    bounded stop (idle workers exit immediately; a worker mid-job is
+    left to finish on its daemon thread rather than blocking the
+    reset)."""
     global _engine
     with _engine_lock:
-        _engine = None
+        old, _engine = _engine, None
+    if old is not None:
+        old.close(timeout=2.0)

@@ -16,11 +16,14 @@ fallback.
 
 from __future__ import annotations
 
+import logging
 from typing import Dict, FrozenSet, List, Optional
 
 import numpy as np
 
 from .nfa import NFA
+
+logger = logging.getLogger(__name__)
 
 
 class TokenTable:
@@ -88,8 +91,16 @@ class MaskCache:
             from .cpp import CppMasker
 
             self._cpp = CppMasker(nfa, table)
+        except (ImportError, OSError) as e:
+            # expected on hosts without the built native extension —
+            # the pure-Python walk is the always-available fallback
+            logger.debug("CppMasker unavailable (%s); pure-python mask walk", e)
         except Exception:
-            self._cpp = None
+            # anything else is a real bug worth surfacing, but masking
+            # must keep working: classify loudly, fall back anyway
+            logger.exception(
+                "CppMasker init failed; falling back to pure-python mask walk"
+            )
 
     def mask(self, states: FrozenSet[int]) -> np.ndarray:
         return self.mask_and_dist(states)[0]

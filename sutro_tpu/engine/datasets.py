@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import datetime
 import json
+import logging
 import os
 import shutil
 import threading
@@ -21,6 +22,8 @@ from typing import Any, Dict, List, Optional, Union
 import pandas as pd
 
 from ..validation import config_dir
+
+logger = logging.getLogger(__name__)
 
 
 def _root() -> Path:
@@ -38,12 +41,18 @@ class DatasetStore:
 
     @staticmethod
     def _write_meta(d: Path, meta: Dict[str, Any]) -> None:
-        """Atomic replace so concurrent readers never see torn JSON."""
+        """Atomic replace so concurrent readers never see torn JSON.
+        Runs under the store lock from ``_touch_meta``: the tiny local
+        meta write IS that lock's critical section (serialized RMW)."""
         tmp = d / ".meta.json.tmp"
+        # graftlint: disable=lock-blocking-call
         tmp.write_text(json.dumps(meta, indent=2))
-        os.replace(tmp, d / ".meta.json")
+        os.replace(tmp, d / ".meta.json")  # graftlint: disable=lock-blocking-call
 
     def _touch_meta(self, d: Path) -> None:
+        # the meta read-modify-write IS the critical section the lock
+        # exists for; the file is tiny and local (see _write_meta's
+        # graftlint suppressions)
         with self._lock:
             meta = json.loads((d / ".meta.json").read_text())
             meta["updated_at"] = datetime.datetime.now(
@@ -111,7 +120,15 @@ class DatasetStore:
                 continue
             try:
                 meta = json.loads((d / ".meta.json").read_text())
-            except Exception:
+            except (OSError, ValueError) as e:
+                # missing/torn meta must not hide the dataset's files —
+                # serve id-only metadata, but say why
+                logger.warning(
+                    "dataset %s: unreadable .meta.json (%s); listing "
+                    "with id-only metadata",
+                    d.name,
+                    e,
+                )
                 meta = {"dataset_id": d.name}
             meta["schema"] = self._schema(d)
             meta["num_files"] = len(self.list_files(d.name))
@@ -126,13 +143,27 @@ class DatasetStore:
 
                     sch = pq.read_schema(f)
                     return {n: str(t) for n, t in zip(sch.names, sch.types)}
-                except Exception:
+                except (ImportError, OSError, ValueError) as e:
+                    # ArrowInvalid/ArrowIOError subclass ValueError/OSError
+                    logger.warning(
+                        "dataset file %s: cannot read parquet schema "
+                        "(%s); reporting none",
+                        f,
+                        e,
+                    )
                     return {}
             if f.suffix == ".csv":
                 try:
                     head = pd.read_csv(f, nrows=10)
                     return {c: str(t) for c, t in head.dtypes.items()}
-                except Exception:
+                except (OSError, ValueError) as e:
+                    # pandas parser errors subclass ValueError
+                    logger.warning(
+                        "dataset file %s: cannot infer csv schema "
+                        "(%s); reporting none",
+                        f,
+                        e,
+                    )
                     return {}
         return {}
 

@@ -108,6 +108,10 @@ def shard_requests(
 
 
 def _send(sock: socket.socket, msg: Dict) -> None:
+    # callers hold their channel's send lock on purpose: sendall is not
+    # atomic across messages, and the lock is what keeps NDJSON frames
+    # from interleaving — the send IS the critical section
+    # graftlint: disable=lock-blocking-call
     sock.sendall(json.dumps(msg, separators=(",", ":")).encode() + b"\n")
 
 
@@ -400,6 +404,7 @@ def run_dp_coordinator(
     listener.settimeout(_ACCEPT_TIMEOUT_S)
     n_workers = world.world - 1
     conns: List[socket.socket] = []
+    serve_threads: List[threading.Thread] = []
     res_lock = threading.Lock()  # on_result mutates job state
     emit_lock = threading.Lock()  # serialize on_progress callbacks
     # per-rank progress snapshots, summed into one stream
@@ -431,10 +436,14 @@ def run_dp_coordinator(
                 last_msg[rank] = _time.monotonic()
                 t = m.get("t")
                 if t == "res":
+                    # res_lock exists to serialize on_result (it mutates
+                    # job state across per-worker serve threads) — the
+                    # callback IS the critical section
                     with res_lock:
-                        on_result(_msg_res(m))
+                        on_result(_msg_res(m))  # graftlint: disable=lock-callback
                 elif t == "emb":
                     with res_lock:
+                        # graftlint: disable=lock-callback
                         on_result(
                             EmbResult(
                                 row_id=int(m["row_id"]),
@@ -498,8 +507,11 @@ def run_dp_coordinator(
                 s.get("tps", 0.0) for s in snaps
             ),
         }
+        # emit_lock serializes the merged-progress callback across serve
+        # threads (consumers expect monotonic snapshots, not interleaved
+        # partial merges) — the callback IS the critical section
         with emit_lock:
-            on_progress(merged)
+            on_progress(merged)  # graftlint: disable=lock-callback
 
     def accept_all() -> None:
         # synchronous handshake per connection: only hellos carrying
@@ -567,11 +579,13 @@ def run_dp_coordinator(
                     except OSError:
                         pass
                 conns.append(conn)
-                threading.Thread(
+                st = threading.Thread(
                     target=serve,
                     args=(conn, lines, rank, gen),
                     daemon=True,
-                ).start()
+                )
+                st.start()
+                serve_threads.append(st)
         except OSError as e:
             # listener timed out (a rank never connected) or was closed
             # by the job's finally. Mark ranks that never connected so
@@ -601,8 +615,9 @@ def run_dp_coordinator(
         _emit_progress()
 
     def locked_result(res: GenResult) -> None:
+        # same serialization point as serve(): see res_lock note there
         with res_lock:
-            on_result(res)
+            on_result(res)  # graftlint: disable=lock-callback
 
     def cancel_check() -> bool:
         if should_cancel and should_cancel():
@@ -693,3 +708,8 @@ def run_dp_coordinator(
         for c in conns:
             c.close()
         listener.close()
+        # closing the conns EOFs the serve threads; a bounded join keeps
+        # them from mutating rank_status/prog after this function
+        # returns (they are daemon, so a hung one cannot wedge exit)
+        for st in serve_threads:
+            st.join(timeout=5.0)

@@ -31,12 +31,15 @@ from __future__ import annotations
 import dataclasses
 import datetime
 import json
+import logging
 import threading
 import uuid
 from pathlib import Path
 from typing import Any, Dict, List, Optional
 
 import pandas as pd
+
+logger = logging.getLogger(__name__)
 
 from ..interfaces import JobStatus
 from ..validation import config_dir
@@ -170,6 +173,10 @@ class JobStore:
     def _write_record(self, rec: JobRecord) -> None:
         path = self._record_path(rec.job_id)
         tmp = path.with_suffix(".json.tmp")
+        # small local record write; when reached from ``update`` it runs
+        # under the store lock — that read-modify-write IS the lock's
+        # critical section
+        # graftlint: disable=lock-blocking-call
         tmp.write_text(json.dumps(rec.to_dict(), indent=2))
         tmp.replace(path)  # atomic on POSIX
 
@@ -177,6 +184,8 @@ class JobStore:
         path = self._record_path(job_id)
         if not path.exists():
             raise KeyError(f"Unknown job: {job_id}")
+        # tiny local JSON; under the lock only via ``update`` (the RMW)
+        # graftlint: disable=lock-blocking-call
         data = json.loads(path.read_text())
         fields = {f.name for f in dataclasses.fields(JobRecord)}
         return JobRecord(**{k: v for k, v in data.items() if k in fields})
@@ -207,7 +216,12 @@ class JobStore:
             if (d / "record.json").exists():
                 try:
                     out.append(self.get(d.name).to_dict())
-                except Exception:
+                except (KeyError, TypeError, ValueError, OSError) as e:
+                    # a torn/foreign record must not break the listing,
+                    # but the skip has to be visible
+                    logger.warning(
+                        "skipping unreadable job record %s: %s", d.name, e
+                    )
                     continue
         out.sort(key=lambda r: r.get("datetime_created") or "", reverse=True)
         return out
@@ -485,8 +499,14 @@ class JobStore:
 
     def read_results(self, job_id: str) -> pd.DataFrame:
         path = self._dir(job_id) / "results.parquet"
-        if not path.exists():
-            status = self.status(job_id)
+        # status gate, not just file existence: results.parquet lands
+        # (atomic rename) a few ms BEFORE the record flips to SUCCEEDED
+        # (accounting updates sit between), and a concurrent reader must
+        # not observe results on a still-RUNNING job — the public
+        # contract is "SUCCEEDED implies results readable", never the
+        # converse (caught by test_races' pre-terminal-results check)
+        status = self.status(job_id)
+        if status != JobStatus.SUCCEEDED or not path.exists():
             raise FileNotFoundError(
                 f"Results for {job_id} not available (status={status.value})"
             )
@@ -498,8 +518,10 @@ class JobStore:
         if path.exists():
             try:
                 return json.loads(path.read_text())
-            except Exception:
-                pass
+            except (OSError, ValueError) as e:
+                logger.warning(
+                    "quotas.json unreadable (%s); using default quotas", e
+                )
         return [dict(q) for q in DEFAULT_QUOTAS]
 
     def check_quota(

@@ -101,7 +101,9 @@ _TAKES_BUDGET: Dict[int, Tuple[Any, bool]] = {}
 def _probe_takes_budget(fn: Any) -> bool:
     try:
         sig = inspect.signature(fn)
-    except Exception:
+    except (TypeError, ValueError):
+        # inspect.signature's documented failure modes for builtins /
+        # C callables: no signature means no ``remaining`` kwarg
         return False
     kw_ok = (
         inspect.Parameter.POSITIONAL_OR_KEYWORD,
@@ -133,8 +135,8 @@ def _method_takes_budget(obj: Any, bound: Any, attr_cache: str) -> bool:
         takes = _probe_takes_budget(bound)
         try:
             setattr(obj, attr_cache, takes)
-        except Exception:
-            pass  # __slots__ etc.: re-probe next call
+        except (AttributeError, TypeError):
+            pass  # __slots__ / frozen instances: re-probe next call
     return takes
 
 
