@@ -9,4 +9,7 @@ make compile-check
 # tier-1 gate: graftlint static analysis vs the committed baseline —
 # any new lock-discipline / jit-purity / hygiene finding fails CI
 make lint
+# tier-1 gate: seeded chaos subset — deterministic fault injection must
+# keep reaching terminal states with partial-store consistency
+make chaos
 bash .github/run_tests_chunked.sh

@@ -5,6 +5,7 @@
 PY ?= python
 
 .PHONY: all native test test-oneshot test-fast compile-check lint lint-baseline \
+	chaos \
 	bench bench-e2e dryrun chip-validate bench-8b cost golden host-profile clean
 
 all: native compile-check
@@ -49,6 +50,14 @@ lint:
 # sutro_tpu/analysis/baseline.json before committing!)
 lint-baseline:
 	$(PY) -m sutro_tpu.analysis sutro_tpu --write-baseline
+
+# seeded chaos suite (FAILURES.md): deterministic fault injection
+# end-to-end — row quarantine (incl. the 256-row poison-row acceptance
+# case), transient I/O retry, torn chunks, device errors + resume
+# bit-identity, crash-mid-finalize, dp liveness. A tier-1 CI step.
+chaos:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py -q -m "not slow" \
+		-p no:cacheprovider
 
 # raw decode microbench (one JSON line; driver contract)
 bench:

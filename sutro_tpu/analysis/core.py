@@ -53,6 +53,8 @@ RULES = {
     "thread-unbounded-join": "thread joined without a bounded timeout",
     "silent-except": "broad except swallows the exception without "
     "logging or re-raising",
+    "unbounded-retry": "retry loop with no attempt/deadline bound or "
+    "no (growing) backoff sleep between attempts",
 }
 
 _SUPPRESS_RE = re.compile(

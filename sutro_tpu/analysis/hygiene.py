@@ -1,4 +1,4 @@
-"""Thread and exception hygiene pass.
+"""Thread, exception, and retry hygiene pass.
 
 - ``thread-unjoined``        every ``threading.Thread(...)`` must be
                              ``daemon=True`` or have a ``.join(...)``
@@ -10,12 +10,19 @@
                              whose body neither calls anything (no
                              logging), re-raises, nor stores the error
                              — the classic swallowed-failure shape
+- ``unbounded-retry``        a retry loop (except-driven re-iteration
+                             that sleeps or names attempts) must carry
+                             BOTH an attempt/deadline bound and a
+                             growing (non-constant) backoff sleep —
+                             unbounded or lockstep retries turn one
+                             transient fault into a hammering loop
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Optional, Set
+import re
+from typing import Dict, Iterator, List, Optional, Set
 
 from .callgraph import ModuleInfo, PackageIndex, dotted
 from .core import Finding
@@ -193,5 +200,164 @@ def _except_findings(index: PackageIndex) -> List[Finding]:
     return findings
 
 
+# -- unbounded-retry ---------------------------------------------------
+
+_RETRYISH = re.compile(r"attempt|retr|tries|backoff", re.I)
+_BOUNDISH = re.compile(
+    r"attempt|retr|tries|deadline|budget|remaining|timeout", re.I
+)
+
+_LOOP_STOPS = (
+    ast.While,
+    ast.For,
+    ast.FunctionDef,
+    ast.AsyncFunctionDef,
+    ast.Lambda,
+)
+
+
+def _shallow(nodes: List[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk a loop body WITHOUT descending into nested loops or
+    function definitions (those are their own retry scopes)."""
+    stack: List[ast.AST] = list(nodes)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _LOOP_STOPS):
+                continue
+            stack.append(child)
+
+
+def _names_in(node: ast.AST) -> Iterator[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            yield sub.id
+        elif isinstance(sub, ast.Attribute):
+            yield sub.attr
+
+
+def _handler_reiterates(handler: ast.ExceptHandler) -> bool:
+    """True when the except body leads to another loop iteration: it
+    contains a ``continue``, or nothing that leaves the loop (no
+    raise/return/break on every path is approximated as 'none present
+    at all')."""
+    kinds = [type(n) for n in _shallow(handler.body)]
+    if ast.Continue in kinds:
+        return True
+    return not any(k in kinds for k in (ast.Raise, ast.Return, ast.Break))
+
+
+def _guarded_exit(loop_nodes: List[ast.AST]) -> bool:
+    """A bound expressed as control flow: an ``if`` whose test compares
+    something attempt/deadline-ish (or reads the clock) and whose body
+    leaves the loop (raise/break/return)."""
+    for node in loop_nodes:
+        if not isinstance(node, ast.If):
+            continue
+        test_names = list(_names_in(node.test))
+        timeish = any(n in ("monotonic", "time") for n in test_names)
+        boundish = any(_BOUNDISH.search(n) for n in test_names)
+        if not (timeish or boundish):
+            continue
+        if any(
+            isinstance(n, (ast.Raise, ast.Break, ast.Return))
+            for n in _shallow(node.body)
+        ):
+            return True
+    return False
+
+
+def _sleep_calls(loop_nodes: List[ast.AST]) -> List[ast.Call]:
+    out = []
+    for node in loop_nodes:
+        if isinstance(node, ast.Call):
+            text = dotted(node.func)
+            if text and text.split(".")[-1] == "sleep":
+                out.append(node)
+    return out
+
+
+def _retry_findings(index: PackageIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in index.modules.values():
+        # loop -> enclosing function label (innermost wins: outer
+        # functions are walked first, nested ones overwrite)
+        enclosing: Dict[int, str] = {}
+        for func in mod.functions.values():
+            for sub in ast.walk(func.node):
+                if isinstance(sub, (ast.While, ast.For)):
+                    enclosing[id(sub)] = func.label
+        loop_idx: Dict[str, int] = {}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.While, ast.For)):
+                continue
+            symbol = enclosing.get(id(node), mod.name)
+            idx = loop_idx.get(symbol, 0)
+            loop_idx[symbol] = idx + 1
+            body = list(_shallow(node.body))
+            handlers = [
+                h
+                for t in body
+                if isinstance(t, ast.Try)
+                for h in t.handlers
+            ]
+            if not any(_handler_reiterates(h) for h in handlers):
+                continue
+            sleeps = _sleep_calls(body)
+            # a RETRY loop (vs a service/poll loop): it sleeps between
+            # attempts or names its iteration state attempt/retry-ish
+            header = (
+                node.target if isinstance(node, ast.For) else node.test
+            )
+            retryish = bool(sleeps) or (
+                header is not None
+                and any(_RETRYISH.search(n) for n in _names_in(header))
+            )
+            if not retryish:
+                continue
+            # bound: a for loop is finite; a while needs a non-constant
+            # test or an explicit attempt/deadline exit guard
+            if isinstance(node, ast.For):
+                bounded = True
+            else:
+                test_const_true = (
+                    isinstance(node.test, ast.Constant)
+                    and bool(node.test.value)
+                )
+                bounded = not test_const_true or _guarded_exit(body)
+            # backoff: at least one sleep with a NON-constant argument
+            # (a growing delay); constant sleeps retry in lockstep
+            backoff = any(
+                c.args and not isinstance(c.args[0], ast.Constant)
+                for c in sleeps
+            )
+            if bounded and backoff:
+                continue
+            aspect = "bound" if not bounded else "backoff"
+            findings.append(
+                Finding(
+                    rule="unbounded-retry",
+                    path=mod.path,
+                    line=node.lineno,
+                    symbol=symbol,
+                    key=f"loop{idx}|{aspect}",
+                    message=(
+                        "retry loop has no attempt/deadline bound — a "
+                        "permanent fault retries forever"
+                        if not bounded
+                        else "retry loop has no growing backoff sleep "
+                        "— attempts hammer the faulted resource in "
+                        "lockstep"
+                    ),
+                )
+            )
+    return findings
+
+
 def run(index: PackageIndex) -> List[Finding]:
-    return _thread_findings(index) + _except_findings(index)
+    return (
+        _thread_findings(index)
+        + _except_findings(index)
+        + _retry_findings(index)
+    )

@@ -170,7 +170,32 @@ def jobs_list(limit: int) -> None:
 @jobs.command("status")
 @click.argument("job_id")
 def jobs_status(job_id: str) -> None:
-    click.echo(get_sdk().get_job_status(job_id))
+    """Job status plus its failure_log — per-row retries/quarantines,
+    transient-I/O retries, and terminal failures (FAILURES.md)."""
+    out = get_sdk().get_job_status(job_id, with_failure_log=True)
+    click.echo(out["status"])
+    log = out.get("failure_log") or []
+    if log:
+        shown = log[-20:]
+        click.echo(
+            to_colored_text(
+                f"failure_log ({len(log)} event(s)"
+                + (f", last {len(shown)}" if len(shown) < len(log) else "")
+                + "):",
+                "callout",
+            )
+        )
+        for ev in shown:
+            bits = [str(ev.get("ts", "")), str(ev.get("event", "?"))]
+            if ev.get("row_id") is not None:
+                bits.append(f"row={ev['row_id']}")
+            if ev.get("attempt"):
+                bits.append(f"attempt={ev['attempt']}")
+            if ev.get("site"):
+                bits.append(f"site={ev['site']}")
+            if ev.get("error"):
+                bits.append(str(ev["error"]))
+            click.echo("  " + " ".join(bits))
 
 
 @jobs.command("results")

@@ -32,6 +32,7 @@ logger = logging.getLogger(__name__)
 from ..common import MODEL_CATALOG
 from ..interfaces import JobStatus
 from ..models.configs import MODEL_CONFIGS, ModelConfig
+from . import faults
 from .config import EngineConfig, load_engine_config
 from .datasets import DatasetStore
 from .jobstore import JobRecord, JobStore, estimate_cost
@@ -93,7 +94,15 @@ def resolve_model(model: str) -> Tuple[str, ModelConfig, Dict[str, Any]]:
 class LocalEngine:
     def __init__(self, ecfg: Optional[EngineConfig] = None):
         self.ecfg = ecfg or load_engine_config()
-        self.jobs = JobStore()
+        # per-job fault-injection activation (EngineConfig.fault_plan or
+        # SUTRO_FAULT_PLAN; None clears — a fresh engine with no plan
+        # runs injection-free at zero overhead)
+        faults.configure(self.ecfg.fault_plan)
+        self.jobs = JobStore(
+            io_retries=self.ecfg.io_retries,
+            io_backoff=self.ecfg.io_backoff_base,
+            io_backoff_cap=self.ecfg.io_backoff_cap,
+        )
         self.metrics = MetricsBus()
         self.datasets = DatasetStore()
         self._queue: "queue.PriorityQueue" = queue.PriorityQueue()
@@ -232,6 +241,9 @@ class LocalEngine:
                     rec.job_priority, 0, exact
                 )
         if quota_err:
+            self.jobs.append_failure_log(
+                rec.job_id, {"event": "job_failed", "error": quota_err}
+            )
             self.jobs.set_status(
                 rec.job_id,
                 JobStatus.FAILED,
@@ -394,6 +406,14 @@ class LocalEngine:
             df = df.sort_values("row_id")  # streamed results are
             #                                already row-ordered
         out: Dict[str, Any] = {"outputs": df["outputs"].tolist()}
+        if "error" in df.columns and df["error"].notna().any():
+            # quarantined rows (row-level failure domain): 1:1 with
+            # outputs, None for clean rows
+            out["errors"] = [
+                None if v is None or (isinstance(v, float) and v != v)
+                else str(v)
+                for v in df["error"].tolist()
+            ]
         if include_inputs:
             out["inputs"] = self.jobs.read_inputs(job_id)
         if include_cumulative_logprobs and "cumulative_logprobs" in df:
@@ -587,6 +607,13 @@ class LocalEngine:
                 requeue_priority = self._run_job(job_id)
             except Exception as e:  # noqa: BLE001 — job isolation boundary
                 traceback.print_exc()
+                # terminal failure_log entry BEFORE the status flip, so
+                # a watcher that sees FAILED also sees why
+                self.jobs.append_failure_log(
+                    job_id,
+                    {"event": "job_failed",
+                     "error": f"{type(e).__name__}: {e}"},
+                )
                 try:
                     self.jobs.set_status(
                         job_id,
@@ -722,12 +749,21 @@ class LocalEngine:
                     h.update(rb)
                 job_key = h.hexdigest()[:16]
                 shard = shard_requests(sess.requests, dp.rank, dp.world)
+                import functools
+
+                # row retries ride the shard-owning rank's batcher;
+                # row events reach the coordinator's failure_log via
+                # the channel's fault messages (dphost)
+                run_shard = functools.partial(
+                    batcher.run, row_retries=self.ecfg.row_retries
+                )
                 outcome = self._dp_dispatch(
-                    dp, batcher.run, shard,
+                    dp, run_shard, shard,
                     job_id=job_id, job_key=job_key,
                     on_result=sess.on_result,
                     on_progress=sess.on_progress,
                     should_cancel=sess.should_cancel,
+                    on_row_event=sess.on_row_event,
                     # the coordinator's partial store holds every
                     # rank's flushed rows — the done set lets
                     # relaunched workers resume row-granularly
@@ -774,6 +810,11 @@ class LocalEngine:
                 build["session"] = s2
             except Exception as e:  # noqa: BLE001 — job isolation
                 traceback.print_exc()
+                self.jobs.append_failure_log(
+                    jid,
+                    {"event": "job_failed",
+                     "error": f"{type(e).__name__}: {e}"},
+                )
                 try:
                     self.jobs.set_status(
                         jid,
@@ -836,19 +877,22 @@ class LocalEngine:
 
         def on_job_done(ctx, outcome: str) -> None:
             s = sessions[ctx.job_id]
-            try:
-                if outcome == "completed":
-                    s.finalize_completed(batcher)
-                else:
-                    s.finalize_cancelled()
-            finally:
-                s.finalized = True
-                if ctx.job_id != job_id:
-                    # the worker loop's epilogue only covers the
-                    # primary; attached jobs close out here
-                    self.metrics.job(ctx.job_id).finish()
-                    with self._lock:
-                        self._attached.discard(ctx.job_id)
+            # NO try/finally: a raised finalize (e.g. the store's
+            # bounded I/O retries exhausted) must leave ``finalized``
+            # False so the session-error path below — or the worker
+            # loop for the primary — marks the job FAILED resumably
+            # instead of abandoning it RUNNING with no owner
+            if outcome == "completed":
+                s.finalize_completed(batcher)
+            else:
+                s.finalize_cancelled()
+            s.finalized = True
+            if ctx.job_id != job_id:
+                # the worker loop's epilogue only covers the
+                # primary; attached jobs close out here
+                self.metrics.job(ctx.job_id).finish()
+                with self._lock:
+                    self._attached.discard(ctx.job_id)
 
         def should_yield() -> bool:
             live = [
@@ -880,7 +924,15 @@ class LocalEngine:
                 try:
                     s2.flush()
                 except Exception:
-                    pass
+                    logger.warning(
+                        "partial flush failed while failing attached "
+                        "job %s", jid2, exc_info=True,
+                    )
+                self.jobs.append_failure_log(
+                    jid2,
+                    {"event": "job_failed",
+                     "error": "co-batched session error"},
+                )
                 try:
                     self.jobs.set_status(
                         jid2,
@@ -920,6 +972,7 @@ class LocalEngine:
     def _dp_dispatch(
         self, dp, run_shard, shard, *, job_id, job_key, on_result,
         on_progress, should_cancel, done_rows, num_rows,
+        on_row_event=None,
     ) -> Optional[str]:
         """Execute one rank's share of a DP job. Returns the outcome on
         rank 0 (coordinator: merges every rank through ``on_result``),
@@ -950,6 +1003,7 @@ class LocalEngine:
                 should_cancel=should_cancel,
                 job_key=job_key,
                 done_rows=done_rows,
+                on_row_event=on_row_event,
             )
         try:
             w_outcome = run_dp_worker(
@@ -1120,6 +1174,9 @@ class LocalEngine:
                 on_progress=embed_progress,
                 should_cancel=lambda: job_id in self._cancel,
                 done_rows=set(results), num_rows=rec.num_rows,
+                on_row_event=lambda ev: self.jobs.append_failure_log(
+                    job_id, ev
+                ),
             )
             if outcome is None:  # worker rank: terminal status set
                 return None
@@ -1243,17 +1300,13 @@ class _GenSession:
         # ONE prefix-aware batched pass (tokenizer.encode_chat_batch):
         # the shared template shell (chat scaffold + system prompt)
         # encodes once, per-row suffixes in batch, bit-identical ids.
-        from .tokenizer import encode_chat_batch
-
+        # Row-level failure domain: if the batched pass raises, fall
+        # back to per-row encodes and QUARANTINE only the failing rows
+        # (``tokenizer.encode`` fault site) instead of failing the job.
+        self.pre_quarantined: Dict[int, str] = {}
         self.token_rows = [
             np.array(ids, np.int32)
-            for ids in encode_chat_batch(
-                tok,
-                inputs,
-                rec.system_prompt,
-                mcfg.chat_template,
-                threads=eng.ecfg.tokenize_threads,
-            )
+            for ids in self._encode_rows(inputs, rec, mcfg)
         ]
         self.input_tokens = int(sum(len(r) for r in self.token_rows))
 
@@ -1278,6 +1331,21 @@ class _GenSession:
             if reason != "cancelled"
         }
         self.pending_flush: List[Dict[str, Any]] = []
+        # rows whose tokenize failed never reach the scheduler: they
+        # quarantine straight into the partial store as error rows
+        for i, msg in self.pre_quarantined.items():
+            if i in self.done:
+                continue
+            self.done[i] = "error"
+            self.pending_flush.append(
+                {"row_id": i, "outputs": None,
+                 "cumulative_logprobs": 0.0, "gen_tokens": 0,
+                 "finish_reason": "error", "error": msg}
+            )
+            self.on_row_event(
+                {"event": "row_quarantined", "row_id": i,
+                 "attempt": 0, "error": msg}
+            )
 
         import jax
 
@@ -1340,9 +1408,64 @@ class _GenSession:
             should_cancel=self.should_cancel,
             priority=int(rec.job_priority or 0),
             seq=seq,
+            row_retries=eng.ecfg.row_retries,
+            on_row_event=self.on_row_event,
         )
 
+    def _encode_rows(self, inputs, rec, mcfg) -> List[List[int]]:
+        """Batched chat tokenize with per-row quarantine fallback.
+        Quarantined rows land in ``self.pre_quarantined`` and get an
+        empty token row (never admitted — they enter ``done`` as error
+        rows before requests are built)."""
+        from .tokenizer import encode_chat_batch
+
+        eng, tok = self.eng, self.tok
+
+        def _inject_rows() -> None:
+            for i in range(len(inputs)):
+                faults.inject(
+                    "tokenizer.encode", row=i, job=self.job_id
+                )
+
+        try:
+            if faults.ACTIVE is not None:
+                _inject_rows()
+            return encode_chat_batch(
+                tok,
+                inputs,
+                rec.system_prompt,
+                mcfg.chat_template,
+                threads=eng.ecfg.tokenize_threads,
+            )
+        except Exception:  # noqa: BLE001 — row isolation: retry per row
+            logger.warning(
+                "batched tokenize failed for %s; per-row fallback",
+                self.job_id, exc_info=True,
+            )
+        rows: List[List[int]] = []
+        for i, row in enumerate(inputs):
+            try:
+                if faults.ACTIVE is not None:
+                    faults.inject(
+                        "tokenizer.encode", row=i, job=self.job_id
+                    )
+                rows.append(
+                    encode_chat_batch(
+                        tok, [row], rec.system_prompt, mcfg.chat_template
+                    )[0]
+                )
+            except Exception as e:  # noqa: BLE001 — quarantine the row
+                self.pre_quarantined[i] = f"{type(e).__name__}: {e}"
+                rows.append([])
+        return rows
+
     # -- streaming callbacks (scheduler thread) ------------------------
+
+    def on_row_event(self, event: Dict[str, Any]) -> None:
+        """failure_log sink: every scheduler retry/quarantine decision
+        (and the session's own pre-run quarantines) lands on the durable
+        job record."""
+        self.eng.jobs.append_failure_log(self.job_id, dict(event))
 
     def render_output(self, token_ids) -> str:
         text = self.tok.decode(token_ids)
@@ -1390,17 +1513,38 @@ class _GenSession:
         return text
 
     def on_result(self, res: GenResult) -> None:
+        # row-level failure domain: quarantined rows (finish_reason
+        # "error*") carry a null output + the error message; a decode
+        # failure in the RENDERER is itself quarantined per row rather
+        # than failing the job
+        err = res.error
+        if err is None and res.finish_reason.startswith("error"):
+            err = res.finish_reason
+        if err is not None:
+            outputs = None
+        else:
+            try:
+                outputs = self.render_output(res.token_ids)
+            except Exception as e:  # noqa: BLE001 — row isolation
+                err = f"{type(e).__name__}: {e}"
+                outputs = None
+                self.on_row_event(
+                    {"event": "row_quarantined", "row_id": res.row_id,
+                     "attempt": 0, "error": err}
+                )
         row = {
             "row_id": res.row_id,
-            "outputs": self.render_output(res.token_ids),
+            "outputs": outputs,
             "cumulative_logprobs": res.cumulative_logprob,
             # true sampled-token count: the denominator matching
             # cumulative_logprobs (re-tokenizing the decoded text would
             # drop stop tokens and need not round-trip)
             "gen_tokens": len(res.token_ids),
-            "finish_reason": res.finish_reason,
+            "finish_reason": res.finish_reason if err is None or
+            res.finish_reason.startswith("error") else "error",
+            "error": err,
         }
-        self.done[res.row_id] = res.finish_reason
+        self.done[res.row_id] = row["finish_reason"]
         self.pending_flush.append(row)
         if len(self.pending_flush) >= _PARTIAL_FLUSH_EVERY:
             self.flush()

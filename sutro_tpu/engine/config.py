@@ -150,6 +150,25 @@ class EngineConfig:
     temperature: float = 0.7
     top_p: float = 0.95
     top_k: int = 0                  # 0 = disabled
+    # --- robustness / failure domains (engine/faults.py, FAILURES.md) ------
+    fault_plan: Optional[str] = None    # deterministic fault-injection
+                                        # plan (DSL/JSON); None falls back
+                                        # to $SUTRO_FAULT_PLAN; empty/off
+                                        # means ZERO added work per row
+    row_retries: int = 2                # per-row failure domain: a row
+                                        # whose decode/constrain raises is
+                                        # re-admitted as a fresh request up
+                                        # to this many times, then
+                                        # quarantined into an error-column
+                                        # result (the job still SUCCEEDs)
+    io_retries: int = 4                 # bounded attempts for transient
+                                        # jobstore I/O (partial flush,
+                                        # streamed finalize)
+    io_backoff_base: float = 0.05       # first-retry backoff (seconds);
+                                        # doubles per attempt with
+                                        # deterministic jitter, capped at
+                                        # io_backoff_cap
+    io_backoff_cap: float = 2.0
     # --- runtime -----------------------------------------------------------
     use_pallas: Optional[bool] = None   # None => auto (TPU yes, CPU no)
     weights_dir: Optional[str] = None   # local HF-style checkpoint root

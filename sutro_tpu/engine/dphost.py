@@ -24,6 +24,8 @@ Protocol (newline-delimited JSON over one TCP connection per worker):
                      "finish", "in_toks"}
   worker -> coord   {"t": "emb", "row_id", "vec"}   (embedding jobs)
   worker -> coord   {"t": "prog", <scheduler progress fields>}
+  worker -> coord   {"t": "fault", "ev": {<failure_log event>}}
+  worker -> coord   {"t": "hb", "rank": N}          (liveness beacon)
   worker -> coord   {"t": "done", "outcome": "completed"}
   worker -> coord   {"t": "err", "msg": "..."}
   coord  -> worker  {"t": "cancel"}
@@ -41,9 +43,12 @@ Configuration is per-process environment (set by the pod launcher):
   SUTRO_DP_SECRET   optional shared secret mixed into the job-key
                     handshake (see trust model below)
   SUTRO_DP_STALL_TIMEOUT  seconds of silence from a live worker
-                    connection (after the local shard finished) before
-                    the coordinator declares it stalled and fails the
-                    job resumably (default 600; 0 disables)
+                    connection before the coordinator declares it
+                    stalled and fails the job resumably (default 600;
+                    0 disables). Enforced for the WHOLE round by a
+                    watchdog thread — workers heartbeat every
+                    SUTRO_DP_HEARTBEAT seconds (default 20) so a slow
+                    but alive slice is never mistaken for a hung one
 
 Trust model: the channel is designed for a POD-INTERNAL network — the
 slices of one pod behind one job launcher, the same boundary the
@@ -58,19 +63,52 @@ actually-private network (or tunnel) for confidential row data.
 
 from __future__ import annotations
 
+import inspect
 import json
+import logging
 import os
+import random
 import socket
 import threading
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
+from . import faults
 from .scheduler import GenRequest, GenResult
+
+logger = logging.getLogger(__name__)
 
 # worker engines may still be initializing/compiling when the
 # coordinator starts listening — generous by design (a loaded CI box
 # runs several JAX processes; a pod slice cold-starts its runner)
 _ACCEPT_TIMEOUT_S = float(os.environ.get("SUTRO_DP_ACCEPT_TIMEOUT", "420"))
+
+
+class TruncatedFrameError(OSError):
+    """The peer closed mid-NDJSON-frame: bytes arrived after the last
+    newline. Distinguishes a torn frame — data lost at a KNOWN point,
+    reported as a connection fault — from a clean EOF (this tail used
+    to be silently discarded, i.e. silent row loss)."""
+
+
+def _accepts_kwarg(fn: Callable, name: str) -> bool:
+    """Does ``fn`` take keyword ``name``? Probed once per call site so
+    the run_shard contract stays backward compatible (older shard
+    runners without ``on_row_event`` keep working)."""
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    p = sig.parameters.get(name)
+    if p is not None:
+        return p.kind in (
+            inspect.Parameter.POSITIONAL_OR_KEYWORD,
+            inspect.Parameter.KEYWORD_ONLY,
+        )
+    return any(
+        q.kind == inspect.Parameter.VAR_KEYWORD
+        for q in sig.parameters.values()
+    )
 
 
 @dataclass(frozen=True)
@@ -107,6 +145,19 @@ def shard_requests(
     return [q for q in requests if q.row_id % world == rank]
 
 
+def _hard_close(sock: socket.socket) -> None:
+    """Close with an immediate FIN. A plain ``close()`` while another
+    thread of the SAME process is blocked in ``recv`` on the fd keeps
+    the kernel file alive and sends nothing — the peer would never see
+    EOF. ``shutdown`` tears the connection down right now, the way a
+    process death would."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass  # already dead — that's what we wanted
+    sock.close()
+
+
 def _send(sock: socket.socket, msg: Dict) -> None:
     # callers hold their channel's send lock on purpose: sendall is not
     # atomic across messages, and the lock is what keeps NDJSON frames
@@ -120,6 +171,15 @@ def _recv_lines(sock: socket.socket):
     while True:
         chunk = sock.recv(1 << 16)
         if not chunk:
+            if buf:
+                # EOF mid-frame: the peer died between a frame's first
+                # byte and its newline — surface it as a fault so the
+                # drop is REPORTED (consumers treat it like any other
+                # connection loss), never silently swallowed
+                raise TruncatedFrameError(
+                    f"connection closed mid-frame ({len(buf)} bytes of "
+                    "unterminated NDJSON tail)"
+                )
             return
         buf += chunk
         while b"\n" in buf:
@@ -140,7 +200,7 @@ class EmbResult:
 def _res_msg(res) -> Dict:
     if isinstance(res, EmbResult):
         return {"t": "emb", "row_id": res.row_id, "vec": res.vector}
-    return {
+    out = {
         "t": "res",
         "row_id": res.row_id,
         "token_ids": [int(t) for t in res.token_ids],
@@ -148,6 +208,11 @@ def _res_msg(res) -> Dict:
         "finish": res.finish_reason,
         "in_toks": int(res.input_tokens),
     }
+    if getattr(res, "error", None) is not None:
+        # quarantined rows cross the channel with their error message
+        # (row-level failure domains span ranks)
+        out["err"] = str(res.error)
+    return out
 
 
 def _msg_res(m: Dict) -> GenResult:
@@ -157,6 +222,7 @@ def _msg_res(m: Dict) -> GenResult:
         cumulative_logprob=float(m["logprob"]),
         finish_reason=str(m["finish"]),
         input_tokens=int(m["in_toks"]),
+        error=m.get("err"),
     )
 
 
@@ -191,6 +257,7 @@ def run_dp_worker(
     deadline = time.monotonic() + _ACCEPT_TIMEOUT_S
     sock = None
     lines = None
+    attempt = 0
     while True:
         if should_cancel and should_cancel():
             # cancelled before the coordinator ever served this job —
@@ -226,7 +293,14 @@ def run_dp_worker(
                 "dp worker: coordinator never served job "
                 f"{job_key!r} within {_ACCEPT_TIMEOUT_S:.0f}s"
             )
-        time.sleep(0.5)
+        # exponential backoff + jitter between reconnect attempts
+        # (bounded by the deadline above): a pod-wide relaunch must not
+        # hammer the coordinator port in lockstep
+        delay = min(0.25 * (2.0 ** attempt), 5.0) * (
+            0.5 + random.random()
+        )
+        attempt += 1
+        time.sleep(min(delay, max(deadline - time.monotonic(), 0.05)))
     already_done = set(first.get("rows", []))
     shard = [q for q in shard if _row_id(q) not in already_done]
 
@@ -245,9 +319,53 @@ def run_dp_worker(
 
     lock = threading.Lock()  # sendall is not atomic across messages
 
+    # liveness beacon: results/progress can go quiet for minutes while a
+    # device step runs; the coordinator's stall watchdog needs a signal
+    # that distinguishes "slow but alive" from "hung"
+    hb_stop = threading.Event()
+    hb_every = float(os.environ.get("SUTRO_DP_HEARTBEAT", "20"))
+
+    def heartbeat() -> None:
+        while not hb_stop.wait(hb_every):
+            try:
+                with lock:
+                    _send(sock, {"t": "hb", "rank": world.rank})
+            except OSError:
+                return  # channel gone; the serve/read paths report it
+
+    if hb_every > 0:
+        threading.Thread(
+            target=heartbeat, daemon=True, name="sutro-dp-hb"
+        ).start()
+
     def on_result(res: GenResult) -> None:
+        if faults.ACTIVE is not None:
+            spec = faults.fire("dphost.send", row=_row_id(res))
+            if spec is not None:
+                if spec.kind == "drop":
+                    # tear the frame mid-send: the coordinator must see
+                    # a TruncatedFrameError, not silent row loss. The
+                    # send is under the channel lock on purpose — the
+                    # torn bytes must not interleave with another frame
+                    with lock:
+                        try:
+                            # graftlint: disable=lock-blocking-call
+                            sock.sendall(b'{"t":"res","row_id":')
+                        finally:
+                            _hard_close(sock)
+                spec.trigger()
         with lock:
             _send(sock, _res_msg(res))
+
+    def on_row_event(ev: Dict) -> None:
+        # forward row retry/quarantine events to the coordinator's
+        # authoritative failure_log (best effort: a dead channel is
+        # already being reported through the result path)
+        try:
+            with lock:
+                _send(sock, {"t": "fault", "ev": ev})
+        except OSError:
+            logger.warning("could not forward fault event", exc_info=True)
 
     def on_progress(p: Dict) -> None:
         with lock:
@@ -271,12 +389,28 @@ def run_dp_worker(
         return bool(should_cancel and should_cancel())
 
     try:
+        kw: Dict = {}
+        if _accepts_kwarg(run_shard, "on_row_event"):
+            kw["on_row_event"] = on_row_event
         outcome = run_shard(
             shard,
             on_result=on_result,
             on_progress=on_progress,
             should_cancel=cancelled,
+            **kw,
         )
+        if faults.ACTIVE is not None:
+            spec = faults.fire("dphost.worker_done")
+            if spec is not None:
+                if spec.kind == "crash":
+                    # hard crash before done: no err message, just a
+                    # dead connection for the coordinator to detect
+                    _hard_close(sock)
+                elif spec.kind == "hang":
+                    # a truly hung process beats no drum: stop the
+                    # heartbeat so the stall watchdog sees silence
+                    hb_stop.set()
+                spec.trigger()
         with lock:
             _send(sock, {"t": "done", "outcome": outcome})
         return outcome
@@ -288,9 +422,13 @@ def run_dp_worker(
                     {"t": "err", "msg": f"{type(e).__name__}: {e}"},
                 )
         except OSError:
-            pass
+            logger.warning(
+                "dp worker: could not report error to coordinator "
+                "(connection already down)"
+            )
         raise
     finally:
+        hb_stop.set()
         sock.close()
 
 
@@ -387,6 +525,7 @@ def run_dp_coordinator(
     job_key: str = "",
     should_cancel: Optional[Callable[[], bool]] = None,
     done_rows: Optional[set] = None,
+    on_row_event: Optional[Callable[[Dict], None]] = None,
 ) -> str:
     """Rank-0 execution: collect the local shard AND every worker's
     stream through the same ``on_result`` (the jobstore's row_id-keyed
@@ -394,6 +533,15 @@ def run_dp_coordinator(
     across ranks. Raises if any worker reports an error or drops its
     connection before ``done`` — partial rows stay in the partial store
     for a row-granular resume, exactly like a single-host failure.
+
+    Liveness: a stall watchdog covers the WHOLE round — a connected
+    rank silent past SUTRO_DP_STALL_TIMEOUT (heartbeats count as
+    signal) is declared stalled and the job fails resumably in bounded
+    time, even while the local shard is still decoding.
+
+    ``on_row_event`` receives row retry/quarantine events from every
+    rank (workers forward theirs as ``fault`` messages) — the
+    coordinator's record is the authoritative failure_log.
 
     Connections greeting with a different ``job_key`` (a rank whose
     queue diverged) are rejected and do not count toward the expected
@@ -454,6 +602,17 @@ def run_dp_coordinator(
                     with prog_lock:
                         prog[m["rank"]] = m
                     _emit_progress()
+                elif t == "fault":
+                    # a worker rank's row retry/quarantine: record it on
+                    # the authoritative (coordinator) failure_log
+                    if on_row_event is not None:
+                        try:
+                            on_row_event(m.get("ev") or {})
+                        except Exception:
+                            logger.warning(
+                                "on_row_event sink failed",
+                                exc_info=True,
+                            )
                 elif t == "done":
                     # a worker shard that did not COMPLETE (e.g.
                     # cancelled after the coordinator's own shard
@@ -601,6 +760,56 @@ def run_dp_coordinator(
     acceptor = threading.Thread(target=accept_all, daemon=True)
     acceptor.start()
 
+    # -- liveness watchdog (whole round) -------------------------------
+    # The old stall check only ran AFTER the local shard finished, so a
+    # hung rank could wedge the coordinator for as long as rank 0 kept
+    # decoding. The watchdog enforces the stall bound from accept
+    # onward; worker heartbeats (SUTRO_DP_HEARTBEAT) keep live-but-slow
+    # ranks fresh.
+    stall_s = float(os.environ.get("SUTRO_DP_STALL_TIMEOUT", "600"))
+    watchdog_stop = threading.Event()
+
+    def _mark_stalled(r: int) -> None:
+        with state_cv:
+            if r in rank_status:
+                return  # terminal beat the timeout
+            rank_gen[r] = rank_gen.get(r, 0) + 1
+            rank_status[r] = (
+                f"worker rank={r} stalled (no message for "
+                f"{stall_s:.0f}s)"
+            )
+            state_cv.notify_all()
+        conn = rank_conn.get(r)
+        if conn is not None:
+            try:
+                conn.close()  # EOFs its serve thread
+            except OSError:
+                logger.warning(
+                    "closing stalled rank %d connection failed", r
+                )
+
+    def stall_watchdog() -> None:
+        import time as _time
+
+        period = min(max(stall_s / 4.0, 0.25), 5.0)
+        while not watchdog_stop.wait(period):
+            now = _time.monotonic()
+            with state_cv:
+                stalled = [
+                    r
+                    for r in range(1, world.world)
+                    if r in rank_conn
+                    and r not in rank_status
+                    and now - last_msg.get(r, now) > stall_s
+                ]
+            for r in stalled:
+                _mark_stalled(r)
+
+    if stall_s > 0:
+        threading.Thread(
+            target=stall_watchdog, daemon=True, name="sutro-dp-stall"
+        ).start()
+
     def local_progress(p: Dict) -> None:
         with prog_lock:
             prog[0] = {
@@ -633,11 +842,17 @@ def run_dp_coordinator(
         return False
 
     try:
+        kw: Dict = {}
+        if on_row_event is not None and _accepts_kwarg(
+            run_shard, "on_row_event"
+        ):
+            kw["on_row_event"] = on_row_event
         outcome = run_shard(
             shard,
             on_result=locked_result,
             on_progress=local_progress,
             should_cancel=cancel_check,
+            **kw,
         )
         local_done["flag"] = True
         with prog_lock:  # same staleness rule for the local shard
@@ -650,26 +865,16 @@ def run_dp_coordinator(
         # stops waiting entirely: a hung or never-connecting worker
         # must not wedge cancellation (closing conns in the finally
         # unblocks their serve threads; stragglers see EOF and cancel
-        # locally). A LIVE connection that goes silent for
-        # SUTRO_DP_STALL_TIMEOUT after the local shard finished is
-        # declared stalled and fails the job resumably — a hung slice
-        # must not wedge the coordinator forever (EOF detection only
-        # covers DEAD connections).
+        # locally). Hung-but-live connections are the stall watchdog's
+        # job — it has been enforcing the silence bound since accept.
         import time
 
-        stall_s = float(os.environ.get("SUTRO_DP_STALL_TIMEOUT", "600"))
-        t_local_done = time.monotonic()
         cancel_deadline = None
         while True:
             with state_cv:
                 if len(rank_status) >= n_workers:
                     break
                 state_cv.wait(timeout=0.25)
-                pending = [
-                    r
-                    for r in range(1, world.world)
-                    if r not in rank_status
-                ]
             if cancel_check():
                 if outcome == "completed":
                     outcome = "cancelled"
@@ -677,24 +882,6 @@ def run_dp_coordinator(
                     cancel_deadline = time.monotonic() + 30.0
                 elif time.monotonic() >= cancel_deadline:
                     break
-            elif stall_s > 0:
-                now = time.monotonic()
-                for r in pending:
-                    seen = max(last_msg.get(r, 0.0), t_local_done)
-                    if r in rank_conn and now - seen > stall_s:
-                        with state_cv:
-                            if r in rank_status:
-                                continue  # terminal beat the timeout
-                            rank_gen[r] = rank_gen.get(r, 0) + 1
-                            rank_status[r] = (
-                                f"worker rank={r} stalled (no message "
-                                f"for {stall_s:.0f}s)"
-                            )
-                            state_cv.notify_all()
-                        try:
-                            rank_conn[r].close()
-                        except OSError:
-                            pass
         with state_cv:
             errs = [
                 s for s in rank_status.values() if s != "completed"
@@ -705,6 +892,7 @@ def run_dp_coordinator(
             )
         return outcome
     finally:
+        watchdog_stop.set()
         for c in conns:
             c.close()
         listener.close()

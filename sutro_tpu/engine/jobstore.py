@@ -43,6 +43,8 @@ logger = logging.getLogger(__name__)
 
 from ..interfaces import JobStatus
 from ..validation import config_dir
+from . import faults
+from .faults import retry_transient
 
 # ---------------------------------------------------------------------------
 # Cost model (USD per 1M tokens). The reference surfaces only a server-side
@@ -121,6 +123,10 @@ class JobRecord:
     cost_estimate: Optional[float] = None
     job_cost: Optional[float] = None
     failure_reason: Optional[Dict[str, Any]] = None
+    # structured, bounded event trail: every retry / per-row quarantine /
+    # terminal failure appends here (reference sessions carry the same
+    # ``failure_log[]`` — SURVEY §5.3; schema in FAILURES.md)
+    failure_log: Optional[List[Dict[str, Any]]] = None
     output_schema: Optional[Dict[str, Any]] = None
     system_prompt: Optional[str] = None
     sampling_params: Optional[Dict[str, Any]] = None
@@ -135,8 +141,18 @@ class JobRecord:
 
 
 class JobStore:
+    # failure_log entries kept per job (oldest dropped first): the log is
+    # an incident trail, not a metrics store — bounded so a pathological
+    # job can't grow its record without limit
+    _FAILURE_LOG_CAP = 200
+
     def __init__(
-        self, root: Optional[Path] = None, chunk_rows: Optional[int] = None
+        self,
+        root: Optional[Path] = None,
+        chunk_rows: Optional[int] = None,
+        io_retries: Optional[int] = None,
+        io_backoff: Optional[float] = None,
+        io_backoff_cap: Optional[float] = None,
     ):
         import os
 
@@ -151,6 +167,24 @@ class JobStore:
         )
         if self.chunk_rows < 1:
             self.chunk_rows = 1
+        # transient-I/O retry policy (exponential backoff + jitter,
+        # bounded attempts — engine/faults.retry_transient): a blip in
+        # the store must not fail a 20k-row job, a dead disk still must
+        self.io_retries = int(
+            io_retries
+            if io_retries is not None
+            else os.environ.get("SUTRO_IO_RETRIES", "4")
+        )
+        self.io_backoff = float(
+            io_backoff
+            if io_backoff is not None
+            else os.environ.get("SUTRO_IO_BACKOFF", "0.05")
+        )
+        self.io_backoff_cap = float(
+            io_backoff_cap
+            if io_backoff_cap is not None
+            else os.environ.get("SUTRO_IO_BACKOFF_CAP", "2.0")
+        )
         self._lock = threading.Lock()
         self._flush_seq: Dict[str, int] = {}  # job_id -> next chunk seq
 
@@ -208,6 +242,32 @@ class JobStore:
 
     def status(self, job_id: str) -> JobStatus:
         return JobStatus(self.get(job_id).status)
+
+    def append_failure_log(
+        self, job_id: str, event: Dict[str, Any]
+    ) -> None:
+        """Append one structured event to the job's bounded
+        ``failure_log[]`` (retry / quarantine / terminal failure — the
+        reference session schema). Best-effort by design: recording a
+        recovery must never itself become a new failure. ``ts`` is
+        stamped here so callers only describe the event."""
+        ev = {"ts": _now(), **event}
+        try:
+            # inline RMW (``update`` would re-take the non-reentrant
+            # store lock); the record write IS the critical section
+            with self._lock:
+                rec = self.get(job_id)
+                log = list(rec.failure_log or [])
+                log.append(ev)
+                if len(log) > self._FAILURE_LOG_CAP:
+                    log = log[-self._FAILURE_LOG_CAP :]
+                rec.failure_log = log
+                self._write_record(rec)
+        except Exception:
+            logger.warning(
+                "failure_log append failed for %s (event %r)",
+                job_id, event.get("event"), exc_info=True,
+            )
 
     def list_jobs(self) -> List[Dict[str, Any]]:
         """Newest-first job records (reference /list-jobs, cli.py:157-196)."""
@@ -283,9 +343,43 @@ class JobStore:
         O(len(rows)) per call: each flush lands as immutable chunk
         files under ``partial/`` split by row_id bucket (the old
         single-file scheme re-read and re-wrote the WHOLE partial store
-        every flush — quadratic over a long job)."""
+        every flush — quadratic over a long job).
+
+        Transient-fault domain: OSError flushes retry with exponential
+        backoff + jitter, bounded by ``io_retries``, each retry recorded
+        in the job's ``failure_log[]``; chunks are idempotent (a fresh
+        seq per attempt, later seq wins on duplicate row_ids), so a
+        half-landed attempt is harmless."""
         if not rows:
             return
+        retry_transient(
+            lambda: self._flush_partial_once(job_id, rows),
+            attempts=self.io_retries,
+            base=self.io_backoff,
+            cap=self.io_backoff_cap,
+            retry_on=(OSError,),
+            on_retry=lambda attempt, delay, exc: self.append_failure_log(
+                job_id,
+                {"event": "io_retry", "site": "jobstore.flush_partial",
+                 "attempt": attempt,
+                 "error": f"{type(exc).__name__}: {exc}"},
+            ),
+            what=f"flush_partial[{job_id}]",
+        )
+
+    def _flush_partial_once(
+        self, job_id: str, rows: List[Dict[str, Any]]
+    ) -> None:
+        if faults.ACTIVE is not None:
+            spec = faults.fire("jobstore.flush_partial", job=job_id)
+            if spec is not None:
+                if spec.kind == "torn":
+                    # simulate a crash mid-flush on a non-durable fs:
+                    # a chunk file exists at its FINAL name with only
+                    # part of its bytes (readers must skip+quarantine
+                    # it; the retry lands a good chunk at a higher seq)
+                    self._write_torn_chunk(job_id, rows)
+                spec.trigger()
         d = self._partial_dir(job_id)
         d.mkdir(parents=True, exist_ok=True)
         seq = self._next_flush_seq(job_id)
@@ -300,6 +394,53 @@ class JobStore:
             tmp = path.with_suffix(".parquet.tmp")
             df.to_parquet(tmp)
             tmp.replace(path)  # atomic on POSIX
+
+    def _write_torn_chunk(
+        self, job_id: str, rows: List[Dict[str, Any]]
+    ) -> None:
+        """Fault-plan helper (kind ``torn``): land a truncated chunk
+        file at a real chunk name, as a crash between write and fsync
+        would on a non-durable filesystem."""
+        import io
+
+        d = self._partial_dir(job_id)
+        d.mkdir(parents=True, exist_ok=True)
+        seq = self._next_flush_seq(job_id)
+        bucket = int(rows[0]["row_id"]) // self.chunk_rows
+        buf = io.BytesIO()
+        pd.DataFrame(rows).to_parquet(buf)
+        data = buf.getvalue()
+        (d / f"b{bucket:08d}-s{seq:08d}.parquet").write_bytes(
+            data[: max(8, len(data) // 2)]
+        )
+
+    def _read_chunk(
+        self, job_id: str, path: Path, columns: Optional[List[str]] = None
+    ) -> Optional[pd.DataFrame]:
+        """Read one partial chunk, tolerating a torn/corrupt file (crash
+        mid-flush): the bad chunk is quarantined to ``partial/.corrupt/``
+        and logged instead of failing the WHOLE store — its rows simply
+        regenerate on resume. Returns None for a quarantined chunk."""
+        try:
+            return pd.read_parquet(path, columns=columns)
+        except Exception as e:  # pyarrow raises ArrowInvalid/OSError/...
+            logger.warning(
+                "quarantining corrupt partial chunk %s: %s", path, e
+            )
+            try:
+                cdir = path.parent / ".corrupt"
+                cdir.mkdir(exist_ok=True)
+                path.replace(cdir / path.name)
+            except OSError:
+                logger.warning(
+                    "could not quarantine %s", path, exc_info=True
+                )
+            self.append_failure_log(
+                job_id,
+                {"event": "torn_chunk_quarantined", "chunk": path.name,
+                 "error": f"{type(e).__name__}: {e}"},
+            )
+            return None
 
     def _legacy_partial(self, job_id: str) -> Optional[pd.DataFrame]:
         path = self._dir(job_id) / "partial.parquet"
@@ -318,7 +459,9 @@ class JobStore:
         for _, _, p in sorted(
             self._partial_chunks(job_id), key=lambda t: t[1]
         ):
-            frames.append(pd.read_parquet(p))
+            df = self._read_chunk(job_id, p)
+            if df is not None:
+                frames.append(df)
         out: Dict[int, Dict[str, Any]] = {}
         for df in frames:
             for _, r in df.iterrows():
@@ -337,7 +480,9 @@ class JobStore:
         for _, _, p in sorted(
             self._partial_chunks(job_id), key=lambda t: t[1]
         ):
-            frames.append(pd.read_parquet(p, columns=cols))
+            df = self._read_chunk(job_id, p, columns=cols)
+            if df is not None:
+                frames.append(df)
         out: Dict[int, str] = {}
         for df in frames:
             ids = df["row_id"].to_numpy()
@@ -361,14 +506,21 @@ class JobStore:
 
     # generation result schema: one definition so every row-group of a
     # streamed results.parquet agrees with what finalize_results used
-    # to produce via pandas
+    # to produce via pandas. ``error`` carries a quarantined row's
+    # failure message (null for clean rows) — SUCCEEDED with N-k good
+    # rows + k error rows, instead of one bad row failing the job.
     _GEN_COLS = (
         "row_id",
         "outputs",
         "cumulative_logprobs",
         "gen_tokens",
         "finish_reason",
+        "error",
     )
+
+    # columns absent from pre-upgrade partial rows that backfill with a
+    # default instead of raising (anything else missing is a bug)
+    _GEN_BACKFILL = ("gen_tokens", "error")
 
     def write_results_streamed(
         self,
@@ -388,11 +540,39 @@ class JobStore:
         final file only appears at the atomic rename below).
 
         ``on_chunk(df)`` sees each ordered bucket frame — accounting
-        hooks (output-token counts) ride the same single pass.
+        hooks (output-token counts) ride the same single pass. On a
+        TRANSIENT I/O failure the whole pass retries from scratch
+        (bounded, backed off), so ``on_chunk`` observers must reset
+        when they see the bucket starting at row 0 again.
         """
+        retry_transient(
+            lambda: self._write_results_streamed_once(
+                job_id, num_rows, on_chunk
+            ),
+            attempts=self.io_retries,
+            base=self.io_backoff,
+            cap=self.io_backoff_cap,
+            retry_on=(OSError,),
+            on_retry=lambda attempt, delay, exc: self.append_failure_log(
+                job_id,
+                {"event": "io_retry", "site": "jobstore.finalize",
+                 "attempt": attempt,
+                 "error": f"{type(exc).__name__}: {exc}"},
+            ),
+            what=f"finalize[{job_id}]",
+        )
+
+    def _write_results_streamed_once(
+        self,
+        job_id: str,
+        num_rows: int,
+        on_chunk=None,
+    ) -> None:
         import pyarrow as pa
         import pyarrow.parquet as pq
 
+        if faults.ACTIVE is not None:
+            faults.inject("jobstore.finalize", job=job_id)
         schema = pa.schema(
             [
                 ("row_id", pa.int64()),
@@ -400,6 +580,7 @@ class JobStore:
                 ("cumulative_logprobs", pa.float64()),
                 ("gen_tokens", pa.int64()),
                 ("finish_reason", pa.string()),
+                ("error", pa.string()),
             ]
         )
         import numpy as np
@@ -426,17 +607,20 @@ class JobStore:
                     if len(in_range):
                         frames.append(in_range)
                 for _seq, p in sorted(by_bucket.get(bucket, ())):
-                    frames.append(pd.read_parquet(p))
+                    chunk = self._read_chunk(job_id, p)
+                    if chunk is not None:
+                        frames.append(chunk)
                 if frames:
                     df = pd.concat(frames, ignore_index=True)
                     missing = [
                         c
                         for c in self._GEN_COLS
-                        if c != "gen_tokens" and c not in df.columns
+                        if c not in self._GEN_BACKFILL
+                        and c not in df.columns
                     ]
                     if missing:
-                        # gen_tokens alone is backfillable (pre-upgrade
-                        # partial rows lack it); anything else missing
+                        # gen_tokens/error are backfillable (pre-upgrade
+                        # partial rows lack them); anything else missing
                         # is a bug and must raise, not record nulls
                         raise ValueError(
                             f"partial rows for {job_id} lack columns "
@@ -444,6 +628,8 @@ class JobStore:
                         )
                     if "gen_tokens" not in df.columns:
                         df = df.assign(gen_tokens=0)
+                    if "error" not in df.columns:
+                        df = df.assign(error=None)
                     sub = df.drop_duplicates(
                         subset="row_id", keep="last"
                     ).set_index("row_id").reindex(range(lo, hi))
@@ -458,6 +644,12 @@ class JobStore:
                             never_ran.tolist(),
                             sub["finish_reason"].tolist(),
                         )
+                    ]
+                    errors = [
+                        None if (isinstance(v, float) and pd.isna(v))
+                        or v is None
+                        else str(v)
+                        for v in sub["error"].tolist()
                     ]
                     logps = (
                         pd.to_numeric(
@@ -475,6 +667,7 @@ class JobStore:
                     n = hi - lo
                     outputs = [None] * n
                     reasons = ["cancelled"] * n
+                    errors = [None] * n
                     logps = np.zeros((n,), np.float64)
                     gen_toks = np.zeros((n,), np.int64)
                 out = pd.DataFrame(
@@ -484,6 +677,7 @@ class JobStore:
                         "cumulative_logprobs": logps,
                         "gen_tokens": gen_toks,
                         "finish_reason": reasons,
+                        "error": errors,
                     }
                 )
                 if on_chunk is not None:

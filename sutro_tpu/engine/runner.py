@@ -29,6 +29,7 @@ import numpy as np
 
 from ..models import transformer
 from ..models.configs import ModelConfig
+from . import faults
 from .config import EngineConfig
 from .kvcache import KVCache, alloc_cache, write_kv
 from ..ops.sampling import NEG_INF, sample, cumulative_logprob
@@ -302,6 +303,8 @@ class ModelRunner:
         position, attending over pages that already hold positions
         < start (shared-prefix jobs: the common prefix was prefilled
         once into pages at the head of ``page_table``)."""
+        if faults.ACTIVE is not None:
+            faults.inject("runner.prefill")
         n = len(token_ids)
         C = self.ecfg.prefill_chunk
         # the chunked paged path does not route through the ring (sp) or
@@ -357,6 +360,8 @@ class ModelRunner:
         reference's headline workload, /root/reference/README.md:36-38):
         prefill FLOPs for many short rows ride one MXU dispatch instead
         of one per row."""
+        if faults.ACTIVE is not None:
+            faults.inject("runner.prefill")
         n = len(rows)
         maxlen = max((len(r) for r in rows), default=1)
         T = next_bucket(max(maxlen, 1), lo=16, hi=self.ecfg.max_context())
@@ -390,6 +395,8 @@ class ModelRunner:
         every row's table; only the suffix rides this program). Padding
         rows carry ``valid_len`` 0, start 0 and an all-zero table, so
         their K/V land on the garbage page."""
+        if faults.ACTIVE is not None:
+            faults.inject("runner.prefill")
         n = len(rows)
         maxlen = max((len(r) for r in rows), default=1)
         T = next_bucket(max(maxlen, 1), lo=16, hi=self.ecfg.max_context())
@@ -529,6 +536,8 @@ class ModelRunner:
         #                   incrementally; no O(B*V) host work here)
         pfx=None,  # tuple of (pages [Pp_g], pfx_len [B]) split-prefix groups
     ) -> Tuple[np.ndarray, np.ndarray]:
+        if faults.ACTIVE is not None:
+            faults.inject("runner.decode")
         B = len(last_tokens)
         if top_k is None:
             top_k = np.zeros((B,), np.int32)
@@ -728,6 +737,8 @@ class ModelRunner:
         host<->device round trip — the dominant cost when the chip sits
         behind a network tunnel (PERF.md round-2 profile: ~135 ms RTT vs
         ~16 ms device compute per step)."""
+        if faults.ACTIVE is not None:
+            faults.inject("runner.decode")
         B = past_len.shape[0]
         if top_k is None:
             top_k = np.zeros((B,), np.int32)
@@ -954,6 +965,8 @@ class ModelRunner:
         ``commit_window(handle, accepted)`` with per-row accepted token
         counts. ``allowed0`` FSM-masks the first step for rows whose
         previous window rejected a token (scheduler per-row recovery)."""
+        if faults.ACTIVE is not None:
+            faults.inject("runner.decode")
         B = len(last_tokens)
         if top_k is None:
             top_k = np.zeros((B,), np.int32)
@@ -1009,6 +1022,8 @@ class ModelRunner:
 
     def embed_batch(self, rows: list) -> np.ndarray:
         """List of token-id arrays -> [N, H] float32 embeddings."""
+        if faults.ACTIVE is not None:
+            faults.inject("runner.embed")
         n = len(rows)
         maxlen = max((len(r) for r in rows), default=1)
         T = next_bucket(max(maxlen, 1), lo=16, hi=self.ecfg.max_context())

@@ -37,6 +37,7 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
+from . import faults
 from .kvcache import PageAllocator, pages_needed
 from .runner import ModelRunner, next_bucket
 from ..ops.sampling import cumulative_logprob, sample as device_sample
@@ -195,9 +196,13 @@ class GenResult:
     token_ids: List[int]
     cumulative_logprob: float
     # "stop" | "length" | "schema_complete" | "cancelled" |
-    # "error_too_long" | "error_capacity"
+    # "error" | "error_too_long" | "error_capacity"
     finish_reason: str
     input_tokens: int
+    # quarantine message for error_* rows (row-level failure domain):
+    # the jobstore lands it in the results ``error`` column; None for
+    # clean rows
+    error: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -240,6 +245,14 @@ class JobCtx:
     on_progress: Optional[Callable[[Dict[str, Any]], None]] = None
     should_cancel: Optional[Callable[[], bool]] = None
     progress_every: float = 1.0
+    # Row-level failure domain: a row whose decode/constrain raises is
+    # re-admitted as a FRESH request up to ``row_retries`` times, then
+    # quarantined as an error result (the job still completes).
+    # ``on_row_event`` is the failure_log sink — every retry/quarantine
+    # event streams through it (engine wires it to the jobstore).
+    row_retries: int = 0
+    on_row_event: Optional[Callable[[Dict[str, Any]], None]] = None
+    row_attempts: Dict[int, int] = dataclasses.field(default_factory=dict)
     # -- internal session state --
     prefix: Optional[_SharedPrefix] = None
     prefix_ready: bool = False  # _setup_prefix attempted (lazily, at
@@ -569,6 +582,8 @@ class ContinuousBatcher:
         changes again (slots rely on it)."""
         if req.constraint is not None or req.constraint_factory is None:
             return
+        if faults.ACTIVE is not None:
+            faults.inject("constrain.compile", row=req.row_id)
         c = req.prepped_constraint
         if c is not None:
             req.constraint = c
@@ -1216,12 +1231,21 @@ class ContinuousBatcher:
         single-step and the speculative window's allowed0 recovery, so
         the two cannot drift."""
         allowed = np.ones((self.B, self.vocab), bool)
-        for i in rows:
+        for i in list(rows):
             s = self.slots[i]
+            if s is None:
+                continue  # failed earlier in this assembly pass
             c = s.req.constraint
             if c is not None:
                 rem = self._remaining(s.req, len(s.out_ids), s.pos)
-                allowed[i] = self._constraint_mask(c, rem)
+                try:
+                    allowed[i] = self._constraint_mask(c, rem)
+                except Exception as e:  # noqa: BLE001 — row isolation
+                    # one row's broken FSM must not take the batch down:
+                    # release it into the retry/quarantine path; its
+                    # all-True mask row samples a token that the (slot,
+                    # gen) / None-slot checks then discard
+                    self._fail_slot(i, e)
         return allowed
 
     def _remaining(self, req: GenRequest, emitted: int, pos: int) -> int:
@@ -1350,24 +1374,117 @@ class ContinuousBatcher:
             return "length"
         return None
 
+    def _row_error(
+        self, ctx: JobCtx, req: GenRequest, exc: BaseException
+    ) -> None:
+        """Row-level failure domain (one bad row must not kill the
+        job): retry the row as a FRESH request up to ``ctx.row_retries``
+        times — only when its constraint can be rebuilt (a directly
+        supplied FSM has advanced and cannot be rewound) — then
+        quarantine it as an error result the jobstore records in the
+        ``error`` column. Every decision streams a failure_log event."""
+        rid = req.row_id
+        attempt = ctx.row_attempts.get(rid, 0) + 1
+        ctx.row_attempts[rid] = attempt
+        msg = f"{type(exc).__name__}: {exc}"
+        rebuildable = (
+            req.constraint is None or req.constraint_factory is not None
+        )
+        if attempt <= ctx.row_retries and rebuildable:
+            logger.warning(
+                "row %d failed (attempt %d/%d), retrying: %s",
+                rid, attempt, ctx.row_retries, msg,
+            )
+            if ctx.on_row_event is not None:
+                ctx.on_row_event(
+                    {"event": "row_retry", "row_id": rid,
+                     "attempt": attempt, "error": msg}
+                )
+            # fresh request: FSM state, prep handoff, and flags reset —
+            # appended at the TAIL, which admission pops next
+            ctx.pending.append(
+                dataclasses.replace(
+                    req,
+                    constraint=None,
+                    prepped_constraint=None,
+                    prep_queued=False,
+                )
+            )
+            return
+        logger.warning(
+            "row %d quarantined after %d attempt(s): %s", rid, attempt, msg
+        )
+        if ctx.on_row_event is not None:
+            ctx.on_row_event(
+                {"event": "row_quarantined", "row_id": rid,
+                 "attempt": attempt, "error": msg}
+            )
+        ctx.stats["rows"] += 1
+        ctx.on_result(
+            GenResult(
+                row_id=rid,
+                token_ids=[],
+                cumulative_logprob=0.0,
+                finish_reason="error",
+                input_tokens=len(req.prompt_ids),
+                error=msg,
+            )
+        )
+
+    def _fail_slot(self, i: int, exc: BaseException) -> None:
+        """Release slot ``i`` after a per-row exception WITHOUT emitting
+        its partial output, then route the row through
+        :meth:`_row_error` (retry or quarantine). Mirrors ``_release``'s
+        bookkeeping; the in-flight-window dead-store argument documented
+        there covers the pages freed here too."""
+        slot = self.slots[i]
+        if self.native is not None:
+            self.native.release(i)
+        else:
+            self.allocator.free(slot.pages[slot.shared_n :])
+        ctx = slot.job
+        if ctx is not None:
+            ctx.n_slots -= 1
+        self.slots[i] = None
+        self._gen[i] += 1
+        self._needs_mask.discard(i)
+        if ctx is not None:
+            self._row_error(ctx, slot.req, exc)
+
     def _accept_token(
         self, i: int, tok: int, logp: float, release: bool = True
     ) -> int:
         """Record one sampled token for slot ``i``; release on finish.
-        Returns 1 if the row completed, else 0. ``release=False`` defers
-        the release to the caller (speculative windows must commit the
-        accepted K/V to pages BEFORE freeing them). Results and token
-        accounting route through the SLOT'S job (co-batched sessions
-        interleave jobs within one decode batch)."""
+        Returns 1 if the row completed, 2 if the row FAILED (slot
+        released into the retry/quarantine path — the token was NOT
+        recorded), else 0. ``release=False`` defers the release to the
+        caller (speculative windows must commit the accepted K/V to
+        pages BEFORE freeing them). Results and token accounting route
+        through the SLOT'S job (co-batched sessions interleave jobs
+        within one decode batch)."""
         s = self.slots[i]
-        s.pos += 1  # last_token's KV is now cached
-        if self.native is not None:
-            self.native.note_token(i, tok)
-        self._record_token(s, tok, logp)
+        try:
+            if faults.ACTIVE is not None:
+                faults.inject(
+                    "row.decode", row=s.req.row_id,
+                    job=s.job.job_id if s.job is not None else None,
+                )
+            s.pos += 1  # last_token's KV is now cached
+            if self.native is not None:
+                self.native.note_token(i, tok)
+            self._record_token(s, tok, logp)
+        except Exception as e:  # noqa: BLE001 — row isolation boundary
+            self._fail_slot(i, e)
+            return 2
         s.last_token = tok
         if s.job is not None:
             s.job.stats["out"] += 1
-        if self._finish_reason(s, tok):
+        try:
+            done = self._finish_reason(s, tok)
+        except Exception as e:  # noqa: BLE001 — row isolation (FSM state)
+            self._fail_slot(i, e)
+            return 2
+        if done:
             if release:
                 self._emit(i)
             return 1
@@ -1596,6 +1713,17 @@ class ContinuousBatcher:
         INF = wK + 1
         for col, i in enumerate(idxs):
             s = self.slots[i]
+            if faults.ACTIVE is not None:
+                # the vectorized path skips _accept_token, so the
+                # per-row decode fault site fires here instead
+                try:
+                    faults.inject(
+                        "row.decode", row=s.req.row_id,
+                        job=s.job.job_id if s.job is not None else None,
+                    )
+                except Exception as e:  # noqa: BLE001 — row isolation
+                    self._fail_slot(i, e)
+                    continue
             # first k (tokens accepted) at which the row finishes —
             # mirrors _finish_reason's per-token checks
             stops = np.flatnonzero(is_stop[:, col])
@@ -1635,6 +1763,8 @@ class ContinuousBatcher:
         should_cancel: Optional[Callable[[], bool]] = None,
         should_yield: Optional[Callable[[], bool]] = None,
         progress_every: float = 1.0,
+        row_retries: int = 0,
+        on_row_event: Optional[Callable[[Dict[str, Any]], None]] = None,
     ) -> str:
         """Run all requests to completion, streaming results/progress.
 
@@ -1646,6 +1776,10 @@ class ContinuousBatcher:
         returns immediately — the preemption primitive behind priority
         scheduling (reference two-priority semantics, README.md:168-171).
 
+        ``row_retries``/``on_row_event`` configure the row-level failure
+        domain (see JobCtx) — DP shards get the same retry/quarantine
+        semantics as co-batched sessions.
+
         Single-job convenience over :meth:`run_multi`."""
         outcome: Dict[str, str] = {}
         ctx = JobCtx(
@@ -1655,6 +1789,8 @@ class ContinuousBatcher:
             on_progress=on_progress,
             should_cancel=should_cancel,
             progress_every=progress_every,
+            row_retries=row_retries,
+            on_row_event=on_row_event,
         )
         state = self.run_multi(
             [ctx],
@@ -1688,9 +1824,20 @@ class ContinuousBatcher:
 
                 key = id(req.constraint_factory)
                 if key not in factory_room:
-                    factory_room[key] = constraint_room(
-                        req.constraint_factory()
-                    )
+                    try:
+                        factory_room[key] = constraint_room(
+                            req.constraint_factory()
+                        )
+                    except Exception:  # noqa: BLE001 — row isolation
+                        # a failing factory surfaces PER ROW at
+                        # materialization (retry/quarantine); the probe
+                        # only loses the schema-room truncation reserve
+                        logger.warning(
+                            "constraint probe failed at job start; "
+                            "surfacing per-row at admission",
+                            exc_info=True,
+                        )
+                        factory_room[key] = 1
                 need = factory_room[key]
             max_prompt = self.ecfg.max_context() - need
             if len(req.prompt_ids) > max_prompt:
@@ -1701,6 +1848,18 @@ class ContinuousBatcher:
                 else:
                     # schema minimum cannot fit the context at all —
                     # an explicit per-row error beats invalid JSON
+                    msg = (
+                        f"prompt of {len(req.prompt_ids)} tokens leaves "
+                        f"no room for generation (max context "
+                        f"{self.ecfg.max_context()}, reserve {need}) "
+                        "and truncate_rows is off"
+                    )
+                    if ctx.on_row_event is not None:
+                        ctx.on_row_event(
+                            {"event": "row_quarantined",
+                             "row_id": req.row_id, "attempt": 0,
+                             "error": msg}
+                        )
                     ctx.stats["rows"] += 1
                     ctx.on_result(
                         GenResult(
@@ -1709,6 +1868,7 @@ class ContinuousBatcher:
                             cumulative_logprob=0.0,
                             finish_reason="error_too_long",
                             input_tokens=len(req.prompt_ids),
+                            error=msg,
                         )
                     )
                     continue
@@ -1840,7 +2000,14 @@ class ContinuousBatcher:
                     if r is None:
                         break
                     ctx.pending.pop()
-                    self._materialize_constraint(req)
+                    try:
+                        self._materialize_constraint(req)
+                    except Exception as e:  # noqa: BLE001 — row isolation
+                        # a row whose FSM won't compile fails ALONE:
+                        # roll the reservation back and retry/quarantine
+                        self._unreserve(r[0], r[1])
+                        self._row_error(ctx, req, e)
+                        continue
                     # Sarathi-style: reserve now, prefill ONE chunk per
                     # scheduler iteration (_prefill_tick) so active rows
                     # keep decoding instead of stalling for the whole
@@ -1857,7 +2024,12 @@ class ContinuousBatcher:
                 if r is None:
                     break
                 ctx.pending.pop()
-                self._materialize_constraint(req)
+                try:
+                    self._materialize_constraint(req)
+                except Exception as e:  # noqa: BLE001 — row isolation
+                    self._unreserve(r[0], r[1])
+                    self._row_error(ctx, req, e)
+                    continue
                 batch.append((req, ctx) + r)
                 reserved_tokens += self._max_total(req)
                 reserved_idxs.add(r[0])
@@ -1970,6 +2142,17 @@ class ContinuousBatcher:
                         )
                         if ctx is not None:
                             req = ctx.pending.pop()
+                            msg = (
+                                "row cannot fit an empty machine: "
+                                f"prompt + max_new_tokens need more KV "
+                                "than the engine's total page pool"
+                            )
+                            if ctx.on_row_event is not None:
+                                ctx.on_row_event(
+                                    {"event": "row_quarantined",
+                                     "row_id": req.row_id, "attempt": 0,
+                                     "error": msg}
+                                )
                             ctx.stats["rows"] += 1
                             ctx.on_result(
                                 GenResult(
@@ -1978,6 +2161,7 @@ class ContinuousBatcher:
                                     cumulative_logprob=0.0,
                                     finish_reason="error_capacity",
                                     input_tokens=len(req.prompt_ids),
+                                    error=msg,
                                 )
                             )
                             self._sweep_done(live, on_job_done)
@@ -2234,6 +2418,8 @@ class ContinuousBatcher:
                     finished: List[int] = []
                     for i in active:
                         s = self.slots[i]
+                        if s is None:
+                            continue  # failed during mask assembly
                         c = s.req.constraint
                         for j in range(K):
                             tok = int(toks_w[j][i])
@@ -2251,7 +2437,12 @@ class ContinuousBatcher:
                                 rem = self._remaining(
                                     s.req, len(s.out_ids), s.pos
                                 )
-                                if not self._token_ok(c, tok, rem):
+                                try:
+                                    tok_ok = self._token_ok(c, tok, rem)
+                                except Exception as e:  # noqa: BLE001 — row isolation
+                                    self._fail_slot(i, e)
+                                    break
+                                if not tok_ok:
                                     # this row's NEXT window opens with
                                     # its FSM-masked step (allowed0) so
                                     # it crosses the scaffold token;
@@ -2259,11 +2450,14 @@ class ContinuousBatcher:
                                     # cadence
                                     self._needs_mask.add(i)
                                     break
-                            accepted[i] += 1
-                            if self._accept_token(
+                            rc = self._accept_token(
                                 i, tok, float(logps_w[j][i]),
                                 release=False,
-                            ):
+                            )
+                            if rc == 2:
+                                break  # row failed: token NOT committed
+                            accepted[i] += 1
+                            if rc:
                                 finished.append(i)
                                 break
                     # pages are still reserved for every row (releases
@@ -2363,6 +2557,8 @@ class ContinuousBatcher:
                     # rejected scaffold token
                     self._needs_mask.clear()
                     for i in active:
+                        if self.slots[i] is None:
+                            continue  # failed during mask assembly
                         self._accept_token(
                             i, int(toks[i]), float(logps[i])
                         )

@@ -487,11 +487,30 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
         reason = self._fetch_job(job_id).get("failure_reason") or {}
         return reason.get("message", "unknown")
 
-    def get_job_status(self, job_id: str) -> str:
+    def get_job_status(
+        self, job_id: str, with_failure_log: bool = False
+    ) -> Any:
+        """Job status string; with ``with_failure_log`` a dict
+        ``{"status", "failure_log"}`` — the engine's structured
+        retry/quarantine/terminal-failure trail (FAILURES.md)."""
         if self.backend == "remote":
             body = self._remote_json("get", f"job-status/{job_id}")
-            return body["job_status"][job_id]
-        return self.engine.job_status(job_id)
+            status = body["job_status"][job_id]
+        else:
+            status = self.engine.job_status(job_id)
+        if with_failure_log:
+            return {
+                "status": status,
+                "failure_log": self.get_job_failure_log(job_id),
+            }
+        return status
+
+    def get_job_failure_log(self, job_id: str) -> List[Dict[str, Any]]:
+        """Structured failure events for a job: per-row retries and
+        quarantines, transient-I/O retries, torn-chunk quarantines, and
+        terminal failures. Empty for clean jobs (and for jobs predating
+        the failure_log schema)."""
+        return self._fetch_job(job_id).get("failure_log") or []
 
     def list_jobs(self) -> List[Dict[str, Any]]:
         if self.backend == "remote":
