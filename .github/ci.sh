@@ -12,4 +12,7 @@ make lint
 # tier-1 gate: seeded chaos subset — deterministic fault injection must
 # keep reaching terminal states with partial-store consistency
 make chaos
+# tier-1 gate: telemetry — exporter golden file, flight-recorder
+# reconciliation, and the telemetry-on/off host-overhead budget
+make telemetry-check
 bash .github/run_tests_chunked.sh

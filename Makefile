@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: all native test test-oneshot test-fast compile-check lint lint-baseline \
-	chaos \
+	chaos telemetry-check \
 	bench bench-e2e dryrun chip-validate bench-8b cost golden host-profile clean
 
 all: native compile-check
@@ -58,6 +58,14 @@ lint-baseline:
 chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py -q -m "not slow" \
 		-p no:cacheprovider
+
+# telemetry gate (OBSERVABILITY.md): exporter golden-file + flight-
+# recorder/reconciliation tests, then the telemetry-on vs telemetry-off
+# host-overhead comparison (< 2% delta asserted in code). Tier-1 CI.
+telemetry-check:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_telemetry.py -q -m "not slow" \
+		-p no:cacheprovider
+	JAX_PLATFORMS=cpu $(PY) benchmarks/profile_host_overhead.py --telemetry
 
 # raw decode microbench (one JSON line; driver contract)
 bench:

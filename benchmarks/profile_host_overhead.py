@@ -179,6 +179,9 @@ def mk_ecfg(B):
 # (PERF.md round 4); the budget rule is host <= window x (lookahead-1)
 DEVICE_WINDOW_MS = 10.9
 FLAT_SCALING_MAX = 1.25
+# telemetry budget: instrumentation (spans + sharded counters) may add
+# at most 2% to the per-row host cost of the 512-row e2e leg
+TEL_OVERHEAD_MAX = 1.02
 
 
 def warm_admit_buckets(vocab: int, ecfg) -> None:
@@ -444,10 +447,215 @@ def run_e2e(assert_budget: bool) -> dict:
     return e2e
 
 
+def _unit_us(fn, n: int = 20000, reps: int = 3) -> float:
+    """Per-call cost of ``fn`` in microseconds: best-of-``reps``
+    tight loops (min damps scheduler preemption out of the loop)."""
+    import time as _time
+
+    best = float("inf")
+    for _ in range(reps):
+        t0 = _time.perf_counter()
+        for _ in range(n):
+            fn()
+        best = min(best, (_time.perf_counter() - t0) / n)
+    return best * 1e6
+
+
+# telemetry entry points priced + counted by run_telemetry_compare:
+# (class, method, count key) — every instrumented call site funnels
+# through one of these
+_TEL_OPS = (
+    ("registry", "Counter", "inc", "counter_inc"),
+    ("registry", "Gauge", "set", "gauge_set"),
+    ("registry", "Histogram", "observe", "hist_observe"),
+    ("spans", "FlightRecorder", "record", "recorder_record"),
+    ("spans", "JobCounters", "add", "jobctr_add"),
+    ("spans", "JobCounters", "set", "jobctr_set"),
+)
+
+
+def run_telemetry_compare(assert_budget: bool) -> dict:
+    """Telemetry-on vs telemetry-off host overhead on the 512-row e2e
+    leg, over one warm engine. Two numbers land in HOST_OVERHEAD.json:
+
+    - ``wall_ratio`` (informational): best-of-3 telemetry-on vs
+      best-of-3 telemetry-off wall us/row. On a shared CI box the
+      leg-to-leg wall spread is 10-70% — far above the 2% budget — so
+      this documents the end-to-end comparison but cannot gate it
+      (an off-only control run showed the same spread).
+    - ``overhead_ratio`` (asserted): deterministic accounting. One
+      counted on-leg records how many telemetry operations actually
+      fire (counter incs, gauge sets, histogram observes, flight-
+      recorder spans, per-job counter ops — every instrumented site
+      funnels through these six entry points); tight-loop
+      microbenchmarks price each op class plus the time.monotonic()
+      reads at span sites; added host cost per row is
+      sum(count x unit cost) / rows, and the budget rule asserts
+      (off + added) / off <= TEL_OVERHEAD_MAX against the best
+      off-leg. A counted OFF-leg must fire ZERO ops — "disabled means
+      no telemetry work" is asserted, not assumed.
+    """
+    import functools
+    import tempfile
+    import time as _time
+
+    import sutro_tpu.engine.api as api_mod
+    import sutro_tpu.telemetry as tel
+    import sutro_tpu.telemetry.registry as tel_registry
+    import sutro_tpu.telemetry.spans as tel_spans
+    from sutro_tpu.engine.config import EngineConfig
+    from sutro_tpu.models.configs import MODEL_CONFIGS
+
+    ecfg = EngineConfig(
+        kv_page_size=16,
+        max_pages_per_seq=32,
+        decode_batch_size=64,
+        max_model_len=512,
+        use_pallas=False,
+        param_dtype="float32",
+        decode_multi_step=16,
+        decode_lookahead=2,
+        max_new_tokens=32,
+    )
+    tmp = tempfile.mkdtemp(prefix="sutro-tel-profile-")
+    eng = _e2e_engine(tmp, ecfg)
+    warm_admit_buckets(MODEL_CONFIGS["tiny-dense"].vocab_size, ecfg)
+    _run_e2e_leg(eng, api_mod, 128, {}, max_new=32)  # warm leg
+
+    # -- unit costs on SCRATCH objects (never pollutes live series) ----
+    sreg = tel.MetricsRegistry()
+    sc = sreg.counter("bench_counter", labels=("outcome",))
+    sg = sreg.gauge("bench_gauge")
+    sh = sreg.histogram("bench_hist", labels=("stage",))
+    srec = tel.FlightRecorder(capacity=4096)
+    sjc = tel.JobCounters("bench")
+    unit_us = {
+        "counter_inc": _unit_us(lambda: sc.inc(1.0, "ok")),
+        "gauge_set": _unit_us(lambda: sg.set(1234.5)),
+        "hist_observe": _unit_us(lambda: sh.observe(0.0031, "decode_window")),
+        # record priced WITH a small attrs dict, matching the
+        # scheduler's batch-wide span sites
+        "recorder_record": _unit_us(
+            lambda: srec.record(
+                "decode_window", None, 0.0, 0.003, {"jobs": ("a", "b")}
+            )
+        ),
+        "jobctr_add": _unit_us(lambda: sjc.add("rows_ok")),
+        "jobctr_set": _unit_us(lambda: sjc.set("input_tokens", 123.0)),
+        "monotonic": _unit_us(_time.monotonic),
+    }
+
+    # -- wall legs (informational) -------------------------------------
+    legs: dict = {"off": [], "on": []}
+    was_enabled = tel.enabled()
+    mods = {"registry": tel_registry, "spans": tel_spans}
+    counts = {key: 0 for _, _, _, key in _TEL_OPS}
+    try:
+        for _ in range(3):
+            for mode, on in (("off", False), ("on", True)):
+                tel.set_enabled(on)
+                legs[mode].append(
+                    _run_e2e_leg(eng, api_mod, 512, {}, max_new=32)
+                )
+
+        # -- counted legs: op census on, zero-work check off ----------
+        restore = []
+        for mod, cls_name, meth, key in _TEL_OPS:
+            cls = getattr(mods[mod], cls_name)
+            orig = getattr(cls, meth)
+
+            def wrap(orig=orig, key=key):
+                @functools.wraps(orig)
+                def counting(self, *a, **kw):
+                    counts[key] += 1
+                    return orig(self, *a, **kw)
+
+                return counting
+
+            setattr(cls, meth, wrap())
+            restore.append((cls, meth, orig))
+        try:
+            tel.set_enabled(True)
+            _run_e2e_leg(eng, api_mod, 512, {}, max_new=32)
+            _time.sleep(0.25)  # let the worker's finally-block gauge land
+            on_counts = dict(counts)
+            for key in counts:
+                counts[key] = 0
+            tel.set_enabled(False)
+            _run_e2e_leg(eng, api_mod, 512, {}, max_new=32)
+            _time.sleep(0.25)
+            off_counts = dict(counts)
+        finally:
+            for cls, meth, orig in restore:
+                setattr(cls, meth, orig)
+    finally:
+        tel.set_enabled(was_enabled)
+
+    best = {
+        m: min(ls, key=lambda leg: leg["us_per_row"])
+        for m, ls in legs.items()
+    }
+    # span sites read the clock around the timed region: ~2 monotonic
+    # reads per recorded span, 1 per bare histogram observe
+    ops_us = sum(on_counts[k] * unit_us[k] for k in on_counts)
+    ops_us += (
+        2 * on_counts["recorder_record"] + on_counts["hist_observe"]
+    ) * unit_us["monotonic"]
+    added_us_per_row = ops_us / 512.0
+    off_us = best["off"]["us_per_row"]
+    ratio = (off_us + added_us_per_row) / off_us
+    wall_ratio = best["on"]["us_per_row"] / off_us
+    off_ops = sum(off_counts.values())
+    out = {
+        "off_us_per_row": off_us,
+        "on_us_per_row": best["on"]["us_per_row"],
+        "wall_ratio": round(wall_ratio, 4),
+        "off_host_ms_per_window": best["off"]["host_ms_per_window"],
+        "on_host_ms_per_window": best["on"]["host_ms_per_window"],
+        "op_counts": on_counts,
+        "op_unit_us": {k: round(v, 3) for k, v in unit_us.items()},
+        "added_us_per_row": round(added_us_per_row, 2),
+        "off_leg_ops_fired": off_ops,
+        "overhead_ratio": round(ratio, 4),
+        "budget_ratio": TEL_OVERHEAD_MAX,
+        "ok": bool(ratio <= TEL_OVERHEAD_MAX and off_ops == 0),
+    }
+    if assert_budget:
+        assert off_ops == 0, (
+            f"telemetry-off leg still fired ops: {off_counts} — "
+            "disabled must mean no telemetry work"
+        )
+        assert ratio <= TEL_OVERHEAD_MAX, (
+            f"telemetry adds {added_us_per_row:.1f} us/row "
+            f"({sum(on_counts.values())} ops) on a {off_us} us/row "
+            f"baseline (ratio {ratio:.4f} > {TEL_OVERHEAD_MAX})"
+        )
+    return out
+
+
 def main() -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")  # rng keys only
+
+    if "--telemetry" in sys.argv:
+        # fast standalone gate (make telemetry-check): only the
+        # telemetry-on/off comparison; merge into HOST_OVERHEAD.json
+        # without clobbering the full profile
+        tel = run_telemetry_compare(
+            assert_budget="--no-assert" not in sys.argv
+        )
+        path = REPO / "HOST_OVERHEAD.json"
+        base = {}
+        if path.exists():
+            try:
+                base = json.loads(path.read_text())
+            except ValueError:
+                base = {}
+        base["telemetry"] = tel
+        path.write_text(json.dumps(base, indent=2) + "\n")
+        print(json.dumps({"telemetry_overhead": tel}))
+        return
 
     from sutro_tpu.engine.config import EngineConfig
     from sutro_tpu.engine.scheduler import ContinuousBatcher, GenRequest
@@ -567,6 +775,9 @@ def main() -> None:
 
     if "--e2e" in sys.argv:
         out["e2e"] = run_e2e(
+            assert_budget="--no-assert" not in sys.argv
+        )
+        out["telemetry"] = run_telemetry_compare(
             assert_budget="--no-assert" not in sys.argv
         )
 

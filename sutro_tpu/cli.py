@@ -110,6 +110,60 @@ def serve(host: str, port: int, quiet: bool) -> None:
 
 
 @cli.command()
+@click.option("--job", "job_id", default=None,
+              help="Per-job span timeline + counters instead of the "
+              "process-wide metrics snapshot")
+@click.option("--json", "as_json", is_flag=True,
+              help="Raw JSON instead of rendered output")
+def telemetry(job_id: Optional[str], as_json: bool) -> None:
+    """Engine telemetry: live metrics snapshot, or one job's flight-
+    recorder timeline with --job (OBSERVABILITY.md)."""
+    sdk = get_sdk()
+    if job_id is None:
+        if as_json:
+            from .telemetry import REGISTRY
+
+            if sdk.backend == "remote":
+                # remote registry is only exposed as prometheus text;
+                # render that verbatim
+                click.echo(sdk.get_metrics_text())
+            else:
+                click.echo(json.dumps(REGISTRY.to_json(), indent=2))
+        else:
+            click.echo(sdk.get_metrics_text(), nl=False)
+        return
+    doc = sdk.get_job_telemetry(job_id)
+    if as_json:
+        click.echo(json.dumps(doc, indent=2))
+        return
+    click.echo(to_colored_text(f"job {doc.get('job_id')}", "callout"))
+    counters = doc.get("counters") or {}
+    if counters:
+        click.echo("counters:")
+        for k, v in sorted(counters.items()):
+            click.echo(f"  {k} = {v}")
+    spans = doc.get("spans") or []
+    click.echo(f"timeline ({len(spans)} span(s)):")
+    rows = [
+        {
+            "t0_ms": round(1e3 * s["t0_s"], 1),
+            "dur_ms": round(1e3 * s["dur_s"], 3),
+            "stage": s["name"],
+            "attrs": json.dumps(s.get("attrs") or {})[:48],
+        }
+        for s in spans[-60:]
+    ]
+    if rows:
+        click.echo(
+            tabulate(rows, headers="keys", tablefmt="rounded_outline")
+        )
+    if len(spans) > 60:
+        click.echo(
+            to_colored_text(f"(+ {len(spans) - 60} earlier)", "callout")
+        )
+
+
+@cli.command()
 def quotas() -> None:
     """Show per-priority row/token quotas (reference cli.py:398-416)."""
     rows = get_sdk().get_quotas()

@@ -21,6 +21,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 import warnings
 import traceback
 from typing import Any, Dict, Iterator, List, Optional, Tuple
@@ -29,6 +30,7 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
+from .. import telemetry
 from ..common import MODEL_CATALOG
 from ..interfaces import JobStatus
 from ..models.configs import MODEL_CONFIGS, ModelConfig
@@ -527,6 +529,30 @@ class LocalEngine:
     def try_authentication(self) -> Dict[str, Any]:
         return {"authenticated": True}  # local engine needs no key
 
+    def job_telemetry(
+        self, job_id: str, write: bool = True
+    ) -> Dict[str, Any]:
+        """Per-job telemetry document: the flight recorder's span
+        timeline for this job plus its exact counters (rows by outcome,
+        tokens in/out). ``write`` persists it as
+        ``jobs/<job_id>/telemetry.json`` (the same artifact the engine
+        dumps automatically when a job FAILs). Falls back to a
+        previously persisted dump when this process has no live state
+        for the job (engine restarted)."""
+        self.jobs.get(job_id)  # KeyError -> 404 upstream if unknown
+        doc = telemetry.job_doc(job_id)
+        if not doc["spans"] and not doc["counters"]:
+            persisted = telemetry.load_job_dump(self.jobs._dir(job_id))
+            if persisted is not None:
+                return persisted
+        if write and telemetry.enabled():
+            telemetry.dump_job(self.jobs._dir(job_id), job_id)
+        return doc
+
+    def _dump_telemetry(self, job_id: str) -> None:
+        """Flight-recorder postmortem on job failure (best-effort)."""
+        telemetry.dump_job(self.jobs._dir(job_id), job_id)
+
     # ------------------------------------------------------------------
     # Worker
     # ------------------------------------------------------------------
@@ -599,6 +625,8 @@ class LocalEngine:
                 self._queued.discard(job_id)
                 self._queued_prio.pop(job_id, None)
                 self._current_job = job_id
+            if telemetry.enabled():
+                telemetry.JOBS_RUNNING.set(1 + len(self._attached))
             requeue_priority = None
             try:
                 if job_id in self._cancel:
@@ -622,6 +650,9 @@ class LocalEngine:
                     )
                 except Exception:
                     pass
+                # crash-time postmortem: the job's span timeline +
+                # counters land next to its failure_log[]
+                self._dump_telemetry(job_id)
             finally:
                 if requeue_priority is None:
                     # finish metrics BEFORE releasing _current_job:
@@ -639,6 +670,8 @@ class LocalEngine:
                     self._enqueue(requeue_priority, job_id)
                 with self._lock:
                     self._current_job = None
+                if telemetry.enabled():
+                    telemetry.JOBS_RUNNING.set(len(self._attached))
 
     def _run_job(self, job_id: str) -> Optional[int]:
         """Run one job to a terminal state. Returns None normally, or
@@ -825,6 +858,7 @@ class LocalEngine:
                     )
                 except Exception:
                     pass
+                self._dump_telemetry(jid)
                 self.metrics.job(jid).finish()
                 with self._lock:
                     self._attached.discard(jid)
@@ -943,6 +977,7 @@ class LocalEngine:
                     )
                 except Exception:
                     pass
+                self._dump_telemetry(jid2)
                 self.metrics.job(jid2).finish()
                 with self._lock:
                     self._attached.discard(jid2)
@@ -996,6 +1031,26 @@ class LocalEngine:
                     dp, job_key=job_key, done_rows=done_rows
                 )
                 return "completed"
+            if telemetry.enabled():
+                with telemetry.RECORDER.span(
+                    "dp_round", job_id, world=dp.world,
+                    shard_rows=len(shard),
+                ):
+                    t0 = time.monotonic()
+                    try:
+                        return run_dp_coordinator(
+                            dp, run_shard, shard,
+                            on_result=on_result,
+                            on_progress=on_progress,
+                            should_cancel=should_cancel,
+                            job_key=job_key,
+                            done_rows=done_rows,
+                            on_row_event=on_row_event,
+                        )
+                    finally:
+                        telemetry.stage_observe(
+                            "dp_round", time.monotonic() - t0
+                        )
             return run_dp_coordinator(
                 dp, run_shard, shard,
                 on_result=on_result,
@@ -1083,7 +1138,13 @@ class LocalEngine:
 
         row_progress = BatchedProgress(jm, every_rows=bs)
 
+        tel_on = telemetry.enabled()
+        jtel = telemetry.job(job_id) if tel_on else None
+
         def record_result(r: "EmbResult") -> None:
+            if tel_on:
+                jtel.add("rows_ok")
+                telemetry.ROWS_TOTAL.inc(1.0, "ok")
             results[r.row_id] = r.vector
             pending_flush.append(
                 {"row_id": r.row_id, "outputs": r.vector,
@@ -1126,9 +1187,16 @@ class LocalEngine:
                 if should_yield and should_yield():
                     return "yielded"
                 grp = pairs[off : off + bs]
+                t0e = _time.monotonic() if tel_on else 0.0
                 emb = runner.embed_batch(
                     [list(map(int, ids)) for _, ids in grp]
                 )
+                if tel_on:
+                    dte = _time.monotonic() - t0e
+                    telemetry.stage_observe("embed", dte)
+                    telemetry.RECORDER.record(
+                        "embed", job_id, t0e, dte, {"rows": len(grp)}
+                    )
                 for (i, ids), vec in zip(grp, emb.tolist()):
                     on_result(EmbResult(row_id=i, vector=vec))
                     done_n += 1
@@ -1200,6 +1268,10 @@ class LocalEngine:
         flush()
         row_progress.flush(len(results))  # terminal count always lands
         input_tokens = int(sum(len(r) for r in token_rows))
+        if tel_on:
+            jtel.set("input_tokens", input_tokens)
+            jtel.set("output_tokens", 0)
+            telemetry.TOKENS_TOTAL.inc(float(input_tokens), "in")
         self.jobs.update(
             job_id,
             input_tokens=input_tokens,
@@ -1303,11 +1375,21 @@ class _GenSession:
         # Row-level failure domain: if the batched pass raises, fall
         # back to per-row encodes and QUARANTINE only the failing rows
         # (``tokenizer.encode`` fault site) instead of failing the job.
+        self._tel_on = telemetry.enabled()
+        self.jtel = telemetry.job(job_id) if self._tel_on else None
         self.pre_quarantined: Dict[int, str] = {}
+        t_tok = time.monotonic()
         self.token_rows = [
             np.array(ids, np.int32)
             for ids in self._encode_rows(inputs, rec, mcfg)
         ]
+        if self._tel_on:
+            # span only: the latency histogram sample comes from
+            # encode_chat_batch itself (one sample per batched encode)
+            telemetry.RECORDER.record(
+                "tokenize", job_id, t_tok,
+                time.monotonic() - t_tok, {"rows": len(inputs)},
+            )
         self.input_tokens = int(sum(len(r) for r in self.token_rows))
 
         constraint_factory = None
@@ -1346,6 +1428,9 @@ class _GenSession:
                 {"event": "row_quarantined", "row_id": i,
                  "attempt": 0, "error": msg}
             )
+            if self._tel_on:
+                self.jtel.add("rows_quarantined")
+                telemetry.ROWS_TOTAL.inc(1.0, "quarantined")
 
         import jax
 
@@ -1544,6 +1629,16 @@ class _GenSession:
             res.finish_reason.startswith("error") else "error",
             "error": err,
         }
+        if self._tel_on:
+            # exact per-job accounting (reconciles against results):
+            # quarantined beats cancelled beats ok
+            outcome = (
+                "quarantined" if err is not None
+                else "cancelled" if res.finish_reason == "cancelled"
+                else "ok"
+            )
+            self.jtel.add(f"rows_{outcome}")
+            telemetry.ROWS_TOTAL.inc(1.0, outcome)
         self.done[res.row_id] = row["finish_reason"]
         self.pending_flush.append(row)
         if len(self.pending_flush) >= _PARTIAL_FLUSH_EVERY:
@@ -1555,7 +1650,16 @@ class _GenSession:
 
     def on_progress(self, p: Dict[str, Any]) -> None:
         self.row_progress.flush(len(self.done))
-        self.tput.total = p["input_tokens"] + p["output_tokens"]
+        self.tput.note_total(p["input_tokens"] + p["output_tokens"])
+        if self._tel_on:
+            # the Throughput estimator folded into registry gauges
+            # (same per-chip division the progress stream reports)
+            telemetry.TOKENS_PER_SECOND.set(
+                p["total_tokens_processed_per_second"]
+            )
+            telemetry.TOKENS_PER_SECOND_PER_CHIP.set(
+                p["total_tokens_processed_per_second"] / self.n_chips
+            )
         self.jm.tokens(
             {
                 "input_tokens": p["input_tokens"],
@@ -1628,6 +1732,11 @@ class _GenSession:
             # FSM fast-forward: scaffold tokens committed through
             # parallel verify forwards instead of per-step windows
             perf["fastforward"] = {"forced_tokens": ff}
+        if self._tel_on:
+            self.jtel.set("input_tokens", self.input_tokens)
+            self.jtel.set("output_tokens", output_tokens)
+            telemetry.TOKENS_TOTAL.inc(float(self.input_tokens), "in")
+            telemetry.TOKENS_TOTAL.inc(float(output_tokens), "out")
         self.eng.jobs.update(
             self.job_id,
             input_tokens=self.input_tokens,

@@ -73,10 +73,18 @@ import threading
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
+from .. import telemetry
 from . import faults
 from .scheduler import GenRequest, GenResult
 
 logger = logging.getLogger(__name__)
+
+
+def _dp_event(kind: str) -> None:
+    """Coordinator-liveness event counter (reconnect / stall / reject /
+    fault_forwarded) — the dp channel's registry surface."""
+    if telemetry.ENABLED:
+        telemetry.DP_EVENTS_TOTAL.inc(1.0, kind)
 
 # worker engines may still be initializing/compiling when the
 # coordinator starts listening — generous by design (a loaded CI box
@@ -605,6 +613,7 @@ def run_dp_coordinator(
                 elif t == "fault":
                     # a worker rank's row retry/quarantine: record it on
                     # the authoritative (coordinator) failure_log
+                    _dp_event("fault_forwarded")
                     if on_row_event is not None:
                         try:
                             on_row_event(m.get("ev") or {})
@@ -695,6 +704,7 @@ def run_dp_coordinator(
                         or first.get("job", "") != job_key
                         or not (1 <= rank < world.world)
                     ):
+                        _dp_event("reject")
                         try:
                             _send(conn, {"t": "reject"})
                         except OSError:
@@ -733,6 +743,7 @@ def run_dp_coordinator(
                     last_msg[rank] = _time.monotonic()
                     state_cv.notify_all()
                 if prev is not None:
+                    _dp_event("reconnect")
                     try:
                         prev.close()
                     except OSError:
@@ -770,6 +781,7 @@ def run_dp_coordinator(
     watchdog_stop = threading.Event()
 
     def _mark_stalled(r: int) -> None:
+        _dp_event("stall")
         with state_cv:
             if r in rank_status:
                 return  # terminal beat the timeout
@@ -896,8 +908,28 @@ def run_dp_coordinator(
         for c in conns:
             c.close()
         listener.close()
+        # Wake a blocked acceptor AFTER the close: a thread inside
+        # ``listener.accept()`` holds a kernel reference to the
+        # listening socket for the duration of its poll, so close()
+        # alone leaves the PORT bound until the poll wakes (up to
+        # _ACCEPT_TIMEOUT_S) — and this process's NEXT dp round then
+        # fails its create_server with EADDRINUSE (observed as a
+        # test_dphost flake: generation round, then embed round on the
+        # same port). The self-connect reaches the still-alive kernel
+        # socket, the woken accept retries on the closed fd, gets
+        # EBADF, and the acceptor exits — releasing the port. If the
+        # acceptor already exited, the connect is refused and ignored.
+        try:
+            _hard_close(
+                socket.create_connection(
+                    (world.host, world.port), timeout=1.0
+                )
+            )
+        except OSError:
+            logger.debug("acceptor wake connect failed", exc_info=True)
         # closing the conns EOFs the serve threads; a bounded join keeps
         # them from mutating rank_status/prog after this function
         # returns (they are daemon, so a hung one cannot wedge exit)
         for st in serve_threads:
             st.join(timeout=5.0)
+        acceptor.join(timeout=5.0)

@@ -279,7 +279,15 @@ def fire(
     plan = ACTIVE
     if plan is None:
         return None
-    return plan.fire(site, row=row, job=job)
+    spec = plan.fire(site, row=row, job=job)
+    if spec is not None:
+        # count OUTSIDE the plan lock; imported lazily so the zero-
+        # overhead guarantee for plan-off engines never pays an import
+        from .. import telemetry
+
+        if telemetry.ENABLED:
+            telemetry.FAULTS_INJECTED_TOTAL.inc(1.0, site)
+    return spec
 
 
 def inject(
@@ -326,6 +334,14 @@ def retry_transient(
         except retry_on as e:
             if attempt + 1 >= attempts:
                 raise
+            from .. import telemetry
+
+            if telemetry.ENABLED:
+                # label = the operation class, never the bracketed job
+                # id (fixed cardinality)
+                telemetry.IO_RETRIES_TOTAL.inc(
+                    1.0, what.split("[", 1)[0]
+                )
             delay = backoff_delay(attempt, base, cap, key=what)
             if on_retry is not None:
                 try:

@@ -33,6 +33,7 @@ import datetime
 import json
 import logging
 import threading
+import time
 import uuid
 from pathlib import Path
 from typing import Any, Dict, List, Optional
@@ -41,6 +42,7 @@ import pandas as pd
 
 logger = logging.getLogger(__name__)
 
+from .. import telemetry
 from ..interfaces import JobStatus
 from ..validation import config_dir
 from . import faults
@@ -239,6 +241,12 @@ class JobStore:
         if status.is_terminal():
             fields.setdefault("datetime_completed", _now())
         self.update(job_id, **fields)
+        if telemetry.ENABLED and status in (
+            JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.CANCELLED
+        ):
+            # terminal TRANSITIONS (a resumed-then-failed job counts
+            # twice — each is a real lifecycle event)
+            telemetry.JOBS_TOTAL.inc(1.0, status.value.lower())
 
     def status(self, job_id: str) -> JobStatus:
         return JobStatus(self.get(job_id).status)
@@ -252,6 +260,12 @@ class JobStore:
         recovery must never itself become a new failure. ``ts`` is
         stamped here so callers only describe the event."""
         ev = {"ts": _now(), **event}
+        if telemetry.ENABLED:
+            # the single funnel every retry/quarantine/terminal event
+            # passes through — one counter covers them all
+            telemetry.ROW_EVENTS_TOTAL.inc(
+                1.0, str(event.get("event", "unknown"))
+            )
         try:
             # inline RMW (``update`` would re-take the non-reentrant
             # store lock); the record write IS the critical section
@@ -352,6 +366,7 @@ class JobStore:
         half-landed attempt is harmless."""
         if not rows:
             return
+        t0 = time.monotonic()
         retry_transient(
             lambda: self._flush_partial_once(job_id, rows),
             attempts=self.io_retries,
@@ -366,6 +381,12 @@ class JobStore:
             ),
             what=f"flush_partial[{job_id}]",
         )
+        if telemetry.ENABLED:
+            dt = time.monotonic() - t0
+            telemetry.stage_observe("flush", dt)
+            telemetry.RECORDER.record(
+                "flush", job_id, t0, dt, {"rows": len(rows)}
+            )
 
     def _flush_partial_once(
         self, job_id: str, rows: List[Dict[str, Any]]
@@ -498,10 +519,17 @@ class JobStore:
         Materializes the whole frame — kept for the embedding path
         (vector-valued outputs); generation jobs use
         ``write_results_streamed``."""
+        t0 = time.monotonic()
         df = pd.DataFrame(results)
         tmp = self._dir(job_id) / "results.parquet.tmp"
         df.to_parquet(tmp)
         tmp.replace(self._dir(job_id) / "results.parquet")
+        if telemetry.ENABLED:
+            dt = time.monotonic() - t0
+            telemetry.stage_observe("finalize", dt)
+            telemetry.RECORDER.record(
+                "finalize", job_id, t0, dt, {"rows": len(df)}
+            )
         self.set_status(job_id, JobStatus.SUCCEEDED)
 
     # generation result schema: one definition so every row-group of a
@@ -545,6 +573,7 @@ class JobStore:
         (bounded, backed off), so ``on_chunk`` observers must reset
         when they see the bucket starting at row 0 again.
         """
+        t0 = time.monotonic()
         retry_transient(
             lambda: self._write_results_streamed_once(
                 job_id, num_rows, on_chunk
@@ -561,6 +590,12 @@ class JobStore:
             ),
             what=f"finalize[{job_id}]",
         )
+        if telemetry.ENABLED:
+            dt = time.monotonic() - t0
+            telemetry.stage_observe("finalize", dt)
+            telemetry.RECORDER.record(
+                "finalize", job_id, t0, dt, {"rows": num_rows}
+            )
 
     def _write_results_streamed_once(
         self,

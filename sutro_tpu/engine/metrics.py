@@ -153,18 +153,47 @@ class MetricsBus:
 
 
 class Throughput:
-    """Per-chip tokens/sec estimator (BASELINE.md tracked metric)."""
+    """Per-chip tokens/sec estimator (BASELINE.md tracked metric).
+
+    The clock anchors at :meth:`start` — implicitly the first
+    :meth:`add`/:meth:`note_total` — NOT at construction: a session
+    builds its estimator before tokenize/compile/prefill, and counting
+    that dead time deflated early readings after a long compile (the
+    rate then crept up for the whole job instead of being honest from
+    the first window)."""
 
     def __init__(self, n_chips: int = 1):
         self.n_chips = max(n_chips, 1)
-        self.t0 = time.monotonic()
+        self.t0: "float | None" = None
         self.total = 0
+        self._base = 0  # total already accounted when the clock anchored
+
+    def start(self) -> None:
+        """Anchor the rate clock now (idempotent)."""
+        if self.t0 is None:
+            self.t0 = time.monotonic()
 
     def add(self, tokens: int) -> None:
+        self.start()
         self.total += tokens
 
+    def note_total(self, total: int) -> None:
+        """Replace the running total with an externally accounted
+        cumulative count (the progress stream's in+out totals). The
+        first report anchors the clock AND the baseline, so the rate
+        measures tokens per second *since the anchor* instead of
+        dividing a pre-anchor backlog by epsilon."""
+        if self.t0 is None:
+            self.start()
+            self._base = int(total)
+        self.total = int(total)
+
     def per_second(self) -> float:
-        return self.total / max(time.monotonic() - self.t0, 1e-9)
+        if self.t0 is None:
+            return 0.0
+        return (self.total - self._base) / max(
+            time.monotonic() - self.t0, 1e-9
+        )
 
     def per_chip_per_second(self) -> float:
         return self.per_second() / self.n_chips

@@ -40,10 +40,17 @@ def job_trace(profile_dir: Optional[str], job_id: str) -> Iterator[None]:
 
 
 class StepTimer:
-    """Wall-clock step latencies by phase ("prefill" / "decode")."""
+    """Wall-clock step latencies by phase ("prefill" / "decode").
 
-    def __init__(self) -> None:
+    ``sink`` (optional) forwards every sample as ``sink(phase, t0,
+    seconds)`` the moment it lands — the telemetry layer's single tap
+    into ALL device-dispatch phases (scheduler sets it to a span/
+    histogram recorder when telemetry is enabled; None costs one
+    attribute load per sample)."""
+
+    def __init__(self, sink: Optional[Any] = None) -> None:
         self._samples: Dict[str, List[float]] = {}
+        self.sink = sink
 
     @contextlib.contextmanager
     def time(self, phase: str) -> Iterator[None]:
@@ -51,12 +58,15 @@ class StepTimer:
         try:
             yield
         finally:
-            self._samples.setdefault(phase, []).append(
-                time.monotonic() - t0
-            )
+            dt = time.monotonic() - t0
+            self._samples.setdefault(phase, []).append(dt)
+            if self.sink is not None:
+                self.sink(phase, t0, dt)
 
     def add(self, phase: str, seconds: float) -> None:
         self._samples.setdefault(phase, []).append(seconds)
+        if self.sink is not None:
+            self.sink(phase, time.monotonic() - seconds, seconds)
 
     def summary(self) -> Dict[str, Dict[str, Any]]:
         out: Dict[str, Dict[str, Any]] = {}

@@ -40,7 +40,17 @@ logger = logging.getLogger(__name__)
 from . import faults
 from .kvcache import PageAllocator, pages_needed
 from .runner import ModelRunner, next_bucket
+from .. import telemetry
 from ..ops.sampling import cumulative_logprob, sample as device_sample
+
+# StepTimer phase -> telemetry stage (OBSERVABILITY.md span schema):
+# the timer wraps DEVICE dispatches, so its phases map onto the
+# device-side stages of the flight-recorder timeline
+_TEL_STAGE = {
+    "prefill": "prefill",
+    "decode": "decode_window",
+    "admit_sample": "admit",
+}
 
 
 @jax.jit
@@ -413,7 +423,24 @@ class ContinuousBatcher:
         self.prep_rows_overlapped = 0
         from .profiling import StepTimer
 
-        self.timer = StepTimer()
+        # telemetry latch (one decision per batcher, zero per-step cost
+        # when off): the timer sink feeds every device-dispatch phase
+        # into the stage histogram + flight recorder; _tel_jobs carries
+        # the live co-batched job ids so batch-wide spans are
+        # attributable per job
+        self._tel_on = telemetry.enabled()
+        self._tel_jobs: Tuple[str, ...] = ()
+        self.timer = StepTimer(
+            sink=self._tel_sink if self._tel_on else None
+        )
+
+    def _tel_sink(self, phase: str, t0: float, dt: float) -> None:
+        stage = _TEL_STAGE.get(phase, phase)
+        telemetry.stage_observe(stage, dt)
+        telemetry.RECORDER.record(
+            stage, None, t0, dt,
+            {"jobs": self._tel_jobs} if self._tel_jobs else None,
+        )
 
     # ------------------------------------------------------------------
 
@@ -591,7 +618,15 @@ class ContinuousBatcher:
             return
         t0 = time.perf_counter()
         req.constraint = req.constraint_factory()
-        self.prep_inline_s += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self.prep_inline_s += dt
+        if self._tel_on:
+            telemetry.stage_observe("constraint_compile", dt)
+            telemetry.RECORDER.record(
+                "constraint_compile", None, time.monotonic() - dt, dt,
+                {"jobs": self._tel_jobs, "row": req.row_id}
+                if self._tel_jobs else {"row": req.row_id},
+            )
 
     def _prep_worker(self, q) -> None:
         while True:
@@ -614,7 +649,12 @@ class ContinuousBatcher:
             except Exception:
                 logger.exception("admission prep failed; admission "
                                  "will rebuild inline")
-            self.prep_overlap_s += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self.prep_overlap_s += dt
+            if self._tel_on:
+                # overlapped builds hide behind device windows but are
+                # still real work on the timeline
+                telemetry.stage_observe("constraint_compile", dt)
 
     def _prep_pump(self, order: List["JobCtx"]) -> None:
         """Queue the NEXT admission group's lazy constraints for the
@@ -1665,6 +1705,7 @@ class ContinuousBatcher:
         with self.timer.time("decode"):
             toks = np.asarray(toks_dev)
             logps = np.asarray(logps_dev)
+        t_acc = time.monotonic() if self._tel_on else 0.0
         plain: List[int] = []
         rest: List[int] = []
         for idx, i in enumerate(w_active):
@@ -1690,6 +1731,19 @@ class ContinuousBatcher:
                 self._accept_token(
                     i, int(toks[j][i]), float(logps[j][i])
                 )
+        if self._tel_on:
+            self._tel_accept(t_acc)
+
+    def _tel_accept(self, t0: float) -> None:
+        """Record the host-side token-acceptance leg of one window as
+        an ``accept`` span (the decode span covers only the device
+        dispatch/fetch)."""
+        dt = time.monotonic() - t0
+        telemetry.stage_observe("accept", dt)
+        telemetry.RECORDER.record(
+            "accept", None, t0, dt,
+            {"jobs": self._tel_jobs} if self._tel_jobs else None,
+        )
 
     def _accept_plain_window(
         self, idxs: List[int], toks: np.ndarray, logps: np.ndarray,
@@ -2095,6 +2149,11 @@ class ContinuousBatcher:
                             self._suspend_job(ctx)
                     return "yielded"
                 ajobs = [c for c in live if not c.done]
+                if self._tel_on:
+                    # batch-wide spans (prefill/decode/accept) carry the
+                    # live job ids; a tuple rebuild per iteration is a
+                    # few hundred ns against a multi-ms device window
+                    self._tel_jobs = tuple(c.job_id for c in ajobs)
                 if not ajobs:
                     break
                 order = sorted(
@@ -2414,6 +2473,7 @@ class ContinuousBatcher:
                             )
                         )
                     self._step += K
+                    t_acc = time.monotonic() if self._tel_on else 0.0
                     accepted = np.zeros((self.B,), np.int32)
                     finished: List[int] = []
                     for i in active:
@@ -2460,6 +2520,8 @@ class ContinuousBatcher:
                             if rc:
                                 finished.append(i)
                                 break
+                    if self._tel_on:
+                        self._tel_accept(t_acc)
                     # pages are still reserved for every row (releases
                     # were deferred), so the accepted K/V lands safely
                     with self.timer.time("decode"):

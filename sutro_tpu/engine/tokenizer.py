@@ -21,7 +21,10 @@ Both expose ``token_bytes(id)`` so the constrained-decoding FSM
 from __future__ import annotations
 
 import os
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
+
+from .. import telemetry
 
 
 def _gpt2_byte_decoder() -> Dict[str, int]:
@@ -324,7 +327,27 @@ def encode_chat_batch(
     rows = list(rows)
     if not rows:
         return []
+    if telemetry.ENABLED:
+        # batch-granular (never per row): row volume through the batched
+        # tokenize path, plus its latency histogram below
+        telemetry.TOKENIZE_ROWS_TOTAL.inc(float(len(rows)))
+        t0 = time.monotonic()
+        try:
+            return _encode_chat_batch(tok, rows, system, template, threads)
+        finally:
+            telemetry.stage_observe(
+                "tokenize", time.monotonic() - t0
+            )
+    return _encode_chat_batch(tok, rows, system, template, threads)
 
+
+def _encode_chat_batch(
+    tok: BaseTokenizer,
+    rows: List[str],
+    system: Optional[str],
+    template: str,
+    threads: int = 0,
+) -> List[List[int]]:
     def _batched(texts: List[str]) -> List[List[int]]:
         if threads > 1 and len(texts) >= 2 * threads:
             from concurrent.futures import ThreadPoolExecutor

@@ -28,6 +28,7 @@ import contextlib
 import logging
 import os
 import uuid
+from datetime import datetime, timezone
 from typing import Any, Callable, Dict, List, Optional
 
 logger = logging.getLogger("sutro.observability")
@@ -146,7 +147,7 @@ def _complete_batch_traces(
                         }
                     }
                 },
-                "end_time": __import__("datetime").datetime.utcnow(),
+                "end_time": datetime.now(timezone.utc),
             }
             for i, out in enumerate(outputs)
         ]

@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .moe import _act, _grouped, _route
+from .shard_compat import shard_map as _shard_map
 
 
 def moe_mlp_ep(
@@ -116,8 +117,11 @@ def moe_mlp_ep(
             # slices per shard), but bias_down lands on the unsharded H
             # output — every model shard would add it, so pre-divide by
             # the axis size to survive the psum intact
+            # axis size via psum(1): works on every jax version (the
+            # top-level jax.lax.axis_size helper is newer than some
+            # hosts' pins) and folds to a constant under shard_map
             y = y + (
-                bd[s_eidx] / jax.lax.axis_size("model")
+                bd[s_eidx] / jax.lax.psum(1, "model")
             ).astype(y.dtype)
         y = y * s_weight[:, None].astype(y.dtype)
         out = jnp.zeros((N, H), y.dtype).at[s_token].add(y)
@@ -127,7 +131,7 @@ def moe_mlp_ep(
         return out.reshape(Bl, Tl, H)
 
     opt = lambda spec, v: None if v is None else spec  # noqa: E731
-    fn = jax.shard_map(
+    fn = _shard_map(
         body,
         mesh=mesh,
         in_specs=(

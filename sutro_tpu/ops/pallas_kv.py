@@ -38,6 +38,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed pltpu.TPUCompilerParams -> pltpu.CompilerParams; accept
+# either so the kernels build across the jax versions we run on
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
 
 def _kv_write_kernel(
     # scalar prefetch (flattened [B*S] segment tables)
@@ -201,7 +207,7 @@ def kv_write_pallas(
         # flattened operands: scalars(0-4), k_new(5), v_new(6),
         # k_pages(7), v_pages(8) -> outputs 0, 1
         input_output_aliases={7: 0, 8: 1},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=interpret,

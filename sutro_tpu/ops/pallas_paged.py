@@ -41,6 +41,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed pltpu.TPUCompilerParams -> pltpu.CompilerParams; accept
+# either so the kernels build across the jax versions we run on
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
 NEG_INF = -1e30
 
 
@@ -705,7 +711,7 @@ def paged_decode_attention(
         # out rows, scratch reinitialized per step) and "parallel" lets
         # megacore TPUs split the grid; the cross-row handoff threads
         # DMA state between steps and needs sequential "arbitrary" rows
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=(
                 "arbitrary" if cross_row else "parallel",
             ),

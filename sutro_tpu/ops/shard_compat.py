@@ -1,0 +1,86 @@
+"""``shard_map`` version compatibility.
+
+The sharded execution paths (expert-parallel MoE, ring attention,
+pipeline parallelism) are written against the current top-level
+``jax.shard_map`` API, whose ``axis_names=`` selects the *manual*
+axes (partial-manual shard_map). Older jax releases (<= 0.4.x, the
+version some of our hosts pin) only ship
+``jax.experimental.shard_map.shard_map``, where the same thing is
+expressed inversely via ``auto=`` (the axes that stay automatic).
+
+One wrapper, one translation rule:
+
+- new jax: forward verbatim to ``jax.shard_map``;
+- old jax: ``auto = mesh.axis_names - axis_names`` (manual-over-all
+  when ``axis_names`` is omitted), with ``check_rep=False`` — the
+  replication checker predates several collectives these bodies use
+  (psum over partial-manual meshes) and the parity tests, not the
+  checker, are what pin correctness here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Set
+
+import jax
+
+# True when the running jax ships the top-level partial-manual
+# shard_map API. Legacy jax can emulate full-manual and size-1-auto
+# meshes (the wrapper below) but NOT genuinely-sharded auto axes —
+# its rewriter raises NotImplementedError and XLA:CPU SPMD rejects
+# the PartitionId instruction those programs need. Tests for such
+# configs skip on this flag.
+HAS_NEW_SHARD_MAP = hasattr(jax, "shard_map")
+
+
+def pcast(x: Any, axes: Any, to: str = "varying"):
+    """``jax.lax.pcast`` when the running jax has varying-manual-axis
+    (VMA) types; identity otherwise — under the legacy shard_map every
+    value inside the body is already device-varying, so the cast only
+    exists to satisfy the new type system."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axes, to=to)
+    return x
+
+
+def shard_map(
+    f: Callable,
+    mesh: Any,
+    in_specs: Any,
+    out_specs: Any,
+    axis_names: Optional[Set[str]] = None,
+    check_vma: bool = True,
+):
+    if hasattr(jax, "shard_map"):
+        kw: dict = {"check_vma": check_vma}
+        if axis_names is not None:
+            kw["axis_names"] = set(axis_names)
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    # Size-1 auto axes are dropped: manual over a 1-sized axis is
+    # semantically identical (the body sees the only shard), and the
+    # legacy partial-auto path is far less supported (NotImplementedError
+    # in the 0.4.x rewriter, PartitionId UNIMPLEMENTED in XLA:CPU SPMD) —
+    # so only genuinely-sharded auto axes take it.
+    auto = (
+        frozenset(
+            a
+            for a in mesh.axis_names
+            if a not in axis_names and mesh.shape[a] > 1
+        )
+        if axis_names is not None
+        else frozenset()
+    )
+    # check_rep is the old name for check_vma; partial-manual bodies
+    # (auto axes) predate the checker entirely, so it is off there
+    return _legacy(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_rep=bool(check_vma) and not auto,
+        auto=auto,
+    )

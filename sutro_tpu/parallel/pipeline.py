@@ -45,6 +45,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.configs import ModelConfig
+from ..ops.shard_compat import pcast as _pcast, shard_map as _shard_map
 from ..models import transformer
 from .sharding import param_shardings
 
@@ -162,7 +163,7 @@ def pipeline_forward(
         )
         return out, k_out, v_out
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         stage,
         mesh=mesh,
         in_specs=(P("pipe"), P("pipe"), P("pipe"), P(), P(), P()),
@@ -251,7 +252,7 @@ def pipeline_decode(
         v_out = jnp.zeros_like(k_out)
         # the carry becomes pipe-varying after the first stage's layers;
         # mark it varying from the start so scan carry types line up
-        buf = jax.lax.pcast(h0, ("pipe",), to="varying")
+        buf = _pcast(h0, ("pipe",), to="varying")
         y = buf
         for t in range(S):
             active = s == t
@@ -260,7 +261,7 @@ def pipeline_decode(
                 run_stage,
                 lambda x: (
                     x,
-                    jax.lax.pcast(
+                    _pcast(
                         (jnp.zeros((Lb, B, T, KVH, Dh), h0.dtype),
                          jnp.zeros((Lb, B, T, KVH, Dh), h0.dtype)),
                         ("pipe",),
@@ -288,7 +289,7 @@ def pipeline_decode(
         wv_all = jnp.zeros((L, B, 0, KVH * Dh), h0.dtype)
         win_len = jnp.asarray(0, jnp.int32)
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         stage,
         mesh=mesh,
         in_specs=(

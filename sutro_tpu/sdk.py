@@ -512,6 +512,29 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
         the failure_log schema)."""
         return self._fetch_job(job_id).get("failure_log") or []
 
+    def get_job_telemetry(self, job_id: str) -> Dict[str, Any]:
+        """The job's flight-recorder document (OBSERVABILITY.md): span
+        timeline across engine stages (tokenize, prefill, decode
+        windows, accept, flush, finalize, ...) plus exact per-job
+        counters (rows by outcome, tokens in/out). Dumped automatically
+        when a job FAILs; this fetches/refreshes it on demand."""
+        if self.backend == "remote":
+            return self._remote_json("get", f"job-telemetry/{job_id}")[
+                "telemetry"
+            ]
+        return self.engine.job_telemetry(job_id)
+
+    def get_metrics_text(self) -> str:
+        """Engine metrics registry in Prometheus text exposition format
+        (the same payload ``GET /metrics`` serves on the daemon)."""
+        if self.backend == "remote":
+            resp = self.do_request("get", "metrics")
+            resp.raise_for_status()
+            return resp.text
+        from . import telemetry
+
+        return telemetry.REGISTRY.to_prometheus()
+
     def list_jobs(self) -> List[Dict[str, Any]]:
         if self.backend == "remote":
             return self._remote_json("get", "list-jobs")["jobs"]

@@ -21,6 +21,11 @@ GET /job-status/{id}, GET /job-cancel/{id}, GET /list-jobs, GET
 /create-dataset, POST /upload-to-dataset (multipart), POST
 /list-datasets, POST /list-dataset-files, POST /download-from-dataset,
 GET /try-authentication, GET /get-quotas, POST /functions/run.
+
+Telemetry surfaces (no reference analogue — OBSERVABILITY.md): GET
+/metrics serves the engine registry in Prometheus text exposition
+format for scraping; GET /job-telemetry/{id} serves a job's flight-
+recorder document (span timeline + exact per-job counters).
 """
 
 from __future__ import annotations
@@ -140,6 +145,10 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
                 self._json(eng.try_authentication())
             elif head == "get-quotas":
                 self._json({"quotas": eng.get_quotas()})
+            elif head == "metrics":
+                self._metrics()
+            elif head == "job-telemetry" and rest:
+                self._json({"telemetry": eng.job_telemetry(rest)})
             elif head == "healthz":
                 self._json({"ok": True})
             else:
@@ -211,6 +220,19 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
             self._error(500, f"{type(e).__name__}: {e}")
 
     # -- endpoint bodies ----------------------------------------------
+
+    def _metrics(self) -> None:
+        """Prometheus text exposition (0.0.4) of the engine registry."""
+        from . import telemetry
+
+        data = telemetry.REGISTRY.to_prometheus().encode()
+        self.send_response(200)
+        self.send_header(
+            "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+        )
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
 
     def _stream_progress(self, job_id: str) -> None:
         """NDJSON progress stream (chunked) — reference sdk.py:311-367."""

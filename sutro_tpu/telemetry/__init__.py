@@ -1,0 +1,256 @@
+"""Engine telemetry: metrics registry, span tracing, flight recorder.
+
+The operator surface the hosted reference keeps server-side (SURVEY §0:
+progress accounting and quota enforcement live behind api.sutro.sh),
+rebuilt for the TPU-native engine. Three pillars:
+
+1. **Metrics registry** (:mod:`.registry`) — lock-light counters,
+   gauges and bounded histograms with thread-local write shards, fixed
+   label cardinality, and Prometheus-text / JSON exporters. Scraped via
+   ``GET /metrics`` on the engine daemon (server.py) or ``sutro
+   telemetry`` on the CLI.
+2. **Span tracer + flight recorder** (:mod:`.spans`) — per-stage
+   timings (tokenize, constraint compile, prefill, decode window,
+   accept, flush, finalize, dp round) in a bounded ring buffer, dumped
+   to ``$SUTRO_HOME/jobs/<job_id>/telemetry.json`` when a job FAILs
+   (pairing with the job record's ``failure_log[]``) and on demand.
+3. **Per-job counters** — exact rows/tokens accumulators outside the
+   label space (job ids are unbounded), reconciled against job results.
+
+The catalog of engine metrics lives here (OBSERVABILITY.md documents
+names/labels/units). Everything is guarded by one module-global switch:
+``SUTRO_TELEMETRY=0`` (or :func:`set_enabled`) turns instrumentation
+off, and call sites pay a single attribute load + truth test — the
+same zero-overhead-when-off pattern as engine/faults.py ``ACTIVE``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+from .registry import DEFAULT_BUCKETS, MetricsRegistry
+from .spans import (
+    DEFAULT_CAPACITY,
+    FlightRecorder,
+    JobCounters,
+    JobTelemetryStore,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "REGISTRY",
+    "RECORDER",
+    "JOBS",
+    "enabled",
+    "set_enabled",
+    "stage_observe",
+    "job",
+    "job_doc",
+    "dump_job",
+    "load_job_dump",
+    "MetricsRegistry",
+    "FlightRecorder",
+    "JobCounters",
+    "JobTelemetryStore",
+]
+
+# -- the one enable switch ---------------------------------------------
+
+ENABLED: bool = os.environ.get("SUTRO_TELEMETRY", "1").lower() not in (
+    "0", "false", "off",
+)
+
+
+def enabled() -> bool:
+    return ENABLED
+
+
+def set_enabled(on: bool) -> bool:
+    """Flip instrumentation globally (tests / the overhead profiler).
+    Components that latch the switch at construction (the scheduler's
+    timer sink) pick it up on their next construction."""
+    global ENABLED
+    ENABLED = bool(on)
+    return ENABLED
+
+
+# -- singletons --------------------------------------------------------
+
+REGISTRY = MetricsRegistry()
+RECORDER = FlightRecorder(
+    capacity=int(os.environ.get("SUTRO_TELEMETRY_SPANS", DEFAULT_CAPACITY))
+)
+JOBS = JobTelemetryStore(
+    capacity=int(os.environ.get("SUTRO_TELEMETRY_JOBS", 256))
+)
+
+# -- engine metric catalog (documented in OBSERVABILITY.md) ------------
+
+STAGE_SECONDS = REGISTRY.histogram(
+    "sutro_stage_seconds",
+    "Engine stage latency (tokenize, constraint_compile, prefill, "
+    "decode_window, admit, accept, flush, finalize, dp_round, embed)",
+    labels=("stage",),
+    unit="seconds",
+)
+ROWS_TOTAL = REGISTRY.counter(
+    "sutro_rows_total",
+    "Result rows emitted by terminal outcome",
+    labels=("outcome",),  # ok | quarantined | cancelled
+)
+TOKENS_TOTAL = REGISTRY.counter(
+    "sutro_tokens_total",
+    "Tokens processed by direction (accounted at job finalize)",
+    labels=("direction",),  # in | out
+    unit="tokens",
+)
+JOBS_TOTAL = REGISTRY.counter(
+    "sutro_jobs_total",
+    "Jobs reaching a terminal status",
+    labels=("status",),  # succeeded | failed | cancelled
+)
+ROW_EVENTS_TOTAL = REGISTRY.counter(
+    "sutro_failure_events_total",
+    "failure_log[] events appended (row_retry, row_quarantined, "
+    "io_retry, torn_chunk_quarantined, job_failed, ...)",
+    labels=("event",),
+)
+FAULTS_INJECTED_TOTAL = REGISTRY.counter(
+    "sutro_faults_injected_total",
+    "Deterministic fault-plan injections fired, by site",
+    labels=("site",),
+)
+IO_RETRIES_TOTAL = REGISTRY.counter(
+    "sutro_io_retries_total",
+    "Transient-I/O retry attempts (engine/faults.retry_transient)",
+    labels=("what",),
+)
+TOKENIZE_ROWS_TOTAL = REGISTRY.counter(
+    "sutro_tokenize_rows_total",
+    "Prompt rows tokenized through encode_chat_batch",
+    unit="rows",
+)
+DP_EVENTS_TOTAL = REGISTRY.counter(
+    "sutro_dp_events_total",
+    "Data-parallel coordinator events",
+    labels=("kind",),  # reconnect | stall | fault_forwarded | reject
+)
+TOKENS_PER_SECOND = REGISTRY.gauge(
+    "sutro_tokens_per_second",
+    "Most recent total token throughput reported by a running job",
+    unit="tokens/s",
+)
+TOKENS_PER_SECOND_PER_CHIP = REGISTRY.gauge(
+    "sutro_tokens_per_second_per_chip",
+    "Most recent per-chip token throughput (Throughput estimator)",
+    unit="tokens/s",
+)
+JOBS_RUNNING = REGISTRY.gauge(
+    "sutro_jobs_running",
+    "Generation/embedding jobs currently executing in this process",
+)
+SPANS_DROPPED = REGISTRY.gauge(
+    "sutro_flight_recorder_dropped",
+    "Spans evicted from the flight-recorder ring since process start",
+)
+
+# Span names the engine emits — OBSERVABILITY.md's span schema section
+# and tests key off this tuple, so additions land in one place.
+STAGES = (
+    "tokenize",
+    "constraint_compile",
+    "admit",
+    "prefill",
+    "decode_window",
+    "accept",
+    "flush",
+    "finalize",
+    "dp_round",
+    "embed",
+)
+
+
+def stage_observe(stage: str, dur_s: float) -> None:
+    """One engine stage latency sample into the registry histogram
+    (the flight-recorder span is the caller's concern — spans carry
+    job identity, the histogram does not)."""
+    STAGE_SECONDS.observe(dur_s, stage)
+
+
+def job(job_id: str) -> JobCounters:
+    return JOBS.job(job_id)
+
+
+# -- per-job document / flight-recorder dump ---------------------------
+
+SCHEMA_VERSION = 1
+
+
+def job_doc(job_id: str) -> Dict[str, Any]:
+    """Assemble the per-job telemetry document from live state: the
+    job's span timeline (flight recorder) + its exact counters."""
+    jc = JOBS.peek(job_id)
+    spans = RECORDER.snapshot(job_id)
+    return {
+        "version": SCHEMA_VERSION,
+        "job_id": job_id,
+        "dumped_at": time.strftime(
+            "%Y-%m-%dT%H:%M:%S%z", time.localtime()
+        ),
+        "recorder": {
+            "capacity": RECORDER.capacity,
+            "dropped": RECORDER.dropped,
+            "epoch_unix": RECORDER.epoch_wall,
+        },
+        "counters": jc.to_dict() if jc is not None else {},
+        "stages": sorted({s["name"] for s in spans}),
+        "spans": spans,
+    }
+
+
+def dump_job(job_dir: Path, job_id: str) -> Optional[Dict[str, Any]]:
+    """Write ``telemetry.json`` into the job directory (atomic rename,
+    jobstore convention). Best-effort: recording a postmortem must
+    never become a new failure. Returns the doc (or None on failure/
+    disabled)."""
+    if not ENABLED:
+        return None
+    try:
+        doc = job_doc(job_id)
+        SPANS_DROPPED.set(RECORDER.dropped)
+        path = Path(job_dir) / "telemetry.json"
+        tmp = path.with_suffix(".json.tmp")
+        tmp.write_text(json.dumps(doc, indent=2))
+        tmp.replace(path)
+        return doc
+    except Exception:
+        logger.warning(
+            "telemetry dump failed for %s", job_id, exc_info=True
+        )
+        return None
+
+
+def load_job_dump(job_dir: Path) -> Optional[Dict[str, Any]]:
+    path = Path(job_dir) / "telemetry.json"
+    if not path.exists():
+        return None
+    try:
+        return json.loads(path.read_text())
+    except (OSError, ValueError) as e:
+        logger.warning("unreadable telemetry.json in %s: %s", job_dir, e)
+        return None
+
+
+def reset_for_tests() -> None:
+    """Drop accumulated registry/recorder/job state (declarations
+    stay). Tests only."""
+    REGISTRY.reset()
+    RECORDER.clear()
+    for jc in JOBS:
+        JOBS.drop(jc.job_id)

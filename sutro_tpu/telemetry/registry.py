@@ -1,0 +1,371 @@
+"""Lock-light metrics registry: counters, gauges, bounded histograms.
+
+The reference SDK has no metrics at all — progress/usage accounting
+lives behind the hosted service (SURVEY §0). The TPU-native engine
+replaces that fleet, so it also needs the fleet's eyes: cheap,
+always-on process metrics an operator can scrape.
+
+Design constraints (the hot paths this serves are the scheduler's
+decode loop and the jobstore's flush path):
+
+- **Writes never contend.** Every writer thread accumulates into its
+  own thread-local shard (a plain dict keyed by ``(metric, labels)``);
+  an increment is a dict get/set — no lock, no atomics beyond the GIL.
+  Readers aggregate across shards at collect time; shards of dead
+  threads fold into a retired base so a daemon that spawns per-job
+  threads stays bounded.
+- **Fixed label cardinality.** Metrics declare their label names up
+  front, and each metric admits at most ``max_series`` distinct label
+  value tuples; overflow collapses into a single ``"_overflow"``
+  series instead of growing without bound. Job ids and other unbounded
+  identifiers therefore never become labels — per-job numbers live in
+  the flight recorder's per-job counters (telemetry/__init__.py).
+- **Bounded histogram buckets.** Fixed boundaries chosen at
+  declaration; observation is a bisect + two adds.
+
+Exporters: Prometheus text exposition (0.0.4) via
+:meth:`MetricsRegistry.to_prometheus` and a JSON snapshot via
+:meth:`MetricsRegistry.to_json`. Both produce deterministic ordering
+(sorted by metric name, then label values) so goldens are stable.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# default latency buckets (seconds): 100us .. ~100s, log-ish spacing —
+# covers tokenize batches, decode windows, flushes and finalizes alike
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 100.0,
+)
+
+_OVERFLOW = ("_overflow",)
+
+
+class _Shard:
+    """One thread's private accumulators. Only its owner thread writes;
+    readers only ever sum snapshots, so a torn read costs at most a
+    momentarily-stale value, never corruption."""
+
+    __slots__ = ("counters", "hists")
+
+    def __init__(self) -> None:
+        # (metric_name, label_values) -> float
+        self.counters: Dict[Tuple[str, Tuple[str, ...]], float] = {}
+        # (metric_name, label_values) -> [bucket_counts..., sum, count]
+        self.hists: Dict[Tuple[str, Tuple[str, ...]], List[float]] = {}
+
+
+class _Metric:
+    """Common metric definition: name, kind, help, unit, label names."""
+
+    def __init__(
+        self,
+        registry: "MetricsRegistry",
+        name: str,
+        kind: str,
+        help_: str,
+        labels: Sequence[str],
+        unit: str,
+        max_series: int,
+    ) -> None:
+        self.registry = registry
+        self.name = name
+        self.kind = kind
+        self.help = help_
+        self.label_names = tuple(labels)
+        self.unit = unit
+        self.max_series = max_series
+        # label tuples this metric has admitted (reads are GIL-safe;
+        # admission of a NEW tuple takes the registry lock)
+        self._series: set = set()
+
+    def _labelvals(self, labels: Tuple[str, ...]) -> Tuple[str, ...]:
+        """Admit a label tuple under the cardinality cap (overflow
+        collapses). Hot calls hit the membership test only."""
+        if labels in self._series:
+            return labels
+        overflow = _OVERFLOW * len(self.label_names)
+        with self.registry._lock:
+            if labels in self._series:
+                return labels
+            if len(self._series) >= self.max_series:
+                self._series.add(overflow)
+                return overflow
+            self._series.add(labels)
+        return labels
+
+    def _check(self, labels: Tuple[str, ...]) -> None:
+        if len(labels) != len(self.label_names):
+            raise ValueError(
+                f"{self.name} takes labels {self.label_names}, "
+                f"got {labels!r}"
+            )
+
+
+class Counter(_Metric):
+    def inc(self, n: float = 1.0, *labels: str) -> None:
+        lv = tuple(str(x) for x in labels)
+        self._check(lv)
+        lv = self._labelvals(lv)
+        c = self.registry._shard().counters
+        key = (self.name, lv)
+        c[key] = c.get(key, 0.0) + n
+
+
+class Gauge(_Metric):
+    """Last-write-wins value. Stored registry-global (not sharded):
+    a gauge is a statement about *now*, so per-thread accumulation
+    would be meaningless. A plain dict assignment is GIL-atomic."""
+
+    def set(self, value: float, *labels: str) -> None:
+        lv = tuple(str(x) for x in labels)
+        self._check(lv)
+        lv = self._labelvals(lv)
+        self.registry._gauges[(self.name, lv)] = float(value)
+
+
+class Histogram(_Metric):
+    def __init__(self, *args: Any, buckets: Sequence[float] = DEFAULT_BUCKETS,
+                 **kw: Any) -> None:
+        super().__init__(*args, **kw)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+
+    def observe(self, value: float, *labels: str) -> None:
+        lv = tuple(str(x) for x in labels)
+        self._check(lv)
+        lv = self._labelvals(lv)
+        h = self.registry._shard().hists
+        key = (self.name, lv)
+        acc = h.get(key)
+        if acc is None:
+            acc = h[key] = [0.0] * (len(self.buckets) + 3)
+        # layout: [b0..bn, +Inf, sum, count]
+        acc[bisect.bisect_left(self.buckets, value)] += 1.0
+        acc[-2] += value
+        acc[-1] += 1.0
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+        self._gauges: Dict[Tuple[str, Tuple[str, ...]], float] = {}
+        self._local = threading.local()
+        # (thread, shard) pairs; dead threads' shards fold into _retired
+        self._shards: List[Tuple[threading.Thread, _Shard]] = []
+        self._retired = _Shard()
+
+    # -- declaration ---------------------------------------------------
+
+    def _declare(self, cls, name: str, help_: str, labels: Sequence[str],
+                 unit: str, max_series: int, **kw: Any):
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if not isinstance(existing, cls):
+                    raise ValueError(
+                        f"metric {name} already declared as "
+                        f"{existing.kind}"
+                    )
+                return existing
+            m = cls(self, name, cls.__name__.lower(), help_, labels,
+                    unit, max_series, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help_: str = "",
+                labels: Sequence[str] = (), unit: str = "",
+                max_series: int = 64) -> Counter:
+        return self._declare(Counter, name, help_, labels, unit,
+                             max_series)
+
+    def gauge(self, name: str, help_: str = "",
+              labels: Sequence[str] = (), unit: str = "",
+              max_series: int = 64) -> Gauge:
+        return self._declare(Gauge, name, help_, labels, unit,
+                             max_series)
+
+    def histogram(self, name: str, help_: str = "",
+                  labels: Sequence[str] = (), unit: str = "",
+                  max_series: int = 64,
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._declare(Histogram, name, help_, labels, unit,
+                             max_series, buckets=buckets)
+
+    # -- shards --------------------------------------------------------
+
+    def _shard(self) -> _Shard:
+        s = getattr(self._local, "shard", None)
+        if s is None:
+            s = _Shard()
+            self._local.shard = s
+            with self._lock:
+                self._shards.append((threading.current_thread(), s))
+        return s
+
+    def _merge_shard(self, into: _Shard, s: _Shard) -> None:
+        for k, v in list(s.counters.items()):
+            into.counters[k] = into.counters.get(k, 0.0) + v
+        for k, acc in list(s.hists.items()):
+            base = into.hists.get(k)
+            if base is None:
+                into.hists[k] = list(acc)
+            else:
+                for i, v in enumerate(list(acc)):
+                    if i < len(base):
+                        base[i] += v
+
+    def _aggregate(self) -> _Shard:
+        """Sum every live shard over the retired base. Dead threads'
+        shards fold into the retired base and drop from the live list
+        (keeps a long-lived daemon's shard list bounded by its LIVE
+        thread count)."""
+        with self._lock:
+            live = []
+            for t, s in self._shards:
+                if t.is_alive():
+                    live.append((t, s))
+                else:
+                    self._merge_shard(self._retired, s)
+            self._shards = live
+            out = _Shard()
+            self._merge_shard(out, self._retired)
+            shards = [s for _, s in live]
+        for s in shards:
+            self._merge_shard(out, s)
+        return out
+
+    # -- collection / export -------------------------------------------
+
+    def collect(self) -> Dict[str, Dict[str, Any]]:
+        """Aggregated snapshot:
+        ``{name: {type, help, unit, labels, series: {"a,b": value}}}``
+        — histogram series values are
+        ``{buckets: {le: n}, sum, count}``."""
+        agg = self._aggregate()
+        with self._lock:
+            metrics = dict(self._metrics)
+        gauges = dict(self._gauges)
+        out: Dict[str, Dict[str, Any]] = {}
+        for name in sorted(metrics):
+            m = metrics[name]
+            entry: Dict[str, Any] = {
+                "type": m.kind,
+                "help": m.help,
+                "unit": m.unit,
+                "labels": list(m.label_names),
+                "series": {},
+            }
+            if isinstance(m, Gauge):
+                src: Dict[Tuple[str, ...], Any] = {
+                    lv: v for (n, lv), v in gauges.items() if n == name
+                }
+            elif isinstance(m, Histogram):
+                src = {}
+                for (n, lv), acc in agg.hists.items():
+                    if n != name:
+                        continue
+                    les = [*m.buckets, math.inf]
+                    src[lv] = {
+                        "buckets": {
+                            ("+Inf" if math.isinf(le) else repr(le)): int(
+                                sum(acc[: i + 1])
+                            )
+                            for i, le in enumerate(les)
+                        },
+                        "sum": acc[-2],
+                        "count": int(acc[-1]),
+                    }
+            else:
+                src = {
+                    lv: v
+                    for (n, lv), v in agg.counters.items()
+                    if n == name
+                }
+            for lv in sorted(src):
+                entry["series"][",".join(lv)] = src[lv]
+            out[name] = entry
+        return out
+
+    @staticmethod
+    def _fmt_labels(names: Sequence[str], values: Sequence[str],
+                    extra: Optional[Tuple[str, str]] = None) -> str:
+        def esc(v: str) -> str:
+            return (
+                v.replace("\\", "\\\\")
+                .replace('"', '\\"')
+                .replace("\n", "\\n")
+            )
+
+        pairs = list(zip(names, values))
+        if extra is not None:
+            pairs.append(extra)
+        if not pairs:
+            return ""
+        body = ",".join(f'{k}="{esc(v)}"' for k, v in pairs)
+        return "{" + body + "}"
+
+    @staticmethod
+    def _fmt_value(v: float) -> str:
+        if v == int(v) and abs(v) < 1e15:
+            return str(int(v))
+        return repr(float(v))
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        snap = self.collect()
+        lines: List[str] = []
+        for name, m in snap.items():
+            lines.append(f"# HELP {name} {m['help']}")
+            lines.append(f"# TYPE {name} {m['type']}")
+            names = m["labels"]
+            for key, val in m["series"].items():
+                values = tuple(key.split(",")) if names else ()
+                if m["type"] == "histogram":
+                    for le, n in val["buckets"].items():
+                        le_s = le if le == "+Inf" else self._fmt_value(
+                            float(le)
+                        )
+                        lines.append(
+                            f"{name}_bucket"
+                            + self._fmt_labels(names, values,
+                                               ("le", le_s))
+                            + f" {n}"
+                        )
+                    lines.append(
+                        f"{name}_sum"
+                        + self._fmt_labels(names, values)
+                        + f" {self._fmt_value(val['sum'])}"
+                    )
+                    lines.append(
+                        f"{name}_count"
+                        + self._fmt_labels(names, values)
+                        + f" {val['count']}"
+                    )
+                else:
+                    lines.append(
+                        name
+                        + self._fmt_labels(names, values)
+                        + f" {self._fmt_value(val)}"
+                    )
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def to_json(self) -> Dict[str, Any]:
+        return self.collect()
+
+    def reset(self) -> None:
+        """Test hook: drop every accumulated value (declarations stay).
+        Not for production use — concurrent writers may keep shards the
+        reset has already cleared."""
+        with self._lock:
+            self._retired = _Shard()
+            self._shards = []
+            self._gauges.clear()
+            self._local = threading.local()
+            for m in self._metrics.values():
+                m._series = set()

@@ -49,6 +49,10 @@ def test_param_shardings_cover_all_leaves(eight_devices):
         assert len(flat_p) == len(flat_s)
 
 
+@pytest.mark.slow  # multi-device XLA compiles: excluded from the
+#   single-process tier-1 run (in-process compile accumulation is
+#   what trips this host's XLA:CPU flake, see run_tests_chunked.sh);
+#   the chunked full-suite CI runs it per-file
 def test_tp_matches_single_device_generation(eight_devices):
     """Greedy generation must be identical under TP+EP sharding."""
     cfg = MODEL_CONFIGS["tiny-moe"]
@@ -81,6 +85,10 @@ def test_tp_matches_single_device_generation(eight_devices):
     assert single == sharded
 
 
+@pytest.mark.slow  # multi-device XLA compiles: excluded from the
+#   single-process tier-1 run (in-process compile accumulation is
+#   what trips this host's XLA:CPU flake, see run_tests_chunked.sh);
+#   the chunked full-suite CI runs it per-file
 def test_dp_ep_tp_full_mesh_step(eight_devices):
     """A full 2x2x2 mesh executes a prefill+decode step without error and
     params actually land sharded."""
@@ -113,6 +121,10 @@ def test_shard_params_helper(eight_devices):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow  # multi-device XLA compiles: excluded from the
+#   single-process tier-1 run (in-process compile accumulation is
+#   what trips this host's XLA:CPU flake, see run_tests_chunked.sh);
+#   the chunked full-suite CI runs it per-file
 @pytest.mark.parametrize("dp,ep,tp", [(2, 2, 2), (1, 4, 2), (1, 2, 1)])
 @pytest.mark.parametrize("with_bias", [False, True])
 def test_moe_ep_matches_reference(eight_devices, dp, ep, tp, with_bias):
@@ -191,6 +203,10 @@ def test_moe_ep_weight_residency(eight_devices):
     assert shard.shape == (E // 4, H, F // 2)
 
 
+@pytest.mark.slow  # multi-device XLA compiles: excluded from the
+#   single-process tier-1 run (in-process compile accumulation is
+#   what trips this host's XLA:CPU flake, see run_tests_chunked.sh);
+#   the chunked full-suite CI runs it per-file
 @pytest.mark.parametrize("sp,pp", [(2, 1), (1, 2)])
 def test_moe_ep_gspmd_fallback_under_sp_pp(eight_devices, sp, pp):
     """VERDICT r3 weak #6: under sp/pp the explicit shard_map EP path
@@ -198,6 +214,13 @@ def test_moe_ep_gspmd_fallback_under_sp_pp(eight_devices, sp, pp):
     is unsupported). The fallback COMBINATION must still generate
     greedy tokens identical to single-device; its perf remains
     chip-gated (PARITY.md), but correctness is pinned here."""
+    from sutro_tpu.ops.shard_compat import HAS_NEW_SHARD_MAP
+
+    if pp > 1 and not HAS_NEW_SHARD_MAP:
+        pytest.skip(
+            "pp through the jitted runner needs partial-auto shard_map "
+            "support (XLA:CPU rejects PartitionId on legacy jax)"
+        )
     cfg = MODEL_CONFIGS["tiny-moe"]
     prompt = np.arange(11, dtype=np.int32) % 200
 

@@ -89,6 +89,10 @@ def _run(tok, split: bool, monkeypatch):
     return {i: r.token_ids for i, r in res.items()}
 
 
+@pytest.mark.slow  # multi-device XLA compiles: excluded from the
+#   single-process tier-1 run (in-process compile accumulation is
+#   what trips this host's XLA:CPU flake, see run_tests_chunked.sh);
+#   the chunked full-suite CI runs it per-file
 def test_two_prefix_groups_cobatched(byte_tok, monkeypatch):
     """Two templated jobs with DIFFERENT shared prefixes co-batched:
     each gets its own carry group (disjoint member sets combine by
@@ -159,6 +163,10 @@ def test_two_prefix_groups_cobatched(byte_tok, monkeypatch):
     assert on_a == off_a and on_b == off_b
 
 
+@pytest.mark.slow  # multi-device XLA compiles: excluded from the
+#   single-process tier-1 run (in-process compile accumulation is
+#   what trips this host's XLA:CPU flake, see run_tests_chunked.sh);
+#   the chunked full-suite CI runs it per-file
 def test_engine_split_decode_matches_unsplit(byte_tok, monkeypatch):
     from sutro_tpu.ops import pallas_paged
 

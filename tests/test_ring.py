@@ -35,6 +35,10 @@ def _assert_close(out, ref, valid):
         )
 
 
+@pytest.mark.slow  # multi-device XLA compiles: excluded from the
+#   single-process tier-1 run (in-process compile accumulation is
+#   what trips this host's XLA:CPU flake, see run_tests_chunked.sh);
+#   the chunked full-suite CI runs it per-file
 @pytest.mark.parametrize("sp,tp", [(4, 1), (8, 1), (2, 2), (1, 2)])
 def test_ring_matches_dense(eight_devices, qkv, sp, tp):
     q, k, v, pos, valid = qkv
@@ -44,6 +48,10 @@ def test_ring_matches_dense(eight_devices, qkv, sp, tp):
     _assert_close(out, ref, valid)
 
 
+@pytest.mark.slow  # multi-device XLA compiles: excluded from the
+#   single-process tier-1 run (in-process compile accumulation is
+#   what trips this host's XLA:CPU flake, see run_tests_chunked.sh);
+#   the chunked full-suite CI runs it per-file
 def test_ring_window_and_sink(eight_devices, qkv):
     q, k, v, pos, valid = qkv
     sink = jnp.asarray(
@@ -81,6 +89,10 @@ def _ecfg(**kw):
     return EngineConfig(**base)
 
 
+@pytest.mark.slow  # multi-device XLA compiles: excluded from the
+#   single-process tier-1 run (in-process compile accumulation is
+#   what trips this host's XLA:CPU flake, see run_tests_chunked.sh);
+#   the chunked full-suite CI runs it per-file
 @pytest.mark.parametrize("model", ["tiny-dense", "tiny-oss"])
 def test_sp_prefill_matches_single_device(eight_devices, model):
     """Full-model prefill + follow-on greedy decode must be identical with

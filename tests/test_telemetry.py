@@ -1,0 +1,523 @@
+"""Engine telemetry subsystem (sutro_tpu/telemetry, OBSERVABILITY.md).
+
+Covers the three pillars end to end:
+
+1. registry semantics — counters/gauges/histograms, thread-sharded
+   writes aggregating exactly, fixed label cardinality, deterministic
+   exporters (golden file) and Prometheus-text validity;
+2. flight recorder — bounded ring, per-job filtering, dump artifact;
+3. the acceptance scenario — a seeded 256-row job with one PR-3
+   injected quarantined row produces a dump whose span timeline covers
+   every exercised stage and whose counters reconcile EXACTLY with the
+   job's results and record, while /metrics parses as Prometheus text.
+
+Plus the PR's satellites: JobMetrics subscriber churn and the
+Throughput first-add anchor.
+"""
+
+import json
+import re
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from sutro_tpu import telemetry
+from sutro_tpu.engine import faults
+from sutro_tpu.engine.api import LocalEngine
+from sutro_tpu.engine.config import EngineConfig
+from sutro_tpu.engine.metrics import JobMetrics, Throughput
+from sutro_tpu.interfaces import JobStatus
+from sutro_tpu.telemetry.registry import MetricsRegistry
+from sutro_tpu.telemetry.spans import FlightRecorder
+
+GOLDEN = Path(__file__).parent / "data" / "telemetry_export.golden"
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+def _golden_registry() -> MetricsRegistry:
+    """THE deterministic registry the golden file pins: fixed metrics,
+    fixed values, fixed order. Regenerate the golden by running this
+    file with --regen-golden (see __main__ below)."""
+    r = MetricsRegistry()
+    c = r.counter("demo_rows_total", "Rows by outcome",
+                  labels=("outcome",))
+    c.inc(3, "ok")
+    c.inc(1, "quarantined")
+    g = r.gauge("demo_tokens_per_second", "Throughput", unit="tokens/s")
+    g.set(1234.5)
+    h = r.histogram("demo_stage_seconds", "Stage latency",
+                    labels=("stage",), buckets=(0.01, 0.1, 1.0))
+    h.observe(0.005, "decode")
+    h.observe(0.05, "decode")
+    h.observe(2.0, "decode")
+    return r
+
+
+def test_exporter_matches_golden():
+    assert GOLDEN.exists(), (
+        "golden file missing (regen: python tests/test_telemetry.py "
+        "--regen-golden)"
+    )
+    assert _golden_registry().to_prometheus() == GOLDEN.read_text()
+
+
+# one exposition line: name{labels} value  (labels optional; value is
+# an int/float, inf or NaN)
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\""
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\")*\})?"
+    r" (-?\d+(\.\d+)?([eE]-?\d+)?|\+Inf|-Inf|NaN)$"
+)
+
+
+def assert_valid_prometheus(text: str) -> None:
+    """Structural validity of a text-exposition payload: every line is
+    a comment or a well-formed sample; every samples' metric family has
+    HELP+TYPE; histogram families carry _bucket/_sum/_count."""
+    assert text.endswith("\n")
+    helps, types, samples = set(), {}, []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            helps.add(line.split()[2])
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            types[parts[2]] = parts[3]
+            assert parts[3] in ("counter", "gauge", "histogram")
+            continue
+        assert _PROM_LINE.match(line), f"bad exposition line: {line!r}"
+        samples.append(line.split("{")[0].split(" ")[0])
+    for name in samples:
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        assert base in types or name in types, f"sample {name} untyped"
+    for name, kind in types.items():
+        assert name in helps, f"{name} has TYPE but no HELP"
+        if kind == "histogram" and any(
+            s.startswith(name + "_") for s in samples
+        ):
+            assert name + "_sum" in samples
+            assert name + "_count" in samples
+            assert name + "_bucket" in samples
+
+
+def test_prometheus_text_valid_for_golden_registry():
+    assert_valid_prometheus(_golden_registry().to_prometheus())
+
+
+def test_counter_shards_aggregate_across_threads():
+    r = MetricsRegistry()
+    c = r.counter("t_total", "x", labels=("k",))
+    n_threads, n_inc = 8, 5000
+
+    def worker():
+        for _ in range(n_inc):
+            c.inc(1, "a")
+
+    ts = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    snap = r.collect()
+    assert snap["t_total"]["series"]["a"] == n_threads * n_inc
+    # dead threads' shards fold into the retired base: a second collect
+    # (threads are dead now) returns the identical total
+    assert r.collect()["t_total"]["series"]["a"] == n_threads * n_inc
+
+
+def test_label_cardinality_bounded():
+    r = MetricsRegistry()
+    c = r.counter("card_total", "x", labels=("k",), max_series=4)
+    for i in range(50):
+        c.inc(1, f"v{i}")
+    series = r.collect()["card_total"]["series"]
+    assert len(series) <= 5  # 4 admitted + the _overflow bucket
+    assert series.get("_overflow", 0) == 50 - 4
+
+
+def test_histogram_buckets_bounded_and_cumulative():
+    r = MetricsRegistry()
+    h = r.histogram("h_seconds", "x", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    s = r.collect()["h_seconds"]["series"][""]
+    assert s["count"] == 3 and abs(s["sum"] - 5.55) < 1e-9
+    b = s["buckets"]
+    assert b["0.1"] == 1 and b["1.0"] == 2 and b["+Inf"] == 3
+
+
+def test_gauge_last_write_wins():
+    r = MetricsRegistry()
+    g = r.gauge("g", "x")
+    g.set(1)
+    g.set(42.5)
+    assert r.collect()["g"]["series"][""] == 42.5
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_ring_bounded():
+    rec = FlightRecorder(capacity=32)
+    for i in range(100):
+        rec.record("s", f"job-{i % 2}", time.monotonic(), 0.001, None)
+    snap = rec.snapshot()
+    assert len(snap) == 32
+    assert rec.dropped > 0
+
+
+def test_flight_recorder_job_filter_includes_batch_spans():
+    rec = FlightRecorder(capacity=64)
+    t = time.monotonic()
+    rec.record("tokenize", "job-a", t, 0.01, None)
+    rec.record("decode_window", None, t, 0.02,
+               {"jobs": ("job-a", "job-b")})
+    rec.record("tokenize", "job-b", t, 0.01, None)
+    a = rec.snapshot("job-a")
+    assert [s["name"] for s in a] == ["tokenize", "decode_window"]
+    assert len(rec.snapshot("job-b")) == 2
+    assert len(rec.snapshot()) == 3
+
+
+def test_span_context_manager_annotates_errors():
+    rec = FlightRecorder(capacity=8)
+    with pytest.raises(ValueError):
+        with rec.span("flush", "j1", rows=3):
+            raise ValueError("boom")
+    (s,) = rec.snapshot("j1")
+    assert s["attrs"]["rows"] == 3
+    assert "ValueError" in s["attrs"]["error"]
+
+
+# ---------------------------------------------------------------------------
+# engine acceptance: seeded 256-row job with one quarantined row
+# ---------------------------------------------------------------------------
+
+
+def _wait_terminal(eng, job_id, timeout=600):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = JobStatus(eng.job_status(job_id))
+        if st.is_terminal() and st != JobStatus.CANCELLING:
+            return st
+        time.sleep(0.05)
+    raise TimeoutError(f"{job_id} not terminal within {timeout}s")
+
+
+@pytest.fixture()
+def telemetry_engine(tmp_path, monkeypatch):
+    monkeypatch.setenv("SUTRO_HOME", str(tmp_path / "home"))
+    (tmp_path / "home").mkdir()
+    telemetry.reset_for_tests()
+    telemetry.set_enabled(True)
+    eng = LocalEngine(
+        EngineConfig(
+            kv_page_size=8,
+            max_pages_per_seq=16,
+            decode_batch_size=8,
+            max_model_len=128,
+            use_pallas=False,
+            param_dtype="float32",
+            activation_dtype="float32",
+            fault_plan="row.decode:error:rows=77",
+            row_retries=1,
+        )
+    )
+    yield eng
+    faults.clear()
+    eng.close(timeout=5)
+
+
+def test_flight_recorder_dump_reconciles_256_rows(telemetry_engine):
+    """Acceptance criterion verbatim: seeded 256-row job, one injected
+    quarantined row -> dump covers every exercised stage, counters
+    reconcile exactly, /metrics parses as Prometheus text."""
+    eng = telemetry_engine
+    n = 256
+    jid = eng.submit_batch_inference(
+        {
+            "model": "tiny-dense",
+            "inputs": [f"telemetry row {i}" for i in range(n)],
+            "sampling_params": {"max_new_tokens": 8,
+                                "temperature": 0.0},
+        }
+    )
+    assert _wait_terminal(eng, jid) == JobStatus.SUCCEEDED
+
+    doc = eng.job_telemetry(jid, write=True)
+    dump_path = Path(eng.jobs._dir(jid)) / "telemetry.json"
+    assert dump_path.exists()
+    persisted = json.loads(dump_path.read_text())
+    assert persisted["job_id"] == jid
+
+    # -- span timeline covers every stage this job exercises ----------
+    stages = set(doc["stages"])
+    assert {
+        "tokenize", "admit", "prefill", "decode_window", "accept",
+        "flush", "finalize",
+    } <= stages, f"missing stages: {stages}"
+    for s in doc["spans"]:
+        assert s["dur_s"] >= 0 and s["t0_s"] >= 0
+
+    # -- counters reconcile EXACTLY with job results -------------------
+    res = eng.job_results(jid)
+    rec = eng.jobs.get(jid)
+    n_err = sum(1 for e in (res.get("errors") or []) if e)
+    c = doc["counters"]
+    assert c["rows_ok"] == n - n_err == 255
+    assert c["rows_quarantined"] == n_err == 1
+    assert c["rows_ok"] + c["rows_quarantined"] == rec.num_rows
+    assert c["input_tokens"] == rec.input_tokens
+    assert c["output_tokens"] == rec.output_tokens
+
+    # the injected fault and its quarantine surfaced in the registry
+    snap = telemetry.REGISTRY.collect()
+    assert (
+        snap["sutro_faults_injected_total"]["series"]["row.decode"] >= 1
+    )
+    assert (
+        snap["sutro_failure_events_total"]["series"]["row_quarantined"]
+        >= 1
+    )
+    assert snap["sutro_rows_total"]["series"]["quarantined"] >= 1
+
+    # -- /metrics export is valid Prometheus text ----------------------
+    assert_valid_prometheus(telemetry.REGISTRY.to_prometheus())
+
+
+def test_metrics_endpoint_and_job_telemetry_over_http(tmp_path,
+                                                      monkeypatch):
+    """GET /metrics + GET /job-telemetry/{id} + SDK accessors over the
+    daemon (remote backend)."""
+    import urllib.request
+
+    from sutro_tpu.server import start_server_thread
+
+    monkeypatch.setenv("SUTRO_HOME", str(tmp_path / "home"))
+    (tmp_path / "home").mkdir()
+    telemetry.set_enabled(True)
+    eng = LocalEngine(
+        EngineConfig(
+            kv_page_size=8, max_pages_per_seq=16, decode_batch_size=4,
+            max_model_len=128, use_pallas=False, param_dtype="float32",
+            activation_dtype="float32",
+        )
+    )
+    server, _, url = start_server_thread(eng)
+    try:
+        jid = eng.submit_batch_inference(
+            {"model": "tiny-dense", "inputs": ["hi", "there"],
+             "sampling_params": {"max_new_tokens": 4,
+                                 "temperature": 0.0}}
+        )
+        assert _wait_terminal(eng, jid) == JobStatus.SUCCEEDED
+        with urllib.request.urlopen(f"{url}/metrics") as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        assert_valid_prometheus(text)
+        assert "sutro_rows_total" in text
+        with urllib.request.urlopen(f"{url}/job-telemetry/{jid}") as r:
+            doc = json.loads(r.read())["telemetry"]
+        assert doc["job_id"] == jid and doc["counters"]["rows_ok"] == 2
+        # SDK surface, both backends
+        from sutro_tpu.sdk import Sutro
+
+        remote = Sutro(api_key="k", base_url=url, backend="remote")
+        assert remote.get_job_telemetry(jid)["job_id"] == jid
+        assert "sutro_jobs_total" in remote.get_metrics_text()
+    finally:
+        server.shutdown()
+        eng.close(timeout=5)
+
+
+def test_failed_job_dumps_telemetry(tmp_path, monkeypatch):
+    """A job that FAILs terminally leaves telemetry.json next to its
+    failure_log — the crash-time postmortem pairing."""
+    monkeypatch.setenv("SUTRO_HOME", str(tmp_path / "home"))
+    (tmp_path / "home").mkdir()
+    telemetry.set_enabled(True)
+    eng = LocalEngine(
+        EngineConfig(
+            kv_page_size=8, max_pages_per_seq=16, decode_batch_size=4,
+            max_model_len=128, use_pallas=False, param_dtype="float32",
+            activation_dtype="float32",
+            fault_plan="runner.prefill:error", row_retries=0,
+        )
+    )
+    try:
+        jid = eng.submit_batch_inference(
+            {"model": "tiny-dense", "inputs": ["x"],
+             "sampling_params": {"max_new_tokens": 4,
+                                 "temperature": 0.0}}
+        )
+        assert _wait_terminal(eng, jid) == JobStatus.FAILED
+        path = Path(eng.jobs._dir(jid)) / "telemetry.json"
+        assert path.exists()
+        doc = json.loads(path.read_text())
+        assert doc["job_id"] == jid
+        assert "tokenize" in doc["stages"]  # timeline reached tokenize
+    finally:
+        faults.clear()
+        eng.close(timeout=5)
+
+
+def test_telemetry_disabled_is_inert(tmp_path, monkeypatch):
+    """SUTRO_TELEMETRY off: no spans recorded, no dump written, jobs
+    unaffected."""
+    monkeypatch.setenv("SUTRO_HOME", str(tmp_path / "home"))
+    (tmp_path / "home").mkdir()
+    telemetry.reset_for_tests()
+    telemetry.set_enabled(False)
+    try:
+        eng = LocalEngine(
+            EngineConfig(
+                kv_page_size=8, max_pages_per_seq=16,
+                decode_batch_size=4, max_model_len=128,
+                use_pallas=False, param_dtype="float32",
+                activation_dtype="float32",
+            )
+        )
+        jid = eng.submit_batch_inference(
+            {"model": "tiny-dense", "inputs": ["a", "b"],
+             "sampling_params": {"max_new_tokens": 4,
+                                 "temperature": 0.0}}
+        )
+        assert _wait_terminal(eng, jid) == JobStatus.SUCCEEDED
+        assert telemetry.RECORDER.snapshot() == []
+        doc = eng.job_telemetry(jid)  # still answers, just empty
+        assert doc["spans"] == [] and doc["counters"] == {}
+        assert not (Path(eng.jobs._dir(jid)) / "telemetry.json").exists()
+        eng.close(timeout=5)
+    finally:
+        telemetry.set_enabled(True)
+
+
+# ---------------------------------------------------------------------------
+# satellite: JobMetrics subscriber churn
+# ---------------------------------------------------------------------------
+
+
+class TestJobMetricsChurn:
+    def test_concurrent_subscribe_unsubscribe_no_leaks(self):
+        """Subscribers attach/detach while a producer publishes: every
+        attach sees a snapshot first, stayers see the final count and
+        the done sentinel, and nothing leaks from the subscriber list."""
+        jm = JobMetrics()
+        N = 400
+        errors = []
+        finals = []
+
+        def producer():
+            for i in range(1, N + 1):
+                jm.progress(i)
+                if i % 50 == 0:
+                    jm.tokens({"input_tokens": i})
+                if i % 97 == 0:
+                    time.sleep(0.001)
+            jm.finish()
+
+        def stayer():
+            try:
+                seen = []
+                for u in jm.subscribe():
+                    if u["update_type"] == "progress":
+                        seen.append(u["result"])
+                assert seen, "no snapshot delivered"
+                assert seen == sorted(seen), "progress went backwards"
+                finals.append(seen[-1])
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        def churner():
+            try:
+                for _ in range(10):
+                    it = jm.subscribe()
+                    first = next(it)
+                    # mid-run attach sees a snapshot immediately
+                    assert first["update_type"] == "progress"
+                    assert 0 <= first["result"] <= N
+                    it.close()  # unsubscribe mid-stream
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        threads = (
+            [threading.Thread(target=stayer) for _ in range(4)]
+            + [threading.Thread(target=churner) for _ in range(4)]
+        )
+        prod = threading.Thread(target=producer)
+        for t in threads:
+            t.start()
+        prod.start()
+        prod.join(30)
+        for t in threads:
+            t.join(30)
+        assert not errors, errors
+        # no lost done-sentinel: every stayer terminated with the final
+        # count (the pending-drain-before-done contract)
+        assert finals == [N] * 4
+        # no leaked subscribers after every generator exited
+        assert jm._subscribers == []
+
+    def test_late_attach_after_finish_gets_snapshot_and_returns(self):
+        jm = JobMetrics()
+        jm.progress(7)
+        jm.tokens({"input_tokens": 3})
+        jm.finish()
+        updates = list(jm.subscribe())
+        assert updates[0] == {"update_type": "progress", "result": 7}
+        assert {"update_type": "tokens",
+                "result": {"input_tokens": 3}} in updates
+        assert jm._subscribers == []
+
+
+# ---------------------------------------------------------------------------
+# satellite: Throughput first-add anchor
+# ---------------------------------------------------------------------------
+
+
+class TestThroughputAnchor:
+    def test_rate_anchors_at_first_add_not_construction(self):
+        t = Throughput(n_chips=2)
+        time.sleep(0.05)  # the "long compile" before any tokens
+        t.add(1000)
+        # anchored at add: elapsed is ~0, so the rate must NOT be
+        # diluted by the 50 ms of pre-token dead time
+        assert t.per_second() > 1000 / 0.05
+        time.sleep(0.05)  # stable elapsed for the ratio check
+        assert t.per_chip_per_second() == pytest.approx(
+            t.per_second() / 2, rel=0.1
+        )
+
+    def test_zero_before_first_add(self):
+        t = Throughput()
+        assert t.per_second() == 0.0
+
+    def test_note_total_baselines_first_report(self):
+        t = Throughput()
+        time.sleep(0.02)
+        t.note_total(10_000)  # first report anchors AND baselines
+        assert t.per_second() == 0.0
+        t.note_total(10_100)
+        time.sleep(0.01)
+        rate = t.per_second()
+        assert 0 < rate < 100 / 0.01
+
+
+if __name__ == "__main__":
+    import sys
+
+    if "--regen-golden" in sys.argv:
+        GOLDEN.parent.mkdir(parents=True, exist_ok=True)
+        GOLDEN.write_text(_golden_registry().to_prometheus())
+        print(f"wrote {GOLDEN}")
