@@ -60,10 +60,13 @@ chaos:
 		-p no:cacheprovider
 
 # telemetry gate (OBSERVABILITY.md): exporter golden-file + flight-
-# recorder/reconciliation tests, then the telemetry-on vs telemetry-off
-# host-overhead comparison (< 2% delta asserted in code). Tier-1 CI.
+# recorder/reconciliation tests + distributed telemetry (trace
+# propagation, federation, doctor golden), then the telemetry-on vs
+# telemetry-off host-overhead comparison (< 2% delta asserted in code,
+# including the dp-coordinator wire leg). Tier-1 CI.
 telemetry-check:
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_telemetry.py -q -m "not slow" \
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_telemetry.py \
+		tests/test_distributed_telemetry.py -q -m "not slow" \
 		-p no:cacheprovider
 	JAX_PLATFORMS=cpu $(PY) benchmarks/profile_host_overhead.py --telemetry
 

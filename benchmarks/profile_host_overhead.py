@@ -463,7 +463,9 @@ def _unit_us(fn, n: int = 20000, reps: int = 3) -> float:
 
 # telemetry entry points priced + counted by run_telemetry_compare:
 # (class, method, count key) — every instrumented call site funnels
-# through one of these
+# through one of these. The distributed entries are the dp wire layer
+# (per ROUND, not per row): worker shard open/build + coordinator
+# ingest (telemetry/distributed.py).
 _TEL_OPS = (
     ("registry", "Counter", "inc", "counter_inc"),
     ("registry", "Gauge", "set", "gauge_set"),
@@ -471,7 +473,127 @@ _TEL_OPS = (
     ("spans", "FlightRecorder", "record", "recorder_record"),
     ("spans", "JobCounters", "add", "jobctr_add"),
     ("spans", "JobCounters", "set", "jobctr_set"),
+    ("distributed", "WorkerTelemetry", "begin", "tele_begin"),
+    ("distributed", "WorkerTelemetry", "payload", "tele_payload"),
+    ("distributed", "DistributedTelemetry", "ingest", "tele_ingest"),
 )
+
+
+class _Census:
+    """Wrap every _TEL_OPS entry point with a counting shim; restore on
+    exit. Counts land in the shared ``counts`` dict."""
+
+    def __init__(self, mods, counts):
+        self.mods = mods
+        self.counts = counts
+        self._restore = []
+
+    def __enter__(self):
+        import functools
+
+        for mod, cls_name, meth, key in _TEL_OPS:
+            cls = getattr(self.mods[mod], cls_name)
+            orig = getattr(cls, meth)
+
+            def wrap(orig=orig, key=key, counts=self.counts):
+                @functools.wraps(orig)
+                def counting(self, *a, **kw):
+                    counts[key] += 1
+                    return orig(self, *a, **kw)
+
+                return counting
+
+            setattr(cls, meth, wrap())
+            self._restore.append((cls, meth, orig))
+        return self
+
+    def __exit__(self, *exc):
+        for cls, meth, orig in self._restore:
+            setattr(cls, meth, orig)
+        return False
+
+
+def _run_dp_leg(n_rows: int) -> dict:
+    """One coordinator+worker dp round over localhost with stub shards,
+    mirroring engine/api.py's distributed-telemetry wiring (trace
+    context in the resume frame, worker shard on done, coordinator
+    ingest). Honors the current telemetry enable switch — the off leg
+    must construct NO telemetry objects, exactly like the engine."""
+    import socket
+    import threading
+    import time as _time
+
+    import sutro_tpu.telemetry as tel
+    from sutro_tpu.engine.dphost import (
+        DPWorld,
+        run_dp_coordinator,
+        run_dp_worker,
+        shard_requests,
+    )
+    from sutro_tpu.engine.scheduler import GenRequest, GenResult
+    from sutro_tpu.telemetry import distributed
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    cw = DPWorld(rank=0, world=2, host="127.0.0.1", port=port)
+    ww = DPWorld(rank=1, world=2, host="127.0.0.1", port=port)
+    zeros = np.zeros(1, np.int32)
+    reqs = [
+        GenRequest(row_id=i, prompt_ids=zeros, max_new_tokens=1)
+        for i in range(n_rows)
+    ]
+
+    def shard_fn(shard, on_result, on_progress, should_cancel):
+        for q in shard:
+            on_result(
+                GenResult(
+                    row_id=q.row_id, token_ids=[7],
+                    cumulative_logprob=-0.5, finish_reason="stop",
+                    input_tokens=1,
+                )
+            )
+        return "completed"
+
+    tel_on = tel.enabled()
+    tele_ctx = None
+    on_worker_tele = None
+    store = distributed.DistributedTelemetry()
+    if tel_on:
+        tele_ctx = distributed.trace_context(
+            "dp-bench", store.next_round("dp-bench")
+        )
+
+        def on_worker_tele(rank, shard):
+            store.ingest("dp-bench", rank, shard)
+
+    merged = {"n": 0}
+    out = {}
+
+    def worker_main():
+        out["w"] = run_dp_worker(
+            ww, shard_fn, shard_requests(reqs, 1, 2),
+            tele=(
+                distributed.WorkerTelemetry("dp-bench", 1)
+                if tel_on
+                else None
+            ),
+        )
+
+    t0 = _time.perf_counter()
+    wt = threading.Thread(target=worker_main)
+    wt.start()
+    outcome = run_dp_coordinator(
+        cw, shard_fn, shard_requests(reqs, 0, 2),
+        on_result=lambda r: merged.__setitem__("n", merged["n"] + 1),
+        tele_ctx=tele_ctx,
+        on_worker_tele=on_worker_tele,
+    )
+    wt.join(timeout=120)
+    dt = _time.perf_counter() - t0
+    assert outcome == "completed" and out.get("w") == "completed"
+    assert merged["n"] == n_rows, merged
+    return {"us_per_row": round(dt / n_rows * 1e6, 2)}
 
 
 def run_telemetry_compare(assert_budget: bool) -> dict:
@@ -495,12 +617,12 @@ def run_telemetry_compare(assert_budget: bool) -> dict:
       off-leg. A counted OFF-leg must fire ZERO ops — "disabled means
       no telemetry work" is asserted, not assumed.
     """
-    import functools
     import tempfile
     import time as _time
 
     import sutro_tpu.engine.api as api_mod
     import sutro_tpu.telemetry as tel
+    import sutro_tpu.telemetry.distributed as tel_distributed
     import sutro_tpu.telemetry.registry as tel_registry
     import sutro_tpu.telemetry.spans as tel_spans
     from sutro_tpu.engine.config import EngineConfig
@@ -544,11 +666,64 @@ def run_telemetry_compare(assert_budget: bool) -> dict:
         "jobctr_set": _unit_us(lambda: sjc.set("input_tokens", 123.0)),
         "monotonic": _unit_us(_time.monotonic),
     }
+    # dp wire ops, priced on a REPRESENTATIVELY loaded scratch setup
+    # (a populated registry + a few hundred spans — these fire once per
+    # round, so the absolute cost matters more than the marginal one)
+    was_enabled_pricing = tel.enabled()
+    tel.set_enabled(True)
+    try:
+        dreg = tel.MetricsRegistry()
+        dcount = dreg.counter("bench_rows_total", labels=("outcome",))
+        dhist = dreg.histogram("bench_stage_seconds", labels=("stage",))
+        for i in range(40):
+            dcount.inc(float(i), f"o{i % 8}")
+            dhist.observe(0.001 * i, f"s{i % 8}")
+        # representative ring: a shared recorder where ~1/4 of spans
+        # belong to the shipping job (dp workers co-host other jobs'
+        # history in the ring; the payload filter walks it all but only
+        # materializes its own)
+        drec = tel.FlightRecorder(capacity=512)
+        for i in range(512):
+            drec.record(
+                "decode_window",
+                "bench" if i % 4 == 0 else f"other-{i % 3}",
+                0.0, 0.003, {"batch": 64, "steps": 16},
+            )
+        djobs = tel.JobTelemetryStore()
+        djobs.job("bench").add("rows_ok", 512)
+        dwt = tel_distributed.WorkerTelemetry(
+            "bench", 1, registry=dreg, recorder=drec, jobs=djobs
+        )
+        dctx = {
+            "v": tel_distributed.WIRE_VERSION, "trace": "bench/r1",
+            "round": 1, "epoch_unix": 0.0, "job": "bench",
+        }
+        unit_us["tele_begin"] = _unit_us(
+            lambda: dwt.begin(dctx), n=2000
+        )
+        dwt.begin(dctx)
+        unit_us["tele_payload"] = _unit_us(lambda: dwt.payload(), n=500)
+        dstore = tel_distributed.DistributedTelemetry(registry=dreg)
+        dpayload = dwt.payload()
+        unit_us["tele_ingest"] = _unit_us(
+            lambda: dstore.ingest("bench", 1, dpayload), n=500
+        )
+    finally:
+        tel.set_enabled(was_enabled_pricing)
 
     # -- wall legs (informational) -------------------------------------
     legs: dict = {"off": [], "on": []}
+    dp_legs: dict = {"off": [], "on": []}
+    # pod-scale round: the wire telemetry is a FIXED per-round cost
+    # (context + one shard + one ingest), so it amortizes over the
+    # round's rows — 4096 is the small end of what dp exists for
+    DP_ROWS = 4096
     was_enabled = tel.enabled()
-    mods = {"registry": tel_registry, "spans": tel_spans}
+    mods = {
+        "registry": tel_registry,
+        "spans": tel_spans,
+        "distributed": tel_distributed,
+    }
     counts = {key: 0 for _, _, _, key in _TEL_OPS}
     try:
         for _ in range(3):
@@ -557,24 +732,13 @@ def run_telemetry_compare(assert_budget: bool) -> dict:
                 legs[mode].append(
                     _run_e2e_leg(eng, api_mod, 512, {}, max_new=32)
                 )
+        for _ in range(2):
+            for mode, on in (("off", False), ("on", True)):
+                tel.set_enabled(on)
+                dp_legs[mode].append(_run_dp_leg(DP_ROWS))
 
         # -- counted legs: op census on, zero-work check off ----------
-        restore = []
-        for mod, cls_name, meth, key in _TEL_OPS:
-            cls = getattr(mods[mod], cls_name)
-            orig = getattr(cls, meth)
-
-            def wrap(orig=orig, key=key):
-                @functools.wraps(orig)
-                def counting(self, *a, **kw):
-                    counts[key] += 1
-                    return orig(self, *a, **kw)
-
-                return counting
-
-            setattr(cls, meth, wrap())
-            restore.append((cls, meth, orig))
-        try:
+        with _Census(mods, counts):
             tel.set_enabled(True)
             _run_e2e_leg(eng, api_mod, 512, {}, max_new=32)
             _time.sleep(0.25)  # let the worker's finally-block gauge land
@@ -585,9 +749,19 @@ def run_telemetry_compare(assert_budget: bool) -> dict:
             _run_e2e_leg(eng, api_mod, 512, {}, max_new=32)
             _time.sleep(0.25)
             off_counts = dict(counts)
-        finally:
-            for cls, meth, orig in restore:
-                setattr(cls, meth, orig)
+            # dp-coordinator leg: the wire telemetry (trace context,
+            # worker shard build, coordinator ingest) must stay inside
+            # the same accounted budget — and fire ZERO ops when off
+            for key in counts:
+                counts[key] = 0
+            tel.set_enabled(True)
+            _run_dp_leg(DP_ROWS)
+            dp_on_counts = dict(counts)
+            for key in counts:
+                counts[key] = 0
+            tel.set_enabled(False)
+            _run_dp_leg(DP_ROWS)
+            dp_off_counts = dict(counts)
     finally:
         tel.set_enabled(was_enabled)
 
@@ -606,6 +780,32 @@ def run_telemetry_compare(assert_budget: bool) -> dict:
     ratio = (off_us + added_us_per_row) / off_us
     wall_ratio = best["on"]["us_per_row"] / off_us
     off_ops = sum(off_counts.values())
+    # dp-coordinator leg accounting: same rule, over the stub dp round
+    dp_best = {
+        m: min(ls, key=lambda leg: leg["us_per_row"])
+        for m, ls in dp_legs.items()
+    }
+    dp_ops_us = sum(dp_on_counts[k] * unit_us[k] for k in dp_on_counts)
+    dp_ops_us += (
+        2 * dp_on_counts["recorder_record"]
+        + dp_on_counts["hist_observe"]
+    ) * unit_us["monotonic"]
+    dp_added_us_per_row = dp_ops_us / DP_ROWS
+    dp_off_us = dp_best["off"]["us_per_row"]
+    dp_ratio = (dp_off_us + dp_added_us_per_row) / dp_off_us
+    dp_off_ops = sum(dp_off_counts.values())
+    dp_out = {
+        "rows": DP_ROWS,
+        "off_us_per_row": dp_off_us,
+        "on_us_per_row": dp_best["on"]["us_per_row"],
+        "op_counts": {k: v for k, v in dp_on_counts.items() if v},
+        "added_us_per_row": round(dp_added_us_per_row, 3),
+        "off_leg_ops_fired": dp_off_ops,
+        "overhead_ratio": round(dp_ratio, 4),
+        "budget_ratio": TEL_OVERHEAD_MAX,
+        "ok": bool(dp_ratio <= TEL_OVERHEAD_MAX and dp_off_ops == 0),
+    }
+
     out = {
         "off_us_per_row": off_us,
         "on_us_per_row": best["on"]["us_per_row"],
@@ -619,6 +819,7 @@ def run_telemetry_compare(assert_budget: bool) -> dict:
         "overhead_ratio": round(ratio, 4),
         "budget_ratio": TEL_OVERHEAD_MAX,
         "ok": bool(ratio <= TEL_OVERHEAD_MAX and off_ops == 0),
+        "dp": dp_out,
     }
     if assert_budget:
         assert off_ops == 0, (
@@ -629,6 +830,15 @@ def run_telemetry_compare(assert_budget: bool) -> dict:
             f"telemetry adds {added_us_per_row:.1f} us/row "
             f"({sum(on_counts.values())} ops) on a {off_us} us/row "
             f"baseline (ratio {ratio:.4f} > {TEL_OVERHEAD_MAX})"
+        )
+        assert dp_off_ops == 0, (
+            f"dp-coordinator telemetry-off leg still fired ops: "
+            f"{dp_off_counts} — disabled must mean no wire telemetry"
+        )
+        assert dp_ratio <= TEL_OVERHEAD_MAX, (
+            f"dp wire telemetry adds {dp_added_us_per_row:.2f} us/row "
+            f"on a {dp_off_us} us/row dp round baseline "
+            f"(ratio {dp_ratio:.4f} > {TEL_OVERHEAD_MAX})"
         )
     return out
 

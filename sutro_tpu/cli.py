@@ -164,6 +164,47 @@ def telemetry(job_id: Optional[str], as_json: bool) -> None:
 
 
 @cli.command()
+@click.argument("job_id")
+@click.option("--json", "as_json", is_flag=True,
+              help="Raw diagnosis document instead of rendered output")
+def doctor(job_id: str, as_json: bool) -> None:
+    """Bottleneck doctor: analyze a job's merged cross-process
+    telemetry — per-worker stage attribution, roofline grades, and one
+    named verdict (OBSERVABILITY.md "Doctor")."""
+    diag = get_sdk().diagnose_job(job_id)
+    if as_json:
+        click.echo(json.dumps(diag, indent=2))
+        return
+    click.echo(to_colored_text(f"job {diag.get('job_id')}", "callout"))
+    partial = " (partial data)" if diag.get("partial") else ""
+    click.echo(f"verdict: {diag.get('verdict')}{partial}")
+    for line in diag.get("evidence") or []:
+        click.echo(f"  - {line}")
+    rows = []
+    for name, p in sorted((diag.get("processes") or {}).items()):
+        stages = p.get("stages") or {}
+        top = max(
+            stages, key=lambda k: stages[k]["total_s"], default=""
+        )
+        rl = p.get("roofline") or {}
+        rows.append(
+            {
+                "process": name,
+                "spans": p.get("spans"),
+                "wall_s": p.get("wall_s"),
+                "device_s": p.get("device_s"),
+                "host_s": p.get("host_s"),
+                "top_stage": top,
+                "decode_%hbm": rl.get("decode_pct_hbm_median", ""),
+            }
+        )
+    if rows:
+        click.echo(
+            tabulate(rows, headers="keys", tablefmt="rounded_outline")
+        )
+
+
+@cli.command()
 def quotas() -> None:
     """Show per-priority row/token quotas (reference cli.py:398-416)."""
     rows = get_sdk().get_quotas()
@@ -228,6 +269,15 @@ def jobs_status(job_id: str) -> None:
     transient-I/O retries, and terminal failures (FAILURES.md)."""
     out = get_sdk().get_job_status(job_id, with_failure_log=True)
     click.echo(out["status"])
+    if out.get("has_telemetry_dump"):
+        click.echo(
+            to_colored_text(
+                "telemetry dump available: "
+                f"`sutro telemetry --job {job_id}` for the timeline, "
+                f"`sutro doctor {job_id}` for the bottleneck verdict",
+                "callout",
+            )
+        )
     log = out.get("failure_log") or []
     if log:
         shown = log[-20:]

@@ -379,7 +379,13 @@ class LocalEngine:
         return self.jobs.status(job_id).value
 
     def get_job(self, job_id: str) -> Dict[str, Any]:
-        return self.jobs.get(job_id).to_dict()
+        d = self.jobs.get(job_id).to_dict()
+        # surfaced so clients (``sutro jobs status``) can hint at the
+        # flight-recorder dump without fetching the whole document
+        d["has_telemetry_dump"] = (
+            self.jobs._dir(job_id) / "telemetry.json"
+        ).exists()
+        return d
 
     def list_jobs(self) -> List[Dict[str, Any]]:
         return self.jobs.list_jobs()
@@ -553,6 +559,20 @@ class LocalEngine:
         """Flight-recorder postmortem on job failure (best-effort)."""
         telemetry.dump_job(self.jobs._dir(job_id), job_id)
 
+    def diagnose_job(self, job_id: str) -> Dict[str, Any]:
+        """Bottleneck doctor (OBSERVABILITY.md "Doctor"): analyze the
+        job's merged cross-process telemetry document — per-process
+        stage attribution, roofline grades for device windows, and one
+        named bottleneck verdict with evidence lines."""
+        from ..telemetry import doctor
+
+        rec = self.jobs.get(job_id)
+        return doctor.diagnose(
+            self.job_telemetry(job_id, write=False),
+            status=rec.status,
+            num_rows=rec.num_rows,
+        )
+
     # ------------------------------------------------------------------
     # Worker
     # ------------------------------------------------------------------
@@ -686,6 +706,13 @@ class LocalEngine:
         self.jobs.set_status(job_id, JobStatus.STARTING)
         engine_key, mcfg, meta = resolve_model(rec.model)
         runner, tok = self._get_runner(engine_key, mcfg)
+        if telemetry.enabled():
+            # the doctor's roofline denominator: device kind + model
+            # byte counts land in the job's flight-recorder attrs
+            # (probed: stub runners in tests/benchmarks have no device)
+            device_info = getattr(runner, "device_info", None)
+            if device_info is not None:
+                telemetry.job(job_id).attrs["device"] = device_info()
 
         if rec.dry_run or mcfg.head == "embedding":
             inputs = self.jobs.read_inputs(job_id)
@@ -786,9 +813,12 @@ class LocalEngine:
 
                 # row retries ride the shard-owning rank's batcher;
                 # row events reach the coordinator's failure_log via
-                # the channel's fault messages (dphost)
+                # the channel's fault messages (dphost). job_id tags
+                # the run's spans so the shipped/merged timeline is
+                # attributable to this job
                 run_shard = functools.partial(
-                    batcher.run, row_retries=self.ecfg.row_retries
+                    batcher.run, row_retries=self.ecfg.row_retries,
+                    job_id=job_id,
                 )
                 outcome = self._dp_dispatch(
                     dp, run_shard, shard,
@@ -1014,10 +1044,27 @@ class LocalEngine:
         or None on worker ranks after setting their terminal status —
         single policy copy for the generation AND embedding paths
         (never-served sentinel, CANCELLED-not-FAILED worker mapping,
-        full-resume round skip)."""
+        full-resume round skip).
+
+        Distributed telemetry rides the channel here: rank 0 stamps a
+        trace context into the round and ingests every worker's
+        piggybacked shard (telemetry/distributed.py); worker ranks open
+        the round under the received context and ship their bounded
+        span/metrics shard on the terminal frame."""
+        from ..telemetry import distributed
         from .dphost import run_dp_coordinator, run_dp_worker
 
+        tel_on = telemetry.enabled()
         if dp.rank == 0:
+            tele_ctx = None
+            on_worker_tele = None
+            if tel_on:
+                round_no = distributed.REMOTE.next_round(job_id)
+                tele_ctx = distributed.trace_context(job_id, round_no)
+
+                def on_worker_tele(rank: int, shard: Dict) -> None:
+                    distributed.REMOTE.ingest(job_id, rank, shard)
+
             if len(done_rows) >= num_rows:
                 # resume of a fully-merged job: serve a TRIVIAL round
                 # (bind, send resume-all, drain dones briefly) so
@@ -1028,10 +1075,11 @@ class LocalEngine:
                 from .dphost import serve_resume_round
 
                 serve_resume_round(
-                    dp, job_key=job_key, done_rows=done_rows
+                    dp, job_key=job_key, done_rows=done_rows,
+                    tele_ctx=tele_ctx, on_worker_tele=on_worker_tele,
                 )
                 return "completed"
-            if telemetry.enabled():
+            if tel_on:
                 with telemetry.RECORDER.span(
                     "dp_round", job_id, world=dp.world,
                     shard_rows=len(shard),
@@ -1046,6 +1094,8 @@ class LocalEngine:
                             job_key=job_key,
                             done_rows=done_rows,
                             on_row_event=on_row_event,
+                            tele_ctx=tele_ctx,
+                            on_worker_tele=on_worker_tele,
                         )
                     finally:
                         telemetry.stage_observe(
@@ -1060,11 +1110,50 @@ class LocalEngine:
                 done_rows=done_rows,
                 on_row_event=on_row_event,
             )
+        if tel_on:
+            # the worker's results leave through the channel, not
+            # through the session's on_result — tally shard rows into
+            # the LOCAL per-job counters so the shipped shard reports
+            # what this rank executed. Registry rows_total is NOT
+            # incremented here on purpose: rows count at the
+            # coordinator's merge, so federated series sum to pod
+            # totals instead of double-counting worker rows.
+            from .dphost import _accepts_kwarg
+
+            jtel = telemetry.job(job_id)
+            inner_shard = run_shard
+
+            def run_shard(rows, *, on_result, **kw):
+                def tally(res):
+                    err = getattr(res, "error", None)
+                    fin = str(getattr(res, "finish_reason", ""))
+                    outcome = (
+                        "quarantined"
+                        if err is not None or fin.startswith("error")
+                        else "cancelled" if fin == "cancelled"
+                        else "ok"
+                    )
+                    jtel.add(f"rows_{outcome}")
+                    on_result(res)
+
+                # this wrapper's **kw makes dphost's signature probe
+                # over-permissive; re-probe the REAL shard runner
+                if "on_row_event" in kw and not _accepts_kwarg(
+                    inner_shard, "on_row_event"
+                ):
+                    kw.pop("on_row_event")
+                return inner_shard(rows, on_result=tally, **kw)
+
         try:
             w_outcome = run_dp_worker(
                 dp, run_shard, shard,
                 job_key=job_key,
                 should_cancel=should_cancel,
+                tele=(
+                    distributed.WorkerTelemetry(job_id, dp.rank)
+                    if tel_on
+                    else None
+                ),
             )
         except RuntimeError as e:
             if "never served" not in str(e):
@@ -1154,18 +1243,28 @@ class LocalEngine:
                 flush()
             row_progress.update(len(results))
 
+        # rows/s for the embed workload (live on /metrics, satellite of
+        # the distributed-telemetry PR: throughput gauges cover every
+        # workload type, not just generate). Rate is measured over the
+        # MERGED stream — under dp this is the coordinator's pod rate.
+        rows_rate = Throughput(1)
+
         def embed_progress(p: Dict[str, Any]) -> None:
+            tps = p.get("total_tokens_processed_per_second", 0.0)
+            if tel_on:
+                rows_rate.note_total(p.get("rows_completed", 0))
+                telemetry.ROWS_PER_SECOND.set(
+                    rows_rate.per_second(),
+                    "dp" if dp is not None else "embed",
+                )
+                telemetry.TOKENS_PER_SECOND.set(tps)
+                telemetry.TOKENS_PER_SECOND_PER_CHIP.set(tps / n_chips)
             jm.tokens(
                 {
                     "input_tokens": p.get("input_tokens", 0),
                     "output_tokens": 0,
-                    "total_tokens_processed_per_second": p.get(
-                        "total_tokens_processed_per_second", 0.0
-                    ),
-                    "tokens_per_second_per_chip": p.get(
-                        "total_tokens_processed_per_second", 0.0
-                    )
-                    / n_chips,
+                    "total_tokens_processed_per_second": tps,
+                    "tokens_per_second_per_chip": tps / n_chips,
                 }
             )
 
@@ -1443,7 +1542,12 @@ class _GenSession:
         self.n_chips = max(jax.device_count(), 1) * (
             dp.world if dp else 1
         )
+        self._dp = dp is not None
         self.tput = Throughput(self.n_chips)
+        # rows/s gauge feed (all workloads live on /metrics): measured
+        # over the merged done set — on a dp coordinator that is the
+        # pod-wide completion rate
+        self.rows_rate = Throughput(1)
         self.cancelled = {"flag": False}
 
         requests = []
@@ -1659,6 +1763,11 @@ class _GenSession:
             )
             telemetry.TOKENS_PER_SECOND_PER_CHIP.set(
                 p["total_tokens_processed_per_second"] / self.n_chips
+            )
+            self.rows_rate.note_total(len(self.done))
+            telemetry.ROWS_PER_SECOND.set(
+                self.rows_rate.per_second(),
+                "dp" if self._dp else "generate",
             )
         self.jm.tokens(
             {

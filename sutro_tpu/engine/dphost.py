@@ -19,16 +19,26 @@ reserved for the compute path.
 Protocol (newline-delimited JSON over one TCP connection per worker):
 
   worker -> coord   {"t": "hello", "rank": N}
-  coord  -> worker  {"t": "resume", "rows": [row_id, ...]}   (reply)
+  coord  -> worker  {"t": "resume", "rows": [row_id, ...]
+                     [, "tele": {<trace context>}]}   (reply)
   worker -> coord   {"t": "res", "row_id", "token_ids", "logprob",
                      "finish", "in_toks"}
   worker -> coord   {"t": "emb", "row_id", "vec"}   (embedding jobs)
   worker -> coord   {"t": "prog", <scheduler progress fields>}
   worker -> coord   {"t": "fault", "ev": {<failure_log event>}}
   worker -> coord   {"t": "hb", "rank": N}          (liveness beacon)
-  worker -> coord   {"t": "done", "outcome": "completed"}
-  worker -> coord   {"t": "err", "msg": "..."}
+  worker -> coord   {"t": "done", "outcome": "completed"
+                     [, "tele": {<telemetry shard>}]}
+  worker -> coord   {"t": "err", "msg": "..."
+                     [, "tele": {<telemetry shard>}]}
   coord  -> worker  {"t": "cancel"}
+
+The optional ``tele`` keys are the distributed-telemetry layer
+(telemetry/distributed.py): the coordinator stamps a versioned trace
+context into ``resume``; workers ship a bounded span/metrics shard
+back on their terminal frame. Both keys are strictly additive — an old
+peer ignores them and the round completes with partial telemetry
+(OBSERVABILITY.md "Distributed telemetry").
 
 The ``resume`` reply carries the coordinator's already-done row_ids
 (its partial store holds EVERY rank's flushed rows), so a relaunched
@@ -234,6 +244,17 @@ def _msg_res(m: Dict) -> GenResult:
     )
 
 
+def _tele_payload(tele) -> Optional[Dict]:
+    """Best-effort shard build: telemetry must never fail the round."""
+    if tele is None:
+        return None
+    try:
+        return tele.payload()
+    except Exception:
+        logger.warning("telemetry shard build failed", exc_info=True)
+        return None
+
+
 def run_dp_worker(
     world: DPWorld,
     run_shard: Callable[..., str],
@@ -241,6 +262,7 @@ def run_dp_worker(
     *,
     job_key: str = "",
     should_cancel: Optional[Callable[[], bool]] = None,
+    tele=None,
 ) -> str:
     """Rank>0 execution: run the local shard, streaming every finished
     row to the coordinator. The local jobstore is NOT authoritative —
@@ -254,7 +276,13 @@ def run_dp_worker(
     a different job must not merge its rows into whatever job the
     coordinator is currently serving — mismatched hellos are rejected
     and the worker retries until the coordinator reaches ITS job (or the
-    deadline passes)."""
+    deadline passes).
+
+    ``tele`` (optional, telemetry/distributed.py WorkerTelemetry):
+    opened under the trace context the resume reply carries, closed
+    into a bounded shard piggybacked on the terminal done/err frame.
+    None — or a resume reply without a context (old coordinator) —
+    means the round runs exactly as before."""
     import time
 
     remote_cancel = {"flag": False}
@@ -311,6 +339,16 @@ def run_dp_worker(
         time.sleep(min(delay, max(deadline - time.monotonic(), 0.05)))
     already_done = set(first.get("rows", []))
     shard = [q for q in shard if _row_id(q) not in already_done]
+    if tele is not None:
+        try:
+            # no context in the reply (old coordinator / telemetry off
+            # there) leaves the session inert — nothing ships
+            tele.begin(first.get("tele"))
+        except Exception:
+            logger.warning(
+                "telemetry trace-context open failed", exc_info=True
+            )
+            tele = None
 
     def read_control() -> None:
         try:
@@ -419,16 +457,25 @@ def run_dp_worker(
                     # heartbeat so the stall watchdog sees silence
                     hb_stop.set()
                 spec.trigger()
+        done_msg: Dict = {"t": "done", "outcome": outcome}
+        shard_payload = _tele_payload(tele)
+        if shard_payload is not None:
+            done_msg["tele"] = shard_payload
         with lock:
-            _send(sock, {"t": "done", "outcome": outcome})
+            _send(sock, done_msg)
         return outcome
     except Exception as e:  # noqa: BLE001 — surface to the coordinator
         try:
+            err_msg: Dict = {
+                "t": "err", "msg": f"{type(e).__name__}: {e}",
+            }
+            # the shard rides the error too: a failing rank's timeline
+            # is exactly what the doctor needs for the postmortem
+            shard_payload = _tele_payload(tele)
+            if shard_payload is not None:
+                err_msg["tele"] = shard_payload
             with lock:
-                _send(
-                    sock,
-                    {"t": "err", "msg": f"{type(e).__name__}: {e}"},
-                )
+                _send(sock, err_msg)
         except OSError:
             logger.warning(
                 "dp worker: could not report error to coordinator "
@@ -441,7 +488,12 @@ def run_dp_worker(
 
 
 def serve_resume_round(
-    world: DPWorld, *, job_key: str, done_rows: set
+    world: DPWorld,
+    *,
+    job_key: str,
+    done_rows: set,
+    tele_ctx: Optional[Dict] = None,
+    on_worker_tele: Optional[Callable[[int, Dict], None]] = None,
 ) -> None:
     """Serve one trivial coordinator round for the resume of a job whose
     rows are ALL already merged. Re-queued workers connect, receive the
@@ -471,10 +523,23 @@ def serve_resume_round(
     # keeping this port bound past the window
     deadline = _time.monotonic() + grace
 
-    def drain(conn: socket.socket, lines) -> None:
+    def drain(conn: socket.socket, lines, rank: int) -> None:
         try:
             for m in lines:
                 if m.get("t") in ("done", "err"):
+                    # even a trivial no-op round ships its (tiny)
+                    # telemetry shard — same wire as a real round
+                    shard = m.get("tele")
+                    if on_worker_tele is not None and isinstance(
+                        shard, dict
+                    ):
+                        try:
+                            on_worker_tele(rank, shard)
+                        except Exception:
+                            logger.warning(
+                                "worker telemetry ingest failed "
+                                "(rank %d)", rank, exc_info=True,
+                            )
                     break
         except OSError:
             pass
@@ -507,13 +572,18 @@ def serve_resume_round(
                         pass
                     conn.close()
                     continue
-                _send(conn, {"t": "resume", "rows": rows})
+                resume_msg: Dict = {"t": "resume", "rows": rows}
+                if tele_ctx is not None:
+                    resume_msg["tele"] = tele_ctx
+                _send(conn, resume_msg)
             except OSError:
                 conn.close()
                 continue
             accepted += 1
             t = threading.Thread(
-                target=drain, args=(conn, lines), daemon=True
+                target=drain,
+                args=(conn, lines, int(first.get("rank", -1))),
+                daemon=True,
             )
             t.start()
             threads.append(t)
@@ -534,6 +604,8 @@ def run_dp_coordinator(
     should_cancel: Optional[Callable[[], bool]] = None,
     done_rows: Optional[set] = None,
     on_row_event: Optional[Callable[[Dict], None]] = None,
+    tele_ctx: Optional[Dict] = None,
+    on_worker_tele: Optional[Callable[[int, Dict], None]] = None,
 ) -> str:
     """Rank-0 execution: collect the local shard AND every worker's
     stream through the same ``on_result`` (the jobstore's row_id-keyed
@@ -553,7 +625,12 @@ def run_dp_coordinator(
 
     Connections greeting with a different ``job_key`` (a rank whose
     queue diverged) are rejected and do not count toward the expected
-    worker set."""
+    worker set.
+
+    ``tele_ctx`` (optional trace context, telemetry/distributed.py) is
+    stamped into every resume reply; ``on_worker_tele(rank, shard)``
+    receives the telemetry shard a worker piggybacks on its terminal
+    done/err frame. Both default to None — the pre-telemetry wire."""
     listener = socket.create_server(
         (world.host, world.port), reuse_port=False
     )
@@ -581,6 +658,20 @@ def run_dp_coordinator(
     rank_conn: Dict[int, socket.socket] = {}
     rank_gen: Dict[int, int] = {}
     last_msg: Dict[int, float] = {}  # rank -> monotonic of last message
+
+    def _take_tele(rank: int, m: Dict) -> None:
+        # piggybacked telemetry shard on a terminal frame: hand it to
+        # the ingestion sink, never let it affect the round's outcome
+        shard = m.get("tele")
+        if on_worker_tele is None or not isinstance(shard, dict):
+            return
+        try:
+            on_worker_tele(rank, shard)
+        except Exception:
+            logger.warning(
+                "worker telemetry ingest failed (rank %d)", rank,
+                exc_info=True,
+            )
 
     def serve(conn: socket.socket, lines, rank: int, gen: int) -> None:
         import time as _time
@@ -623,6 +714,7 @@ def run_dp_coordinator(
                                 exc_info=True,
                             )
                 elif t == "done":
+                    _take_tele(rank, m)
                     # a worker shard that did not COMPLETE (e.g.
                     # cancelled after the coordinator's own shard
                     # finished clean) must not let the job finalize as
@@ -636,6 +728,7 @@ def run_dp_coordinator(
                         )
                     break
                 elif t == "err":
+                    _take_tele(rank, m)
                     err = str(m["msg"])
                     break
         except OSError as e:
@@ -712,13 +805,13 @@ def run_dp_coordinator(
                         conn.close()
                         continue
                     conn.settimeout(None)
-                    _send(
-                        conn,
-                        {
-                            "t": "resume",
-                            "rows": sorted(done_rows or ()),
-                        },
-                    )
+                    resume_msg: Dict = {
+                        "t": "resume",
+                        "rows": sorted(done_rows or ()),
+                    }
+                    if tele_ctx is not None:
+                        resume_msg["tele"] = tele_ctx
+                    _send(conn, resume_msg)
                     if cancel_sent["flag"]:
                         # cancelled before this worker connected — it
                         # would otherwise run its whole shard

@@ -247,6 +247,13 @@ class JobStore:
             # terminal TRANSITIONS (a resumed-then-failed job counts
             # twice — each is a real lifecycle event)
             telemetry.JOBS_TOTAL.inc(1.0, status.value.lower())
+        if telemetry.ENABLED and status == JobStatus.CANCELLED:
+            # CANCELLED dumps the flight recorder like FAILED does
+            # (engine/api.py handles FAILED at its failure boundaries):
+            # a cancelled 20k-row job is exactly when an operator asks
+            # "how far did it get, and why was it slow" — this is the
+            # one funnel every cancel path passes through
+            telemetry.dump_job(self._dir(job_id), job_id)
 
     def status(self, job_id: str) -> JobStatus:
         return JobStatus(self.get(job_id).status)

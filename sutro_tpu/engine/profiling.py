@@ -17,8 +17,19 @@ latency profile without external tooling.
 from __future__ import annotations
 
 import contextlib
+import logging
+import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional
+
+logger = logging.getLogger(__name__)
+
+# jax.profiler supports ONE device trace per process: two co-batched
+# jobs with profile_dir set used to both call start_trace and the
+# second raised. Refcounted instead — the first job starts the trace,
+# later overlapping jobs join it (logged), the last one out stops it.
+_trace_lock = threading.Lock()
+_trace_state: Dict[str, Any] = {"count": 0, "path": None}
 
 
 @contextlib.contextmanager
@@ -30,13 +41,41 @@ def job_trace(profile_dir: Optional[str], job_id: str) -> Iterator[None]:
 
     import jax
 
-    path = os.path.join(profile_dir, job_id)
-    os.makedirs(path, exist_ok=True)
-    jax.profiler.start_trace(path)
+    with _trace_lock:
+        if _trace_state["count"] == 0:
+            path = os.path.join(profile_dir, job_id)
+            os.makedirs(path, exist_ok=True)
+            jax.profiler.start_trace(path)
+            _trace_state["path"] = path
+        else:
+            logger.info(
+                "device trace already running (%s); %s joins it "
+                "instead of starting a second trace",
+                _trace_state["path"], job_id,
+            )
+        _trace_state["count"] += 1
+        active_path = _trace_state["path"]
+    # the job's telemetry document records WHERE its device trace went
+    # (its own dir, or the co-batched job's trace it joined)
+    from .. import telemetry
+
+    if telemetry.enabled():
+        telemetry.job(job_id).attrs["profile_trace"] = active_path
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        with _trace_lock:
+            _trace_state["count"] -= 1
+            if _trace_state["count"] == 0:
+                _trace_state["path"] = None
+                try:
+                    jax.profiler.stop_trace()
+                except RuntimeError:
+                    # e.g. the trace died with the backend; a profiling
+                    # teardown must never fail the job
+                    logger.warning(
+                        "stop_trace failed", exc_info=True
+                    )
 
 
 class StepTimer:

@@ -206,6 +206,36 @@ class ModelRunner:
                 **scale_kw,
             )
 
+    def device_info(self) -> dict:
+        """Device + model facts the bottleneck doctor grades decode
+        windows against (engine/roofline.py denominators). Computed
+        once per runner — the param-tree walk is not free — and stored
+        in each job's flight-recorder attrs."""
+        cached = getattr(self, "_device_info", None)
+        if cached is not None:
+            return cached
+        from .roofline import param_bytes_of, param_count_of
+
+        devs = jax.devices()
+        info = {
+            "device_kind": str(
+                getattr(devs[0], "device_kind", "") if devs else ""
+            ),
+            "n_devices": len(devs),
+            "param_bytes": param_bytes_of(self.params),
+            "n_params": param_count_of(self.params),
+            "num_layers": int(self.mcfg.num_layers),
+            "kv_heads": int(self.mcfg.num_kv_heads),
+            "head_dim": int(self.mcfg.head_dim),
+            "kv_dtype_bytes": (
+                1
+                if getattr(self.ecfg, "kv_quantize", None) == "int8"
+                else jnp.dtype(self.ecfg.activation_dtype).itemsize
+            ),
+        }
+        self._device_info = info
+        return info
+
     @staticmethod
     def _paged(cache: KVCache, page_table):
         """The ``paged_past`` tuple for transformer.forward: 3 elements

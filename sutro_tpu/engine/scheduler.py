@@ -430,6 +430,10 @@ class ContinuousBatcher:
         # attributable per job
         self._tel_on = telemetry.enabled()
         self._tel_jobs: Tuple[str, ...] = ()
+        # per-window device-time attribution (doctor roofline grades):
+        # the decode/prefill loops stash {stage: {batch, steps, ...}}
+        # here right before dispatch; the sink folds it into the span
+        self._tel_attrs: Dict[str, Dict[str, Any]] = {}
         self.timer = StepTimer(
             sink=self._tel_sink if self._tel_on else None
         )
@@ -437,10 +441,13 @@ class ContinuousBatcher:
     def _tel_sink(self, phase: str, t0: float, dt: float) -> None:
         stage = _TEL_STAGE.get(phase, phase)
         telemetry.stage_observe(stage, dt)
-        telemetry.RECORDER.record(
-            stage, None, t0, dt,
-            {"jobs": self._tel_jobs} if self._tel_jobs else None,
-        )
+        extra = self._tel_attrs.get(stage)
+        attrs = None
+        if self._tel_jobs or extra:
+            attrs = dict(extra or ())
+            if self._tel_jobs:
+                attrs["jobs"] = self._tel_jobs
+        telemetry.RECORDER.record(stage, None, t0, dt, attrs)
 
     # ------------------------------------------------------------------
 
@@ -513,6 +520,8 @@ class ContinuousBatcher:
         table = np.zeros((self.MP,), np.int32)
         table[:n_pages] = pages
         try:
+            if self._tel_on:
+                self._tel_attrs["prefill"] = {"tokens": int(shared)}
             with self.timer.time("prefill"):
                 # last-position logits are discarded: each row derives
                 # its first sample from its OWN suffix prefill
@@ -725,6 +734,16 @@ class ContinuousBatcher:
             for b in batch
         ]
         try:
+            if self._tel_on:
+                self._tel_attrs["prefill"] = {
+                    "tokens": int(
+                        sum(
+                            len(r.prompt_ids) - s
+                            for r, s in zip(reqs, starts)
+                        )
+                    ),
+                    "batch": len(batch),
+                }
             with self.timer.time("prefill"):
                 if len(batch) == 1:
                     logits = self.runner.prefill(
@@ -840,6 +859,8 @@ class ContinuousBatcher:
         req = s.req
         C = self.ecfg.prefill_chunk
         seg = req.prompt_ids[s.prefill_pos : s.prefill_pos + C]
+        if self._tel_on:
+            self._tel_attrs["prefill"] = {"tokens": int(len(seg))}
         with self.timer.time("prefill"):
             logits = self.runner.prefill_batch_at(
                 [np.asarray(seg, np.int32)],
@@ -1819,6 +1840,7 @@ class ContinuousBatcher:
         progress_every: float = 1.0,
         row_retries: int = 0,
         on_row_event: Optional[Callable[[Dict[str, Any]], None]] = None,
+        job_id: str = "_single",
     ) -> str:
         """Run all requests to completion, streaming results/progress.
 
@@ -1834,10 +1856,14 @@ class ContinuousBatcher:
         domain (see JobCtx) — DP shards get the same retry/quarantine
         semantics as co-batched sessions.
 
+        ``job_id`` tags this run's telemetry spans (dp shard runs pass
+        their engine job id so the flight-recorder timeline is
+        attributable; the default keeps ad-hoc callers anonymous).
+
         Single-job convenience over :meth:`run_multi`."""
         outcome: Dict[str, str] = {}
         ctx = JobCtx(
-            job_id="_single",
+            job_id=job_id,
             pending=list(requests),
             on_result=on_result,
             on_progress=on_progress,
@@ -2351,6 +2377,15 @@ class ContinuousBatcher:
                     and not self._needs_mask
                 )
                 if pipe_ok or pipe:
+                    if self._tel_on:
+                        self._tel_attrs["decode_window"] = {
+                            "batch": len(active),
+                            "steps": KS,
+                            "avg_ctx": round(
+                                sum(int(past_len[i]) for i in active)
+                                / max(len(active), 1), 1,
+                            ),
+                        }
                     # a pending spec probe suspends refill so the pipe
                     # drains (one window per iteration) and the probe
                     # above gets its `not pipe` opening
@@ -2422,6 +2457,18 @@ class ContinuousBatcher:
                     if cap >= self.ecfg.decode_multi_step:
                         K = self.ecfg.decode_multi_step
 
+                if self._tel_on:
+                    # window attribution for the doctor's roofline
+                    # grade: occupancy x fused steps over the span's
+                    # duration is the window's attempted token rate
+                    self._tel_attrs["decode_window"] = {
+                        "batch": len(active),
+                        "steps": K,
+                        "avg_ctx": round(
+                            sum(int(past_len[i]) for i in active)
+                            / max(len(active), 1), 1,
+                        ),
+                    }
                 self._key, sub = jax.random.split(self._key)
                 # row-seeded sampling needs a batch-independent base key
                 # so a row's stream reproduces regardless of batch

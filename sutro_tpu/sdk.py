@@ -491,17 +491,23 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
         self, job_id: str, with_failure_log: bool = False
     ) -> Any:
         """Job status string; with ``with_failure_log`` a dict
-        ``{"status", "failure_log"}`` — the engine's structured
-        retry/quarantine/terminal-failure trail (FAILURES.md)."""
+        ``{"status", "failure_log", "has_telemetry_dump"}`` — the
+        engine's structured retry/quarantine/terminal-failure trail
+        (FAILURES.md) plus whether a flight-recorder dump exists
+        (``sutro telemetry --job`` / ``sutro doctor``)."""
         if self.backend == "remote":
             body = self._remote_json("get", f"job-status/{job_id}")
             status = body["job_status"][job_id]
         else:
             status = self.engine.job_status(job_id)
         if with_failure_log:
+            rec = self._fetch_job(job_id)
             return {
                 "status": status,
-                "failure_log": self.get_job_failure_log(job_id),
+                "failure_log": rec.get("failure_log") or [],
+                "has_telemetry_dump": bool(
+                    rec.get("has_telemetry_dump")
+                ),
             }
         return status
 
@@ -523,6 +529,18 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
                 "telemetry"
             ]
         return self.engine.job_telemetry(job_id)
+
+    def diagnose_job(self, job_id: str) -> Dict[str, Any]:
+        """Bottleneck doctor (OBSERVABILITY.md "Doctor"): per-process
+        stage attribution over the job's merged cross-process telemetry
+        document, roofline grades for its device windows, and one named
+        bottleneck verdict with evidence lines. Both backends (the
+        remote daemon serves it as ``GET /job-doctor/{id}``)."""
+        if self.backend == "remote":
+            return self._remote_json("get", f"job-doctor/{job_id}")[
+                "doctor"
+            ]
+        return self.engine.diagnose_job(job_id)
 
     def get_metrics_text(self) -> str:
         """Engine metrics registry in Prometheus text exposition format

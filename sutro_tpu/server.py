@@ -24,8 +24,11 @@ GET /try-authentication, GET /get-quotas, POST /functions/run.
 
 Telemetry surfaces (no reference analogue — OBSERVABILITY.md): GET
 /metrics serves the engine registry in Prometheus text exposition
-format for scraping; GET /job-telemetry/{id} serves a job's flight-
-recorder document (span timeline + exact per-job counters).
+format for scraping (dp coordinators include worker-labelled federated
+series); GET /job-telemetry/{id} serves a job's flight-recorder
+document (span timeline + exact per-job counters + per-worker dp
+sections); GET /job-doctor/{id} serves the bottleneck doctor's
+diagnosis of that document.
 """
 
 from __future__ import annotations
@@ -149,6 +152,8 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
                 self._metrics()
             elif head == "job-telemetry" and rest:
                 self._json({"telemetry": eng.job_telemetry(rest)})
+            elif head == "job-doctor" and rest:
+                self._json({"doctor": eng.diagnose_job(rest)})
             elif head == "healthz":
                 self._json({"ok": True})
             else:

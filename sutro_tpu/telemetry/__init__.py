@@ -47,6 +47,7 @@ __all__ = [
     "REGISTRY",
     "RECORDER",
     "JOBS",
+    "distributed",
     "enabled",
     "set_enabled",
     "stage_observe",
@@ -146,6 +147,13 @@ TOKENS_PER_SECOND = REGISTRY.gauge(
     "Most recent total token throughput reported by a running job",
     unit="tokens/s",
 )
+ROWS_PER_SECOND = REGISTRY.gauge(
+    "sutro_rows_per_second",
+    "Most recent row completion rate by workload "
+    "(generate, embed, dp — dp is the coordinator's pod-merged rate)",
+    labels=("workload",),
+    unit="rows/s",
+)
 TOKENS_PER_SECOND_PER_CHIP = REGISTRY.gauge(
     "sutro_tokens_per_second_per_chip",
     "Most recent per-chip token throughput (Throughput estimator)",
@@ -189,15 +197,20 @@ def job(job_id: str) -> JobCounters:
 
 # -- per-job document / flight-recorder dump ---------------------------
 
-SCHEMA_VERSION = 1
+# v2: adds per-job "attrs" (device info, profile trace path) and, for
+# dp coordinator jobs, "workers" — the ingested per-rank sections
+# (telemetry/distributed.py), merged by (round, rank)
+SCHEMA_VERSION = 2
 
 
 def job_doc(job_id: str) -> Dict[str, Any]:
     """Assemble the per-job telemetry document from live state: the
-    job's span timeline (flight recorder) + its exact counters."""
+    job's span timeline (flight recorder) + its exact counters, plus —
+    on a dp coordinator — every ingested worker section (the merged
+    cross-process timeline the doctor analyzes)."""
     jc = JOBS.peek(job_id)
     spans = RECORDER.snapshot(job_id)
-    return {
+    doc: Dict[str, Any] = {
         "version": SCHEMA_VERSION,
         "job_id": job_id,
         "dumped_at": time.strftime(
@@ -212,6 +225,20 @@ def job_doc(job_id: str) -> Dict[str, Any]:
         "stages": sorted({s["name"] for s in spans}),
         "spans": spans,
     }
+    if jc is not None and jc.attrs:
+        doc["attrs"] = dict(jc.attrs)
+    workers = distributed.REMOTE.sections(job_id)
+    if workers:
+        doc["workers"] = workers
+        doc["stages"] = sorted(
+            set(doc["stages"])
+            | {
+                s["name"]
+                for w in workers
+                for s in w.get("spans", ())
+            }
+        )
+    return doc
 
 
 def dump_job(job_dir: Path, job_id: str) -> Optional[Dict[str, Any]]:
@@ -254,3 +281,9 @@ def reset_for_tests() -> None:
     RECORDER.clear()
     for jc in JOBS:
         JOBS.drop(jc.job_id)
+    distributed.REMOTE.clear()
+
+
+# imported last: distributed.py resolves the package singletons above
+# lazily at call time, so the bottom import only publishes the name
+from . import distributed  # noqa: E402

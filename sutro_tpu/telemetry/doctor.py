@@ -1,0 +1,377 @@
+"""Job bottleneck doctor: name WHY a job was slow, with evidence.
+
+Input is the merged per-job telemetry document (``telemetry.job_doc``
+— local flight-recorder timeline plus, for dp coordinator jobs, the
+ingested per-worker sections from telemetry/distributed.py). Output is
+a deterministic diagnosis document (golden-pinned by
+tests/test_doctor.py):
+
+- **per-process stage attribution** — wall time split across engine
+  stages for the coordinator ("rank0") and every worker rank that
+  shipped telemetry;
+- **roofline grades** — decode windows carry ``batch``/``steps``/
+  ``avg_ctx`` attrs (scheduler) and the job's attrs carry the runner's
+  device info, so each window's attempted token rate grades against
+  the chip's HBM roofline (engine/roofline.py) and prefill spans grade
+  as MFU;
+- **one named verdict** from a fixed taxonomy, most-specific first:
+
+  ========================  ============================================
+  verdict                   meaning
+  ========================  ============================================
+  ``insufficient_data``     no spans anywhere (telemetry off / evicted)
+  ``straggler_worker``      one rank's wall >= 1.5x the median of the
+                            others — the pod waits on that slice
+  ``io_bound``              flush+finalize dominate both compute and
+                            the rest of the host pipeline
+  ``host_bound_admit``      host-side admission work (tokenize,
+                            constraint compile, accept) exceeds device
+                            time — the chip starves behind the host
+  ``decode_below_roofline``  device-bound but the median decode window
+                            runs under 40% of the HBM roofline
+  ``healthy``               none of the above
+  ========================  ============================================
+
+Partial data degrades, never fails: a dp world with silent ranks (old
+workers, telemetry disabled there) is diagnosed from what arrived and
+flagged ``partial`` with the missing ranks named in the evidence.
+
+Pure analysis on purpose — no engine imports beyond the dependency-free
+roofline table — so the doctor runs identically on a live engine, a
+persisted ``telemetry.json``, or a synthetic document in tests.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..engine import roofline
+
+DOCTOR_VERSION = 1
+
+#: stages whose duration is device dispatch/fetch (the chip working)
+DEVICE_STAGES = ("prefill", "decode_window", "admit", "embed")
+#: host-side pipeline stages (the chip idle or overlapped)
+HOST_STAGES = ("tokenize", "constraint_compile", "accept", "flush",
+               "finalize")
+#: I/O subset of the host stages (jobstore writes)
+IO_STAGES = ("flush", "finalize")
+#: round envelopes — excluded from attribution (they CONTAIN stages)
+ENVELOPE_STAGES = ("dp_round",)
+
+#: the verdict taxonomy, in priority order (OBSERVABILITY.md "Doctor")
+VERDICTS = (
+    "insufficient_data",
+    "straggler_worker",
+    "io_bound",
+    "host_bound_admit",
+    "decode_below_roofline",
+    "healthy",
+)
+
+#: a decode window under this fraction of the HBM roofline is "below"
+ROOFLINE_OK_PCT = 40.0
+#: a rank this much slower than the median of the others is a straggler
+STRAGGLER_RATIO = 1.5
+
+
+def _attribution(spans: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Wall/stage attribution for ONE process's span list (its own
+    timeline — offsets are process-relative, so no cross-host clock
+    enters here)."""
+    stages: Dict[str, Dict[str, float]] = {}
+    t_lo, t_hi = float("inf"), float("-inf")
+    worked = 0
+    for s in spans:
+        name = s.get("name")
+        if name in ENVELOPE_STAGES:
+            # envelopes CONTAIN stages — and the coordinator's
+            # dp_round spans the whole pod round including its wait on
+            # workers, so counting it toward wall would make rank0
+            # "slowest" by construction
+            continue
+        dur = float(s.get("dur_s", 0.0))
+        t0 = float(s.get("t0_s", 0.0))
+        t_lo = min(t_lo, t0)
+        t_hi = max(t_hi, t0 + dur)
+        worked += 1
+        e = stages.setdefault(name, {"count": 0, "total_s": 0.0})
+        e["count"] += 1
+        e["total_s"] += dur
+    for e in stages.values():
+        e["total_s"] = round(e["total_s"], 6)
+    wall = max(t_hi - t_lo, 0.0) if worked else 0.0
+
+    def _sum(names: Tuple[str, ...]) -> float:
+        return round(
+            sum(stages.get(n, {}).get("total_s", 0.0) for n in names), 6
+        )
+
+    return {
+        "spans": len(spans),
+        "wall_s": round(wall, 6),
+        "device_s": _sum(DEVICE_STAGES),
+        "host_s": _sum(HOST_STAGES),
+        "io_s": _sum(IO_STAGES),
+        "stages": {k: stages[k] for k in sorted(stages)},
+    }
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    if n == 0:
+        return 0.0
+    if n % 2:
+        return s[n // 2]
+    return (s[n // 2 - 1] + s[n // 2]) / 2.0
+
+
+def _grade_roofline(
+    spans: List[Dict[str, Any]],
+    device: Optional[Dict[str, Any]],
+    counters: Dict[str, Any],
+) -> Optional[Dict[str, Any]]:
+    """Grade one process's device windows against its chip roofline.
+    None when the process shipped no device info; a ``reason`` entry
+    when the device kind has no public spec (CPU, emulators) — grades
+    are omitted, never fabricated (engine/roofline.py contract)."""
+    if not isinstance(device, dict):
+        return None
+    kind = str(device.get("device_kind") or "")
+    if roofline.hw_specs(kind) is None:
+        return {"device_kind": kind, "graded_windows": 0,
+                "reason": f"no roofline spec for device kind {kind!r}"}
+    n_dev = max(int(device.get("n_devices", 1)), 1)
+    # fallback context depth when a window lacks avg_ctx: prompt plus
+    # half the generated tail, from the job's exact counters
+    rows = float(
+        counters.get("rows_ok", 0)
+        + counters.get("rows_quarantined", 0)
+        + counters.get("rows_cancelled", 0)
+    )
+    ctx_fallback = None
+    if rows > 0:
+        ctx_fallback = (
+            float(counters.get("input_tokens", 0))
+            + float(counters.get("output_tokens", 0)) / 2.0
+        ) / rows
+    decode_pcts: List[float] = []
+    mfus: List[float] = []
+    for s in spans:
+        attrs = s.get("attrs") or {}
+        dur = float(s.get("dur_s", 0.0))
+        if dur <= 0:
+            continue
+        if s.get("name") == "decode_window" and attrs.get("batch"):
+            batch = int(attrs["batch"])
+            steps = int(attrs.get("steps", 1))
+            avg_ctx = attrs.get("avg_ctx", ctx_fallback)
+            if avg_ctx is None:
+                continue
+            bps = roofline.decode_bytes_per_step(
+                param_bytes=int(device.get("param_bytes", 0)),
+                batch=batch,
+                avg_ctx=float(avg_ctx),
+                num_layers=int(device.get("num_layers", 0)),
+                kv_heads=int(device.get("kv_heads", 0)),
+                head_dim=int(device.get("head_dim", 0)),
+                kv_dtype_bytes=int(device.get("kv_dtype_bytes", 2)),
+            )
+            g = roofline.grade_decode(
+                batch * steps / dur / n_dev,
+                batch=batch,
+                bytes_per_step=bps,
+                device_kind=kind,
+            )
+            if g.get("pct_hbm_roofline") is not None:
+                decode_pcts.append(float(g["pct_hbm_roofline"]))
+        elif s.get("name") == "prefill" and attrs.get("tokens"):
+            g = roofline.grade_prefill(
+                float(attrs["tokens"]) / dur / n_dev,
+                n_params=int(device.get("n_params", 0)),
+                device_kind=kind,
+            )
+            if g.get("mfu_prefill") is not None:
+                mfus.append(float(g["mfu_prefill"]))
+    out: Dict[str, Any] = {
+        "device_kind": kind,
+        "graded_windows": len(decode_pcts),
+    }
+    if decode_pcts:
+        out["decode_pct_hbm_median"] = round(_median(decode_pcts), 1)
+        out["decode_pct_hbm_best"] = round(max(decode_pcts), 1)
+    if mfus:
+        out["mfu_prefill_median"] = round(_median(mfus), 1)
+    return out
+
+
+def diagnose(
+    doc: Dict[str, Any],
+    *,
+    status: Optional[str] = None,
+    num_rows: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Analyze one merged job telemetry document into a diagnosis with
+    a named bottleneck verdict (see module docstring for the taxonomy)
+    and human-readable evidence lines."""
+    job_id = doc.get("job_id")
+    counters = doc.get("counters") or {}
+    attrs = doc.get("attrs") or {}
+
+    # -- assemble per-process span lists (merged by round per rank) ----
+    procs: Dict[str, Dict[str, Any]] = {
+        "rank0": {
+            "spans": list(doc.get("spans") or ()),
+            "counters": counters,
+            "device": attrs.get("device"),
+        }
+    }
+    world = None
+    for s in procs["rank0"]["spans"]:
+        a = s.get("attrs") or {}
+        if s.get("name") == "dp_round" and a.get("world"):
+            world = int(a["world"])
+    present_ranks = set()
+    for w in doc.get("workers") or ():
+        rank = w.get("rank")
+        present_ranks.add(rank)
+        name = f"rank{rank}"
+        p = procs.setdefault(
+            name, {"spans": [], "counters": {}, "device": None}
+        )
+        p["spans"].extend(w.get("spans") or ())
+        if w.get("counters"):
+            p["counters"] = w["counters"]
+        dev = (w.get("attrs") or {}).get("device")
+        if dev:
+            p["device"] = dev
+
+    processes: Dict[str, Dict[str, Any]] = {}
+    for name in sorted(procs):
+        p = procs[name]
+        att = _attribution(p["spans"])
+        rl = _grade_roofline(p["spans"], p["device"], p["counters"])
+        if rl is not None:
+            att["roofline"] = rl
+        processes[name] = att
+
+    missing_ranks = (
+        sorted(r for r in range(1, world) if r not in present_ranks)
+        if world
+        else []
+    )
+
+    # -- evidence + verdict --------------------------------------------
+    evidence: List[str] = []
+    verdict: Optional[str] = None
+
+    if missing_ranks:
+        evidence.append(
+            "partial data: no telemetry shard from rank(s) "
+            + ", ".join(str(r) for r in missing_ranks)
+            + f" of a world of {world} (old worker or telemetry "
+            "disabled there)"
+        )
+
+    total_spans = sum(a["spans"] for a in processes.values())
+    if total_spans == 0:
+        verdict = "insufficient_data"
+        evidence.append(
+            "no spans recorded for this job (telemetry disabled, or "
+            "the flight recorder evicted its window)"
+        )
+
+    # straggler: a rank whose wall dwarfs the median of the others
+    walls = {
+        n: a["wall_s"] for n, a in processes.items() if a["spans"]
+    }
+    if verdict is None and len(walls) >= 2:
+        slowest = max(sorted(walls), key=lambda n: walls[n])
+        rest = _median([v for n, v in walls.items() if n != slowest])
+        if rest > 0 and walls[slowest] >= STRAGGLER_RATIO * rest:
+            verdict = "straggler_worker"
+            evidence.append(
+                f"{slowest} wall {walls[slowest]:.3f}s vs median "
+                f"{rest:.3f}s of the other process(es) "
+                f"(>= {STRAGGLER_RATIO}x): the pod waits on that slice"
+            )
+
+    device_s = round(
+        sum(a["device_s"] for a in processes.values()), 6
+    )
+    host_s = round(sum(a["host_s"] for a in processes.values()), 6)
+    io_s = round(sum(a["io_s"] for a in processes.values()), 6)
+    admit_s = round(host_s - io_s, 6)  # tokenize+constraint+accept
+
+    if verdict is None and io_s > device_s and io_s > admit_s:
+        verdict = "io_bound"
+        evidence.append(
+            f"flush+finalize {io_s:.3f}s exceed device time "
+            f"{device_s:.3f}s and the rest of the host pipeline "
+            f"{admit_s:.3f}s: the jobstore I/O path is the bottleneck"
+        )
+    if verdict is None and admit_s > device_s:
+        top = ""
+        top_s = -1.0
+        for a in processes.values():
+            for st in ("tokenize", "constraint_compile", "accept"):
+                v = a["stages"].get(st, {}).get("total_s", 0.0)
+                if v > top_s:
+                    top, top_s = st, v
+        verdict = "host_bound_admit"
+        evidence.append(
+            f"host admission pipeline {admit_s:.3f}s exceeds device "
+            f"time {device_s:.3f}s (largest: {top} {top_s:.3f}s): the "
+            "chip starves behind the host"
+        )
+
+    if verdict is None:
+        pcts = [
+            a["roofline"]["decode_pct_hbm_median"]
+            for a in processes.values()
+            if a.get("roofline", {}).get("decode_pct_hbm_median")
+            is not None
+        ]
+        if pcts and _median(pcts) < ROOFLINE_OK_PCT:
+            verdict = "decode_below_roofline"
+            evidence.append(
+                f"median decode window at {_median(pcts):.1f}% of the "
+                f"HBM roofline (< {ROOFLINE_OK_PCT:.0f}%): decode is "
+                "device-bound but far from the memory-bandwidth bound "
+                "(batch too small, context too short, or kernel "
+                "inefficiency)"
+            )
+
+    if verdict is None:
+        verdict = "healthy"
+        evidence.append(
+            f"device time {device_s:.3f}s dominates host time "
+            f"{host_s:.3f}s and no process stands out"
+        )
+
+    q = counters.get("rows_quarantined", 0)
+    if q:
+        evidence.append(
+            f"{q} row(s) quarantined — see the job's failure_log for "
+            "per-row causes"
+        )
+
+    return {
+        "version": DOCTOR_VERSION,
+        "job_id": job_id,
+        "status": status,
+        "num_rows": num_rows,
+        "verdict": verdict,
+        "evidence": evidence,
+        "partial": bool(missing_ranks),
+        "missing_ranks": missing_ranks,
+        "world": world,
+        "processes": processes,
+        "totals": {
+            "spans": total_spans,
+            "device_s": device_s,
+            "host_s": host_s,
+            "io_s": io_s,
+        },
+    }

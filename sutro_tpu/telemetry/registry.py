@@ -27,14 +27,27 @@ Exporters: Prometheus text exposition (0.0.4) via
 :meth:`MetricsRegistry.to_prometheus` and a JSON snapshot via
 :meth:`MetricsRegistry.to_json`. Both produce deterministic ordering
 (sorted by metric name, then label values) so goldens are stable.
+
+Federation (telemetry/distributed.py): a dp worker exports a compact
+local snapshot (:meth:`MetricsRegistry.export_snapshot`), ships the
+per-round difference (:func:`snapshot_delta`) over the dp channel, and
+the coordinator folds it in with :meth:`MetricsRegistry.ingest_remote`.
+Ingested series keep their metric identity but gain a trailing
+``worker`` label (the coordinator's own series export as worker "0");
+metrics with no remote contribution export exactly as before, so
+single-process goldens are unaffected. Worker-label cardinality is
+bounded like any label (overflow collapses into ``_overflow``).
 """
 
 from __future__ import annotations
 
 import bisect
+import logging
 import math
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
 
 # default latency buckets (seconds): 100us .. ~100s, log-ish spacing —
 # covers tokenize batches, decode windows, flushes and finalizes alike
@@ -151,6 +164,10 @@ class Histogram(_Metric):
 
 
 class MetricsRegistry:
+    #: worker-label cardinality cap for federation (ingest_remote):
+    #: shards from more distinct workers collapse into "_overflow"
+    MAX_WORKERS = 64
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._metrics: Dict[str, _Metric] = {}
@@ -159,6 +176,11 @@ class MetricsRegistry:
         # (thread, shard) pairs; dead threads' shards fold into _retired
         self._shards: List[Tuple[threading.Thread, _Shard]] = []
         self._retired = _Shard()
+        # federation: worker id -> accumulated remote series
+        # (telemetry/distributed.py coordinator ingestion). Counters and
+        # histograms ACCUMULATE across ingests (workers ship per-round
+        # deltas); gauges are last-write-wins per worker.
+        self._remote: Dict[str, Dict[str, Dict]] = {}
 
     # -- declaration ---------------------------------------------------
 
@@ -242,23 +264,57 @@ class MetricsRegistry:
 
     # -- collection / export -------------------------------------------
 
+    @staticmethod
+    def _hist_view(m: "Histogram", acc: Sequence[float]) -> Dict[str, Any]:
+        les = [*m.buckets, math.inf]
+        return {
+            "buckets": {
+                ("+Inf" if math.isinf(le) else repr(le)): int(
+                    sum(acc[: i + 1])
+                )
+                for i, le in enumerate(les)
+            },
+            "sum": acc[-2],
+            "count": int(acc[-1]),
+        }
+
     def collect(self) -> Dict[str, Dict[str, Any]]:
         """Aggregated snapshot:
         ``{name: {type, help, unit, labels, series: {"a,b": value}}}``
         — histogram series values are
-        ``{buckets: {le: n}, sum, count}``."""
+        ``{buckets: {le: n}, sum, count}``.
+
+        When remote worker shards have been ingested (federation), any
+        metric with a remote contribution gains a trailing ``worker``
+        label: its local series carry worker "0", remote series carry
+        their worker id. Metrics without remote data are unchanged."""
         agg = self._aggregate()
         with self._lock:
             metrics = dict(self._metrics)
+            remote = {
+                w: {
+                    kind: dict(series)
+                    for kind, series in shard.items()
+                }
+                for w, shard in self._remote.items()
+            }
         gauges = dict(self._gauges)
+        remote_names = {
+            n
+            for shard in remote.values()
+            for series in shard.values()
+            for (n, _lv) in series
+        }
         out: Dict[str, Dict[str, Any]] = {}
         for name in sorted(metrics):
             m = metrics[name]
+            federated = name in remote_names
             entry: Dict[str, Any] = {
                 "type": m.kind,
                 "help": m.help,
                 "unit": m.unit,
-                "labels": list(m.label_names),
+                "labels": list(m.label_names)
+                + (["worker"] if federated else []),
                 "series": {},
             }
             if isinstance(m, Gauge):
@@ -266,31 +322,114 @@ class MetricsRegistry:
                     lv: v for (n, lv), v in gauges.items() if n == name
                 }
             elif isinstance(m, Histogram):
-                src = {}
-                for (n, lv), acc in agg.hists.items():
-                    if n != name:
-                        continue
-                    les = [*m.buckets, math.inf]
-                    src[lv] = {
-                        "buckets": {
-                            ("+Inf" if math.isinf(le) else repr(le)): int(
-                                sum(acc[: i + 1])
-                            )
-                            for i, le in enumerate(les)
-                        },
-                        "sum": acc[-2],
-                        "count": int(acc[-1]),
-                    }
+                src = {
+                    lv: self._hist_view(m, acc)
+                    for (n, lv), acc in agg.hists.items()
+                    if n == name
+                }
             else:
                 src = {
                     lv: v
                     for (n, lv), v in agg.counters.items()
                     if n == name
                 }
+            if federated:
+                src = {lv + ("0",): v for lv, v in src.items()}
+                kind = (
+                    "gauges" if isinstance(m, Gauge)
+                    else "hists" if isinstance(m, Histogram)
+                    else "counters"
+                )
+                for w in sorted(remote):
+                    for (n, lv), v in remote[w].get(kind, {}).items():
+                        if n != name:
+                            continue
+                        if isinstance(m, Histogram):
+                            v = self._hist_view(m, v)
+                        src[lv + (w,)] = v
             for lv in sorted(src):
                 entry["series"][",".join(lv)] = src[lv]
             out[name] = entry
         return out
+
+    # -- federation (telemetry/distributed.py) -------------------------
+
+    def export_snapshot(self) -> Dict[str, List]:
+        """Compact JSON-able snapshot of this process's OWN series
+        (remote ingested data excluded on purpose: a worker's export
+        must never echo back shards it was federated). Shape:
+        ``{"counters": [[name, [labels...], value], ...],
+           "hists":    [[name, [labels...], [acc...]], ...],
+           "gauges":   [[name, [labels...], value], ...]}``."""
+        agg = self._aggregate()
+        gauges = dict(self._gauges)
+        return {
+            "counters": [
+                [n, list(lv), v]
+                for (n, lv), v in sorted(agg.counters.items())
+            ],
+            "hists": [
+                [n, list(lv), list(acc)]
+                for (n, lv), acc in sorted(agg.hists.items())
+            ],
+            "gauges": [
+                [n, list(lv), v] for (n, lv), v in sorted(gauges.items())
+            ],
+        }
+
+    def ingest_remote(self, worker: str, shard: Dict[str, Any]) -> None:
+        """Fold one remote shard (a worker's :func:`snapshot_delta`)
+        into the federation store under ``worker``. Unknown metric
+        names and malformed entries are skipped (wire-version drift must
+        degrade, not raise); histogram entries whose accumulator length
+        does not match this process's bucket schema are skipped too.
+        Counter/histogram values ACCUMULATE across ingests; gauges are
+        last-write-wins."""
+        if not isinstance(shard, dict):
+            return
+        with self._lock:
+            w = str(worker)
+            if w not in self._remote and len(self._remote) >= self.MAX_WORKERS:
+                w = "_overflow"
+            rs = self._remote.setdefault(
+                w, {"counters": {}, "hists": {}, "gauges": {}}
+            )
+            for kind in ("counters", "hists", "gauges"):
+                for item in shard.get(kind) or ():
+                    try:
+                        name, lv, v = item
+                        m = self._metrics.get(str(name))
+                        if m is None:
+                            continue
+                        lv = tuple(str(x) for x in lv)
+                        if len(lv) != len(m.label_names):
+                            continue
+                        key = (m.name, lv)
+                        dst = rs[kind]
+                        if kind == "hists":
+                            if not isinstance(m, Histogram) or len(v) != (
+                                len(m.buckets) + 3
+                            ):
+                                continue
+                            base = dst.get(key)
+                            if base is None:
+                                dst[key] = [float(x) for x in v]
+                            else:
+                                for i, x in enumerate(v):
+                                    base[i] += float(x)
+                        elif kind == "counters":
+                            if not isinstance(m, Counter):
+                                continue
+                            dst[key] = dst.get(key, 0.0) + float(v)
+                        else:
+                            if not isinstance(m, Gauge):
+                                continue
+                            dst[key] = float(v)
+                    except (TypeError, ValueError) as e:
+                        logger.debug(
+                            "skipping malformed remote series %r: %s",
+                            item, e,
+                        )
 
     @staticmethod
     def _fmt_labels(names: Sequence[str], values: Sequence[str],
@@ -366,6 +505,45 @@ class MetricsRegistry:
             self._retired = _Shard()
             self._shards = []
             self._gauges.clear()
+            self._remote.clear()
             self._local = threading.local()
             for m in self._metrics.values():
                 m._series = set()
+
+
+def snapshot_delta(
+    before: Dict[str, List], after: Dict[str, List]
+) -> Dict[str, List]:
+    """Difference of two :meth:`MetricsRegistry.export_snapshot` calls
+    — what a dp worker ships per round. Counters/histograms subtract
+    (series that did not move are dropped); gauges pass through as
+    their CURRENT values (a gauge is a statement about now, a gauge
+    delta is meaningless)."""
+
+    def _index(snap, kind):
+        return {
+            (name, tuple(lv)): v
+            for name, lv, v in (snap.get(kind) or ())
+        }
+
+    out: Dict[str, List] = {"counters": [], "hists": [], "gauges": []}
+    base = _index(before, "counters")
+    for (name, lv), v in sorted(_index(after, "counters").items()):
+        d = v - base.get((name, lv), 0.0)
+        if d > 0:
+            out["counters"].append([name, list(lv), d])
+    base = _index(before, "hists")
+    for (name, lv), acc in sorted(_index(after, "hists").items()):
+        b = base.get((name, lv))
+        d = (
+            list(acc)
+            if b is None or len(b) != len(acc)
+            else [x - y for x, y in zip(acc, b)]
+        )
+        if d and d[-1] > 0:  # count moved
+            out["hists"].append([name, list(lv), d])
+    out["gauges"] = [
+        [name, list(lv), v]
+        for (name, lv), v in sorted(_index(after, "gauges").items())
+    ]
+    return out

@@ -148,13 +148,19 @@ class JobCounters:
     These are NOT registry metrics: job ids are unbounded, so they stay
     out of the label space. Single-writer by construction — the engine
     worker thread (or the dp coordinator's serialized result path)
-    owns a job's accumulator — so plain dict arithmetic is exact."""
+    owns a job's accumulator — so plain dict arithmetic is exact.
 
-    __slots__ = ("job_id", "counters")
+    ``attrs`` carries small non-numeric per-job facts that belong in
+    the telemetry document but not in counters: the runner's device
+    info (the doctor's roofline denominator), the active jax profiler
+    trace path, the dp trace id."""
+
+    __slots__ = ("job_id", "counters", "attrs")
 
     def __init__(self, job_id: str) -> None:
         self.job_id = job_id
         self.counters: Dict[str, float] = {}
+        self.attrs: Dict[str, Any] = {}
 
     def add(self, key: str, n: float = 1.0) -> None:
         self.counters[key] = self.counters.get(key, 0.0) + n

@@ -59,6 +59,38 @@ def main() -> None:
         assert len(res["outputs"]) == N_ROWS
         assert all(o is not None for o in res["outputs"])
         print("RESULTS " + json.dumps(res["outputs"]), flush=True)
+        if os.environ.get("SUTRO_DP_WORLD"):
+            # distributed telemetry: the coordinator's merged document
+            # and the doctor's diagnosis of it (parent asserts shape)
+            doc = eng.job_telemetry(jid, write=False)
+            print(
+                "TELEDOC "
+                + json.dumps(
+                    {
+                        "workers": [
+                            {
+                                "rank": w.get("rank"),
+                                "round": w.get("round"),
+                                "trace": w.get("trace"),
+                                "stages": sorted(
+                                    {
+                                        s["name"]
+                                        for s in w.get("spans", [])
+                                    }
+                                ),
+                                "counters": w.get("counters"),
+                            }
+                            for w in doc.get("workers", [])
+                        ],
+                        "stages": doc.get("stages"),
+                    }
+                ),
+                flush=True,
+            )
+            print(
+                "DOCTOR " + json.dumps(eng.diagnose_job(jid)),
+                flush=True,
+            )
 
     # embedding job through the same DP path (EmbResult channel)
     ejid = eng.submit_batch_inference(

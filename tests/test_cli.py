@@ -175,6 +175,31 @@ def test_jobs_cancel_then_resume(live):
         assert "Not resumed" in res.output
 
 
+def test_doctor_command_renders_verdict(live):
+    runner, sdk, _ = live
+    jid = _submitted_job(sdk)
+    res = runner.invoke(cli, ["doctor", jid])
+    assert res.exit_code == 0
+    assert "verdict:" in res.output
+    assert "rank0" in res.output
+    res = runner.invoke(cli, ["doctor", jid, "--json"])
+    assert res.exit_code == 0
+    import json
+
+    diag = json.loads(res.output)
+    assert diag["job_id"] == jid and diag["verdict"]
+
+
+def test_jobs_status_hints_at_telemetry_dump(live):
+    runner, sdk, _ = live
+    jid = _submitted_job(sdk)
+    # force a dump (on-demand refresh persists telemetry.json)
+    sdk.get_job_telemetry(jid)
+    res = runner.invoke(cli, ["jobs", "status", jid])
+    assert res.exit_code == 0
+    assert "sutro doctor" in res.output
+
+
 def test_jobs_resume_succeeded_refuses(live):
     runner, sdk, _ = live
     jid = _submitted_job(sdk)

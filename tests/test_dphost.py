@@ -102,6 +102,44 @@ def test_dp_job_across_two_engines_matches_single_host(tmp_path):
     # attention; pooled head) — DP grouping must not change values
     np.testing.assert_allclose(dp_emb, ref_emb, rtol=1e-4, atol=1e-5)
 
+    # -- distributed telemetry acceptance: one merged cross-process
+    # timeline + a named doctor verdict on a real 2-process dp run ----
+    def line_of(out: str, tag: str):
+        for line in out.splitlines():
+            if line.startswith(tag + " "):
+                return json.loads(line[len(tag) + 1:])
+        raise AssertionError(f"no {tag} line:\n{out}")
+
+    teledoc = line_of(outs["rank0"], "TELEDOC")
+    workers = teledoc["workers"]
+    assert [w["rank"] for w in workers] == [1], workers
+    w1 = workers[0]
+    # rank 1 ran half the rows and shipped its timeline + counters
+    # under the coordinator's trace (round 1 of this job)
+    assert w1["round"] == 1 and w1["trace"].endswith("/r1")
+    assert w1["counters"].get("rows_ok") == 12
+    assert {"tokenize", "prefill", "decode_window", "dp_round"} <= set(
+        w1["stages"]
+    ), w1["stages"]
+    # the merged document's stage set spans both processes
+    assert "dp_round" in teledoc["stages"]
+
+    doctor = line_of(outs["rank0"], "DOCTOR")
+    assert doctor["verdict"] in (
+        "insufficient_data", "straggler_worker", "io_bound",
+        "host_bound_admit", "decode_below_roofline", "healthy",
+    )
+    assert doctor["verdict"] != "insufficient_data"
+    assert doctor["partial"] is False and doctor["world"] == 2
+    # per-worker stage attribution crossed the wire
+    assert set(doctor["processes"]) == {"rank0", "rank1"}
+    for p in doctor["processes"].values():
+        assert p["spans"] > 0 and p["wall_s"] > 0
+    assert doctor["processes"]["rank1"]["stages"]["decode_window"][
+        "count"
+    ] > 0
+    assert doctor["evidence"], doctor
+
 
 # ---------------------------------------------------------------------------
 # channel-level tests (stub shards, no engines — fast)

@@ -78,16 +78,24 @@ _PROM_LINE = re.compile(
 
 
 def assert_valid_prometheus(text: str) -> None:
-    """Structural validity of a text-exposition payload: every line is
-    a comment or a well-formed sample; every samples' metric family has
-    HELP+TYPE; histogram families carry _bucket/_sum/_count."""
+    """Pure-python prom-text validator (exposition format 0.0.4):
+    every line is a comment or a well-formed sample; every sample's
+    metric family has HELP+TYPE (HELP before samples); label values
+    carry no raw control characters (backslash/quote/newline must be
+    escaped); histogram families carry _bucket/_sum/_count. Run over
+    both the golden file and live output (satellite: no torn
+    exposition under concurrent scrapes)."""
     assert text.endswith("\n")
     helps, types, samples = set(), {}, []
     for line in text.splitlines():
         if not line:
             continue
         if line.startswith("# HELP "):
-            helps.add(line.split()[2])
+            name = line.split()[2]
+            assert name not in samples, (
+                f"HELP for {name} after its samples"
+            )
+            helps.add(name)
             continue
         if line.startswith("# TYPE "):
             parts = line.split()
@@ -95,6 +103,13 @@ def assert_valid_prometheus(text: str) -> None:
             assert parts[3] in ("counter", "gauge", "histogram")
             continue
         assert _PROM_LINE.match(line), f"bad exposition line: {line!r}"
+        # escaping: inside label values, every backslash must open a
+        # valid escape and raw quotes/newlines cannot appear (the line
+        # regex already rejects raw newlines; check escapes here)
+        for lv in re.findall(r'="([^"]*)"', line):
+            assert re.fullmatch(
+                r'(?:[^\\]|\\\\|\\"|\\n)*', lv
+            ), f"bad escaping in label value {lv!r}"
         samples.append(line.split("{")[0].split(" ")[0])
     for name in samples:
         base = re.sub(r"_(bucket|sum|count)$", "", name)
@@ -330,12 +345,20 @@ def test_metrics_endpoint_and_job_telemetry_over_http(tmp_path,
         with urllib.request.urlopen(f"{url}/job-telemetry/{jid}") as r:
             doc = json.loads(r.read())["telemetry"]
         assert doc["job_id"] == jid and doc["counters"]["rows_ok"] == 2
+        with urllib.request.urlopen(f"{url}/job-doctor/{jid}") as r:
+            diag = json.loads(r.read())["doctor"]
+        assert diag["job_id"] == jid and diag["verdict"] in (
+            "healthy", "host_bound_admit", "io_bound",
+            "decode_below_roofline",
+        )
+        assert diag["evidence"]
         # SDK surface, both backends
         from sutro_tpu.sdk import Sutro
 
         remote = Sutro(api_key="k", base_url=url, backend="remote")
         assert remote.get_job_telemetry(jid)["job_id"] == jid
         assert "sutro_jobs_total" in remote.get_metrics_text()
+        assert remote.diagnose_job(jid)["verdict"] == diag["verdict"]
     finally:
         server.shutdown()
         eng.close(timeout=5)
@@ -401,6 +424,244 @@ def test_telemetry_disabled_is_inert(tmp_path, monkeypatch):
         eng.close(timeout=5)
     finally:
         telemetry.set_enabled(True)
+
+
+# ---------------------------------------------------------------------------
+# satellite: concurrent /metrics scrapes during a running job
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_scrapes_valid_and_deterministic(telemetry_engine):
+    """Scrapers hammering the registry while a job runs (and while a
+    remote shard ingests mid-flight) must always see a structurally
+    valid exposition with deterministic family/series ordering — no
+    torn output."""
+    eng = telemetry_engine
+    jid = eng.submit_batch_inference(
+        {
+            "model": "tiny-dense",
+            "inputs": [f"scrape row {i}" for i in range(64)],
+            "sampling_params": {"max_new_tokens": 8,
+                                "temperature": 0.0},
+        }
+    )
+    stop = threading.Event()
+    payloads: list = []
+    errors: list = []
+
+    def scraper():
+        try:
+            while not stop.is_set():
+                payloads.append(telemetry.REGISTRY.to_prometheus())
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    def ingester():
+        # federation churn during scrapes: worker shards arriving
+        # must not tear the exposition either
+        i = 0
+        while not stop.is_set():
+            i += 1
+            telemetry.REGISTRY.ingest_remote(
+                "1",
+                {"counters": [["sutro_tokenize_rows_total", [], 1.0]]},
+            )
+            time.sleep(0.001)
+
+    threads = [threading.Thread(target=scraper) for _ in range(3)] + [
+        threading.Thread(target=ingester)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        assert _wait_terminal(eng, jid) == JobStatus.SUCCEEDED
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(30)
+    assert not errors, errors
+    assert len(payloads) > 10
+    for text in payloads[:: max(len(payloads) // 50, 1)]:
+        assert_valid_prometheus(text)
+
+    def order_of(text):
+        fams = [
+            ln.split()[2]
+            for ln in text.splitlines()
+            if ln.startswith("# TYPE ")
+        ]
+        return fams
+
+    # deterministic ordering: every scrape lists families sorted, and
+    # within the final scrape series are sorted too
+    for text in payloads[-5:]:
+        fams = order_of(text)
+        assert fams == sorted(fams)
+    # the validator also covers the committed golden file (satellite:
+    # golden + live output both validated by the same checker)
+    assert_valid_prometheus(GOLDEN.read_text())
+
+
+# ---------------------------------------------------------------------------
+# satellite: telemetry dump on CANCELLED + status hint
+# ---------------------------------------------------------------------------
+
+
+def test_cancelled_job_dumps_telemetry_and_status_hints(
+    tmp_path, monkeypatch
+):
+    """CANCELLED is a terminal state an operator debugs too: the
+    flight-recorder dump must land exactly like on FAILED, and
+    ``get_job_status(with_failure_log=True)`` must advertise it."""
+    monkeypatch.setenv("SUTRO_HOME", str(tmp_path / "home"))
+    (tmp_path / "home").mkdir()
+    telemetry.reset_for_tests()
+    telemetry.set_enabled(True)
+    eng = LocalEngine(
+        EngineConfig(
+            kv_page_size=8, max_pages_per_seq=16, decode_batch_size=4,
+            max_model_len=256, use_pallas=False, param_dtype="float32",
+            activation_dtype="float32",
+        )
+    )
+    try:
+        jid = eng.submit_batch_inference(
+            {
+                "model": "tiny-dense",
+                "inputs": [f"cancel row {i}" for i in range(32)],
+                "sampling_params": {"max_new_tokens": 64,
+                                    "temperature": 0.0},
+            }
+        )
+        deadline = time.monotonic() + 120
+        while (
+            eng.job_status(jid) not in ("RUNNING",)
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        eng.cancel_job(jid)
+        st = _wait_terminal(eng, jid)
+        assert st == JobStatus.CANCELLED
+        path = Path(eng.jobs._dir(jid)) / "telemetry.json"
+        assert path.exists(), "CANCELLED must dump telemetry.json"
+        doc = json.loads(path.read_text())
+        assert doc["job_id"] == jid
+        # the record advertises the dump for `sutro jobs status`
+        assert eng.get_job(jid)["has_telemetry_dump"] is True
+        from sutro_tpu.sdk import Sutro
+
+        sdk = Sutro(api_key=None)
+        sdk._engine = eng  # bind to THIS engine, not the singleton
+        sdk.set_backend("tpu")
+        out = sdk.get_job_status(jid, with_failure_log=True)
+        assert out["has_telemetry_dump"] is True
+    finally:
+        eng.close(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# satellite: throughput gauges cover the embed path
+# ---------------------------------------------------------------------------
+
+
+def test_embed_job_feeds_rows_per_second_gauge(tmp_path, monkeypatch):
+    monkeypatch.setenv("SUTRO_HOME", str(tmp_path / "home"))
+    (tmp_path / "home").mkdir()
+    telemetry.reset_for_tests()
+    telemetry.set_enabled(True)
+    eng = LocalEngine(
+        EngineConfig(
+            kv_page_size=8, max_pages_per_seq=16, decode_batch_size=4,
+            max_model_len=128, use_pallas=False, param_dtype="float32",
+            activation_dtype="float32",
+        )
+    )
+    try:
+        jid = eng.submit_batch_inference(
+            {
+                "model": "tiny-emb",
+                "inputs": [f"embed row {i}" for i in range(24)],
+            }
+        )
+        assert _wait_terminal(eng, jid) == JobStatus.SUCCEEDED
+        snap = telemetry.REGISTRY.collect()
+        rps = snap["sutro_rows_per_second"]["series"]
+        assert "embed" in rps, rps  # the embed workload reports rows/s
+        # the embed path also feeds the token gauges now
+        assert snap["sutro_tokens_per_second"]["series"][""] >= 0
+    finally:
+        eng.close(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# satellite: job_trace reentrancy (refcounted device trace)
+# ---------------------------------------------------------------------------
+
+
+class TestJobTraceRefcount:
+    def _fake_profiler(self, monkeypatch):
+        import jax
+
+        calls = {"start": [], "stop": 0}
+
+        def fake_start(path):
+            if calls["start"] and calls["stop"] < len(calls["start"]):
+                raise RuntimeError("Profiler is already started")
+            calls["start"].append(path)
+
+        def fake_stop():
+            calls["stop"] += 1
+
+        monkeypatch.setattr(jax.profiler, "start_trace", fake_start)
+        monkeypatch.setattr(jax.profiler, "stop_trace", fake_stop)
+        return calls
+
+    def test_nested_job_traces_refcount(self, tmp_path, monkeypatch):
+        """Two co-batched jobs with profile_dir: the first starts the
+        trace, the second JOINS it (no second start_trace, which
+        raises), the last one out stops it — and both jobs record the
+        active trace path in their flight-recorder attrs."""
+        from sutro_tpu.engine.profiling import job_trace
+
+        telemetry.reset_for_tests()
+        telemetry.set_enabled(True)
+        calls = self._fake_profiler(monkeypatch)
+        pd = str(tmp_path)
+        with job_trace(pd, "job-a"):
+            with job_trace(pd, "job-b"):  # used to raise here
+                pass
+            assert calls["stop"] == 0  # inner exit must NOT stop
+        assert len(calls["start"]) == 1
+        assert calls["start"][0].endswith("job-a")
+        assert calls["stop"] == 1
+        # both jobs know where their device trace went
+        assert telemetry.JOBS.peek("job-a").attrs[
+            "profile_trace"
+        ].endswith("job-a")
+        assert telemetry.JOBS.peek("job-b").attrs[
+            "profile_trace"
+        ].endswith("job-a")
+
+    def test_sequential_traces_restart(self, tmp_path, monkeypatch):
+        from sutro_tpu.engine.profiling import job_trace
+
+        calls = self._fake_profiler(monkeypatch)
+        with job_trace(str(tmp_path), "job-1"):
+            pass
+        with job_trace(str(tmp_path), "job-2"):
+            pass
+        assert [p.split("/")[-1] for p in calls["start"]] == [
+            "job-1", "job-2",
+        ]
+        assert calls["stop"] == 2
+
+    def test_no_profile_dir_is_inert(self, monkeypatch):
+        from sutro_tpu.engine.profiling import job_trace
+
+        calls = self._fake_profiler(monkeypatch)
+        with job_trace(None, "job-x"):
+            pass
+        assert calls["start"] == [] and calls["stop"] == 0
 
 
 # ---------------------------------------------------------------------------
