@@ -54,10 +54,13 @@ lint-baseline:
 # seeded chaos suite (FAILURES.md): deterministic fault injection
 # end-to-end — row quarantine (incl. the 256-row poison-row acceptance
 # case), transient I/O retry, torn chunks, device errors + resume
-# bit-identity, crash-mid-finalize, dp liveness. A tier-1 CI step.
+# bit-identity, crash-mid-finalize, dp liveness, plus the elastic
+# fleet gate (worker crash/hang/mid-frame drop, SIGTERM preemption
+# drain, late join, steal race, coordinator crash + resume). A tier-1
+# CI step.
 chaos:
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py -q -m "not slow" \
-		-p no:cacheprovider
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py tests/test_elastic.py \
+		-q -m "not slow" -p no:cacheprovider
 
 # telemetry gate (OBSERVABILITY.md): exporter golden-file + flight-
 # recorder/reconciliation tests + distributed telemetry (trace

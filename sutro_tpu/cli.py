@@ -266,9 +266,50 @@ def jobs_list(limit: int) -> None:
 @click.argument("job_id")
 def jobs_status(job_id: str) -> None:
     """Job status plus its failure_log — per-row retries/quarantines,
-    transient-I/O retries, and terminal failures (FAILURES.md)."""
-    out = get_sdk().get_job_status(job_id, with_failure_log=True)
+    transient-I/O retries, and terminal failures (FAILURES.md) — and,
+    for elastic dp jobs, the fleet view (per-rank membership state,
+    requeue/steal counters)."""
+    sdk = get_sdk()
+    out = sdk.get_job_status(job_id, with_failure_log=True)
     click.echo(out["status"])
+    try:
+        fleet = sdk.get_job_fleet(job_id)
+    # the fleet view is best-effort decoration on the status output: an
+    # old daemon without the /job-fleet route must not break `status`
+    except Exception:  # graftlint: disable=silent-except
+        fleet = None
+    if fleet and fleet.get("elastic"):
+        rows = fleet.get("rows") or {}
+        c = fleet.get("counters") or {}
+        live = "live" if fleet.get("live") else "final"
+        click.echo(
+            to_colored_text(
+                f"dp fleet ({live}): {fleet.get('live_ranks', 0)} "
+                f"live rank(s) of world {fleet.get('world')}; rows "
+                f"{rows.get('done', 0)}/{rows.get('total', 0)} done, "
+                f"{rows.get('pending', 0)} pending, "
+                f"{rows.get('inflight', 0)} in flight; "
+                f"requeued={c.get('requeued_rows', 0)} "
+                f"stolen={c.get('stolen_rows', 0)} "
+                f"dup_dropped={c.get('duplicate_results_dropped', 0)}",
+                "callout",
+            )
+        )
+        for r, v in sorted(
+            (fleet.get("ranks") or {}).items(),
+            key=lambda kv: int(kv[0]),
+        ):
+            bits = [f"rank {r}: {v.get('state', '?')}"]
+            if v.get("late_join"):
+                bits.append("late-join")
+            if not v.get("elastic", True):
+                bits.append("v1-peer")
+            rem = v.get("rows_remaining")
+            if rem:
+                bits.append(f"{rem} row(s) remaining")
+            if v.get("reason"):
+                bits.append(str(v["reason"]))
+            click.echo("  " + " ".join(bits))
     if out.get("has_telemetry_dump"):
         click.echo(
             to_colored_text(

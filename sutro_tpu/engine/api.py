@@ -100,6 +100,15 @@ class LocalEngine:
         # SUTRO_FAULT_PLAN; None clears — a fresh engine with no plan
         # runs injection-free at zero overhead)
         faults.configure(self.ecfg.fault_plan)
+        # dp channel liveness knobs promoted from env-only to
+        # EngineConfig (validated >= 0 here; the SUTRO_DP_* environment
+        # variables still override when set)
+        from .dphost import configure_channel
+
+        configure_channel(
+            stall_timeout=self.ecfg.dp_stall_timeout,
+            heartbeat=self.ecfg.dp_heartbeat,
+        )
         self.jobs = JobStore(
             io_retries=self.ecfg.io_retries,
             io_backoff=self.ecfg.io_backoff_base,
@@ -573,6 +582,79 @@ class LocalEngine:
             num_rows=rec.num_rows,
         )
 
+    def job_fleet(self, job_id: str) -> Dict[str, Any]:
+        """Elastic dp fleet view: the coordinator's live membership
+        snapshot while this process serves the job's round (per-rank
+        state, row ownership, requeue/steal counters), else the
+        snapshot persisted at round end (``jobs/<id>/fleet.json``).
+        Jobs that never ran an elastic round report
+        ``{"elastic": False}``."""
+        import json as _json
+
+        from .dphost import fleet_view
+
+        self.jobs.get(job_id)  # KeyError -> 404 upstream if unknown
+        snap = fleet_view(job_id)
+        if snap is not None:
+            snap["live"] = True
+            return snap
+        path = self.jobs._dir(job_id) / "fleet.json"
+        if path.exists():
+            try:
+                snap = _json.loads(path.read_text())
+                snap["live"] = False
+                return snap
+            except (OSError, ValueError) as e:
+                logger.warning(
+                    "unreadable fleet.json for %s: %s", job_id, e
+                )
+        return {"job_id": job_id, "elastic": False}
+
+    def _persist_fleet(self, job_id: str) -> None:
+        """Coordinator round end: persist the final membership snapshot
+        (``jobs/<id>/fleet.json``) and stamp a doctor-readable summary
+        into the job's telemetry attrs. Best-effort — fleet bookkeeping
+        must never change a round's outcome."""
+        import json as _json
+
+        from .dphost import fleet_view
+
+        snap = fleet_view(job_id)
+        if snap is None:
+            return
+        try:
+            path = self.jobs._dir(job_id) / "fleet.json"
+            tmp = path.with_suffix(".json.tmp")
+            tmp.write_text(_json.dumps(snap, indent=2))
+            tmp.replace(path)
+        except OSError:
+            logger.warning(
+                "fleet snapshot persist failed for %s", job_id,
+                exc_info=True,
+            )
+        if telemetry.enabled():
+            ranks = snap.get("ranks", {})
+            c = snap.get("counters", {})
+            telemetry.job(job_id).attrs["dp_fleet"] = {
+                "live_ranks": snap.get("live_ranks", 0),
+                "requeued_rows": c.get("requeued_rows", 0),
+                "stolen_rows": c.get("stolen_rows", 0),
+                "duplicate_results_dropped": c.get(
+                    "duplicate_results_dropped", 0
+                ),
+                "lost_ranks": sorted(
+                    r for r, v in ranks.items()
+                    if v.get("state") == "lost"
+                ),
+                "drained_ranks": sorted(
+                    r for r, v in ranks.items()
+                    if v.get("state") == "drained"
+                ),
+                "late_joiners": sorted(
+                    r for r, v in ranks.items() if v.get("late_join")
+                ),
+            }
+
     # ------------------------------------------------------------------
     # Worker
     # ------------------------------------------------------------------
@@ -772,8 +854,6 @@ class LocalEngine:
                 # concerns and disabled for DP jobs — yielding or
                 # multiplexing one slice of a pod-spanning job would
                 # stall, not help, the pod.
-                from .dphost import shard_requests
-
                 import hashlib
                 import json as _json
 
@@ -808,7 +888,6 @@ class LocalEngine:
                     h.update(f"{len(rb)}:".encode())
                     h.update(rb)
                 job_key = h.hexdigest()[:16]
-                shard = shard_requests(sess.requests, dp.rank, dp.world)
                 import functools
 
                 # row retries ride the shard-owning rank's batcher;
@@ -820,8 +899,11 @@ class LocalEngine:
                     batcher.run, row_retries=self.ecfg.row_retries,
                     job_id=job_id,
                 )
+                # the whole request pool goes down — elastic rounds
+                # re-shard it dynamically (rank 0 strides its own share;
+                # workers receive row assignments in the handshake)
                 outcome = self._dp_dispatch(
-                    dp, run_shard, shard,
+                    dp, run_shard, sess.requests,
                     job_id=job_id, job_key=job_key,
                     on_result=sess.on_result,
                     on_progress=sess.on_progress,
@@ -1035,16 +1117,21 @@ class LocalEngine:
         return None
 
     def _dp_dispatch(
-        self, dp, run_shard, shard, *, job_id, job_key, on_result,
+        self, dp, run_shard, pool, *, job_id, job_key, on_result,
         on_progress, should_cancel, done_rows, num_rows,
         on_row_event=None,
     ) -> Optional[str]:
-        """Execute one rank's share of a DP job. Returns the outcome on
-        rank 0 (coordinator: merges every rank through ``on_result``),
-        or None on worker ranks after setting their terminal status —
-        single policy copy for the generation AND embedding paths
+        """Execute one rank's share of a DP job. ``pool`` is the FULL
+        request pool (not a pre-strided shard): elastic rounds re-shard
+        it dynamically, so every rank needs the whole row universe —
+        rank 0 strides its own share, workers run the row assignment
+        received in the handshake (falling back to their stride against
+        a pre-elastic coordinator). Returns the outcome on rank 0
+        (coordinator: merges every rank through ``on_result``), or None
+        on worker ranks after setting their terminal status — single
+        policy copy for the generation AND embedding paths
         (never-served sentinel, CANCELLED-not-FAILED worker mapping,
-        full-resume round skip).
+        preemption-drain mapping, full-resume round skip).
 
         Distributed telemetry rides the channel here: rank 0 stamps a
         trace context into the round and ingests every worker's
@@ -1052,7 +1139,11 @@ class LocalEngine:
         the round under the received context and ship their bounded
         span/metrics shard on the terminal frame."""
         from ..telemetry import distributed
-        from .dphost import run_dp_coordinator, run_dp_worker
+        from .dphost import (
+            run_dp_coordinator,
+            run_dp_worker,
+            shard_requests,
+        )
 
         tel_on = telemetry.enabled()
         if dp.rank == 0:
@@ -1074,42 +1165,67 @@ class LocalEngine:
                 # not expected and not errors
                 from .dphost import serve_resume_round
 
-                serve_resume_round(
+                if not serve_resume_round(
                     dp, job_key=job_key, done_rows=done_rows,
                     tele_ctx=tele_ctx, on_worker_tele=on_worker_tele,
-                )
-                return "completed"
-            if tel_on:
-                with telemetry.RECORDER.span(
-                    "dp_round", job_id, world=dp.world,
-                    shard_rows=len(shard),
                 ):
-                    t0 = time.monotonic()
-                    try:
-                        return run_dp_coordinator(
-                            dp, run_shard, shard,
-                            on_result=on_result,
-                            on_progress=on_progress,
-                            should_cancel=should_cancel,
-                            job_key=job_key,
-                            done_rows=done_rows,
-                            on_row_event=on_row_event,
-                            tele_ctx=tele_ctx,
-                            on_worker_tele=on_worker_tele,
-                        )
-                    finally:
-                        telemetry.stage_observe(
-                            "dp_round", time.monotonic() - t0
-                        )
-            return run_dp_coordinator(
-                dp, run_shard, shard,
-                on_result=on_result,
-                on_progress=on_progress,
-                should_cancel=should_cancel,
-                job_key=job_key,
-                done_rows=done_rows,
-                on_row_event=on_row_event,
-            )
+                    # port held by a dying predecessor through every
+                    # bind retry: the job's rows are all merged, so
+                    # still complete — record why re-queued workers
+                    # may spin until their accept deadline
+                    self.jobs.append_failure_log(
+                        job_id,
+                        {"event": "dp_resume_round_unserved",
+                         "message": (
+                             "coordinator port busy through bind "
+                             "retries; re-queued workers retry until "
+                             "their accept deadline — resume again "
+                             "once the port frees"
+                         )},
+                    )
+                return "completed"
+            shard = shard_requests(pool, 0, dp.world)
+            try:
+                if tel_on:
+                    with telemetry.RECORDER.span(
+                        "dp_round", job_id, world=dp.world,
+                        shard_rows=len(shard),
+                    ):
+                        t0 = time.monotonic()
+                        try:
+                            return run_dp_coordinator(
+                                dp, run_shard, shard,
+                                on_result=on_result,
+                                on_progress=on_progress,
+                                should_cancel=should_cancel,
+                                job_key=job_key,
+                                done_rows=done_rows,
+                                on_row_event=on_row_event,
+                                tele_ctx=tele_ctx,
+                                on_worker_tele=on_worker_tele,
+                                requests=pool,
+                                job_id=job_id,
+                            )
+                        finally:
+                            telemetry.stage_observe(
+                                "dp_round", time.monotonic() - t0
+                            )
+                return run_dp_coordinator(
+                    dp, run_shard, shard,
+                    on_result=on_result,
+                    on_progress=on_progress,
+                    should_cancel=should_cancel,
+                    job_key=job_key,
+                    done_rows=done_rows,
+                    on_row_event=on_row_event,
+                    requests=pool,
+                    job_id=job_id,
+                )
+            finally:
+                # round over (any outcome): persist the final fleet
+                # snapshot next to the job record and stamp the doctor
+                # summary before the live registry entry ages out
+                self._persist_fleet(job_id)
         if tel_on:
             # the worker's results leave through the channel, not
             # through the session's on_result — tally shard rows into
@@ -1146,7 +1262,7 @@ class LocalEngine:
 
         try:
             w_outcome = run_dp_worker(
-                dp, run_shard, shard,
+                dp, run_shard, pool,
                 job_key=job_key,
                 should_cancel=should_cancel,
                 tele=(
@@ -1154,6 +1270,7 @@ class LocalEngine:
                     if tel_on
                     else None
                 ),
+                elastic=True,
             )
         except RuntimeError as e:
             if "never served" not in str(e):
@@ -1171,6 +1288,18 @@ class LocalEngine:
         # worker stores are not authoritative: results live on rank 0;
         # mark the local record terminal honestly (a cancelled shard,
         # e.g. coordinator death, is not a success)
+        if w_outcome == "drained":
+            self.jobs.set_status(
+                job_id,
+                JobStatus.CANCELLED,
+                failure_reason={
+                    "message": (
+                        "worker preempted: drained in-flight rows "
+                        "to the coordinator"
+                    )
+                },
+            )
+            return None
         self.jobs.set_status(
             job_id,
             JobStatus.SUCCEEDED
@@ -1329,13 +1458,11 @@ class LocalEngine:
                 rb = np.asarray(r, np.int32).tobytes()
                 h.update(f"{len(rb)}:".encode())
                 h.update(rb)
-            shard = [
-                (i, token_rows[i])
-                for i in todo
-                if i % dp.world == dp.rank
-            ]
+            # full pool, not a pre-strided shard: elastic rounds
+            # re-shard it dynamically (see _dp_dispatch)
+            pool = [(i, token_rows[i]) for i in todo]
             outcome = self._dp_dispatch(
-                dp, embed_rows, shard,
+                dp, embed_rows, pool,
                 job_id=job_id, job_key=h.hexdigest()[:16],
                 on_result=record_result,
                 on_progress=embed_progress,

@@ -169,6 +169,18 @@ class EngineConfig:
                                         # deterministic jitter, capped at
                                         # io_backoff_cap
     io_backoff_cap: float = 2.0
+    dp_stall_timeout: float = 600.0     # dp coordinator: seconds of
+                                        # silence from a connected rank
+                                        # before it is declared stalled
+                                        # (0 disables the watchdog).
+                                        # $SUTRO_DP_STALL_TIMEOUT
+                                        # overrides when set; must be
+                                        # >= 0 (engine/dphost.py
+                                        # configure_channel)
+    dp_heartbeat: float = 20.0          # dp worker liveness beacon
+                                        # period in seconds (0 disables;
+                                        # $SUTRO_DP_HEARTBEAT overrides;
+                                        # must be >= 0)
     # --- runtime -----------------------------------------------------------
     use_pallas: Optional[bool] = None   # None => auto (TPU yes, CPU no)
     weights_dir: Optional[str] = None   # local HF-style checkpoint root

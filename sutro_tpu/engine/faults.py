@@ -22,6 +22,14 @@ jobstore.flush_partial partial-chunk flush (``torn`` writes a torn file)
 jobstore.finalize      write_results_streamed
 dphost.send            worker result send (``drop`` tears the frame)
 dphost.worker_done     worker before its done message (``hang``/``crash``)
+dphost.join            elastic worker right after admission — join churn
+                       (``crash`` closes the channel first)
+dphost.preempt         elastic worker cancel poll: any firing spec requests
+                       a preemption drain (``hang`` sleeps first to widen
+                       the preempt/steal race); no raise
+dphost.steal           elastic coordinator steal planner: a firing spec
+                       forces a steal without waiting out
+                       SUTRO_DP_STEAL_AFTER; no raise
 ====================== ====================================================
 
 Kinds: ``error`` (RuntimeError), ``oom`` (RESOURCE_EXHAUSTED-shaped

@@ -542,6 +542,21 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
             ]
         return self.engine.diagnose_job(job_id)
 
+    def get_job_fleet(self, job_id: str) -> Dict[str, Any]:
+        """Elastic dp fleet view for a job (FAILURES.md "Elastic
+        fleet"): per-rank membership state (running, idle, lost,
+        drained, late-joined), row ownership, and the round's
+        requeue/steal/duplicate counters. Live while the coordinator is
+        serving the round, else the snapshot persisted at round end;
+        ``{"elastic": False}`` for jobs that never ran one. Both
+        backends (the remote daemon serves it as
+        ``GET /job-fleet/{id}``)."""
+        if self.backend == "remote":
+            return self._remote_json("get", f"job-fleet/{job_id}")[
+                "fleet"
+            ]
+        return self.engine.job_fleet(job_id)
+
     def get_metrics_text(self) -> str:
         """Engine metrics registry in Prometheus text exposition format
         (the same payload ``GET /metrics`` serves on the daemon)."""

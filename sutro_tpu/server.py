@@ -154,6 +154,8 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
                 self._json({"telemetry": eng.job_telemetry(rest)})
             elif head == "job-doctor" and rest:
                 self._json({"doctor": eng.diagnose_job(rest)})
+            elif head == "job-fleet" and rest:
+                self._json({"fleet": eng.job_fleet(rest)})
             elif head == "healthz":
                 self._json({"ok": True})
             else:

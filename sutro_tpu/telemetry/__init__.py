@@ -140,7 +140,27 @@ TOKENIZE_ROWS_TOTAL = REGISTRY.counter(
 DP_EVENTS_TOTAL = REGISTRY.counter(
     "sutro_dp_events_total",
     "Data-parallel coordinator events",
-    labels=("kind",),  # reconnect | stall | fault_forwarded | reject
+    # reconnect | stall | fault_forwarded | reject | join | requeue |
+    # reshard | steal | drain | dup_result | resume_port_busy
+    labels=("kind",),
+)
+DP_FLEET_SIZE = REGISTRY.gauge(
+    "sutro_dp_fleet_size",
+    "Live dp ranks (running or idle-parked) in the coordinator's "
+    "current elastic round, coordinator included",
+    unit="ranks",
+)
+DP_REQUEUED_ROWS_TOTAL = REGISTRY.counter(
+    "sutro_dp_requeued_rows_total",
+    "Rows returned to the pending pool after a rank died, stalled, "
+    "tore a frame, drained (preemption), or never connected",
+    unit="rows",
+)
+DP_STOLEN_ROWS_TOTAL = REGISTRY.counter(
+    "sutro_dp_stolen_rows_total",
+    "Straggler tail rows dual-assigned to an idle rank "
+    "(first result wins; duplicates dropped by row id)",
+    unit="rows",
 )
 TOKENS_PER_SECOND = REGISTRY.gauge(
     "sutro_tokens_per_second",

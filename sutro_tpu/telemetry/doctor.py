@@ -357,6 +357,44 @@ def diagnose(
             "per-row causes"
         )
 
+    # elastic dp fleet summary (engine/api stamps attrs["dp_fleet"]
+    # at round end): steals corroborate — or pre-empt — a straggler
+    # verdict, requeues explain wall-time spent re-running rows
+    fleet = attrs.get("dp_fleet") or {}
+    stolen = fleet.get("stolen_rows", 0)
+    if stolen:
+        evidence.append(
+            f"{stolen} row(s) stolen from straggling rank(s) by idle "
+            "ranks (first result won; "
+            f"{fleet.get('duplicate_results_dropped', 0)} duplicate "
+            "result(s) dropped) — the fleet masked a straggler"
+        )
+    requeued = fleet.get("requeued_rows", 0)
+    if requeued:
+        lost = fleet.get("lost_ranks") or []
+        drained = fleet.get("drained_ranks") or []
+        detail = []
+        if lost:
+            detail.append(
+                "lost rank(s) " + ", ".join(str(r) for r in lost)
+            )
+        if drained:
+            detail.append(
+                "preemption-drained rank(s) "
+                + ", ".join(str(r) for r in drained)
+            )
+        evidence.append(
+            f"{requeued} row(s) requeued and re-run elsewhere"
+            + (" (" + "; ".join(detail) + ")" if detail else "")
+            + " — wall time includes the re-execution"
+        )
+    late = fleet.get("late_joiners") or []
+    if late:
+        evidence.append(
+            "rank(s) " + ", ".join(str(r) for r in late)
+            + " joined the round late and absorbed re-sharded rows"
+        )
+
     return {
         "version": DOCTOR_VERSION,
         "job_id": job_id,

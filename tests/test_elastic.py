@@ -1,0 +1,751 @@
+"""Elastic dp fleet chaos suite (FAILURES.md "Elastic fleet").
+
+The chaos gate for the elastic membership protocol: worker crash, hang,
+mid-frame drop, SIGTERM preemption drain, and late join on a 256-row
+multi-worker job must all end with the round COMPLETED, zero lost rows,
+and a merged result set bit-identical to a fault-free run (first result
+wins; duplicates dropped by row id before the merge). Runs the
+coordinator/worker in-process on threads — the same channel-level
+harness as tests/test_dphost.py and the dp scenarios in test_chaos.py —
+so every scenario finishes in seconds.
+
+Also covers the protocol-degradation contract (old worker with elastic
+coordinator and vice versa run fixed-world rounds unchanged), the
+coordinator-crash resume path (restart replays only missing rows), the
+EngineConfig channel knobs, and serve_resume_round's bounded bind
+retry.
+"""
+
+import os
+import signal
+import socket
+import threading
+import time
+
+import pytest
+
+from sutro_tpu.engine import faults
+from sutro_tpu.engine import dphost
+from sutro_tpu.engine.dphost import (
+    DPWorld,
+    fleet_view,
+    run_dp_coordinator,
+    run_dp_worker,
+    serve_resume_round,
+    shard_requests,
+)
+
+from tests.conftest import free_low_port as _free_port
+
+N_ROWS = 256
+
+
+@pytest.fixture(autouse=True)
+def _clean_channel_state():
+    """Every scenario starts with no fault plan, no sticky drain flag,
+    and the EngineConfig channel overrides reset."""
+    yield
+    faults.clear()
+    dphost._DRAIN.clear()
+    dphost._CHANNEL_CFG.update({"stall_timeout": None, "heartbeat": None})
+
+
+def _worlds(port, world):
+    return [
+        DPWorld(rank=r, world=world, host="127.0.0.1", port=port)
+        for r in range(world)
+    ]
+
+
+def _reqs(n=N_ROWS):
+    import numpy as np
+
+    from sutro_tpu.engine.scheduler import GenRequest
+
+    return [
+        GenRequest(row_id=i, prompt_ids=np.array([1, 2], np.int32))
+        for i in range(n)
+    ]
+
+
+def _res(row_id):
+    from sutro_tpu.engine.scheduler import GenResult
+
+    # per-row-distinct content so "bit-identical merge" is a real claim
+    return GenResult(
+        row_id=row_id, token_ids=[row_id % 11, 7],
+        cumulative_logprob=0.0, finish_reason="stop", input_tokens=2,
+    )
+
+
+def _shard_fn(ran=None, per_row=None):
+    """Trivial deterministic shard runner. ``ran`` collects executed row
+    ids; ``per_row(row_id)`` runs before each row (sleep / drain
+    hooks)."""
+
+    def fn(shard, on_result, on_progress, should_cancel):
+        for q in shard:
+            if should_cancel():
+                return "cancelled"
+            if per_row is not None:
+                per_row(q.row_id)
+            if ran is not None:
+                ran.append(q.row_id)
+            on_result(_res(q.row_id))
+        return "completed"
+
+    return fn
+
+
+class _Merge:
+    """Coordinator-side merge recorder: counts on_result invocations
+    per row so duplicate merges (a steal race both sides winning) are
+    detected, not absorbed."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.counts = {}
+        self.results = {}
+
+    def __call__(self, res):
+        with self.lock:
+            self.counts[res.row_id] = self.counts.get(res.row_id, 0) + 1
+            self.results[res.row_id] = list(res.token_ids)
+
+    def assert_complete_no_dups(self, n=N_ROWS):
+        assert set(self.results) == set(range(n)), (
+            f"lost rows: {sorted(set(range(n)) - set(self.results))[:16]}"
+        )
+        dups = {r: c for r, c in self.counts.items() if c != 1}
+        assert not dups, f"duplicate merges reached on_result: {dups}"
+        # bit-identical to a fault-free run: content is row-determined
+        for rid, toks in self.results.items():
+            assert toks == [rid % 11, 7]
+
+
+class _Events:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.items = []
+
+    def __call__(self, ev):
+        with self.lock:
+            self.items.append(dict(ev))
+
+    def of(self, kind):
+        with self.lock:
+            return [e for e in self.items if e.get("event") == kind]
+
+
+def _spawn_worker(world, shard_fn, pool, *, elastic=True, drain=None,
+                  outcomes=None, name=None):
+    key = name or f"rank{world.rank}"
+
+    def main():
+        try:
+            out = run_dp_worker(
+                world, shard_fn, pool, elastic=elastic, drain=drain,
+            )
+        except Exception as e:  # noqa: BLE001 — injected faults re-raise
+            out = f"raised:{type(e).__name__}"
+        if outcomes is not None:
+            outcomes[key] = out
+
+    t = threading.Thread(target=main, daemon=True, name=f"dpw-{key}")
+    t.start()
+    return t
+
+
+# ---------------------------------------------------------------------------
+# the chaos gate: crash / hang / torn frame / preempt / late join
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_clean_round_three_workers():
+    """Baseline: a 256-row job across a coordinator + 3 elastic workers
+    completes with every row merged exactly once, and the fleet view
+    reports the round."""
+    port = _free_port()
+    cw, w1, w2, w3 = _worlds(port, 4)
+    reqs = _reqs()
+    merge, events, outcomes = _Merge(), _Events(), {}
+    threads = [
+        _spawn_worker(w, _shard_fn(), reqs, outcomes=outcomes)
+        for w in (w1, w2, w3)
+    ]
+    outcome = run_dp_coordinator(
+        cw, _shard_fn(), shard_requests(reqs, 0, 4),
+        on_result=merge, on_row_event=events,
+        requests=reqs, job_id="job-clean",
+    )
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive()
+    assert outcome == "completed"
+    assert all(v == "completed" for v in outcomes.values()), outcomes
+    merge.assert_complete_no_dups()
+    joins = events.of("dp_worker_joined")
+    assert {e["rank"] for e in joins} == {1, 2, 3}
+    snap = fleet_view("job-clean")
+    assert snap is not None and snap["elastic"]
+    assert snap["rows"]["done"] == N_ROWS
+    assert snap["rows"]["pending"] == 0
+
+
+def test_elastic_worker_crash_after_join_requeues_and_completes():
+    """A worker that dies right after admission (join churn) loses its
+    whole assignment; the coordinator requeues those rows onto the
+    surviving idle rank and the round still completes — zero lost rows,
+    no duplicate merges, the requeue on the failure_log."""
+    faults.configure("dphost.join:crash:times=1")
+    port = _free_port()
+    cw, w1, w2 = _worlds(port, 3)
+    reqs = _reqs()
+    merge, events, outcomes = _Merge(), _Events(), {}
+    threads = [
+        _spawn_worker(w, _shard_fn(), reqs, outcomes=outcomes)
+        for w in (w1, w2)
+    ]
+    outcome = run_dp_coordinator(
+        cw, _shard_fn(), shard_requests(reqs, 0, 3),
+        on_result=merge, on_row_event=events, requests=reqs,
+    )
+    for t in threads:
+        t.join(timeout=120)
+    assert outcome == "completed"
+    merge.assert_complete_no_dups()
+    req_evts = events.of("dp_rows_requeued")
+    assert req_evts, "crash produced no dp_rows_requeued event"
+    assert sum(e["rows"] for e in req_evts) >= 1
+    assert sorted(outcomes.values()).count("completed") == 1
+
+
+def test_elastic_worker_hang_stalled_rows_requeued(monkeypatch):
+    """A worker that goes TRULY silent mid-round (no heartbeat, no
+    results — a wedged process, simulated with a raw socket that
+    handshakes and then says nothing) is declared stalled by the
+    watchdog; an elastic round requeues its rows and completes instead
+    of failing."""
+    monkeypatch.setenv("SUTRO_DP_STALL_TIMEOUT", "1")
+    # healthy ranks must beat the 1s stall bound even while parked idle
+    monkeypatch.setenv("SUTRO_DP_HEARTBEAT", "0.2")
+    port = _free_port()
+    cw, _w1, w2 = _worlds(port, 3)
+    reqs = _reqs()
+    merge, events, outcomes = _Merge(), _Events(), {}
+    hung = threading.Event()
+
+    def hung_rank1():
+        deadline = time.monotonic() + 60
+        sock = None
+        while sock is None:
+            try:
+                sock = socket.create_connection(
+                    ("127.0.0.1", port), timeout=10.0
+                )
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.05)
+        dphost._send(
+            sock, {"t": "hello", "rank": 1, "job": "", "elastic": 1}
+        )
+        next(dphost._recv_lines(sock), None)  # resume reply
+        hung.set()
+        time.sleep(60)  # wedged: no results, no heartbeat
+        sock.close()
+
+    threading.Thread(target=hung_rank1, daemon=True).start()
+    t = _spawn_worker(w2, _shard_fn(), reqs, outcomes=outcomes)
+    t0 = time.monotonic()
+    outcome = run_dp_coordinator(
+        cw, _shard_fn(), shard_requests(reqs, 0, 3),
+        on_result=merge, on_row_event=events, requests=reqs,
+    )
+    assert outcome == "completed"
+    assert time.monotonic() - t0 < 60  # stall bound, not accept bound
+    merge.assert_complete_no_dups()
+    assert hung.is_set()
+    assert any(
+        e.get("reason") == "stall" for e in events.of("dp_rows_requeued")
+    ), events.items
+    t.join(timeout=120)
+    assert outcomes.get("rank2") == "completed"
+
+
+def test_elastic_mid_frame_drop_requeues_torn_row():
+    """A connection torn MID-FRAME (injected socket drop during a result
+    send) must not lose the row: the coordinator requeues the dead
+    rank's remainder and the merge stays bit-identical."""
+    faults.configure("dphost.send:drop:nth=5,times=1")
+    port = _free_port()
+    cw, w1, w2 = _worlds(port, 3)
+    reqs = _reqs()
+    merge, events, outcomes = _Merge(), _Events(), {}
+    threads = [
+        _spawn_worker(w, _shard_fn(), reqs, outcomes=outcomes)
+        for w in (w1, w2)
+    ]
+    outcome = run_dp_coordinator(
+        cw, _shard_fn(), shard_requests(reqs, 0, 3),
+        on_result=merge, on_row_event=events, requests=reqs,
+    )
+    for t in threads:
+        t.join(timeout=120)
+    assert outcome == "completed"
+    merge.assert_complete_no_dups()
+    assert events.of("dp_rows_requeued")
+
+
+def test_elastic_preempt_drain_via_fault_site(monkeypatch):
+    """The dphost.preempt fault site: a worker drains mid-shard —
+    finishes the in-flight row, hands unfinished ids back in a drain
+    frame, returns "drained" — and the round completes without it.
+    With the requeue limit at 0, ANY counted requeue would fail the
+    round, proving a graceful drain is not held against the rows."""
+    monkeypatch.setenv("SUTRO_DP_REQUEUE_LIMIT", "0")
+    faults.configure("dphost.preempt:error:nth=10,times=1")
+    port = _free_port()
+    cw, w1, w2 = _worlds(port, 3)
+    reqs = _reqs()
+    merge, events, outcomes = _Merge(), _Events(), {}
+    threads = [
+        _spawn_worker(w, _shard_fn(), reqs, outcomes=outcomes)
+        for w in (w1, w2)
+    ]
+    outcome = run_dp_coordinator(
+        cw, _shard_fn(), shard_requests(reqs, 0, 3),
+        on_result=merge, on_row_event=events, requests=reqs,
+    )
+    for t in threads:
+        t.join(timeout=120)
+    assert outcome == "completed"
+    merge.assert_complete_no_dups()
+    drains = events.of("dp_preempt_drain")
+    assert len(drains) == 1, events.items
+    assert sorted(outcomes.values()) == ["completed", "drained"]
+
+
+def test_elastic_sigterm_drains_main_thread_worker():
+    """SIGTERM on an elastic worker running on the MAIN thread is the
+    spot-preemption notice: the installed handler requests a drain, the
+    worker returns "drained", and the previous handler is restored."""
+    port = _free_port()
+    cw, w1, w2 = _worlds(port, 3)
+    reqs = _reqs()
+    merge, events, outcomes = _Merge(), _Events(), {}
+    coord_out = {}
+
+    def coord_main():
+        coord_out["v"] = run_dp_coordinator(
+            cw, _shard_fn(), shard_requests(reqs, 0, 3),
+            on_result=merge, on_row_event=events, requests=reqs,
+        )
+
+    ct = threading.Thread(target=coord_main, daemon=True)
+    ct.start()
+    _spawn_worker(w2, _shard_fn(), reqs, outcomes=outcomes)
+
+    fired = threading.Event()
+
+    def preempt(row_id):
+        # the "cloud" preempts this host a few rows into its shard —
+        # but only once rank 2 has joined: _DRAIN is process-global,
+        # and a rank 2 still in its connect loop would drain without
+        # ever connecting, parking its stride until the join grace
+        if row_id > 10 and not fired.is_set():
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                with merge.lock:
+                    if any(r % 3 == 2 for r in merge.results):
+                        break
+                time.sleep(0.01)
+            fired.set()
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    prev_handler = signal.getsignal(signal.SIGTERM)
+    out = run_dp_worker(
+        w1, _shard_fn(per_row=preempt), reqs, elastic=True,
+    )
+    assert out == "drained"
+    assert signal.getsignal(signal.SIGTERM) == prev_handler
+    ct.join(timeout=120)
+    assert not ct.is_alive()
+    assert coord_out["v"] == "completed"
+    merge.assert_complete_no_dups()
+    assert events.of("dp_preempt_drain")
+
+
+def test_elastic_late_joiner_absorbs_requeued_rows():
+    """A rank joining OUTSIDE the fixed world (rank id >= world) is
+    admitted with a fresh rank and an empty assignment, then absorbs
+    rows the round needs re-run — here, the stride of a worker that
+    died right after joining."""
+    faults.configure("dphost.join:crash:times=1")
+    port = _free_port()
+    cw, w1 = _worlds(port, 2)
+    late = DPWorld(rank=7, world=2, host="127.0.0.1", port=port)
+    reqs = _reqs()
+    merge, events, outcomes = _Merge(), _Events(), {}
+    threads = [
+        _spawn_worker(w1, _shard_fn(), reqs, outcomes=outcomes),
+        _spawn_worker(late, _shard_fn(), reqs, outcomes=outcomes,
+                      name="late"),
+    ]
+    outcome = run_dp_coordinator(
+        cw, _shard_fn(), shard_requests(reqs, 0, 2),
+        on_result=merge, on_row_event=events,
+        requests=reqs, job_id="job-late",
+    )
+    for t in threads:
+        t.join(timeout=120)
+    assert outcome == "completed"
+    merge.assert_complete_no_dups()
+    joins = events.of("dp_worker_joined")
+    assert any(e["late_join"] for e in joins), joins
+    # the late joiner was assigned a fresh rank beyond the fixed world
+    assert any(e["rank"] >= 2 for e in joins)
+
+
+def test_elastic_steal_race_first_result_wins():
+    """Work stealing: with nothing pending and an idle rank parked, the
+    straggler's tail half is dual-assigned (forced here by the
+    dphost.steal site instead of waiting out SUTRO_DP_STEAL_AFTER).
+    Both ranks may stream the same rows — exactly one copy reaches the
+    merge."""
+    faults.configure("dphost.steal:error:times=1")
+    port = _free_port()
+    cw, w1, w2 = _worlds(port, 3)
+    reqs = _reqs(36)  # straggler sleeps per row; keep the tail short
+    merge, events, outcomes = _Merge(), _Events(), {}
+
+    def slow(row_id):
+        time.sleep(0.08)
+
+    def gate(row_id):
+        # don't let rank 2 park idle before the straggler has even
+        # joined: the forced-steal fault is times=1, and firing it
+        # with no admitted victim would waste the charge
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            with merge.lock:
+                if any(r % 3 == 1 for r in merge.results):
+                    return
+            time.sleep(0.01)
+
+    threads = [
+        _spawn_worker(
+            w1, _shard_fn(per_row=slow), reqs, outcomes=outcomes,
+            name="straggler",
+        ),
+        _spawn_worker(
+            w2, _shard_fn(per_row=gate), reqs, outcomes=outcomes,
+        ),
+    ]
+    outcome = run_dp_coordinator(
+        cw, _shard_fn(), shard_requests(reqs, 0, 3),
+        on_result=merge, on_row_event=events,
+        requests=reqs, job_id="job-steal",
+    )
+    for t in threads:
+        t.join(timeout=120)
+    assert outcome == "completed"
+    merge.assert_complete_no_dups(36)
+    steals = events.of("dp_rows_stolen")
+    assert len(steals) == 1, events.items
+    assert steals[0]["victim"] == 1 and steals[0]["thief"] == 2
+    snap = fleet_view("job-steal")
+    assert snap["counters"]["stolen_rows"] == steals[0]["rows"]
+
+
+def test_elastic_all_workers_die_rank0_claims_everything():
+    """The zero-lost-rows backstop: every worker dies right after
+    joining, no idle rank is ever parked, and rank 0 claims and runs
+    the orphaned rows itself."""
+    faults.configure("dphost.join:crash:times=2")
+    port = _free_port()
+    cw, w1, w2 = _worlds(port, 3)
+    reqs = _reqs()
+    merge, events, outcomes = _Merge(), _Events(), {}
+    local_ran = []
+    threads = [
+        _spawn_worker(w, _shard_fn(), reqs, outcomes=outcomes)
+        for w in (w1, w2)
+    ]
+    outcome = run_dp_coordinator(
+        cw, _shard_fn(ran=local_ran), shard_requests(reqs, 0, 3),
+        on_result=merge, on_row_event=events, requests=reqs,
+    )
+    for t in threads:
+        t.join(timeout=120)
+    assert outcome == "completed"
+    merge.assert_complete_no_dups()
+    # rank 0 ran more than its own stride (it picked up orphans)
+    assert len(local_ran) > len(shard_requests(reqs, 0, 3))
+    assert all(v.startswith("raised:") for v in outcomes.values())
+
+
+def test_elastic_never_connected_rank_released_after_join_grace(
+    monkeypatch,
+):
+    """A reserved stride whose rank never connects stops blocking the
+    round after SUTRO_DP_JOIN_GRACE: the rows requeue (not counted
+    against the limit) and the round completes without it."""
+    monkeypatch.setenv("SUTRO_DP_JOIN_GRACE", "1.5")
+    port = _free_port()
+    cw, w1, _w2 = _worlds(port, 3)  # rank 2 never shows up
+    reqs = _reqs(64)
+    merge, events, outcomes = _Merge(), _Events(), {}
+    t = _spawn_worker(w1, _shard_fn(), reqs, outcomes=outcomes)
+    outcome = run_dp_coordinator(
+        cw, _shard_fn(), shard_requests(reqs, 0, 3),
+        on_result=merge, on_row_event=events, requests=reqs,
+    )
+    t.join(timeout=120)
+    assert outcome == "completed"
+    merge.assert_complete_no_dups(64)
+    assert any(
+        e.get("reason") == "never_connected_within_join_grace"
+        for e in events.of("dp_rows_requeued")
+    ), events.items
+
+
+# ---------------------------------------------------------------------------
+# protocol degradation: old peers on either side
+# ---------------------------------------------------------------------------
+
+
+def test_old_worker_with_elastic_coordinator_runs_fixed_stride():
+    """A v1 worker (no elastic hello) against an elastic coordinator is
+    a fixed-stride member: it runs exactly its stride and the round
+    completes unchanged."""
+    port = _free_port()
+    cw, w1 = _worlds(port, 2)
+    reqs = _reqs(64)
+    merge, events = _Merge(), _Events()
+    ran = []
+    t = _spawn_worker(
+        w1, _shard_fn(ran=ran), shard_requests(reqs, 1, 2),
+        elastic=False, name="v1",
+    )
+    outcome = run_dp_coordinator(
+        cw, _shard_fn(), shard_requests(reqs, 0, 2),
+        on_result=merge, on_row_event=events, requests=reqs,
+    )
+    t.join(timeout=120)
+    assert outcome == "completed"
+    merge.assert_complete_no_dups(64)
+    assert sorted(ran) == [i for i in range(64) if i % 2 == 1]
+    joins = events.of("dp_worker_joined")
+    assert joins and joins[0]["elastic"] is False
+
+
+def test_elastic_worker_with_old_coordinator_degrades_to_stride():
+    """An elastic worker whose resume reply carries no assignment (old
+    coordinator) falls back to its fixed stride over the pool — the
+    pre-elastic round, byte for byte."""
+    port = _free_port()
+    cw, w1 = _worlds(port, 2)
+    reqs = _reqs(64)
+    merge = _Merge()
+    ran = []
+    outcomes = {}
+    t = _spawn_worker(
+        w1, _shard_fn(ran=ran), reqs, elastic=True, outcomes=outcomes,
+    )
+    # requests=None -> the coordinator runs the fixed-world (v1) round
+    outcome = run_dp_coordinator(
+        cw, _shard_fn(), shard_requests(reqs, 0, 2), on_result=merge,
+    )
+    t.join(timeout=120)
+    assert outcome == "completed"
+    assert outcomes["rank1"] == "completed"
+    merge.assert_complete_no_dups(64)
+    assert sorted(ran) == [i for i in range(64) if i % 2 == 1]
+
+
+# ---------------------------------------------------------------------------
+# coordinator crash mid-round: restart + resume replays only missing rows
+# ---------------------------------------------------------------------------
+
+
+def test_coordinator_crash_mid_round_resume_replays_only_missing():
+    """Rank 0 dies mid-round (its local shard raises); the workers see
+    EOF and stop. A restarted coordinator resumes with the merged set:
+    workers re-run ONLY rows that never merged, and the final result
+    set is bit-identical to a fault-free run."""
+    reqs = _reqs(96)
+    merge = _Merge()
+
+    port = _free_port()
+    cw, w1, w2 = _worlds(port, 3)
+    outcomes = {}
+
+    def dawdle(row_id):
+        # keep round-1 workers slow enough that the crash lands while
+        # every stride still has unmerged rows — otherwise round 2 has
+        # nothing for the workers to replay and finishes before they
+        # can even connect
+        time.sleep(0.02)
+
+    def crashing_local(shard, on_result, on_progress, should_cancel):
+        for q in shard[:10]:
+            on_result(_res(q.row_id))
+        # die only once BOTH workers have merged rows — a worker still
+        # in its connect loop when the listener closes would spin out
+        # its whole accept deadline
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with merge.lock:
+                if {r % 3 for r in merge.results} >= {1, 2}:
+                    break
+            time.sleep(0.01)
+        raise RuntimeError("rank0 host died")
+
+    threads = [
+        _spawn_worker(
+            w, _shard_fn(per_row=dawdle), reqs, outcomes=outcomes
+        )
+        for w in (w1, w2)
+    ]
+    with pytest.raises(RuntimeError, match="rank0 host died"):
+        run_dp_coordinator(
+            cw, crashing_local, shard_requests(reqs, 0, 3),
+            on_result=merge, requests=reqs,
+        )
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive()
+    survived = set(merge.results)
+    assert survived and survived != set(range(96))
+    # the crash left unmerged rows in every stride — round 2 must
+    # involve the workers, not just rank 0's leftovers
+    assert {r % 3 for r in set(range(96)) - survived} == {0, 1, 2}
+
+    # restart: same pool, done set = whatever merged before the crash
+    port2 = _free_port()
+    cw2, w1b, w2b = _worlds(port2, 3)
+    ran2 = []
+    outcomes2 = {}
+    threads2 = [
+        _spawn_worker(w, _shard_fn(ran=ran2), reqs, outcomes=outcomes2)
+        for w in (w1b, w2b)
+    ]
+    local_ran2 = []
+    pending = [q for q in reqs if q.row_id not in survived]
+    outcome = run_dp_coordinator(
+        cw2, _shard_fn(ran=local_ran2),
+        shard_requests(pending, 0, 3),
+        on_result=merge, done_rows=set(survived), requests=pending,
+    )
+    for t in threads2:
+        t.join(timeout=60)
+        assert not t.is_alive()
+    assert outcome == "completed"
+    # no already-merged row ran again, anywhere — and the workers did
+    # the replaying, not just rank 0
+    assert ran2
+    assert not (set(ran2) | set(local_ran2)) & survived
+    merge.assert_complete_no_dups(96)
+
+
+# ---------------------------------------------------------------------------
+# satellites: config knobs, seeded backoff, resume bind retry, state unit
+# ---------------------------------------------------------------------------
+
+
+def test_engine_config_channel_fields_and_env_precedence(monkeypatch):
+    from sutro_tpu.engine.config import EngineConfig
+
+    ecfg = EngineConfig()
+    assert ecfg.dp_stall_timeout == 600.0
+    assert ecfg.dp_heartbeat == 20.0
+
+    monkeypatch.delenv("SUTRO_DP_STALL_TIMEOUT", raising=False)
+    monkeypatch.delenv("SUTRO_DP_HEARTBEAT", raising=False)
+    dphost.configure_channel(stall_timeout=5.0, heartbeat=7.0)
+    assert dphost._stall_timeout_s() == 5.0
+    assert dphost._heartbeat_s() == 7.0
+    # env (set and non-empty) overrides the configured value
+    monkeypatch.setenv("SUTRO_DP_STALL_TIMEOUT", "9")
+    assert dphost._stall_timeout_s() == 9.0
+    with pytest.raises(ValueError, match=">= 0"):
+        dphost.configure_channel(stall_timeout=-1.0)
+    with pytest.raises(ValueError, match=">= 0"):
+        dphost.configure_channel(heartbeat=-0.5)
+
+
+def test_reconnect_delay_seeded_by_fault_plan():
+    """Under an active plan the reconnect jitter derives from the plan
+    seed: chaos runs replay with identical timing."""
+    faults.install(faults.parse_plan("seed=42;row.decode:error:p=0"))
+    a = [dphost._reconnect_delay(k, 1) for k in range(4)]
+    b = [dphost._reconnect_delay(k, 1) for k in range(4)]
+    assert a == b
+    faults.install(faults.parse_plan("seed=43;row.decode:error:p=0"))
+    c = [dphost._reconnect_delay(k, 1) for k in range(4)]
+    assert a != c
+    faults.clear()
+    for k, v in enumerate(a):
+        base = min(0.25 * 2.0 ** k, 5.0)
+        assert 0.5 * base <= v < 1.5 * base
+    # no plan: still bounded (random jitter)
+    d = dphost._reconnect_delay(2, 1)
+    assert 0.5 <= d < 1.5
+
+
+def test_serve_resume_round_port_busy_returns_false(monkeypatch):
+    """The busy-port path is a bounded, LOGGED failure now, not a
+    silent return: after the bind retries it reports False so the
+    caller can record a dp_resume_round_unserved event."""
+    monkeypatch.setenv("SUTRO_DP_RESUME_BIND_RETRIES", "2")
+    port = _free_port()
+    blocker = socket.create_server(("127.0.0.1", port))
+    try:
+        cw = DPWorld(rank=0, world=2, host="127.0.0.1", port=port)
+        t0 = time.monotonic()
+        served = serve_resume_round(cw, job_key="k", done_rows={0})
+        assert served is False
+        assert time.monotonic() - t0 < 10
+    finally:
+        blocker.close()
+
+
+def test_requeue_limit_fails_round_resumably():
+    """A row that exceeds SUTRO_DP_REQUEUE_LIMIT requeues (it kills
+    every host it lands on) turns the round into a resumable failure
+    instead of an infinite heal loop."""
+    est = dphost._ElasticState.build(
+        _reqs(8), set(), shard_requests(_reqs(8), 0, 2),
+        DPWorld(rank=0, world=2, host="", port=0),
+        steal_after=180.0, join_grace=60.0, requeue_limit=1, now=0.0,
+    )
+    for _ in range(3):
+        rank, rows, _evts = est.admit(1, True)
+        assert rows == {1, 3, 5, 7} - est.done
+        evts = est.release(1, "worker connection lost")
+        assert evts and evts[0]["event"] == "dp_rows_requeued"
+        # re-admission drains pending back to the rank
+        est.rank_rows[1] = set(est.pending)
+        est.pending.clear()
+    assert est.fatal is not None
+    assert "requeued more than 1" in est.fatal
+
+
+def test_elastic_state_first_result_wins_and_drain_not_counted():
+    est = dphost._ElasticState.build(
+        _reqs(8), {0}, shard_requests(_reqs(8), 0, 2),
+        DPWorld(rank=0, world=2, host="", port=0),
+        steal_after=180.0, join_grace=60.0, requeue_limit=3, now=0.0,
+    )
+    est.admit(1, True)
+    assert est.on_res(1, 1, False) is True
+    assert est.on_res(0, 1, False) is False  # duplicate dropped
+    assert est.dup_dropped == 1
+    # cancelled results merge (later-wins store) but never mark done
+    assert est.on_res(1, 3, True) is True
+    assert 3 not in est.done
+    evts = est.drain(1, [3, 5, 7])
+    assert any(e["event"] == "dp_preempt_drain" for e in evts)
+    assert est.requeue_count == {}  # drain is not counted
+    assert {3, 5, 7} <= est.pending
