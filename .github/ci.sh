@@ -15,4 +15,8 @@ make chaos
 # tier-1 gate: telemetry — exporter golden file, flight-recorder
 # reconciliation, and the telemetry-on/off host-overhead budget
 make telemetry-check
+# tier-1 gate: interactive tier CPU smoke — TTFT/ITL legs + the
+# co-resident-batch throughput retention grade (tests/test_serving.py
+# rides the chunked suite below)
+make serve-bench
 bash .github/run_tests_chunked.sh

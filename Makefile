@@ -6,7 +6,8 @@ PY ?= python
 
 .PHONY: all native test test-oneshot test-fast compile-check lint lint-baseline \
 	chaos telemetry-check \
-	bench bench-e2e dryrun chip-validate bench-8b cost golden host-profile clean
+	bench bench-e2e serve-bench dryrun chip-validate bench-8b cost golden \
+	host-profile clean
 
 all: native compile-check
 
@@ -38,7 +39,8 @@ test-fast: native
 # the reference CI ran `python -m compileall` only (SURVEY §4); kept as
 # the cheapest smoke layer
 compile-check:
-	$(PY) -m compileall -q sutro_tpu tests bench.py bench_e2e.py
+	$(PY) -m compileall -q sutro_tpu tests bench.py bench_e2e.py \
+		bench_interactive.py
 
 # graftlint: engine-aware static analysis (lock discipline, jit purity,
 # thread/exception hygiene) gated against the committed baseline —
@@ -80,6 +82,12 @@ bench:
 # full-engine workloads: classify / generate / embed -> BENCH_E2E.json
 bench-e2e:
 	$(PY) bench_e2e.py
+
+# interactive-tier latency legs (TTFT/ITL idle vs co-resident batch)
+# -> BENCH_INTERACTIVE.json; CI runs the CPU smoke, the chip run uses
+# the same entry point without SUTRO_E2E_CPU
+serve-bench:
+	SUTRO_E2E_CPU=1 JAX_PLATFORMS=cpu $(PY) bench_interactive.py
 
 # multi-chip sharding dry run on 8 virtual CPU devices
 dryrun:

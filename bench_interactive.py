@@ -1,0 +1,213 @@
+"""Interactive-tier latency benchmark: TTFT/ITL for the serving path.
+
+Three legs through ``LocalEngine`` + ``InteractiveGateway`` (the same
+code path POST /v1/chat/completions takes, minus HTTP framing):
+
+- **idle**: interactive requests against an otherwise-empty engine —
+  the TTFT floor the co-resident leg is graded against.
+- **batch_alone**: the reference batch job by itself (rows/hour
+  baseline for the throughput-retention grade).
+- **cobatch**: the same batch job with interactive requests streaming
+  against it — latency-priority admission evicts batch rows via the
+  pause/resume primitive (EngineConfig.interactive_slots budget).
+
+Acceptance targets (ISSUE 9 / PERF.md): cobatch p99 TTFT < 5x idle
+TTFT, batch rows/hour within 20% of batch_alone. On TPU the batch leg
+defaults to 20k rows; the CPU smoke is time-boxed via env overrides
+(SUTRO_IBENCH_ROWS / SUTRO_IBENCH_REQS / SUTRO_IBENCH_MAXTOK).
+
+Writes BENCH_INTERACTIVE.json and prints one JSON line per leg.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from pathlib import Path
+
+from bench_e2e import make_reviews
+
+
+def pct(samples, q):
+    if not samples:
+        return None
+    xs = sorted(samples)
+    i = min(len(xs) - 1, max(0, round(q / 100.0 * (len(xs) - 1))))
+    return round(xs[i], 4)
+
+
+def main() -> None:
+    from sutro_tpu.engine.softdeadline import arm_from_env
+
+    arm_from_env()
+    import jax
+
+    if os.environ.get("SUTRO_E2E_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    on_tpu = jax.default_backend() not in ("cpu",)
+
+    if on_tpu:
+        model = os.environ.get("SUTRO_E2E_MODEL", "qwen-3-0.6b")
+        rows = int(os.environ.get("SUTRO_IBENCH_ROWS", "20000"))
+        n_reqs = int(os.environ.get("SUTRO_IBENCH_REQS", "20"))
+        max_tok = int(os.environ.get("SUTRO_IBENCH_MAXTOK", "64"))
+        ecfg = dict(
+            decode_batch_size=64, kv_page_size=64, max_pages_per_seq=8,
+            max_model_len=512, max_new_tokens=max_tok,
+            interactive_slots=2,
+        )
+    else:  # CPU smoke
+        model = "tiny-dense"
+        rows = int(os.environ.get("SUTRO_IBENCH_ROWS", "48"))
+        n_reqs = int(os.environ.get("SUTRO_IBENCH_REQS", "4"))
+        max_tok = int(os.environ.get("SUTRO_IBENCH_MAXTOK", "8"))
+        ecfg = dict(
+            decode_batch_size=4, kv_page_size=8, max_pages_per_seq=16,
+            max_model_len=128, max_new_tokens=max_tok, use_pallas=False,
+            param_dtype="float32", interactive_slots=2,
+        )
+
+    os.environ.setdefault("SUTRO_HOME", "/tmp/sutro-bench-interactive")
+    from sutro_tpu.sdk import Sutro
+    from sutro_tpu.serving import openai as oai
+    from sutro_tpu.serving.openai import parse_request
+
+    so = Sutro(engine_config=ecfg)
+    eng = so.engine
+    gw = eng.gateway
+    assert gw is not None, "interactive_slots must be > 0"
+    results = {}
+
+    def one_request(i, ttfts, itls):
+        body = {
+            "model": model,
+            "messages": [
+                {"role": "user", "content": f"Question {i}: say something."}
+            ],
+            "max_tokens": max_tok,
+            "stream": True,
+        }
+        ir = gw.submit(parse_request(body, chat=True))
+        for _ in oai.iter_stream(ir, chat=True):
+            pass
+        ttft = ir.channel.ttft_s()
+        if ttft is not None:
+            ttfts.append(ttft)
+        itls.extend(ir.channel.itl_samples)
+
+    def latency_leg(name):
+        ttfts, itls = [], []
+        threads = [
+            threading.Thread(target=one_request, args=(i, ttfts, itls))
+            for i in range(n_reqs)
+        ]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+            # staggered open-loop-ish arrivals, not a thundering herd
+            time.sleep(0.05)
+        for t in threads:
+            t.join()
+        entry = {
+            "n_requests": n_reqs,
+            "max_tokens": max_tok,
+            "elapsed_s": round(time.monotonic() - t0, 2),
+            "ttft_p50_s": pct(ttfts, 50),
+            "ttft_p99_s": pct(ttfts, 99),
+            "itl_p50_s": pct(itls, 50),
+            "itl_p99_s": pct(itls, 99),
+        }
+        results[name] = entry
+        print(json.dumps({name: entry}), flush=True)
+        return entry
+
+    def batch_job(tag):
+        # salt the rows per leg: identical payloads would hit the
+        # jobstore's result reuse and record a no-op as "throughput"
+        t0 = time.monotonic()
+        jid = so.infer(
+            [f"[{tag}] {r}" for r in make_reviews(rows)],
+            model=model,
+            system_prompt="Summarize the review in one short sentence.",
+            stay_attached=False,
+        )
+        df = so.await_job_completion(jid, timeout=24 * 3600)
+        assert df is not None and len(df) == rows, "batch job lost rows"
+        elapsed = time.monotonic() - t0
+        return {
+            "rows": rows,
+            "elapsed_s": round(elapsed, 2),
+            "rows_per_hour": round(rows / elapsed * 3600, 1),
+        }
+
+    # -- leg 1: idle latency floor -------------------------------------
+    # warm the runner so leg 1's first TTFT is not a model-load stall
+    one_request(-1, [], [])
+    latency_leg("idle")
+
+    # -- leg 2: batch throughput baseline ------------------------------
+    # warm the batch path (prefill/decode compile at batch shapes) so
+    # the baseline leg measures steady-state throughput, not JIT —
+    # same review rows as the measured legs so the shape buckets match
+    jid = so.infer(
+        [
+            f"[warm] {r}"
+            for r in make_reviews(
+                min(rows, 4 * ecfg["decode_batch_size"])
+            )
+        ],
+        model=model,
+        system_prompt="Summarize the review in one short sentence.",
+        stay_attached=False,
+    )
+    so.await_job_completion(jid, timeout=24 * 3600, obtain_results=False)
+    entry = batch_job("alone")
+    results["batch_alone"] = entry
+    print(json.dumps({"batch_alone": entry}), flush=True)
+
+    # -- leg 3: interactive against the live batch ---------------------
+    done = {}
+
+    def run_batch():
+        done.update(batch_job("cobatch"))
+
+    bt = threading.Thread(target=run_batch)
+    bt.start()
+    # let the batch session occupy the decode window before probing it
+    time.sleep(1.0 if on_tpu else 0.2)
+    entry = latency_leg("cobatch")
+    bt.join()
+    results["cobatch"].update({"batch": dict(done)})
+    print(json.dumps({"cobatch_batch": done}), flush=True)
+
+    idle99 = results["idle"]["ttft_p99_s"] or 0.0
+    co99 = results["cobatch"]["ttft_p99_s"] or 0.0
+    base_rph = results["batch_alone"]["rows_per_hour"]
+    co_rph = done["rows_per_hour"]
+    results["grades"] = {
+        "ttft_p99_ratio_vs_idle": (
+            round(co99 / idle99, 2) if idle99 else None
+        ),
+        "ttft_target": "p99 cobatch < 5x idle",
+        "batch_throughput_retention": round(co_rph / base_rph, 3),
+        "throughput_target": "cobatch batch rows/hour >= 0.8x alone",
+    }
+    print(json.dumps({"grades": results["grades"]}), flush=True)
+
+    out = {
+        "backend": jax.default_backend(),
+        "n_chips": max(jax.device_count(), 1),
+        "model": model,
+        "interactive_slots": ecfg["interactive_slots"],
+        "legs": results,
+    }
+    Path(__file__).parent.joinpath("BENCH_INTERACTIVE.json").write_text(
+        json.dumps(out, indent=2)
+    )
+    print(json.dumps({"bench_interactive": "written"}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -101,12 +101,69 @@ def set_backend(backend: str) -> None:
 @click.option("--host", default="127.0.0.1", show_default=True)
 @click.option("--port", default=8642, show_default=True)
 @click.option("--quiet", is_flag=True, help="Suppress per-request logging")
-def serve(host: str, port: int, quiet: bool) -> None:
+@click.option("--interactive-slots", default=0, show_default=True, type=int,
+              help="Reserved-slot budget for the interactive tier "
+              "(/v1/chat/completions); 0 disables the endpoints")
+def serve(host: str, port: int, quiet: bool, interactive_slots: int) -> None:
     """Run the engine as a long-lived HTTP daemon (detach/attach across
     processes; clients use `sutro set-backend remote` + `set-base-url`)."""
     from .server import serve as _serve
 
-    _serve(host=host, port=port, verbose=not quiet)
+    ecfg = None
+    if interactive_slots > 0:
+        from .engine.config import load_engine_config
+
+        ecfg = load_engine_config(interactive_slots=interactive_slots)
+    _serve(host=host, port=port, ecfg=ecfg, verbose=not quiet)
+
+
+@cli.command()
+@click.argument("prompt")
+@click.option("--model", default="qwen-3-4b", show_default=True)
+@click.option("--system", "system_prompt", default=None,
+              help="System prompt")
+@click.option("--no-stream", is_flag=True,
+              help="Print the full response at once instead of streaming")
+@click.option("--schema", "schema_file", default=None,
+              type=click.Path(exists=True),
+              help="JSON schema file; constrains the output "
+              "(OpenAI response_format=json_schema)")
+@click.option("--interactive-slots", default=None, type=int,
+              help="Local backend only: enable the interactive tier "
+              "with this reserved-slot budget")
+def chat(prompt: str, model: str, system_prompt: Optional[str],
+         no_stream: bool, schema_file: Optional[str],
+         interactive_slots: Optional[int]) -> None:
+    """One interactive chat completion (tokens stream to stdout)."""
+    sdk = get_sdk()
+    if interactive_slots is not None and sdk.backend != "remote":
+        sdk._engine_config["interactive_slots"] = interactive_slots
+    response_format = None
+    if schema_file:
+        with open(schema_file) as f:
+            response_format = {
+                "type": "json_schema",
+                "json_schema": {"schema": json.load(f)},
+            }
+    try:
+        if no_stream:
+            resp = sdk.chat(
+                prompt, model=model, system_prompt=system_prompt,
+                response_format=response_format,
+            )
+            click.echo(resp["choices"][0]["message"]["content"])
+            return
+        for chunk in sdk.chat(
+            prompt, model=model, system_prompt=system_prompt,
+            response_format=response_format, stream=True,
+        ):
+            content = chunk["choices"][0]["delta"].get("content")
+            if content:
+                click.echo(content, nl=False)
+        click.echo()
+    except RuntimeError as e:
+        click.echo(to_colored_text(f"✗ {e}", "fail"))
+        sys.exit(1)
 
 
 @cli.command()

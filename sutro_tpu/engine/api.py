@@ -132,6 +132,14 @@ class LocalEngine:
         self._lock = threading.Lock()
         self._runner_cache: Dict[str, Tuple[ModelRunner, BaseTokenizer]] = {}
         self._tok_cache: Dict[str, BaseTokenizer] = {}
+        # Interactive serving tier: constructed ONLY when the reserved
+        # slot budget is on — at the default 0 the serving package is
+        # never imported and every batch code path is unchanged.
+        self.gateway = None
+        if getattr(self.ecfg, "interactive_slots", 0) > 0:
+            from ..serving.gateway import InteractiveGateway
+
+            self.gateway = InteractiveGateway(self)
         self._worker = threading.Thread(
             target=self._worker_loop, daemon=True, name="sutro-engine"
         )
@@ -286,6 +294,12 @@ class LocalEngine:
         Cached: the verdict is immutable per job, and this runs on the
         scheduler loop's cadence — it must not re-read job records from
         disk every decode window."""
+        if jid.startswith("serve:"):
+            # serving-wake sentinel (_enqueue_serving): attaches to a
+            # same-key session; for any other session it reads as an
+            # unattachable higher-priority entry, forcing the yield that
+            # gets the interactive request onto the device
+            return jid[6:]
         cached = self._attach_info.get(jid)
         if cached is not None:
             return cached[0]
@@ -361,6 +375,13 @@ class LocalEngine:
                 self._queued.discard(jid)
                 self._queued_prio.pop(jid, None)
             self._attach_info.pop(jid, None)
+            if jid.startswith("serve:"):
+                # same-key serving sentinel: the running session polls
+                # the gateway directly (poll_new), so the wake-up is
+                # already served — consume it and keep scanning
+                if self.gateway is not None:
+                    self.gateway.sentinel_popped(engine_key)
+                continue
             if jid in self._cancel:
                 # mirrors the worker-pop cancel check
                 self.jobs.set_status(jid, JobStatus.CANCELLED)
@@ -383,6 +404,14 @@ class LocalEngine:
         with self._lock:
             seq = self._reserve_queue_entry(priority, job_id)
             self._queue.put((priority, seq, job_id))
+
+    def _enqueue_serving(self, engine_key: str) -> None:
+        """Wake the worker for a parked interactive request: a
+        ``serve:<engine_key>`` sentinel at priority -1 — ahead of every
+        batch priority (all non-negative), so an idle worker starts a
+        serving session immediately and a busy different-model session
+        sees an unattachable higher entry and yields."""
+        self._enqueue(-1, f"serve:{engine_key}")
 
     def job_status(self, job_id: str) -> str:
         return self.jobs.status(job_id).value
@@ -727,6 +756,20 @@ class LocalEngine:
                 self._queued.discard(job_id)
                 self._queued_prio.pop(job_id, None)
                 self._current_job = job_id
+            if job_id.startswith("serve:"):
+                # serving-wake sentinel: run an interactive session for
+                # the key (no job record, no jobstore epilogue)
+                engine_key = job_id[6:]
+                if self.gateway is not None:
+                    self.gateway.sentinel_popped(engine_key)
+                try:
+                    self._run_serving_session(engine_key)
+                except Exception:  # noqa: BLE001 — session isolation
+                    traceback.print_exc()
+                finally:
+                    with self._lock:
+                        self._current_job = None
+                continue
             if telemetry.enabled():
                 telemetry.JOBS_RUNNING.set(1 + len(self._attached))
             requeue_priority = None
@@ -928,14 +971,52 @@ class LocalEngine:
                 job_id, engine_key, sess, batcher
             )
 
+    def _run_serving_session(self, engine_key: str) -> None:
+        """Serving-only co-batch session: no primary batch job, just
+        interactive requests adopted through the gateway (plus any
+        same-model batch jobs that attach mid-session via the normal
+        queue scan)."""
+        gw = self.gateway
+        if gw is None or not gw.has_pending(engine_key):
+            return
+        mcfg = MODEL_CONFIGS.get(engine_key)
+        if mcfg is None:
+            return
+        runner, tok = self._get_runner(engine_key, mcfg)
+        token_bytes = getattr(tok, "token_bytes", None)
+        if token_bytes is not None:
+            try:
+                token_bytes(0)
+            except Exception:  # graftlint: disable=silent-except
+                token_bytes = None  # base-class stub probe
+        batcher = ContinuousBatcher(
+            runner,
+            stop_ids=getattr(tok, "stop_ids", lambda: [tok.eos_id])(),
+            seed=self.ecfg.seed,
+            token_bytes=token_bytes,
+        )
+        self._run_cobatch_session(None, engine_key, None, batcher)
+
     def _run_cobatch_session(
-        self, job_id: str, engine_key: str, sess: "_GenSession", batcher
+        self, job_id: Optional[str], engine_key: str,
+        sess: "Optional[_GenSession]", batcher,
     ) -> Optional[int]:
         """Drive the primary job and any attachable queued same-model
         jobs through ONE scheduler session (cross-job co-batching).
         Returns the primary's requeue priority on preemption yield, else
-        None (each job's terminal state is set as it finishes)."""
-        sessions: Dict[str, _GenSession] = {job_id: sess}
+        None (each job's terminal state is set as it finishes).
+
+        ``sess=None`` runs a SERVING-ONLY session (_run_serving_session):
+        the loop starts empty and lives off gateway adoptions. Either
+        way, when a gateway exists its parked interactive requests are
+        adopted ahead of the queue scan — they are 1-row priority -1
+        ctxs whose results ride the per-request channel, not a session."""
+        sessions: Dict[str, _GenSession] = (
+            {} if sess is None else {job_id: sess}
+        )
+        # live interactive ctxs by request id (gateway-owned lifecycle)
+        iactive: Dict[str, Any] = {}
+        gw = self.gateway
         # in-flight attach build: session construction tokenizes every
         # input row, so it runs on a BACKGROUND thread — the scheduler
         # loop keeps decoding live jobs while a 20k-row attach prepares.
@@ -978,6 +1059,13 @@ class LocalEngine:
                 build["done"] = True
 
         def poll_new():
+            # latency-priority adoption: a parked interactive request
+            # enters the live window before any queued batch job
+            if gw is not None:
+                ictx = gw.take_pending(engine_key)
+                if ictx is not None:
+                    iactive[ictx.job_id] = ictx
+                    return ictx
             if build:
                 if not build.get("done"):
                     return None  # build in flight; keep decoding
@@ -1022,7 +1110,36 @@ class LocalEngine:
                 self._attached.discard(s2.job_id)
 
         def on_job_done(ctx, outcome: str) -> None:
+            if ctx.job_id in iactive:
+                iactive.pop(ctx.job_id, None)
+                stats = gw.finish(ctx, outcome) if gw is not None else {}
+                if stats:
+                    # doctor evidence: co-resident batch jobs record the
+                    # interactive traffic they shared the window with
+                    for s2 in sessions.values():
+                        if s2.finalized or s2.jtel is None:
+                            continue
+                        ia = s2.jtel.attrs.setdefault(
+                            "interactive",
+                            {"requests": 0, "starved": 0,
+                             "ttft_max_s": 0.0},
+                        )
+                        ia["requests"] += 1
+                        if stats.get("starved"):
+                            ia["starved"] += 1
+                        if stats.get("ttft_s") is not None:
+                            ia["ttft_max_s"] = max(
+                                ia["ttft_max_s"],
+                                round(stats["ttft_s"], 3),
+                            )
+                return
             s = sessions[ctx.job_id]
+            if s.jtel is not None and ctx.stats.get("preempted"):
+                ia = s.jtel.attrs.setdefault(
+                    "interactive",
+                    {"requests": 0, "starved": 0, "ttft_max_s": 0.0},
+                )
+                ia["preempted_rows"] = ctx.stats["preempted"]
             # NO try/finally: a raised finalize (e.g. the store's
             # bounded I/O retries exhausted) must leave ``finalized``
             # False so the session-error path below — or the worker
@@ -1046,21 +1163,33 @@ class LocalEngine:
                 for s in sessions.values()
                 if not s.finalized
             ]
+            # a live interactive request (priority -1) outranks every
+            # queued batch job, so min(live) = -1 pins the session
+            live += [c.priority for c in iactive.values() if not c.done]
             if not live:
                 return False
             return self._unattachable_higher_waiting(
                 min(live), engine_key
             )
 
+        def _fail_live_interactive(outcome: str) -> None:
+            for c in list(iactive.values()):
+                if not c.done and gw is not None:
+                    gw.finish(c, outcome)
+            iactive.clear()
+
         try:
             state = batcher.run_multi(
-                [sess.ctx],
+                [sess.ctx] if sess is not None else [],
                 on_job_done=on_job_done,
                 poll_new=poll_new,
                 should_yield=should_yield,
             )
         except Exception:
             _drain_pending_build()
+            # live interactive requests have no resumable record —
+            # their channels get the error and the client retries
+            _fail_live_interactive("error")
             # fail attached non-terminal jobs; the worker loop's except
             # handles the primary — unless the primary already reached a
             # terminal state, in which case swallow (don't flip it)
@@ -1093,12 +1222,21 @@ class LocalEngine:
                 self.metrics.job(jid2).finish()
                 with self._lock:
                     self._attached.discard(jid2)
+            if sess is None:
+                # serving-only session: no primary for the worker-loop
+                # epilogue to fail — the error is fully handled here
+                traceback.print_exc()
+                return None
             if sessions[job_id].finalized:
                 traceback.print_exc()
                 return None
             raise
         _drain_pending_build()
         if state == "yielded":
+            # interactive ctxs cannot suspend/resume (their consumer is
+            # a live stream); only reachable if something outranks
+            # priority -1, which the public surface never produces
+            _fail_live_interactive("error")
             requeue = None
             for jid2, s2 in list(sessions.items()):
                 if s2.finalized:

@@ -57,6 +57,15 @@ class EngineConfig:
                                     # fixed-size chunks (runner.prefill)
     prefill_batch_size: int = 8     # short rows prefilled per device
                                     # dispatch (runner.prefill_batch)
+    interactive_slots: int = 0      # reserved-slot budget for the online
+                                    # serving tier (serving/gateway.py):
+                                    # up to this many decode slots may be
+                                    # taken by interactive /v1 requests,
+                                    # preempting batch rows when the
+                                    # batch is full (the preempted row
+                                    # re-admits row-granularly). 0 = the
+                                    # serving endpoints 404 and the batch
+                                    # path is bit-identical to before
     max_batch_tokens: int = 32768   # admission budget: sum of in-flight
                                     # worst-case totals (scheduler._reserve)
     max_model_len: int = 8192

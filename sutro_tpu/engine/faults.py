@@ -30,6 +30,13 @@ dphost.preempt         elastic worker cancel poll: any firing spec requests
 dphost.steal           elastic coordinator steal planner: a firing spec
                        forces a steal without waiting out
                        SUTRO_DP_STEAL_AFTER; no raise
+serving.admit          interactive gateway submit (serving/gateway.py):
+                       any raising kind rejects the request with a 503
+                       before it touches the scheduler
+serving.stream         interactive SSE write loop (server.py), per sent
+                       frame: a raising kind mid-stream cancels the
+                       request — its slot and KV pages free on the next
+                       scheduler iteration, batch jobs unaffected
 ====================== ====================================================
 
 Kinds: ``error`` (RuntimeError), ``oom`` (RESOURCE_EXHAUSTED-shaped

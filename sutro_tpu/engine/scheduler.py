@@ -263,6 +263,15 @@ class JobCtx:
     row_retries: int = 0
     on_row_event: Optional[Callable[[Dict[str, Any]], None]] = None
     row_attempts: Dict[int, int] = dataclasses.field(default_factory=dict)
+    # Interactive serving tier (serving/gateway.py): ``on_token`` streams
+    # every accepted token to the request's channel the moment the single
+    # commit point (_accept_token) records it — all decode paths (single
+    # step, fused windows, speculative verify, fast-forward) converge
+    # there, so per-token streaming needs exactly one hook. ``interactive``
+    # marks the ctx as a latency-priority request that may preempt batch
+    # rows inside the EngineConfig.interactive_slots budget.
+    on_token: Optional[Callable[[int, int, float], None]] = None
+    interactive: bool = False
     # -- internal session state --
     prefix: Optional[_SharedPrefix] = None
     prefix_ready: bool = False  # _setup_prefix attempted (lazily, at
@@ -798,6 +807,7 @@ class ContinuousBatcher:
                     req.temperature, req.top_p, req.top_k,
                 )
             self._record_token(slot, first, float(logp))
+            self._deliver_token(slot, first, float(logp))
 
     def _seed_penalty_bits(self, slot: _Slot, req: GenRequest) -> None:
         if req.has_penalties():
@@ -891,6 +901,7 @@ class ContinuousBatcher:
             s.job.stats["in"] += len(req.prompt_ids)
             s.job.stats["out"] += 1  # the prefill-sampled first token
         self._record_token(s, first, float(logps[0]))
+        self._deliver_token(s, first, float(logps[0]))
 
     @staticmethod
     def _hist_push(s: _Slot, tok: int) -> None:
@@ -1395,6 +1406,23 @@ class ContinuousBatcher:
             out = np.asarray(tok[:n]), np.asarray(logp[:n])
         return out
 
+    def _deliver_token(self, slot: _Slot, tok: int, logp: float) -> None:
+        """Fan one committed token out to the slot's job ``on_token``
+        hook (the interactive streaming channel). Every commit path
+        must call this — ``_accept_token``, the two prefill-sampled
+        first-token sites, and the vectorized window accept."""
+        j = slot.job
+        if j is None or j.on_token is None:
+            return
+        try:
+            j.on_token(slot.req.row_id, tok, float(logp))
+        except Exception:  # noqa: BLE001 — a broken stream channel
+            # must not kill the decode loop; the request's
+            # should_cancel path tears it down
+            logger.warning(
+                "on_token hook failed for %s", j.job_id, exc_info=True
+            )
+
     def _record_token(self, slot: _Slot, tok: int, logp: float) -> None:
         slot.out_ids.append(tok)
         if slot.hist is not None:  # n-gram draft history (incremental)
@@ -1540,6 +1568,7 @@ class ContinuousBatcher:
         s.last_token = tok
         if s.job is not None:
             s.job.stats["out"] += 1
+        self._deliver_token(s, tok, float(logp))
         try:
             done = self._finish_reason(s, tok)
         except Exception as e:  # noqa: BLE001 — row isolation (FSM state)
@@ -1824,6 +1853,12 @@ class ContinuousBatcher:
                 self.native.note_bulk(i, s.last_token, n_take)
             if s.job is not None:
                 s.job.stats["out"] += n_take
+                if s.job.on_token is not None:
+                    lcol = lw[:n_take, col]
+                    for k in range(n_take):
+                        self._deliver_token(
+                            s, int(col_t[k]), float(lcol[k])
+                        )
             if limit <= wK:
                 self._emit(i)
 
@@ -2026,6 +2061,70 @@ class ContinuousBatcher:
             if not ctx.done and not ctx.pending and ctx.n_slots == 0:
                 self._finish_job(ctx, "completed", on_job_done)
 
+    def _interactive_slots_used(self) -> int:
+        return sum(
+            1
+            for s in self.slots
+            if s is not None and s.job is not None and s.job.interactive
+        )
+
+    def _evict_for_interactive(self, ctx: JobCtx) -> bool:
+        """Latency-priority admission (Sarathi-style mixed windows): when
+        an INTERACTIVE row finds the batch full, suspend one batch row —
+        inside the ``EngineConfig.interactive_slots`` budget — so the
+        request enters the live decode window now instead of waiting for
+        a batch row to finish. The victim re-admits row-granularly (same
+        rebuild rule as the retry path: a directly supplied FSM cannot
+        be rewound); its partial output regenerates, exactly like a
+        session-yield suspend. Returns True when a victim was freed."""
+        budget = getattr(self.ecfg, "interactive_slots", 0)
+        if not ctx.interactive or budget <= 0:
+            return False
+        if self._interactive_slots_used() >= budget:
+            return False  # the tier already holds its reserved share
+        best: Optional[int] = None
+        best_cost = -1
+        for i, s in enumerate(self.slots):
+            if s is None or s.job is None or s.job.interactive:
+                continue
+            if s.req.constraint is not None and (
+                s.req.constraint_factory is None
+            ):
+                continue  # not rebuildable — cannot re-admit from scratch
+            cost = len(s.out_ids) + (s.prefill_pos if s.prefilling else 0)
+            if best is None or cost < best_cost:
+                best, best_cost = i, cost
+        if best is None:
+            return False
+        s = self.slots[best]
+        victim = s.job
+        self._unreserve(best, s.pages[s.shared_n:])
+        victim.n_slots -= 1
+        self.slots[best] = None
+        self._gen[best] += 1
+        self._needs_mask.discard(best)
+        # fresh request at the HEAD of pending (admission pops the tail),
+        # so the victim's other rows keep their order and this one
+        # re-admits once the batch has room again
+        victim.pending.insert(
+            0,
+            dataclasses.replace(
+                s.req,
+                constraint=None,
+                prepped_constraint=None,
+                prep_queued=False,
+            ),
+        )
+        victim.stats["preempted"] = victim.stats.get("preempted", 0) + 1
+        if self._tel_on:
+            telemetry.INTERACTIVE_PREEMPTIONS_TOTAL.inc(1.0)
+        logger.debug(
+            "interactive admit: suspended batch row %d of %s "
+            "(%d tokens regenerate)",
+            s.req.row_id, victim.job_id, best_cost,
+        )
+        return True
+
     def _admit_pending(self, order: List[JobCtx]) -> bool:
         """Admit as many pending rows as slots/pages allow, pulling from
         jobs in (priority, seq) order; rows prefill in batches of up to
@@ -2077,6 +2176,11 @@ class ContinuousBatcher:
                         req, ctx, reserved=reserved_tokens,
                         exclude=reserved_idxs,
                     )
+                    while r is None and self._evict_for_interactive(ctx):
+                        r = self._reserve(
+                            req, ctx, reserved=reserved_tokens,
+                            exclude=reserved_idxs,
+                        )
                     if r is None:
                         break
                     ctx.pending.pop()
@@ -2101,6 +2205,11 @@ class ContinuousBatcher:
                     req, ctx, reserved=reserved_tokens,
                     exclude=reserved_idxs,
                 )
+                while r is None and self._evict_for_interactive(ctx):
+                    r = self._reserve(
+                        req, ctx, reserved=reserved_tokens,
+                        exclude=reserved_idxs,
+                    )
                 if r is None:
                     break
                 ctx.pending.pop()

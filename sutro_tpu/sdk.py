@@ -277,10 +277,130 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
                 "get", f"stream-job-progress/{job_id}", stream=True
             )
             for line in resp.iter_lines():
-                if line:
-                    yield json.loads(line)
+                if not line:
+                    continue
+                update = json.loads(line)
+                if update.get("t") == "end":
+                    # explicit terminal frame (newer servers); older
+                    # servers just close the stream — both end here
+                    break
+                yield update
         else:
             yield from self.engine.stream_job_progress(job_id)
+
+    # ------------------------------------------------------------------
+    # interactive serving API (the serving/ tier's OpenAI surface)
+    # ------------------------------------------------------------------
+
+    def chat(
+        self,
+        messages: Union[str, List[Dict[str, Any]]],
+        model: str = "qwen-3-4b",
+        *,
+        stream: bool = False,
+        system_prompt: Optional[str] = None,
+        response_format: Optional[Dict[str, Any]] = None,
+        max_tokens: Optional[int] = None,
+        temperature: Optional[float] = None,
+        top_p: Optional[float] = None,
+        stop: Optional[Union[str, List[str]]] = None,
+        seed: Optional[int] = None,
+    ) -> Any:
+        """One interactive chat completion against the serving tier.
+
+        ``messages`` is a string (one user turn) or an OpenAI-style
+        message list. Non-streaming returns the ``chat.completion``
+        response dict; ``stream=True`` returns an iterator of
+        ``chat.completion.chunk`` dicts (closing it cancels the request
+        and frees its engine slot). ``response_format`` takes the
+        OpenAI ``json_object`` / ``json_schema`` shapes and routes
+        through the engine's constrained-decode path.
+
+        The tier lives on the same engine daemon as batch: remote
+        backends POST ``/v1/chat/completions`` to ``base_url``; the
+        local backend submits straight to the engine's gateway, which
+        requires ``engine_config={"interactive_slots": N}`` with N > 0.
+        """
+        if isinstance(messages, str):
+            messages = [{"role": "user", "content": messages}]
+        else:
+            messages = list(messages)
+        if system_prompt:
+            messages = [
+                {"role": "system", "content": system_prompt}
+            ] + messages
+        body: Dict[str, Any] = {
+            "model": model,
+            "messages": messages,
+            "stream": bool(stream),
+        }
+        if response_format is not None:
+            body["response_format"] = response_format
+        if max_tokens is not None:
+            body["max_tokens"] = int(max_tokens)
+        if temperature is not None:
+            body["temperature"] = float(temperature)
+        if top_p is not None:
+            body["top_p"] = float(top_p)
+        if stop is not None:
+            body["stop"] = stop
+        if seed is not None:
+            body["seed"] = int(seed)
+
+        if self.backend == "remote":
+            resp = self.do_request(
+                "post", "v1/chat/completions", json=body, stream=stream
+            )
+            if resp.status_code == 404:
+                raise RuntimeError(
+                    "the server's interactive tier is disabled — start "
+                    "it with EngineConfig.interactive_slots > 0"
+                )
+            resp.raise_for_status()
+            if stream:
+                return self._iter_sse(resp)
+            return resp.json()
+
+        gw = getattr(self.engine, "gateway", None)
+        if gw is None:
+            raise RuntimeError(
+                "interactive serving is disabled: construct "
+                "Sutro(engine_config={'interactive_slots': N}) with N > 0"
+            )
+        from .serving import openai as oai
+
+        sreq = oai.parse_request(body, chat=True)
+        ir = gw.submit(sreq)
+        if stream:
+            return self._iter_local_stream(ir)
+        return oai.collect(ir, chat=True)
+
+    def _iter_local_stream(self, ir: Any):
+        """Local streaming chat: the gateway's channel, heartbeats
+        filtered out. An abandoned iterator cancels the request so the
+        scheduler frees its slot."""
+        from .serving import openai as oai
+
+        try:
+            for obj in oai.iter_stream(ir, chat=True):
+                if obj is not None:
+                    yield obj
+        except GeneratorExit:
+            ir.channel.cancel()
+            raise
+
+    def _iter_sse(self, resp: Any):
+        """Parse an SSE chat stream (``data:`` frames until [DONE])."""
+        for raw in resp.iter_lines():
+            if not raw:
+                continue
+            line = raw.decode() if isinstance(raw, bytes) else raw
+            if not line.startswith("data:"):
+                continue  # ": ping" heartbeats / comments
+            data = line[5:].strip()
+            if data == "[DONE]":
+                return
+            yield json.loads(data)
 
     # ------------------------------------------------------------------
     # public inference API

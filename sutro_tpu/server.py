@@ -34,6 +34,7 @@ diagnosis of that document.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 from email.message import Message
 from email.parser import BytesParser
@@ -42,6 +43,8 @@ from typing import Any, Dict, Optional, Tuple
 
 from .engine.api import LocalEngine
 from .interfaces import JobStatus
+
+logger = logging.getLogger(__name__)
 
 DEFAULT_PORT = 8642
 
@@ -167,9 +170,13 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
 
     def do_POST(self) -> None:  # noqa: N802
         try:
-            head, _ = self._route()
+            head, rest = self._route()
             eng = self.engine
-            if head == "batch-inference":
+            if head == "v1" and rest == "chat/completions":
+                self._serve_openai(chat=True)
+            elif head == "v1" and rest == "completions":
+                self._serve_openai(chat=False)
+            elif head == "batch-inference":
                 payload = self._read_json()
                 self._json({"results": eng.submit_batch_inference(payload)})
             elif head == "job-results":
@@ -254,19 +261,139 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
             self.wfile.write(f"{len(line):X}\r\n".encode() + line + b"\r\n")
             self.wfile.flush()
 
+        status: Optional[str] = None
         try:
             for update in self.engine.stream_job_progress(job_id):
                 send_chunk(update)
         except (BrokenPipeError, ConnectionResetError):
             return  # client detached — job keeps running
         except Exception:  # noqa: BLE001 — headers already sent: a second
-            # response would corrupt the chunked body; terminate cleanly
-            # and let the client treat the early end-of-stream as done.
-            pass
+            # response would corrupt the chunked body; record the error
+            # in the terminal frame instead.
+            logger.warning(
+                "progress stream for %s aborted", job_id, exc_info=True
+            )
+            status = "error"
+        # explicit terminal record: clients can tell a finished stream
+        # from a dropped connection (old clients ignore the extra line)
         try:
+            if status is None:
+                try:
+                    status = self.engine.job_status(job_id)
+                except Exception:  # graftlint: disable=silent-except
+                    # terminal frame is best-effort; the stream ended
+                    status = "unknown"
+            send_chunk({"t": "end", "status": status})
             self.wfile.write(b"0\r\n\r\n")
         except (BrokenPipeError, ConnectionResetError):
             pass
+
+    # -- interactive tier (/v1/* — serving/openai.py shapes) -----------
+
+    def _openai_error(
+        self, status: int, message: str, etype: str = "invalid_request_error"
+    ) -> None:
+        self._json(
+            {"error": {"message": message, "type": etype, "code": status}},
+            status=status,
+        )
+
+    def _serve_openai(self, *, chat: bool) -> None:
+        gw = getattr(self.engine, "gateway", None)
+        if gw is None:
+            # interactive tier off: identical 404 surface to a server
+            # built before this tier existed
+            self._error(
+                404,
+                "interactive serving is disabled "
+                "(set EngineConfig.interactive_slots > 0)",
+            )
+            return
+        from .serving import openai as oai
+        from .serving.gateway import GatewayRejected
+
+        try:
+            body = self._read_json()
+        except json.JSONDecodeError as e:
+            self._openai_error(400, f"invalid JSON body: {e}")
+            return
+        try:
+            sreq = oai.parse_request(body, chat=chat)
+        except oai.BadServingRequest as e:
+            self._openai_error(400, str(e))
+            return
+        try:
+            ir = gw.submit(sreq)
+        except GatewayRejected as e:
+            self._openai_error(
+                e.status,
+                str(e),
+                "invalid_request_error"
+                if e.status in (400, 404)
+                else "service_unavailable"
+                if e.status == 503
+                else "server_error",
+            )
+            return
+        except Exception as e:  # noqa: BLE001 — request isolation
+            logger.warning("interactive submit failed", exc_info=True)
+            self._openai_error(500, f"{type(e).__name__}: {e}", "server_error")
+            return
+        if sreq.stream:
+            self._stream_openai(ir, chat)
+        else:
+            self._collect_openai(ir, chat)
+
+    def _collect_openai(self, ir: Any, chat: bool) -> None:
+        from .serving import openai as oai
+
+        try:
+            self._json(oai.collect(ir, chat=chat))
+        except RuntimeError as e:
+            self._openai_error(500, str(e), "server_error")
+
+    def _stream_openai(self, ir: Any, chat: bool) -> None:
+        """SSE token stream over manual chunked framing (same transfer
+        mechanics as ``_stream_progress``). Heartbeat pings double as
+        disconnect probes: a dead socket raises on the write, which
+        cancels the request — the scheduler then frees its slot and KV
+        pages on the next loop iteration."""
+        from .engine import faults
+        from .serving import openai as oai
+
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def send(data: bytes) -> None:
+            self.wfile.write(
+                f"{len(data):X}\r\n".encode() + data + b"\r\n"
+            )
+            self.wfile.flush()
+
+        try:
+            for obj in oai.iter_stream(ir, chat=chat):
+                if faults.ACTIVE is not None:
+                    faults.inject("serving.stream", job=ir.id)
+                send(oai.sse_frame(obj))
+        except (BrokenPipeError, ConnectionResetError):
+            # client disconnect mid-stream: per-request cancellation
+            ir.channel.cancel()
+            return
+        except Exception:  # noqa: BLE001 — injected stream fault or a
+            # channel error: tear this request down; the co-resident
+            # batch session never sees it
+            logger.warning(
+                "interactive stream %s aborted", ir.id, exc_info=True
+            )
+            ir.channel.cancel()
+        try:
+            send(oai.SSE_DONE)
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            ir.channel.cancel()
 
     def _functions_run(self) -> None:
         """Synchronous single-input serving call (reference sdk.py:512-588
@@ -342,6 +469,62 @@ def start_server_thread(
     return server, thread, f"http://{host}:{server.server_address[1]}"
 
 
+def _graceful_shutdown(
+    engine: LocalEngine, server: ThreadingHTTPServer, grace: float
+) -> None:
+    """Drain the interactive tier, then stop the HTTP loop. New
+    interactive submits are refused (503) immediately; in-flight streams
+    get up to ``grace`` seconds to finish naturally (their handlers send
+    the final SSE ``[DONE]``); stragglers are hard-cancelled so the
+    scheduler frees their slots before the server stops. Idempotent —
+    ``server.shutdown()`` is a no-op once the serve loop has exited."""
+    gw = getattr(engine, "gateway", None)
+    if gw is not None:
+        gw.begin_drain()
+        if not gw.wait_idle(grace):
+            logger.warning(
+                "graceful drain timed out after %.1fs; cancelling %d "
+                "interactive request(s)", grace, gw.active_count(),
+            )
+            gw.cancel_all()
+            gw.wait_idle(2.0)
+    server.shutdown()
+
+
+def install_graceful_sigterm(
+    engine: LocalEngine, server: ThreadingHTTPServer, grace: float
+) -> bool:
+    """SIGTERM → background drain + server stop, CHAINING any handler
+    already installed (softdeadline's budget handler raises
+    SystemExit(124); the dp host installs its own drain) instead of
+    clobbering it. Returns False outside the main thread, where signal
+    handlers cannot be installed."""
+    import signal
+
+    prev = signal.getsignal(signal.SIGTERM)
+    started = threading.Event()
+
+    def _handler(signum: int, frame: Any) -> None:
+        if not started.is_set():
+            started.set()
+            threading.Thread(
+                target=_graceful_shutdown,
+                args=(engine, server, grace),
+                daemon=True,
+                name="sutro-serve-drain",
+            ).start()
+        if callable(prev):
+            # chained handler may raise (SystemExit) — serve() catches
+            # it and finishes the drain synchronously before exiting
+            prev(signum, frame)
+
+    try:
+        signal.signal(signal.SIGTERM, _handler)
+    except ValueError:
+        return False  # not the main thread (embedded/test use)
+    return True
+
+
 def serve(
     host: str = "127.0.0.1",
     port: int = DEFAULT_PORT,
@@ -353,10 +536,19 @@ def serve(
 
     engine = get_engine(ecfg)
     server = make_server(engine, host, port, verbose=verbose)
+    # drain budget mirrors the dp stall policy, capped for interactive
+    # use (a 10-minute SIGTERM drain would outlive most supervisors)
+    grace = min(float(engine.ecfg.dp_stall_timeout or 30.0), 30.0)
+    install_graceful_sigterm(engine, server, grace)
     print(f"sutro-tpu engine daemon listening on http://{host}:{port}")
     print("point clients at it with: sutro set-base-url "
           f"http://{host}:{port} && sutro set-backend remote")
     try:
         server.serve_forever()
     except KeyboardInterrupt:
-        server.shutdown()
+        _graceful_shutdown(engine, server, grace)
+    except SystemExit:
+        # chained softdeadline handler: finish the drain (bounded), keep
+        # the exit code contract (124) intact
+        _graceful_shutdown(engine, server, grace)
+        raise

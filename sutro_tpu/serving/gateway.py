@@ -1,0 +1,417 @@
+"""InteractiveGateway: admission + lifecycle for the online tier.
+
+The batch tier's unit of work is a JOB (durable record, jobstore
+results, resumable). The interactive tier's unit is a REQUEST: one
+prompt, one in-memory :class:`~.channel.StreamChannel`, no jobstore
+row. Both meet in the scheduler — an interactive request is a 1-row
+``JobCtx`` at priority ``-1`` (strictly ahead of every batch priority,
+which is non-negative), so ``(priority, seq)`` admission pulls it into
+the live continuous-batch window ahead of waiting batch rows, and the
+``interactive_slots`` budget lets it preempt a running batch row via
+the pause/resume primitive when the batch is full
+(scheduler._evict_for_interactive).
+
+Lifecycle::
+
+    submit(sreq)          HTTP/SDK thread: resolve model, tokenize,
+                          build GenRequest+JobCtx, park on the per-model
+                          pending deque, kick the engine worker
+    take_pending(key)     scheduler session (engine worker thread)
+                          adopts the ctx into its live window
+    on_token -> channel   every accepted token (single commit point)
+    finish(ctx, outcome)  terminal: close the channel, observe TTFT/ITL,
+                          count the outcome, notify drain waiters
+
+The gateway is constructed only when ``EngineConfig.interactive_slots``
+> 0 — at 0 the serving endpoints 404 and none of this code runs, so the
+batch path stays bit-identical to an engine built before this tier.
+"""
+
+from __future__ import annotations
+
+import codecs
+import dataclasses
+import itertools
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Deque, List, Optional
+
+import numpy as np
+
+from .. import telemetry
+from ..engine import faults
+from ..engine.scheduler import GenRequest, JobCtx
+from .channel import StreamChannel
+from .openai import ServingRequest
+
+logger = logging.getLogger(__name__)
+
+#: a request whose first token took longer than this (or that ended
+#: tokenless) counts as starved — doctor verdict ``interactive_starved``
+STARVED_TTFT_S = 5.0
+
+
+class GatewayRejected(Exception):
+    """Admission refused: carries the HTTP status the server maps it to."""
+
+    def __init__(self, status: int, msg: str):
+        super().__init__(msg)
+        self.status = status
+
+
+@dataclasses.dataclass
+class InteractiveRequest:
+    id: str
+    sreq: ServingRequest
+    channel: StreamChannel
+    ctx: JobCtx
+    engine_key: str
+    model: str
+    created_unix: int
+    prompt_tokens: int
+    _tok: Any = None
+
+    def decoder(self) -> Callable[[Optional[int]], str]:
+        """Incremental token->text decoder for this request's stream.
+        Prefers the tokenizer's byte view (``token_bytes``) through an
+        incremental UTF-8 decoder, which holds incomplete multi-byte
+        sequences until they complete (call with ``None`` to flush);
+        falls back to full re-decode with an emitted-length offset."""
+        tok = self._tok
+        tb = getattr(tok, "token_bytes", None)
+        if tb is not None:
+            try:
+                tb(0)
+            except Exception:  # graftlint: disable=silent-except
+                tb = None  # base-class stub probe
+        if tb is not None:
+            dec = codecs.getincrementaldecoder("utf-8")("replace")
+
+            def decode(tok_id: Optional[int]) -> str:
+                if tok_id is None:
+                    return dec.decode(b"", True)
+                return dec.decode(tb(int(tok_id)))
+
+            return decode
+
+        ids: List[int] = []
+        emitted = [0]
+
+        def decode_slow(tok_id: Optional[int]) -> str:
+            if tok_id is None:
+                return ""
+            ids.append(int(tok_id))
+            full = tok.decode(ids)
+            out = full[emitted[0]:]
+            emitted[0] = len(full)
+            return out
+
+        return decode_slow
+
+
+class InteractiveGateway:
+    def __init__(self, eng: Any):
+        self.eng = eng
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self._pending: Dict[str, Deque[InteractiveRequest]] = {}
+        self._active: Dict[str, InteractiveRequest] = {}
+        # engine keys with a serve-sentinel queued but not yet popped
+        # (dedup: one wake per key, not one per request)
+        self._kicked: set = set()
+        self._counter = itertools.count(1)
+        self.draining = False
+
+    # -- admission (HTTP handler / SDK thread) -------------------------
+
+    def submit(self, sreq: ServingRequest) -> InteractiveRequest:
+        rid = f"ivr-{next(self._counter)}"
+        if faults.ACTIVE is not None:
+            try:
+                faults.inject("serving.admit", job=rid)
+            except Exception as e:  # noqa: BLE001 — any injected kind
+                # maps to an admission refusal, never a crashed handler
+                self._count_outcome("rejected")
+                raise GatewayRejected(
+                    503, f"admission fault injected: {e}"
+                ) from e
+        with self._lock:
+            if self.draining:
+                self._count_outcome("rejected")
+                raise GatewayRejected(
+                    503, "server is draining (shutdown in progress)"
+                )
+        from ..engine.api import resolve_model
+
+        try:
+            engine_key, mcfg, meta = resolve_model(sreq.model)
+        except ValueError as e:
+            self._count_outcome("rejected")
+            raise GatewayRejected(404, str(e)) from e
+        if meta.get("embedding") or mcfg.head == "embedding":
+            self._count_outcome("rejected")
+            raise GatewayRejected(
+                400, f"model {sreq.model!r} is an embedding model"
+            )
+        tok = self.eng._get_tokenizer(engine_key, mcfg)
+
+        if sreq.kind == "chat":
+            from ..engine.tokenizer import encode_chat_batch
+
+            ids = encode_chat_batch(
+                tok, [sreq.prompt], sreq.system_prompt, mcfg.chat_template
+            )[0]
+        else:
+            # /v1/completions is raw continuation: no chat scaffold
+            ids = tok.encode(sreq.prompt)
+
+        ecfg = self.eng.ecfg
+        max_new = int(sreq.max_tokens or ecfg.max_new_tokens)
+        constraint_factory = None
+        if sreq.output_schema:
+            from ..engine.constrain import schema_constraint_factory
+            from ..engine.constrain.fsm import constraint_room
+
+            try:
+                constraint_factory = schema_constraint_factory(
+                    sreq.output_schema, tok
+                )
+                # same feasibility raise the batch submit path applies:
+                # the schema's shortest accepting output bounds the cap
+                room = constraint_room(constraint_factory())
+                if max_new < room:
+                    max_new = room
+            except Exception as e:  # noqa: BLE001 — schema errors are
+                # client errors here (no job record to fail later)
+                self._count_outcome("rejected")
+                raise GatewayRejected(
+                    400, f"response_format schema rejected: {e}"
+                ) from e
+
+        channel = StreamChannel()
+        stop_ids = set(
+            tok.stop_ids()
+            if hasattr(tok, "stop_ids")
+            else [tok.eos_id]
+        )
+
+        n_gen = [0]  # raw sampled count, stop tokens included — the
+        # scheduler strips stop ids from token_ids, so an immediate-EOS
+        # row would otherwise bill completion_tokens=0
+
+        def on_token(row_id: int, tok_id: int, logp: float) -> None:
+            n_gen[0] += 1
+            # stop tokens are stripped from the final token_ids by the
+            # scheduler's release path; skipping them here keeps the
+            # streamed text equal to the final rendered text
+            if tok_id in stop_ids:
+                return
+            channel.put_token(row_id, tok_id, logp)
+
+        stop_strs = [s for s in (sreq.stop or []) if s]
+
+        def on_result(res: Any) -> None:
+            if res.finish_reason.startswith("error"):
+                channel.fail(res.error or res.finish_reason)
+                return
+            text: Optional[str] = None
+            try:
+                text = tok.decode(res.token_ids)
+                if stop_strs:
+                    cut = min(
+                        (p for p in (text.find(s) for s in stop_strs)
+                         if p >= 0),
+                        default=-1,
+                    )
+                    if cut >= 0:
+                        text = text[:cut]
+            except Exception:  # noqa: BLE001 — streamed deltas already
+                # delivered the content; the terminal record degrades
+                logger.warning(
+                    "render failed for %s", rid, exc_info=True
+                )
+            channel.finish(
+                {
+                    "status": (
+                        "cancelled"
+                        if res.finish_reason == "cancelled"
+                        else "ok"
+                    ),
+                    "finish_reason": res.finish_reason,
+                    "text": text,
+                    "gen_tokens": max(len(res.token_ids), n_gen[0]),
+                    "input_tokens": res.input_tokens,
+                    "cumulative_logprob": float(res.cumulative_logprob),
+                }
+            )
+
+        req = GenRequest(
+            row_id=0,
+            prompt_ids=np.array(ids, np.int32),
+            max_new_tokens=max_new,
+            temperature=float(
+                sreq.temperature
+                if sreq.temperature is not None
+                else ecfg.temperature
+            ),
+            top_p=float(
+                sreq.top_p if sreq.top_p is not None else ecfg.top_p
+            ),
+            top_k=int(
+                sreq.top_k if sreq.top_k is not None else ecfg.top_k
+            ),
+            constraint_factory=constraint_factory,
+            # an over-long interactive prompt errors (surfaced on the
+            # stream) rather than silently truncating the user's turn
+            allow_truncate=False,
+            row_seed=sreq.seed,
+            stop_seqs=[s.encode() for s in stop_strs] or None,
+        )
+        with self._lock:
+            ctx = JobCtx(
+                job_id=rid,
+                pending=[req],
+                on_result=on_result,
+                should_cancel=lambda: channel.cancelled,
+                priority=-1,  # strictly ahead of all batch priorities
+                seq=next(self._counter),
+                row_retries=0,  # a failed interactive request fails
+                #               fast; the client retries, not the engine
+                on_token=on_token,
+                interactive=True,
+            )
+            ir = InteractiveRequest(
+                id=rid,
+                sreq=sreq,
+                channel=channel,
+                ctx=ctx,
+                engine_key=engine_key,
+                model=sreq.model,
+                created_unix=int(time.time()),
+                prompt_tokens=len(ids),
+                _tok=tok,
+            )
+            self._pending.setdefault(engine_key, deque()).append(ir)
+            self._active[rid] = ir
+            if telemetry.ENABLED:
+                telemetry.INTERACTIVE_ACTIVE.set(float(len(self._active)))
+            kick = engine_key not in self._kicked
+            if kick:
+                self._kicked.add(engine_key)
+        if kick:
+            # wake an idle engine worker (or queue behind the running
+            # session, which also polls take_pending directly)
+            self.eng._enqueue_serving(engine_key)
+        return ir
+
+    # -- scheduler side (engine worker thread) -------------------------
+
+    def sentinel_popped(self, engine_key: str) -> None:
+        with self._lock:
+            self._kicked.discard(engine_key)
+
+    def take_pending(self, engine_key: str) -> Optional[JobCtx]:
+        with self._lock:
+            q = self._pending.get(engine_key)
+            if not q:
+                return None
+            return q.popleft().ctx
+
+    def has_pending(self, engine_key: Optional[str] = None) -> bool:
+        with self._lock:
+            if engine_key is not None:
+                return bool(self._pending.get(engine_key))
+            return any(self._pending.values())
+
+    def pending_keys(self) -> List[str]:
+        with self._lock:
+            return [k for k, q in self._pending.items() if q]
+
+    def finish(self, ctx: JobCtx, outcome: str) -> Dict[str, Any]:
+        """Terminal transition for one request (engine worker thread).
+        Returns the latency stats the session stamps into co-resident
+        batch jobs' telemetry attrs (doctor evidence)."""
+        with self._lock:
+            ir = self._active.pop(ctx.job_id, None)
+            # drop from pending too if it never got adopted (drain/error
+            # before a session picked it up)
+            if ir is not None:
+                q = self._pending.get(ir.engine_key)
+                if q:
+                    try:
+                        q.remove(ir)
+                    except ValueError:
+                        pass
+            if telemetry.ENABLED:
+                telemetry.INTERACTIVE_ACTIVE.set(float(len(self._active)))
+            self._idle.notify_all()
+        if ir is None:
+            return {}
+        ch = ir.channel
+        if not ch.closed:
+            # outcomes that never produced a terminal result record
+            if outcome == "cancelled" or ch.cancelled:
+                ch.finish({"status": "cancelled",
+                           "finish_reason": "cancelled", "text": None,
+                           "gen_tokens": ch.n_tokens,
+                           "input_tokens": ir.prompt_tokens,
+                           "cumulative_logprob": 0.0})
+            else:
+                ch.fail(f"request ended without result ({outcome})")
+        ttft = ch.ttft_s()
+        starved = (ttft is None) or (ttft > STARVED_TTFT_S)
+        final = (
+            "cancelled" if (outcome == "cancelled" or ch.cancelled)
+            else "error" if (outcome == "error" or ch.error is not None)
+            else "ok"
+        )
+        if telemetry.ENABLED:
+            self._count_outcome(final)
+            if ttft is not None:
+                telemetry.TTFT_SECONDS.observe(ttft)
+            for itl in ch.itl_samples:
+                telemetry.ITL_SECONDS.observe(itl)
+            elapsed = max(time.monotonic() - ch.created, 1e-6)
+            telemetry.ROWS_PER_SECOND.set(1.0 / elapsed, "interactive")
+        return {
+            "outcome": final,
+            "ttft_s": ttft,
+            "starved": bool(starved and final != "cancelled"),
+            "tokens": ch.n_tokens,
+            "preempted_rows": ctx.stats.get("preempted", 0),
+        }
+
+    # -- drain (SIGTERM path) ------------------------------------------
+
+    def begin_drain(self) -> None:
+        with self._lock:
+            self.draining = True
+
+    def wait_idle(self, timeout: float) -> bool:
+        """Block until no request is active (or timeout). Used by the
+        graceful-shutdown drain: new submits are already refused."""
+        deadline = time.monotonic() + timeout
+        with self._idle:
+            while self._active:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._idle.wait(min(left, 0.5))
+            return True
+
+    def cancel_all(self) -> None:
+        """Hard-cancel every live request (drain timeout expired)."""
+        with self._lock:
+            irs = list(self._active.values())
+        for ir in irs:
+            ir.channel.cancel()
+
+    def active_count(self) -> int:
+        with self._lock:
+            return len(self._active)
+
+    def _count_outcome(self, outcome: str) -> None:
+        if telemetry.ENABLED:
+            telemetry.INTERACTIVE_REQUESTS_TOTAL.inc(1.0, outcome)

@@ -170,9 +170,37 @@ TOKENS_PER_SECOND = REGISTRY.gauge(
 ROWS_PER_SECOND = REGISTRY.gauge(
     "sutro_rows_per_second",
     "Most recent row completion rate by workload "
-    "(generate, embed, dp — dp is the coordinator's pod-merged rate)",
+    "(generate, embed, dp, interactive — dp is the coordinator's "
+    "pod-merged rate; interactive is the serving tier's request rate)",
     labels=("workload",),
     unit="rows/s",
+)
+# -- interactive serving tier (serving/gateway.py, OBSERVABILITY.md) ----
+TTFT_SECONDS = REGISTRY.histogram(
+    "sutro_interactive_ttft_seconds",
+    "Interactive request time-to-first-token (admission wait + prefill "
+    "+ first decode), measured from gateway submit",
+    unit="seconds",
+)
+ITL_SECONDS = REGISTRY.histogram(
+    "sutro_interactive_itl_seconds",
+    "Interactive inter-token latency (gap between consecutive streamed "
+    "tokens of one request)",
+    unit="seconds",
+)
+INTERACTIVE_REQUESTS_TOTAL = REGISTRY.counter(
+    "sutro_interactive_requests_total",
+    "Interactive serving requests by terminal outcome",
+    labels=("outcome",),  # ok | cancelled | error | rejected
+)
+INTERACTIVE_ACTIVE = REGISTRY.gauge(
+    "sutro_interactive_active",
+    "Interactive requests currently admitted or streaming",
+)
+INTERACTIVE_PREEMPTIONS_TOTAL = REGISTRY.counter(
+    "sutro_interactive_preemptions_total",
+    "Batch rows suspended to admit an interactive request inside the "
+    "interactive_slots budget (the row re-admits row-granularly)",
 )
 TOKENS_PER_SECOND_PER_CHIP = REGISTRY.gauge(
     "sutro_tokens_per_second_per_chip",

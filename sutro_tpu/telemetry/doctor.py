@@ -62,12 +62,17 @@ ENVELOPE_STAGES = ("dp_round",)
 #: the verdict taxonomy, in priority order (OBSERVABILITY.md "Doctor")
 VERDICTS = (
     "insufficient_data",
+    "interactive_starved",
     "straggler_worker",
     "io_bound",
     "host_bound_admit",
     "decode_below_roofline",
     "healthy",
 )
+
+#: gateway TTFT threshold mirrored here for the evidence line
+#: (serving/gateway.py STARVED_TTFT_S stamps attrs["interactive"])
+INTERACTIVE_STARVED_TTFT_S = 5.0
 
 #: a decode window under this fraction of the HBM roofline is "below"
 ROOFLINE_OK_PCT = 40.0
@@ -280,6 +285,33 @@ def diagnose(
         evidence.append(
             "no spans recorded for this job (telemetry disabled, or "
             "the flight recorder evicted its window)"
+        )
+
+    # interactive starvation: the serving gateway stamps per-request
+    # latency aggregates onto co-resident batch jobs' attrs — starved
+    # requests mean the latency tier is losing to this batch traffic
+    ia = attrs.get("interactive") or {}
+    if verdict is None and ia.get("starved"):
+        verdict = "interactive_starved"
+        evidence.append(
+            f"{ia['starved']} of {ia.get('requests', ia['starved'])} "
+            "interactive request(s) sharing this job's decode window "
+            f"waited over {INTERACTIVE_STARVED_TTFT_S:.0f}s for a "
+            "first token (max TTFT "
+            f"{ia.get('ttft_max_s', 0.0):.1f}s): raise "
+            "EngineConfig.interactive_slots or lower the batch load"
+        )
+    elif ia.get("requests"):
+        evidence.append(
+            f"{ia['requests']} interactive request(s) co-scheduled "
+            f"with this job (max TTFT {ia.get('ttft_max_s', 0.0):.1f}s"
+            + (
+                f"; {ia['preempted_rows']} batch row(s) preempted and "
+                "re-admitted"
+                if ia.get("preempted_rows")
+                else ""
+            )
+            + ")"
         )
 
     # straggler: a rank whose wall dwarfs the median of the others

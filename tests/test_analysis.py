@@ -491,7 +491,7 @@ def test_self_scan_matches_committed_baseline():
     assert not stale, stale
     # pin the accepted-debt count: growing it needs a conscious
     # baseline regeneration in the same commit
-    assert len(active) == sum(baseline.values()) == 19
+    assert len(active) == sum(baseline.values()) == 18
 
 
 def run_cli(args, cwd):

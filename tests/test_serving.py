@@ -1,0 +1,339 @@
+"""Interactive serving tier (sutro_tpu/serving/): OpenAI-compatible
+endpoints, SSE streaming, latency-priority admission beside batch,
+disconnect cancellation, chaos sites, and graceful drain."""
+
+import json
+import threading
+
+import pytest
+
+from sutro_tpu.engine import faults
+from sutro_tpu.engine.api import LocalEngine
+from sutro_tpu.engine.config import EngineConfig
+from sutro_tpu.interfaces import JobStatus
+from sutro_tpu.server import start_server_thread
+
+
+@pytest.fixture(scope="module")
+def iserved(tmp_path_factory, monkeypatch_module):
+    """A live daemon over a tiny CPU engine with the interactive tier on."""
+    home = tmp_path_factory.mktemp("iserve-home")
+    monkeypatch_module.setenv("SUTRO_HOME", str(home))
+    ecfg = EngineConfig(
+        kv_page_size=8, max_pages_per_seq=16, decode_batch_size=4,
+        max_model_len=128, use_pallas=False, param_dtype="float32",
+        activation_dtype="float32", max_new_tokens=8,
+        interactive_slots=2,
+    )
+    engine = LocalEngine(ecfg)
+    assert engine.gateway is not None
+    server, thread, url = start_server_thread(engine)
+    from sutro_tpu.sdk import Sutro
+
+    sdk = Sutro(api_key="test-key", base_url=url, backend="remote")
+    yield sdk, engine, url
+    faults.clear()
+    server.shutdown()
+
+
+def _chat_body(prompt, **kw):
+    body = {
+        "model": "tiny-dense",
+        "messages": [{"role": "user", "content": prompt}],
+        "temperature": 0.0,
+        "max_tokens": 6,
+    }
+    body.update(kw)
+    return body
+
+
+def _sse_objects(resp):
+    """Parse an SSE response into (chunk dicts, saw_done)."""
+    objs, done = [], False
+    for line in resp.iter_lines():
+        if not line or line.startswith(b":"):
+            continue
+        assert line.startswith(b"data: "), line
+        data = line[len(b"data: "):]
+        if data == b"[DONE]":
+            done = True
+            break
+        objs.append(json.loads(data))
+    return objs, done
+
+
+def _delta_text(objs):
+    return "".join(
+        c["choices"][0]["delta"].get("content", "") for c in objs
+    )
+
+
+def test_chat_completion_shape(iserved):
+    sdk, _, _ = iserved
+    resp = sdk.do_request(
+        "post", "v1/chat/completions", json=_chat_body("hello"), timeout=120
+    )
+    assert resp.status_code == 200
+    out = resp.json()
+    assert out["object"] == "chat.completion"
+    assert out["model"] == "tiny-dense"
+    choice = out["choices"][0]
+    assert choice["index"] == 0
+    assert choice["message"]["role"] == "assistant"
+    assert isinstance(choice["message"]["content"], str)
+    assert choice["finish_reason"] in ("stop", "length")
+    usage = out["usage"]
+    assert usage["prompt_tokens"] > 0 and usage["completion_tokens"] > 0
+    assert usage["total_tokens"] == (
+        usage["prompt_tokens"] + usage["completion_tokens"]
+    )
+
+
+def test_completions_endpoint_shape(iserved):
+    sdk, _, _ = iserved
+    resp = sdk.do_request(
+        "post", "v1/completions",
+        json={"model": "tiny-dense", "prompt": "once upon",
+              "temperature": 0.0, "max_tokens": 4},
+        timeout=120,
+    )
+    assert resp.status_code == 200
+    out = resp.json()
+    assert out["object"] == "text_completion"
+    assert isinstance(out["choices"][0]["text"], str)
+    assert out["usage"]["completion_tokens"] > 0
+
+
+def test_bad_request_shapes(iserved):
+    sdk, _, _ = iserved
+    r = sdk.do_request("post", "v1/chat/completions",
+                       json={"model": "tiny-dense", "messages": []})
+    assert r.status_code == 400
+    assert r.json()["error"]["type"] == "invalid_request_error"
+    r = sdk.do_request("post", "v1/chat/completions",
+                       json=_chat_body("x", model="no-such-model"))
+    assert r.status_code == 404
+
+
+def test_sse_stream_matches_nonstream(iserved):
+    sdk, _, _ = iserved
+    resp = sdk.do_request(
+        "post", "v1/chat/completions",
+        json=_chat_body("stream me", stream=True), stream=True, timeout=120,
+    )
+    assert resp.status_code == 200
+    assert resp.headers["Content-Type"].startswith("text/event-stream")
+    objs, done = _sse_objects(resp)
+    assert done, "stream must end with data: [DONE]"
+    assert all(o["object"] == "chat.completion.chunk" for o in objs)
+    # first content chunk announces the assistant role
+    first = next(o for o in objs if o["choices"][0]["delta"])
+    assert first["choices"][0]["delta"].get("role") == "assistant"
+    finals = [o for o in objs if o["choices"][0]["finish_reason"]]
+    assert len(finals) == 1
+    streamed = _delta_text(objs)
+    assert streamed
+    # deterministic (temperature=0): non-stream text is bit-identical
+    out = sdk.do_request(
+        "post", "v1/chat/completions", json=_chat_body("stream me"),
+        timeout=120,
+    ).json()
+    assert out["choices"][0]["message"]["content"] == streamed
+
+
+def test_constrained_stream_matches_batch_path(iserved):
+    """response_format rides the same constrained-decode path as batch:
+    greedy streaming output is bit-identical to a batch job of the same
+    prompt + schema."""
+    sdk, _, _ = iserved
+    schema = {
+        "type": "object",
+        "properties": {"a": {"type": "integer"}},
+        "required": ["a"],
+    }
+    body = _chat_body(
+        "give a number", stream=True, max_tokens=24,
+        response_format={
+            "type": "json_schema",
+            "json_schema": {"name": "out", "schema": schema},
+        },
+    )
+    resp = sdk.do_request(
+        "post", "v1/chat/completions", json=body, stream=True, timeout=300,
+    )
+    assert resp.status_code == 200
+    objs, done = _sse_objects(resp)
+    assert done
+    streamed = _delta_text(objs)
+    obj = json.loads(streamed)  # schema guarantee holds on the stream
+    assert isinstance(obj["a"], int)
+    jid = sdk.infer(
+        ["give a number"], model="tiny-dense", output_schema=schema,
+        sampling_params={"temperature": 0.0, "max_new_tokens": 24},
+        stay_attached=False,
+    )
+    df = sdk.await_job_completion(jid, timeout=300)
+    assert df["inference_result"][0] == streamed
+
+
+def test_disconnect_cancels_and_frees_slots(iserved):
+    sdk, engine, _ = iserved
+    gw = engine.gateway
+    from sutro_tpu.serving.openai import parse_request
+
+    ir = gw.submit(parse_request(_chat_body("bye", stream=True), chat=True))
+    # wait for the first token (the request holds a slot), then drop the
+    # client: the should_cancel poll must tear the row down and free it
+    for ev in ir.channel.events():
+        if ev is not None and ev[0] == "token":
+            break
+        if ev is not None and ev[0] != "token":
+            break
+    ir.channel.cancel()
+    deadline = threading.Event()
+    for _ in range(100):
+        if gw.active_count() == 0:
+            break
+        deadline.wait(0.2)
+    assert gw.active_count() == 0
+    # the tier still serves: slots were freed, not leaked
+    out = sdk.do_request(
+        "post", "v1/chat/completions", json=_chat_body("after"), timeout=120,
+    )
+    assert out.status_code == 200
+
+
+def test_batch_job_coexists_with_interactive(iserved):
+    """8 interactive requests stream while a batch job runs: the batch
+    job SUCCEEDs with zero lost rows and every request completes."""
+    sdk, _, _ = iserved
+    jid = sdk.infer(
+        [f"row {i}" for i in range(8)], model="tiny-dense",
+        stay_attached=False,
+    )
+    results = [None] * 8
+    def hit(i):
+        r = sdk.do_request(
+            "post", "v1/chat/completions",
+            json=_chat_body(f"q{i}"), timeout=300,
+        )
+        results[i] = (r.status_code, r.json())
+    threads = [threading.Thread(target=hit, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert all(r is not None and r[0] == 200 for r in results)
+    assert all(
+        r[1]["usage"]["completion_tokens"] > 0 for r in results
+    )
+    df = sdk.await_job_completion(jid, timeout=600)
+    assert sdk.get_job_status(jid) == JobStatus.SUCCEEDED.value
+    assert df is not None and len(df) == 8  # zero lost rows
+
+
+def test_chaos_admit_rejects_503(iserved):
+    sdk, _, _ = iserved
+    faults.configure("serving.admit:error:times=1")
+    try:
+        r = sdk.do_request(
+            "post", "v1/chat/completions", json=_chat_body("x"), timeout=120,
+        )
+        assert r.status_code == 503
+        assert r.json()["error"]["type"] == "service_unavailable"
+    finally:
+        faults.clear()
+    r = sdk.do_request(
+        "post", "v1/chat/completions", json=_chat_body("x"), timeout=120,
+    )
+    assert r.status_code == 200
+
+
+def test_chaos_midstream_drop_cancels_without_stalling_batch(iserved):
+    sdk, engine, _ = iserved
+    jid = sdk.infer(
+        [f"b{i}" for i in range(4)], model="tiny-dense", stay_attached=False,
+    )
+    faults.configure("serving.stream:error:nth=1,times=1")
+    try:
+        resp = sdk.do_request(
+            "post", "v1/chat/completions",
+            json=_chat_body("doomed", stream=True), stream=True, timeout=120,
+        )
+        objs, done = _sse_objects(resp)
+        assert done  # the injected drop still closes the stream cleanly
+        assert objs == []  # dropped before the first frame reached us
+    finally:
+        faults.clear()
+    gw = engine.gateway
+    for _ in range(100):
+        if gw.active_count() == 0:
+            break
+        threading.Event().wait(0.2)
+    assert gw.active_count() == 0  # KV pages / slot freed
+    df = sdk.await_job_completion(jid, timeout=600)
+    assert len(df) == 4  # co-resident batch job unaffected
+
+
+def test_stream_progress_end_record(iserved):
+    """_stream_progress NDJSON now carries a terminal {"t":"end"} record
+    and the SDK tolerates it (old consumers ignored unknown keys)."""
+    sdk, _, _ = iserved
+    jid = sdk.infer(["p"], model="tiny-dense", stay_attached=False)
+    sdk.await_job_completion(jid, timeout=300, obtain_results=False)
+    resp = sdk.do_request("get", f"stream-job-progress/{jid}", stream=True)
+    lines = [json.loads(l) for l in resp.iter_lines() if l]
+    assert lines, "progress stream must emit at least the end record"
+    assert lines[-1]["t"] == "end"
+    assert lines[-1]["status"] == JobStatus.SUCCEEDED.value
+    # the SDK's iterator stops at the end record instead of choking
+    updates = list(sdk._iter_progress(jid))
+    assert all(u.get("t") != "end" for u in updates)
+
+
+def test_sdk_chat_local_backend(iserved):
+    _, engine, _ = iserved
+    from sutro_tpu.sdk import Sutro
+
+    local = Sutro(api_key="k", backend="tpu")
+    local._engine = engine
+    chunks = list(
+        local.chat("hi there", model="tiny-dense", stream=True,
+                   temperature=0.0, max_tokens=4)
+    )
+    assert chunks and chunks[-1]["choices"][0]["finish_reason"]
+    out = local.chat("hi there", model="tiny-dense",
+                     temperature=0.0, max_tokens=4)
+    assert out["choices"][0]["message"]["content"] == "".join(
+        c["choices"][0]["delta"].get("content", "") for c in chunks
+    )
+
+
+def test_interactive_disabled_404_and_batch_unaffected(iserved):
+    sdk, engine, _ = iserved
+    saved, engine.gateway = engine.gateway, None
+    try:
+        r = sdk.do_request(
+            "post", "v1/chat/completions", json=_chat_body("x"))
+        assert r.status_code == 404
+        jid = sdk.infer(["plain"], model="tiny-dense", stay_attached=False)
+        df = sdk.await_job_completion(jid, timeout=300)
+        assert len(df) == 1
+    finally:
+        engine.gateway = saved
+
+
+def test_graceful_drain(iserved):
+    sdk, engine, _ = iserved
+    gw = engine.gateway
+    gw.begin_drain()
+    try:
+        r = sdk.do_request(
+            "post", "v1/chat/completions", json=_chat_body("x"))
+        assert r.status_code == 503
+        assert gw.wait_idle(10.0)
+    finally:
+        gw.draining = False
+    r = sdk.do_request(
+        "post", "v1/chat/completions", json=_chat_body("x"), timeout=120)
+    assert r.status_code == 200
