@@ -15,6 +15,12 @@ make chaos
 # tier-1 gate: telemetry — exporter golden file, flight-recorder
 # reconciliation, and the telemetry-on/off host-overhead budget
 make telemetry-check
+# tier-1 gate: live monitor — SLO hysteresis/debounce, streaming doctor
+# verdicts, tenant attribution, and the monitor tick-cost budget
+# (zero sampling work with telemetry off, asserted in code)
+make monitor-check
+# warn-only: bench-artifact trend report (never fails the build)
+make bench-trend
 # tier-1 gate: interactive tier CPU smoke — TTFT/ITL legs + the
 # co-resident-batch throughput retention grade (tests/test_serving.py
 # rides the chunked suite below)

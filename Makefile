@@ -5,9 +5,9 @@
 PY ?= python
 
 .PHONY: all native test test-oneshot test-fast compile-check lint lint-baseline \
-	chaos telemetry-check \
-	bench bench-e2e serve-bench dryrun chip-validate bench-8b cost golden \
-	host-profile clean
+	chaos telemetry-check monitor-check \
+	bench bench-e2e serve-bench bench-trend dryrun chip-validate bench-8b \
+	cost golden host-profile clean
 
 all: native compile-check
 
@@ -75,6 +75,15 @@ telemetry-check:
 		-p no:cacheprovider
 	JAX_PLATFORMS=cpu $(PY) benchmarks/profile_host_overhead.py --telemetry
 
+# live-monitor gate (OBSERVABILITY.md "Live monitor"): SLO rule
+# hysteresis/debounce, windowed percentiles, streaming doctor verdicts,
+# tenant attribution + the monitor tick-cost leg (budget asserted in
+# code; zero sampling work with telemetry off). Tier-1 CI.
+monitor-check:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_monitor.py \
+		-q -m "not slow" -p no:cacheprovider
+	JAX_PLATFORMS=cpu $(PY) benchmarks/profile_host_overhead.py --monitor
+
 # raw decode microbench (one JSON line; driver contract)
 bench:
 	$(PY) bench.py
@@ -88,6 +97,13 @@ bench-e2e:
 # the same entry point without SUTRO_E2E_CPU
 serve-bench:
 	SUTRO_E2E_CPU=1 JAX_PLATFORMS=cpu $(PY) bench_interactive.py
+
+# warn-only trend report over the accumulated bench artifacts
+# (BENCH_r*.json, BENCH_E2E.json, BENCH_INTERACTIVE.json)
+# -> BENCH_TREND.md; >15% regressions in graded metrics print WARN
+# lines but never fail the build
+bench-trend:
+	$(PY) benchmarks/bench_trend.py
 
 # multi-chip sharding dry run on 8 virtual CPU devices
 dryrun:

@@ -1,0 +1,252 @@
+"""Bench-artifact trend report: BENCH_TREND.md (+ machine snapshot).
+
+Reads every bench artifact the repo accumulates —
+
+- ``BENCH_r*.json``   driver rounds: ``{n, cmd, rc, tail, parsed:
+  {metric, value, unit, vs_baseline}}`` (a round whose ``unit`` is
+  ``error`` or whose ``rc`` is non-zero carries no number);
+- ``BENCH_E2E.json``  full-engine workloads: ``rows_per_hour``,
+  ``tok_s_per_chip``, ``usd_per_1m_tokens`` per workload;
+- ``BENCH_INTERACTIVE.json`` latency legs: TTFT/ITL p50/p99 idle vs
+  co-batched, plus the retention grades
+
+— and writes ``BENCH_TREND.md``: the round-by-round series, the
+current graded metrics, and **warnings** (never a failing exit — bench
+numbers on shared CI boxes are too noisy to gate; the report is for a
+human or the next session to read) whenever a graded metric moved
+>``TREND_TOLERANCE`` in the bad direction:
+
+- between the two most recent *valid* driver rounds, and
+- between the current artifacts and the previous run's snapshot
+  (``BENCH_TREND.json``, rewritten on every run so the comparison is
+  always against the last time someone ran ``make bench-trend``).
+
+Direction matters: throughput-like metrics (rows/hour, tok/s,
+retention) warn on drops; latency- and cost-like metrics (ttft/itl
+seconds, $/1M tokens, ratio-vs-idle) warn on rises.
+
+Usage: ``make bench-trend`` (or ``python benchmarks/bench_trend.py``).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+TREND_TOLERANCE = 0.15  # >15% move in the bad direction -> warning
+
+# graded metrics: (json-path, higher_is_better)
+E2E_METRICS = (
+    ("rows_per_hour", True),
+    ("tok_s_per_chip", True),
+    ("usd_per_1m_tokens", False),
+)
+INTERACTIVE_METRICS = (
+    (("legs", "idle", "ttft_p99_s"), False),
+    (("legs", "idle", "itl_p99_s"), False),
+    (("legs", "cobatch", "ttft_p99_s"), False),
+    (("legs", "cobatch", "itl_p99_s"), False),
+    (("legs", "cobatch", "batch", "rows_per_hour"), True),
+    (("legs", "grades", "ttft_p99_ratio_vs_idle"), False),
+    (("legs", "grades", "batch_throughput_retention"), True),
+)
+
+
+def _load(path: Path):
+    try:
+        return json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+
+
+def _dig(doc, path):
+    cur = doc
+    for key in path:
+        if not isinstance(cur, dict) or key not in cur:
+            return None
+        cur = cur[key]
+    return cur if isinstance(cur, (int, float)) else None
+
+
+def _moved_badly(prev: float, cur: float, higher_better: bool) -> bool:
+    """True when cur regressed vs prev by more than the tolerance."""
+    if prev is None or cur is None or prev == 0:
+        return False
+    delta = (cur - prev) / abs(prev)
+    return (delta < -TREND_TOLERANCE) if higher_better else (
+        delta > TREND_TOLERANCE
+    )
+
+
+def _pct(prev: float, cur: float) -> str:
+    if not prev:
+        return "n/a"
+    return f"{(cur - prev) / abs(prev) * 100.0:+.1f}%"
+
+
+def collect_rounds() -> list:
+    rounds = []
+    for p in sorted(glob.glob(str(REPO / "BENCH_r*.json"))):
+        doc = _load(Path(p))
+        if not isinstance(doc, dict):
+            continue
+        parsed = doc.get("parsed") or {}
+        valid = (
+            doc.get("rc") == 0
+            and parsed.get("unit") not in (None, "error")
+            and isinstance(parsed.get("value"), (int, float))
+        )
+        rounds.append({
+            "file": os.path.basename(p),
+            "n": doc.get("n"),
+            "rc": doc.get("rc"),
+            "valid": valid,
+            "metric": parsed.get("metric"),
+            "value": parsed.get("value") if valid else None,
+            "unit": parsed.get("unit"),
+            "vs_baseline": parsed.get("vs_baseline"),
+        })
+    rounds.sort(key=lambda r: (r["n"] is None, r["n"]))
+    return rounds
+
+
+def build_snapshot() -> dict:
+    """Flat {metric-name: value} map of everything graded, for the
+    next run's cross-run comparison."""
+    snap: dict = {}
+    e2e = _load(REPO / "BENCH_E2E.json")
+    if isinstance(e2e, dict):
+        for wl, rec in (e2e.get("workloads") or {}).items():
+            if not isinstance(rec, dict):
+                continue
+            for key, _hb in E2E_METRICS:
+                v = rec.get(key)
+                if isinstance(v, (int, float)):
+                    snap[f"e2e.{wl}.{key}"] = v
+    inter = _load(REPO / "BENCH_INTERACTIVE.json")
+    if isinstance(inter, dict):
+        for path, _hb in INTERACTIVE_METRICS:
+            v = _dig(inter, path)
+            if v is not None:
+                snap["interactive." + ".".join(path)] = v
+    return snap
+
+
+def _direction(name: str) -> bool:
+    """higher_is_better for a snapshot key."""
+    for key, hb in E2E_METRICS:
+        if name.endswith("." + key):
+            return hb
+    for path, hb in INTERACTIVE_METRICS:
+        if name == "interactive." + ".".join(path):
+            return hb
+    return True
+
+
+def main() -> int:
+    rounds = collect_rounds()
+    snap = build_snapshot()
+    prev_doc = _load(REPO / "BENCH_TREND.json") or {}
+    prev_snap = prev_doc.get("snapshot") or {}
+    warnings: list = []
+
+    # round-over-round: the two most recent valid driver rounds
+    valid_rounds = [r for r in rounds if r["valid"]]
+    if len(valid_rounds) >= 2:
+        a, b = valid_rounds[-2], valid_rounds[-1]
+        if _moved_badly(a["value"], b["value"], True):
+            warnings.append(
+                f"driver round r{b['n']:02d} {b['metric']} = "
+                f"{b['value']:.1f} {b['unit']} "
+                f"({_pct(a['value'], b['value'])} vs r{a['n']:02d})"
+            )
+
+    # cross-run: current artifacts vs last snapshot
+    for name, cur in sorted(snap.items()):
+        prev = prev_snap.get(name)
+        if prev is None:
+            continue
+        if _moved_badly(prev, cur, _direction(name)):
+            warnings.append(
+                f"{name}: {prev:.4g} -> {cur:.4g} ({_pct(prev, cur)})"
+            )
+
+    lines = ["# Bench trend", ""]
+    lines.append(
+        f"Warn-only report (`make bench-trend`); tolerance "
+        f"{TREND_TOLERANCE:.0%} in the bad direction. "
+        "Compared against the previous run's `BENCH_TREND.json` "
+        "snapshot and the prior driver round."
+    )
+    lines.append("")
+    if warnings:
+        lines.append(f"## Warnings ({len(warnings)})")
+        lines.append("")
+        for w in warnings:
+            lines.append(f"- ⚠ {w}")
+    else:
+        lines.append("## Warnings (0)")
+        lines.append("")
+        lines.append("- none — no graded metric moved "
+                     f">{TREND_TOLERANCE:.0%} in the bad direction")
+    lines.append("")
+
+    lines.append("## Driver rounds (BENCH_r*.json)")
+    lines.append("")
+    lines.append("| round | status | metric | value | unit | vs baseline |")
+    lines.append("|---|---|---|---|---|---|")
+    for r in rounds:
+        status = "ok" if r["valid"] else f"error (rc={r['rc']})"
+        value = f"{r['value']:.1f}" if r["valid"] else "—"
+        metric = (r["metric"] or "—")
+        if len(metric) > 48:
+            metric = metric[:45] + "..."
+        lines.append(
+            f"| r{r['n']:02d} | {status} | {metric} | {value} | "
+            f"{r['unit'] or '—'} | {r['vs_baseline'] if r['valid'] else '—'} |"
+        )
+    if not rounds:
+        lines.append("| — | no rounds found | | | | |")
+    lines.append("")
+
+    lines.append("## Current graded metrics")
+    lines.append("")
+    lines.append("| metric | value | prev | delta | direction |")
+    lines.append("|---|---|---|---|---|")
+    for name, cur in sorted(snap.items()):
+        prev = prev_snap.get(name)
+        hb = _direction(name)
+        delta = _pct(prev, cur) if prev is not None else "—"
+        prev_s = f"{prev:.4g}" if prev is not None else "—"
+        lines.append(
+            f"| {name} | {cur:.4g} | {prev_s} | {delta} | "
+            f"{'↑ better' if hb else '↓ better'} |"
+        )
+    if not snap:
+        lines.append("| — | no artifacts found | | | |")
+    lines.append("")
+
+    (REPO / "BENCH_TREND.md").write_text("\n".join(lines) + "\n")
+    (REPO / "BENCH_TREND.json").write_text(json.dumps({
+        "tolerance": TREND_TOLERANCE,
+        "snapshot": snap,
+        "warnings": warnings,
+    }, indent=2) + "\n")
+
+    for w in warnings:
+        print(f"WARN: {w}", file=sys.stderr)
+    print(json.dumps({
+        "rounds": len(rounds),
+        "graded_metrics": len(snap),
+        "warnings": len(warnings),
+        "report": "BENCH_TREND.md",
+    }))
+    return 0  # warn, never fail: bench noise must not block CI
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -617,6 +617,7 @@ def run_telemetry_compare(assert_budget: bool) -> dict:
       off-leg. A counted OFF-leg must fire ZERO ops — "disabled means
       no telemetry work" is asserted, not assumed.
     """
+    import os
     import tempfile
     import time as _time
 
@@ -640,6 +641,9 @@ def run_telemetry_compare(assert_budget: bool) -> dict:
         max_new_tokens=32,
     )
     tmp = tempfile.mkdtemp(prefix="sutro-tel-profile-")
+    # the live monitor is priced by its own leg (run_monitor_compare);
+    # its sampler thread must not race the op census here
+    os.environ["SUTRO_MONITOR"] = "0"
     eng = _e2e_engine(tmp, ecfg)
     warm_admit_buckets(MODEL_CONFIGS["tiny-dense"].vocab_size, ecfg)
     _run_e2e_leg(eng, api_mod, 128, {}, max_new=32)  # warm leg
@@ -843,6 +847,136 @@ def run_telemetry_compare(assert_budget: bool) -> dict:
     return out
 
 
+def run_monitor_compare(assert_budget: bool) -> dict:
+    """Live-monitor host overhead + zero-work-when-off checks.
+
+    The monitor is fixed-rate, not per-row work: one ``tick()`` every
+    ``SUTRO_MONITOR_INTERVAL`` seconds regardless of throughput, off
+    the hot path on its own thread. The accounting:
+
+    - one warm + one measured e2e leg loads the live registry with a
+      real job's series and spans, and gives the leg wall time;
+    - ``tick()`` is priced directly on that loaded registry (a tick is
+      snapshot + window stats + rules + doctor — none of it funnels
+      through the per-op census entry points, so it is wall-priced,
+      with a doctor pass included via a synthetic RUNNING job);
+    - ticks during the leg = wall_s / interval, so
+      added us/row = tick_us x ticks / rows, asserted against the
+      SAME <=TEL_OVERHEAD_MAX rule as the telemetry census — i.e. the
+      monitor alone must fit the whole 2% envelope (conservative).
+
+    Zero-work checks (asserted, not assumed):
+    - SUTRO_MONITOR=0 → the engine never constructs a monitor;
+    - telemetry disabled → a RUNNING monitor thread ticks zero times,
+      accumulates nothing, and fires zero census ops.
+    """
+    import os
+    import tempfile
+    import time as _time
+
+    import sutro_tpu.engine.api as api_mod
+    import sutro_tpu.telemetry as tel
+    import sutro_tpu.telemetry.distributed as tel_distributed
+    import sutro_tpu.telemetry.registry as tel_registry
+    import sutro_tpu.telemetry.spans as tel_spans
+    from sutro_tpu.engine.config import EngineConfig
+    from sutro_tpu.models.configs import MODEL_CONFIGS
+    from sutro_tpu.telemetry import monitor as tmon
+
+    ecfg = EngineConfig(
+        kv_page_size=16,
+        max_pages_per_seq=32,
+        decode_batch_size=64,
+        max_model_len=512,
+        use_pallas=False,
+        param_dtype="float32",
+        decode_multi_step=16,
+        decode_lookahead=2,
+        max_new_tokens=32,
+    )
+    tmp = tempfile.mkdtemp(prefix="sutro-mon-profile-")
+    os.environ["SUTRO_MONITOR"] = "0"
+    eng = _e2e_engine(tmp, ecfg)
+    assert eng.monitor is None, (
+        "SUTRO_MONITOR=0 engine still constructed a monitor"
+    )
+    warm_admit_buckets(MODEL_CONFIGS["tiny-dense"].vocab_size, ecfg)
+    was_enabled = tel.enabled()
+    mods = {
+        "registry": tel_registry,
+        "spans": tel_spans,
+        "distributed": tel_distributed,
+    }
+    counts = {key: 0 for _, _, _, key in _TEL_OPS}
+    try:
+        tel.set_enabled(True)
+        _run_e2e_leg(eng, api_mod, 128, {}, max_new=32)  # warm leg
+        leg = _run_e2e_leg(eng, api_mod, 512, {}, max_new=32)
+
+        # -- price one tick on the now-loaded live registry ------------
+        # jobs_provider lists one synthetic RUNNING job so the tick
+        # includes a doctor pass (span-window walk + diagnose) — the
+        # dominant cost while a job is actually in flight
+        mon = tmon.Monitor(
+            jobs_provider=lambda: [("bench-monitor", "RUNNING")]
+        )
+        mon.tick()  # first tick has no window yet; warm it
+        mon.tick()
+        tick_us = _unit_us(mon.tick, n=40, reps=3)
+
+        interval_s = mon.interval_s
+        leg_wall_s = leg["us_per_row"] * 512.0 / 1e6
+        ticks_per_leg = max(1.0, leg_wall_s / interval_s)
+        added_us_per_row = tick_us * ticks_per_leg / 512.0
+        base_us = leg["us_per_row"]
+        ratio = (base_us + added_us_per_row) / base_us
+
+        # -- zero-work check: telemetry off, monitor thread running ----
+        tel.set_enabled(False)
+        with _Census(mods, counts):
+            off_mon = tmon.Monitor(interval_s=0.01)
+            off_mon.start()
+            _time.sleep(0.3)
+            off_mon.stop()
+            off_counts = dict(counts)
+        off_ops = sum(off_counts.values())
+        off_ticks = off_mon.snapshot_doc()["ticks"]
+    finally:
+        tel.set_enabled(was_enabled)
+        eng.close()
+
+    out = {
+        "tick_us": round(tick_us, 1),
+        "interval_s": interval_s,
+        "leg_us_per_row": base_us,
+        "leg_wall_s": round(leg_wall_s, 2),
+        "ticks_per_leg": round(ticks_per_leg, 2),
+        "added_us_per_row": round(added_us_per_row, 3),
+        "overhead_ratio": round(ratio, 4),
+        "budget_ratio": TEL_OVERHEAD_MAX,
+        "disabled_ticks": off_ticks,
+        "disabled_ops_fired": off_ops,
+        "ok": bool(
+            ratio <= TEL_OVERHEAD_MAX and off_ops == 0 and off_ticks == 0
+        ),
+    }
+    if assert_budget:
+        assert off_ticks == 0, (
+            f"telemetry-off monitor still ticked {off_ticks} times — "
+            "disabled must mean no sampling work"
+        )
+        assert off_ops == 0, (
+            f"telemetry-off monitor fired census ops: {off_counts}"
+        )
+        assert ratio <= TEL_OVERHEAD_MAX, (
+            f"monitor adds {added_us_per_row:.2f} us/row "
+            f"({tick_us:.0f} us/tick x {ticks_per_leg:.1f} ticks) on a "
+            f"{base_us} us/row leg (ratio {ratio:.4f} > "
+            f"{TEL_OVERHEAD_MAX})"
+        )
+    return out
+
+
 def main() -> None:
     import jax
 
@@ -865,6 +999,24 @@ def main() -> None:
         base["telemetry"] = tel
         path.write_text(json.dumps(base, indent=2) + "\n")
         print(json.dumps({"telemetry_overhead": tel}))
+        return
+
+    if "--monitor" in sys.argv:
+        # standalone gate (make monitor-check): live-monitor tick cost
+        # + zero-work-when-off; merge into HOST_OVERHEAD.json
+        mon = run_monitor_compare(
+            assert_budget="--no-assert" not in sys.argv
+        )
+        path = REPO / "HOST_OVERHEAD.json"
+        base = {}
+        if path.exists():
+            try:
+                base = json.loads(path.read_text())
+            except ValueError:
+                base = {}
+        base["monitor"] = mon
+        path.write_text(json.dumps(base, indent=2) + "\n")
+        print(json.dumps({"monitor_overhead": mon}))
         return
 
     from sutro_tpu.engine.config import EngineConfig

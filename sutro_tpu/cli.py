@@ -17,6 +17,7 @@ from __future__ import annotations
 import datetime
 import json
 import sys
+import time
 from typing import Optional
 
 import click
@@ -233,7 +234,13 @@ def doctor(job_id: str, as_json: bool) -> None:
         click.echo(json.dumps(diag, indent=2))
         return
     click.echo(to_colored_text(f"job {diag.get('job_id')}", "callout"))
-    partial = " (partial data)" if diag.get("partial") else ""
+    partial = (
+        " (in flight — partial data)"
+        if diag.get("in_flight")
+        else " (partial data)"
+        if diag.get("partial")
+        else ""
+    )
     click.echo(f"verdict: {diag.get('verdict')}{partial}")
     for line in diag.get("evidence") or []:
         click.echo(f"  - {line}")
@@ -259,6 +266,114 @@ def doctor(job_id: str, as_json: bool) -> None:
         click.echo(
             tabulate(rows, headers="keys", tablefmt="rounded_outline")
         )
+
+
+@cli.command()
+@click.option("--interval", default=2.0, show_default=True,
+              help="Seconds between dashboard refreshes")
+@click.option("--once", is_flag=True,
+              help="Render one frame and exit (no screen clearing)")
+@click.option("--json", "as_json", is_flag=True,
+              help="Raw /monitor document instead of the dashboard")
+def watch(interval: float, once: bool, as_json: bool) -> None:
+    """Live SLO dashboard over the engine's monitor (OBSERVABILITY.md
+    "Live monitor"): windowed rates and latency percentiles, per-tenant
+    attribution, active alerts, and in-flight doctor verdicts.
+    Refreshes until interrupted; requires telemetry and the monitor to
+    be enabled (SUTRO_TELEMETRY / SUTRO_MONITOR)."""
+    sdk = get_sdk()
+    while True:
+        try:
+            doc = sdk.get_monitor()
+        except KeyError as e:
+            click.echo(to_colored_text(f"✗ {e}", "fail"))
+            raise SystemExit(1)
+        except Exception as e:  # noqa: BLE001 — remote 404/conn errors
+            click.echo(to_colored_text(f"✗ monitor unavailable: {e}",
+                                       "fail"))
+            raise SystemExit(1)
+        if as_json:
+            click.echo(json.dumps(doc, indent=2))
+        else:
+            if not once:
+                click.clear()
+            _render_watch_frame(doc)
+        if once or as_json:
+            return
+        try:
+            time.sleep(max(interval, 0.1))
+        except KeyboardInterrupt:
+            return
+
+
+def _render_watch_frame(doc: dict) -> None:
+    stats = doc.get("stats") or {}
+    rates = stats.get("rates") or {}
+    gauges = stats.get("gauges") or {}
+    pcts = stats.get("percentiles") or {}
+    click.echo(to_colored_text(
+        f"sutro watch — tick {doc.get('ticks')} · window "
+        f"{stats.get('window_s', 0)}s · interval {doc.get('interval_s')}s"
+        + (" · DEGRADED: " + str(doc["degraded"])
+           if doc.get("degraded") else ""),
+        "callout",
+    ))
+    row = {
+        "rows/s": rates.get("rows_per_s", 0.0),
+        "tok/s": rates.get("tokens_per_s", 0.0),
+        "quarantine/s": rates.get("quarantined_per_s", 0.0),
+        "jobs": gauges.get("jobs_running", 0),
+        "interactive": gauges.get("interactive_active", 0),
+        "dp fleet": gauges.get("dp_fleet_size", ""),
+    }
+    ttft, itl = pcts.get("ttft"), pcts.get("itl")
+    if ttft:
+        row["ttft p50/p99 (s)"] = (
+            f"{ttft['p50_s']:.3g}/{ttft.get('p99_s') or 0:.3g}"
+        )
+    if itl:
+        row["itl p50/p99 (s)"] = (
+            f"{itl['p50_s']:.3g}/{itl.get('p99_s') or 0:.3g}"
+        )
+    click.echo(tabulate([row], headers="keys",
+                        tablefmt="rounded_outline"))
+    alerts = doc.get("alerts") or {}
+    active = alerts.get("active") or []
+    if active:
+        click.echo(to_colored_text(
+            f"⚠ {len(active)} alert(s) FIRING", "fail"))
+        for a in active:
+            click.echo(
+                f"  {a['name']} [{a['severity']}] {a['metric']} "
+                f"{a['op']} {a['threshold']} (value={a.get('value')})"
+            )
+    else:
+        click.echo(to_colored_text("no alerts firing", "success"))
+    events = (alerts.get("events") or [])[-5:]
+    if events:
+        click.echo("recent transitions:")
+        for ev in events:
+            click.echo(
+                f"  {ev['state']:>8}  {ev['rule']} "
+                f"(value={ev.get('value')})"
+            )
+    verdicts = doc.get("verdicts") or {}
+    if verdicts:
+        click.echo("live doctor:")
+        for jid, v in sorted(verdicts.items()):
+            click.echo(
+                f"  {jid}: {v.get('verdict')} "
+                f"({v.get('spans', 0)} span(s) in window)"
+            )
+    tenants = stats.get("tenants") or {}
+    if tenants:
+        trows = [
+            {"tenant": t, **{k: int(v) for k, v in sorted(d.items())}}
+            for t, d in sorted(tenants.items())
+        ]
+        click.echo("tenants:")
+        click.echo(tabulate(trows, headers="keys",
+                            tablefmt="rounded_outline"))
 
 
 @cli.command()

@@ -31,6 +31,7 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 from .. import telemetry
+from ..telemetry import monitor as tmonitor
 from ..common import MODEL_CATALOG
 from ..interfaces import JobStatus
 from ..models.configs import MODEL_CONFIGS, ModelConfig
@@ -140,6 +141,16 @@ class LocalEngine:
             from ..serving.gateway import InteractiveGateway
 
             self.gateway = InteractiveGateway(self)
+        # Live SLO monitor (telemetry/monitor.py): per-engine sampler —
+        # NOT a package singleton, so parallel test engines don't share
+        # alert state. Constructed only when telemetry AND the monitor
+        # switch are on; with either off, zero threads and zero work.
+        self.monitor = None
+        if telemetry.ENABLED and tmonitor.monitor_enabled():
+            self.monitor = tmonitor.Monitor(
+                jobs_provider=self._monitor_jobs,
+                alert_dump=self._monitor_alert_dump,
+            ).start()
         self._worker = threading.Thread(
             target=self._worker_loop, daemon=True, name="sutro-engine"
         )
@@ -201,6 +212,7 @@ class LocalEngine:
                     "surfacing when the job runs",
                     exc_info=True,
                 )
+        tenant = str(payload.get("tenant") or "default").strip() or "default"
         rec = self.jobs.create(
             name=payload.get("name"),
             description=payload.get("description"),
@@ -216,7 +228,15 @@ class LocalEngine:
             random_seed_per_input=bool(
                 payload.get("random_seed_per_input", False)
             ),
+            tenant=tenant,
         )
+        if telemetry.ENABLED:
+            # tenant attribution starts at submit: the identity rides
+            # the job's telemetry attrs (flight-recorder dumps carry
+            # it) and the capped tenant series (registry collapses an
+            # abusive id space into "_overflow")
+            telemetry.job(rec.job_id).attrs["tenant"] = tenant
+            telemetry.TENANT_REQUESTS_TOTAL.inc(1.0, tenant, "batch")
         self.jobs.write_inputs(rec.job_id, inputs)
 
         # Quota gate (reference /get-quotas semantics). Token honesty
@@ -611,6 +631,46 @@ class LocalEngine:
             num_rows=rec.num_rows,
         )
 
+    # -- live monitor (telemetry/monitor.py) ---------------------------
+
+    def _monitor_jobs(self) -> List[Tuple[str, str]]:
+        """RUNNING jobs for the monitor's continuous doctor: the
+        worker's current job plus every co-batched attached job
+        (serve-wake sentinels excluded — the interactive tier is
+        monitored through its own histograms, not job records)."""
+        with self._lock:
+            ids = set(self._attached)
+            if self._current_job is not None:
+                ids.add(self._current_job)
+        return [
+            (jid, JobStatus.RUNNING.value)
+            for jid in sorted(ids)
+            if not jid.startswith("serve:")
+        ]
+
+    def _monitor_alert_dump(
+        self, job_id: str, alert: Dict[str, Any]
+    ) -> None:
+        """A firing alert persists the flight recorder next to the job
+        — the same ``telemetry.json`` artifact FAILED leaves, written
+        while the incident is live. Covered by the alert-dump leg of
+        the ``telemetry.monitor`` fault site."""
+        if faults.ACTIVE is not None:
+            faults.inject("telemetry.monitor", job=job_id)
+        telemetry.dump_job(self.jobs._dir(job_id), job_id)
+
+    def monitor_doc(self) -> Dict[str, Any]:
+        """The ``GET /monitor`` document (history + active alerts +
+        live doctor verdicts). KeyError when the monitor is disabled
+        (telemetry off or SUTRO_MONITOR=0) — the daemon maps it to 404,
+        same contract as the serving tier's endpoints."""
+        if self.monitor is None:
+            raise KeyError(
+                "live monitor disabled (SUTRO_TELEMETRY=0 or "
+                "SUTRO_MONITOR=0)"
+            )
+        return self.monitor.snapshot_doc()
+
     def job_fleet(self, job_id: str) -> Dict[str, Any]:
         """Elastic dp fleet view: the coordinator's live membership
         snapshot while this process serves the job's round (per-rank
@@ -743,6 +803,8 @@ class LocalEngine:
         Returns True when the worker actually exited. A closed engine
         no longer runs queued jobs (their records stay resumable by a
         fresh engine process)."""
+        if self.monitor is not None:
+            self.monitor.stop()
         self._queue.put(_WORKER_STOP)
         self._worker.join(timeout=timeout)
         return not self._worker.is_alive()

@@ -37,6 +37,11 @@ serving.stream         interactive SSE write loop (server.py), per sent
                        frame: a raising kind mid-stream cancels the
                        request — its slot and KV pages free on the next
                        scheduler iteration, batch jobs unaffected
+telemetry.monitor      live SLO monitor (telemetry/monitor.py): fires at
+                       the top of every sampler tick AND inside the
+                       alert flight-recorder dump (engine/api.py); any
+                       raising kind degrades the monitor to disabled —
+                       a broken monitor never fails a job
 ====================== ====================================================
 
 Kinds: ``error`` (RuntimeError), ``oom`` (RESOURCE_EXHAUSTED-shaped

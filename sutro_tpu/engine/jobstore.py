@@ -135,6 +135,10 @@ class JobRecord:
     truncate_rows: bool = True
     dry_run: bool = False
     random_seed_per_input: bool = False
+    # tenant attribution (telemetry/monitor.py): submit-time identity
+    # every series and terminal accounting row is keyed by; "default"
+    # when the caller names none
+    tenant: Optional[str] = None
     # per-job latency profile (engine/profiling.py StepTimer.summary())
     perf: Optional[Dict[str, Any]] = None
 
@@ -240,13 +244,38 @@ class JobStore:
             fields.setdefault("datetime_started", _now())
         if status.is_terminal():
             fields.setdefault("datetime_completed", _now())
-        self.update(job_id, **fields)
+        rec = self.update(job_id, **fields)
         if telemetry.ENABLED and status in (
             JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.CANCELLED
         ):
             # terminal TRANSITIONS (a resumed-then-failed job counts
             # twice — each is a real lifecycle event)
             telemetry.JOBS_TOTAL.inc(1.0, status.value.lower())
+            # tenant attribution settles at the same funnel: rows from
+            # the job's exact counters, tokens from the record's
+            # accounting — every terminal path (generate, embed, dp,
+            # resume) passes through here exactly once per transition
+            tenant = str(rec.tenant or "default")
+            jc = telemetry.JOBS.peek(job_id)
+            if jc is not None:
+                d = jc.to_dict()
+                if d.get("rows_ok"):
+                    telemetry.TENANT_ROWS_TOTAL.inc(
+                        float(d["rows_ok"]), tenant, "ok"
+                    )
+                if d.get("rows_quarantined"):
+                    telemetry.TENANT_ROWS_TOTAL.inc(
+                        float(d["rows_quarantined"]), tenant,
+                        "quarantined",
+                    )
+            if rec.input_tokens:
+                telemetry.TENANT_TOKENS_TOTAL.inc(
+                    float(rec.input_tokens), tenant, "in"
+                )
+            if rec.output_tokens:
+                telemetry.TENANT_TOKENS_TOTAL.inc(
+                    float(rec.output_tokens), tenant, "out"
+                )
         if telemetry.ENABLED and status == JobStatus.CANCELLED:
             # CANCELLED dumps the flight recorder like FAILED does
             # (engine/api.py handles FAILED at its failure boundaries):

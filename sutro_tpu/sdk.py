@@ -165,6 +165,7 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
         truncate_rows: bool,
         random_seed_per_input: bool,
         sampling_params: Optional[Dict[str, Any]],
+        tenant: Optional[str] = None,
     ) -> Any:
         if name and len(name) > MAX_NAME_LENGTH:
             raise ValueError(
@@ -188,6 +189,7 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
             "truncate_rows": truncate_rows,
             "random_seed_per_input": random_seed_per_input,
             "sampling_params": sampling_params,
+            "tenant": tenant,
         }
 
         if self.backend == "remote":
@@ -424,13 +426,16 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
         truncate_rows: bool = True,
         random_seed_per_input: bool = False,
         sampling_params: Optional[Dict[str, Any]] = None,
+        tenant: Optional[str] = None,
     ) -> Any:
         """Submit a batch-inference job. Returns the job id (or the cost
         estimate for ``dry_run=True``).
 
         Default model matches the reference (``gpt-oss-20b``, sdk.py:445);
         ``stay_attached`` defaults to ``job_priority == 0``
-        (sdk.py:486-488)."""
+        (sdk.py:486-488). ``tenant`` attributes the job's rows/tokens to
+        a named tenant in the live monitor (OBSERVABILITY.md "Live
+        monitor"); unset means tenant ``"default"``."""
         if stay_attached is None:
             stay_attached = job_priority == 0
         schema = normalize_output_schema(output_schema)
@@ -460,6 +465,7 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
             truncate_rows=truncate_rows,
             random_seed_per_input=random_seed_per_input,
             sampling_params=sampling_params,
+            tenant=tenant,
         )
 
     def infer_per_model(
@@ -676,6 +682,19 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
                 "fleet"
             ]
         return self.engine.job_fleet(job_id)
+
+    def get_monitor(self) -> Dict[str, Any]:
+        """The live SLO monitor's consolidated document
+        (OBSERVABILITY.md "Live monitor"): windowed rates and
+        p50/p99 percentiles, per-tenant attribution, SLO rule states,
+        the active/recent alert events, the in-flight doctor verdicts
+        for running jobs, and the tick history trail. Both backends
+        (the remote daemon serves it as ``GET /monitor``); raises
+        ``KeyError`` locally / 404 remotely when the monitor is
+        disabled (``SUTRO_TELEMETRY=0`` or ``SUTRO_MONITOR=0``)."""
+        if self.backend == "remote":
+            return self._remote_json("get", "monitor")["monitor"]
+        return self.engine.monitor_doc()
 
     def get_metrics_text(self) -> str:
         """Engine metrics registry in Prometheus text exposition format

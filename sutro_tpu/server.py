@@ -28,7 +28,10 @@ format for scraping (dp coordinators include worker-labelled federated
 series); GET /job-telemetry/{id} serves a job's flight-recorder
 document (span timeline + exact per-job counters + per-worker dp
 sections); GET /job-doctor/{id} serves the bottleneck doctor's
-diagnosis of that document.
+diagnosis of that document; GET /monitor serves the live SLO monitor's
+consolidated document (windowed rates/percentiles, alert state, in-
+flight doctor verdicts) and GET /monitor/stream tails it as NDJSON,
+one record per sampler tick (404 when the monitor is disabled).
 """
 
 from __future__ import annotations
@@ -159,6 +162,12 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
                 self._json({"doctor": eng.diagnose_job(rest)})
             elif head == "job-fleet" and rest:
                 self._json({"fleet": eng.job_fleet(rest)})
+            elif head == "monitor" and rest == "stream":
+                self._stream_monitor()
+            elif head == "monitor" and rest is None:
+                # monitor disabled -> KeyError -> the 404 arm below,
+                # same surface as the serving tier when it's off
+                self._json({"monitor": eng.monitor_doc()})
             elif head == "healthz":
                 self._json({"ok": True})
             else:
@@ -284,6 +293,50 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
                     # terminal frame is best-effort; the stream ended
                     status = "unknown"
             send_chunk({"t": "end", "status": status})
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def _stream_monitor(self) -> None:
+        """NDJSON live-monitor stream (chunked): one record per sampler
+        tick (telemetry/monitor.py ``Monitor.stream``), same transfer
+        mechanics as ``_stream_progress``. ``?ticks=N`` bounds the
+        stream (tests / one-shot watchers); unbounded streams end when
+        the monitor stops or the client detaches."""
+        mon = getattr(self.engine, "monitor", None)
+        if mon is None:
+            self._error(
+                404,
+                "live monitor disabled (SUTRO_TELEMETRY=0 or "
+                "SUTRO_MONITOR=0)",
+            )
+            return
+        max_ticks: Optional[int] = None
+        q = self.path.partition("?")[2]
+        for kv in q.split("&"):
+            k, _, v = kv.partition("=")
+            if k == "ticks" and v.isdigit():
+                max_ticks = int(v)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def send_chunk(obj: Dict[str, Any]) -> None:
+            line = (json.dumps(obj) + "\n").encode()
+            self.wfile.write(f"{len(line):X}\r\n".encode() + line + b"\r\n")
+            self.wfile.flush()
+
+        try:
+            for rec in mon.stream(max_ticks=max_ticks):
+                send_chunk(rec)
+        except (BrokenPipeError, ConnectionResetError):
+            return  # client detached — the monitor keeps sampling
+        except Exception:  # noqa: BLE001 — headers already sent; end
+            # the chunked body cleanly instead of corrupting it
+            logger.warning("monitor stream aborted", exc_info=True)
+        try:
+            send_chunk({"t": "end", "degraded": mon.failed})
             self.wfile.write(b"0\r\n\r\n")
         except (BrokenPipeError, ConnectionResetError):
             pass

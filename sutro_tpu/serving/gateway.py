@@ -297,6 +297,11 @@ class InteractiveGateway:
             self._active[rid] = ir
             if telemetry.ENABLED:
                 telemetry.INTERACTIVE_ACTIVE.set(float(len(self._active)))
+                # tenant attribution (the OpenAI `user` field) rides
+                # the same capped series as batch submits
+                telemetry.TENANT_REQUESTS_TOTAL.inc(
+                    1.0, sreq.tenant or "default", "interactive"
+                )
             kick = engine_key not in self._kicked
             if kick:
                 self._kicked.add(engine_key)
@@ -375,6 +380,17 @@ class InteractiveGateway:
                 telemetry.ITL_SECONDS.observe(itl)
             elapsed = max(time.monotonic() - ch.created, 1e-6)
             telemetry.ROWS_PER_SECOND.set(1.0 / elapsed, "interactive")
+            if ir.sreq.tenant and (ir.prompt_tokens or ch.n_tokens):
+                # interactive token attribution settles at finish —
+                # batch jobs settle theirs at the jobstore terminal
+                # funnel; anonymous requests don't spend a series
+                telemetry.TENANT_TOKENS_TOTAL.inc(
+                    float(ir.prompt_tokens), ir.sreq.tenant, "in"
+                )
+                if ch.n_tokens:
+                    telemetry.TENANT_TOKENS_TOTAL.inc(
+                        float(ch.n_tokens), ir.sreq.tenant, "out"
+                    )
         return {
             "outcome": final,
             "ttft_s": ttft,

@@ -48,6 +48,9 @@ class ServingRequest:
     stop: Optional[List[str]] = None
     seed: Optional[int] = None
     kind: str = "chat"  # "chat" | "completion"
+    # tenant attribution: the OpenAI `user` field, threaded into the
+    # capped per-tenant telemetry series (telemetry/monitor.py)
+    tenant: Optional[str] = None
 
 
 def _content_text(content: Any) -> str:
@@ -163,6 +166,10 @@ def parse_request(body: Any, *, chat: bool) -> ServingRequest:
         except (TypeError, ValueError):
             raise BadServingRequest(f"{key} must be a number")
 
+    tenant = body.get("user")
+    if tenant is not None and not isinstance(tenant, str):
+        raise BadServingRequest("user must be a string")
+
     return ServingRequest(
         model=model,
         prompt=prompt,
@@ -176,6 +183,7 @@ def parse_request(body: Any, *, chat: bool) -> ServingRequest:
         stop=stop,
         seed=_num("seed", int),
         kind="chat" if chat else "completion",
+        tenant=(tenant.strip() or None) if tenant else None,
     )
 
 

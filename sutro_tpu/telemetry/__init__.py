@@ -48,6 +48,7 @@ __all__ = [
     "RECORDER",
     "JOBS",
     "distributed",
+    "monitor",
     "enabled",
     "set_enabled",
     "stage_observe",
@@ -215,6 +216,38 @@ SPANS_DROPPED = REGISTRY.gauge(
     "sutro_flight_recorder_dropped",
     "Spans evicted from the flight-recorder ring since process start",
 )
+# -- tenant attribution + live monitor (telemetry/monitor.py) -----------
+# Tenant series ride the registry's ordinary cardinality admission: the
+# tenant label value space is capped at TENANT_MAX_SERIES and overflow
+# collapses into the standard ("_overflow", ...) series — an abusive
+# tenant-id generator cannot grow the scrape unboundedly.
+TENANT_MAX_SERIES = int(os.environ.get("SUTRO_TENANT_MAX_SERIES", 32))
+TENANT_REQUESTS_TOTAL = REGISTRY.counter(
+    "sutro_tenant_requests_total",
+    "Submissions by tenant and kind (batch job submits and interactive "
+    "requests)",
+    labels=("tenant", "kind"),  # kind: batch | interactive
+    max_series=TENANT_MAX_SERIES,
+)
+TENANT_ROWS_TOTAL = REGISTRY.counter(
+    "sutro_tenant_rows_total",
+    "Result rows attributed to a tenant at job terminal status",
+    labels=("tenant", "outcome"),  # ok | quarantined
+    max_series=TENANT_MAX_SERIES,
+    unit="rows",
+)
+TENANT_TOKENS_TOTAL = REGISTRY.counter(
+    "sutro_tenant_tokens_total",
+    "Tokens attributed to a tenant at job terminal status",
+    labels=("tenant", "direction"),  # in | out
+    max_series=TENANT_MAX_SERIES,
+    unit="tokens",
+)
+ALERTS_TOTAL = REGISTRY.counter(
+    "sutro_monitor_alerts_total",
+    "SLO alert lifecycle transitions emitted by the live monitor",
+    labels=("rule", "state"),  # state: firing | resolved
+)
 
 # Span names the engine emits — OBSERVABILITY.md's span schema section
 # and tests key off this tuple, so additions land in one place.
@@ -332,6 +365,8 @@ def reset_for_tests() -> None:
     distributed.REMOTE.clear()
 
 
-# imported last: distributed.py resolves the package singletons above
-# lazily at call time, so the bottom import only publishes the name
+# imported last: distributed.py / monitor.py resolve the package
+# singletons above lazily at call time, so the bottom imports only
+# publish the names
 from . import distributed  # noqa: E402
+from . import monitor  # noqa: E402

@@ -20,6 +20,8 @@ tests/test_doctor.py):
   verdict                   meaning
   ========================  ============================================
   ``insufficient_data``     no spans anywhere (telemetry off / evicted)
+  ``warming_up``            in-flight job, no spans landed yet — a
+                            partial-data marker, not a failure
   ``straggler_worker``      one rank's wall >= 1.5x the median of the
                             others — the pod waits on that slice
   ``io_bound``              flush+finalize dominate both compute and
@@ -62,6 +64,7 @@ ENVELOPE_STAGES = ("dp_round",)
 #: the verdict taxonomy, in priority order (OBSERVABILITY.md "Doctor")
 VERDICTS = (
     "insufficient_data",
+    "warming_up",
     "interactive_starved",
     "straggler_worker",
     "io_bound",
@@ -211,15 +214,31 @@ def _grade_roofline(
     return out
 
 
+#: statuses past which a job can no longer gain spans
+_TERMINAL_STATUSES = ("SUCCEEDED", "FAILED", "CANCELLED")
+
+
 def diagnose(
     doc: Dict[str, Any],
     *,
     status: Optional[str] = None,
     num_rows: Optional[int] = None,
+    in_flight: bool = False,
 ) -> Dict[str, Any]:
     """Analyze one merged job telemetry document into a diagnosis with
     a named bottleneck verdict (see module docstring for the taxonomy)
-    and human-readable evidence lines."""
+    and human-readable evidence lines.
+
+    ``in_flight`` marks a diagnosis over a RUNNING job's live span
+    window (the monitor's continuous doctor, or ``sutro doctor`` on a
+    job that hasn't terminated). It is also derived from a non-terminal
+    ``status``. In flight, zero spans are expected early — the verdict
+    is ``warming_up`` (a partial-data marker), never the alarming
+    ``insufficient_data``; with spans present the normal verdict is
+    produced but flagged partial, since attribution covers only what
+    has executed so far."""
+    if status is not None and str(status).upper() not in _TERMINAL_STATUSES:
+        in_flight = True
     job_id = doc.get("job_id")
     counters = doc.get("counters") or {}
     attrs = doc.get("attrs") or {}
@@ -281,10 +300,23 @@ def diagnose(
 
     total_spans = sum(a["spans"] for a in processes.values())
     if total_spans == 0:
-        verdict = "insufficient_data"
+        if in_flight:
+            verdict = "warming_up"
+            evidence.append(
+                "job is still in flight and no spans have landed in "
+                "the live window yet — partial data, retry shortly"
+            )
+        else:
+            verdict = "insufficient_data"
+            evidence.append(
+                "no spans recorded for this job (telemetry disabled, "
+                "or the flight recorder evicted its window)"
+            )
+    elif in_flight:
         evidence.append(
-            "no spans recorded for this job (telemetry disabled, or "
-            "the flight recorder evicted its window)"
+            "live verdict over the flight recorder's current span "
+            "window — the job is still running, so attribution covers "
+            "only work executed so far"
         )
 
     # interactive starvation: the serving gateway stamps per-request
@@ -434,7 +466,8 @@ def diagnose(
         "num_rows": num_rows,
         "verdict": verdict,
         "evidence": evidence,
-        "partial": bool(missing_ranks),
+        "in_flight": in_flight,
+        "partial": bool(missing_ranks) or in_flight,
         "missing_ranks": missing_ranks,
         "world": world,
         "processes": processes,

@@ -538,6 +538,42 @@ def test_worker_socket_drop_mid_stream_fails_round(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# monitor failure domain
+# ---------------------------------------------------------------------------
+
+
+def test_monitor_fault_degrades_to_disabled_job_succeeds(
+    mkengine, monkeypatch
+):
+    """Fault site ``telemetry.monitor``: a sampler tick that raises
+    must degrade the live monitor to disabled — it never takes the
+    job down. The job runs to SUCCEEDED with a consistent store while
+    the monitor thread exits with the failure recorded."""
+    from sutro_tpu import telemetry
+
+    monkeypatch.setenv("SUTRO_MONITOR_INTERVAL", "0.02")
+    monkeypatch.delenv("SUTRO_MONITOR", raising=False)
+    telemetry.set_enabled(True)
+    eng = mkengine(plan="telemetry.monitor:error:times=1")
+    assert eng.monitor is not None
+
+    jid = _submit(eng, n_rows=8, max_new=4)
+    assert _wait_terminal(eng, jid) == JobStatus.SUCCEEDED
+    _assert_no_dup_no_drop(eng, jid, 8)
+
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and eng.monitor.failed is None:
+        time.sleep(0.02)
+    assert eng.monitor.failed is not None, (
+        "injected tick error never degraded the monitor"
+    )
+    assert not eng.monitor.running
+    # the degradation is visible on the published document, not silent
+    doc = eng.monitor_doc()
+    assert doc["degraded"] and not doc["running"]
+
+
+# ---------------------------------------------------------------------------
 # fault plan mechanics
 # ---------------------------------------------------------------------------
 

@@ -381,23 +381,50 @@ def test_elastic_late_joiner_absorbs_requeued_rows():
     admitted with a fresh rank and an empty assignment, then absorbs
     rows the round needs re-run — here, the stride of a worker that
     died right after joining."""
-    faults.configure("dphost.join:crash:times=1")
+    plan = faults.configure("dphost.join:crash:times=1")
     port = _free_port()
     cw, w1 = _worlds(port, 2)
     late = DPWorld(rank=7, world=2, host="127.0.0.1", port=port)
     reqs = _reqs()
-    merge, events, outcomes = _Merge(), _Events(), {}
+    merge, outcomes = _Merge(), {}
+    # Two races to pin down: the crash clause must hit w1 (not `late`),
+    # and `late` must be admitted before the tiny job completes — the
+    # coordinator absorbs a dead worker's requeued rows itself in well
+    # under a second on an idle box, and under CPU load `late` can lose
+    # that race entirely. So: hold `late`'s spawn until the clause has
+    # fired, and hold the coordinator's own rows until the late join is
+    # observed.
+    late_joined = threading.Event()
+    events = _Events()
+
+    def on_evt(ev):
+        events(ev)
+        if ev.get("event") == "dp_worker_joined" and ev.get("late_join"):
+            late_joined.set()
+
     threads = [
         _spawn_worker(w1, _shard_fn(), reqs, outcomes=outcomes),
-        _spawn_worker(late, _shard_fn(), reqs, outcomes=outcomes,
-                      name="late"),
     ]
+
+    def _admit_late():
+        deadline = time.monotonic() + 60
+        while plan.specs[0].fires < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        threads.append(
+            _spawn_worker(late, _shard_fn(), reqs, outcomes=outcomes,
+                          name="late")
+        )
+
+    gate = threading.Thread(target=_admit_late, daemon=True)
+    gate.start()
     outcome = run_dp_coordinator(
-        cw, _shard_fn(), shard_requests(reqs, 0, 2),
-        on_result=merge, on_row_event=events,
+        cw, _shard_fn(per_row=lambda _rid: late_joined.wait(timeout=60)),
+        shard_requests(reqs, 0, 2),
+        on_result=merge, on_row_event=on_evt,
         requests=reqs, job_id="job-late",
     )
-    for t in threads:
+    gate.join(timeout=90)
+    for t in list(threads):
         t.join(timeout=120)
     assert outcome == "completed"
     merge.assert_complete_no_dups()

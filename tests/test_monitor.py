@@ -1,0 +1,511 @@
+"""Live SLO monitor (sutro_tpu/telemetry/monitor.py, OBSERVABILITY.md
+"Live monitor").
+
+Covers the PR's acceptance criteria and test satellites:
+
+1. rule units — hysteresis + debounce state machine: flapping at the
+   threshold produces EXACTLY one fire/resolve pair; a dormant metric
+   holds a firing alert and disarms a pending one;
+2. windowed percentiles — bucket-interpolated p50/p99 agree with a
+   brute-force recompute to within bucket resolution;
+3. tenant attribution — the cardinality cap collapses excess tenant
+   labels into ``_overflow`` instead of growing without bound;
+4. the live acceptance run — a multi-window job is observed MID-JOB
+   via ``GET /monitor``: a doctor verdict with the in-flight marker,
+   one alert firing AND resolving before the job terminates, all while
+   concurrent ``/monitor`` + ``/metrics`` scrapers hammer the server;
+5. disabled semantics — ``SUTRO_TELEMETRY=0`` (or ``SUTRO_MONITOR=0``)
+   means no monitor object, 404s on both endpoints, and a stopped
+   sampler doing zero work (the op-census leg in
+   benchmarks/profile_host_overhead.py --monitor asserts the budget).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from sutro_tpu import telemetry
+from sutro_tpu.engine import faults
+from sutro_tpu.engine.api import LocalEngine
+from sutro_tpu.engine.config import EngineConfig
+from sutro_tpu.interfaces import JobStatus
+from sutro_tpu.telemetry.monitor import (
+    Monitor,
+    SLORule,
+    percentile_from_buckets,
+)
+from sutro_tpu.telemetry.registry import MetricsRegistry
+
+
+# ---------------------------------------------------------------------------
+# rule units: hysteresis + debounce
+# ---------------------------------------------------------------------------
+
+
+def _drive(rule, values):
+    """Feed a value sequence through one rule; returns the
+    (rule, state) transition events in order."""
+    mon = Monitor(rules=[rule])
+    events = []
+    for i, v in enumerate(values):
+        stats = {} if v is None else {rule.metric: v}
+        events.extend(
+            (e["rule"], e["state"])
+            for e in mon._evaluate_rules(stats, float(i))
+        )
+    return events, mon
+
+
+def test_flap_at_threshold_exactly_one_fire_resolve_pair():
+    """The hysteresis band (clear < value <= threshold) holds state:
+    a value flapping between breach and the band fires ONCE, and only
+    a genuine drop past the clear level resolves — no alert churn."""
+    rule = SLORule(
+        "q", metric="quarantine_rate", op=">", threshold=0.05,
+        clear=0.01, for_ticks=2, clear_ticks=2,
+    )
+    events, mon = _drive(
+        rule, [0.10, 0.10, 0.03, 0.10, 0.03, 0.005, 0.005]
+    )
+    assert events == [("q", "firing"), ("q", "resolved")]
+    assert mon._rule_state["q"].state == "ok"
+
+
+def test_debounce_single_breach_never_fires():
+    """One breaching tick (< for_ticks) arms pending only; the next
+    cleared tick disarms it. No events."""
+    rule = SLORule(
+        "q", metric="quarantine_rate", op=">", threshold=0.05,
+        clear=0.01, for_ticks=2, clear_ticks=2,
+    )
+    events, mon = _drive(rule, [0.10, 0.005, 0.10, 0.005])
+    assert events == []
+    assert mon._rule_state["q"].state == "ok"
+
+
+def test_less_than_rule_and_clear_default():
+    """op="<" rules (fleet shrunk, rows stalled) breach below the
+    threshold; clear defaults to the threshold itself."""
+    rule = SLORule(
+        "fleet", metric="dp_fleet_size", op="<", threshold=1.0,
+        for_ticks=1, clear_ticks=1, severity="critical",
+    )
+    events, _ = _drive(rule, [2.0, 0.0, 0.0, 1.0])
+    assert events == [("fleet", "firing"), ("fleet", "resolved")]
+
+
+def test_dormant_metric_holds_firing_and_disarms_pending():
+    """No data is not evidence of recovery: a missing metric (workload
+    not running) must hold a firing alert, but disarm a pending one."""
+    rule = SLORule(
+        "q", metric="quarantine_rate", op=">", threshold=0.05,
+        clear=0.01, for_ticks=2, clear_ticks=2,
+    )
+    # fire, then the metric disappears: alert must stay firing
+    events, mon = _drive(rule, [0.10, 0.10, None, None])
+    assert events == [("q", "firing")]
+    assert mon._rule_state["q"].state == "firing"
+    # pending (one breach), then dormant: disarmed without firing
+    events, mon = _drive(rule, [0.10, None, 0.005])
+    assert events == []
+    assert mon._rule_state["q"].state == "ok"
+
+
+def test_resolve_requires_consecutive_clear_ticks():
+    """clear_ticks debounce on the way down mirrors for_ticks on the
+    way up: clear, re-breach resets the clear streak."""
+    rule = SLORule(
+        "q", metric="quarantine_rate", op=">", threshold=0.05,
+        clear=0.01, for_ticks=1, clear_ticks=2,
+    )
+    events, mon = _drive(
+        rule, [0.10, 0.005, 0.10, 0.005, 0.005]
+    )
+    # second breach while firing does NOT re-fire; the two final
+    # cleared ticks resolve once
+    assert events == [("q", "firing"), ("q", "resolved")]
+
+
+def test_alert_dump_errors_are_swallowed():
+    """A failing flight-recorder dump is best-effort by contract: the
+    monitor logs and keeps sampling (the chaos suite covers the
+    tick-raise degrade path end to end)."""
+    calls = []
+
+    def bad_dump(job_id, ev):
+        calls.append(job_id)
+        raise OSError("disk full")
+
+    mon = Monitor(
+        rules=[],
+        jobs_provider=lambda: [("j1", "RUNNING"), ("j2", "RUNNING")],
+        alert_dump=bad_dump,
+    )
+    mon._dump_for_alert({"rule": "r", "state": "firing"})
+    assert calls == ["j1", "j2"]  # every job attempted despite errors
+    assert mon.failed is None
+
+
+# ---------------------------------------------------------------------------
+# windowed percentiles vs brute force
+# ---------------------------------------------------------------------------
+
+
+def _acc_for(buckets, values):
+    """Build a registry-layout accumulator [b0..bn, +Inf, sum, count]
+    from raw observations."""
+    acc = [0.0] * (len(buckets) + 1) + [0.0, 0.0]
+    for v in values:
+        for i, le in enumerate(buckets):
+            if v <= le:
+                acc[i] += 1
+                break
+        else:
+            acc[len(buckets)] += 1
+        acc[-2] += v
+        acc[-1] += 1
+    return acc
+
+
+def test_windowed_percentile_matches_brute_force_within_bucket():
+    """The interpolated quantile must land inside the SAME bucket as a
+    brute-force recompute over the raw sample — bucket resolution is
+    the honest error bound a histogram can promise."""
+    import numpy as np
+
+    buckets = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+    rng = np.random.default_rng(7)
+    values = rng.gamma(shape=2.0, scale=0.03, size=2000)
+    acc = _acc_for(buckets, values)
+
+    for q in (0.50, 0.90, 0.99):
+        est = percentile_from_buckets(buckets, acc, q)
+        true = float(np.quantile(values, q))
+        # bucket containing the true quantile -> [lo, hi] bound
+        lo = 0.0
+        hi = buckets[-1]
+        for le in buckets:
+            if true <= le:
+                hi = le
+                break
+            lo = le
+        assert est is not None
+        assert lo - 1e-12 <= est <= hi + 1e-12, (
+            f"q={q}: est {est} outside true-quantile bucket "
+            f"[{lo}, {hi}] (true {true})"
+        )
+
+
+def test_percentile_edge_cases():
+    buckets = (0.1, 0.5, 1.0)
+    # empty accumulator
+    assert percentile_from_buckets(buckets, [0, 0, 0, 0, 0.0, 0], 0.5) \
+        is None
+    # mass in the +Inf bucket clamps to the top finite boundary
+    acc = _acc_for(buckets, [5.0, 7.0, 9.0])
+    assert percentile_from_buckets(buckets, acc, 0.5) == 1.0
+    # linear interpolation inside one bucket: 2 below 0.1, 6 in
+    # (0.1, 0.5], 2 in (0.5, 1.0] -> p50 target 5 of 10 -> 0.3
+    acc = [2, 6, 2, 0, 5.0, 10]
+    assert percentile_from_buckets(buckets, acc, 0.5) == pytest.approx(
+        0.3
+    )
+
+
+# ---------------------------------------------------------------------------
+# tenant cardinality cap
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_cardinality_cap_collapses_to_overflow():
+    """More distinct tenants than the cap must collapse into the
+    ``_overflow`` series, never grow the registry unboundedly — the
+    same contract every labeled metric carries."""
+    # mechanics on a scratch registry with a tiny cap
+    r = MetricsRegistry()
+    c = r.counter("t_rows_total", labels=("tenant", "outcome"),
+                  max_series=4)
+    for i in range(10):
+        c.inc(1.0, f"tenant-{i}", "ok")
+    snap = dict()
+    for name, lv, v in r.export_snapshot()["counters"]:
+        if name == "t_rows_total":
+            snap[tuple(lv)] = v
+    assert len(snap) <= 5  # 4 admitted + the single overflow series
+    assert snap[("_overflow", "_overflow")] >= 6.0
+
+    # the REAL tenant counters carry the env-tunable cap
+    assert telemetry.TENANT_ROWS_TOTAL.max_series == \
+        telemetry.TENANT_MAX_SERIES
+    telemetry.reset_for_tests()
+    try:
+        telemetry.set_enabled(True)
+        for i in range(telemetry.TENANT_MAX_SERIES + 20):
+            telemetry.TENANT_ROWS_TOTAL.inc(1.0, f"tenant-{i}", "ok")
+        series = [
+            tuple(lv)
+            for name, lv, _v in
+            telemetry.REGISTRY.export_snapshot()["counters"]
+            if name == "sutro_tenant_rows_total"
+        ]
+        assert len(series) <= telemetry.TENANT_MAX_SERIES + 1
+        assert ("_overflow", "_overflow") in series
+    finally:
+        telemetry.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# live acceptance: mid-job verdicts + alert lifecycle over GET /monitor
+# ---------------------------------------------------------------------------
+
+
+def _wait_terminal(eng, job_id, timeout=600):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = JobStatus(eng.job_status(job_id))
+        if st.is_terminal() and st != JobStatus.CANCELLING:
+            return st
+        time.sleep(0.05)
+    raise TimeoutError(f"{job_id} not terminal within {timeout}s")
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture()
+def monitor_engine(tmp_path, monkeypatch):
+    monkeypatch.setenv("SUTRO_HOME", str(tmp_path / "home"))
+    (tmp_path / "home").mkdir()
+    monkeypatch.setenv("SUTRO_MONITOR_INTERVAL", "0.05")
+    monkeypatch.setenv("SUTRO_MONITOR_WINDOW", "0.4")
+    monkeypatch.delenv("SUTRO_MONITOR", raising=False)
+    telemetry.reset_for_tests()
+    telemetry.set_enabled(True)
+    eng = LocalEngine(
+        EngineConfig(
+            kv_page_size=8,
+            max_pages_per_seq=16,
+            decode_batch_size=4,
+            max_model_len=128,
+            use_pallas=False,
+            param_dtype="float32",
+            activation_dtype="float32",
+        )
+    )
+    yield eng
+    faults.clear()
+    eng.close(timeout=5)
+    telemetry.reset_for_tests()
+
+
+def test_live_monitor_acceptance(monitor_engine):
+    """Acceptance criterion verbatim: a multi-window job is driven
+    while ``GET /monitor`` observes (a) a mid-job doctor verdict with
+    the in-flight marker and (b) one alert firing AND resolving —
+    all BEFORE the job reaches a terminal state — while concurrent
+    ``/monitor`` + ``/metrics`` scrapers run against the same server.
+    The alert metric (windowed quarantine rate) is pumped through the
+    real registry counters on a deterministic schedule so the test
+    pins the window/rule machinery, not CPU decode timing."""
+    from sutro_tpu.server import start_server_thread
+
+    eng = monitor_engine
+    assert eng.monitor is not None and eng.monitor.running
+    eng.monitor.set_rules([
+        SLORule(
+            "q_rate", metric="quarantine_rate", op=">",
+            threshold=0.05, clear=0.01, for_ticks=1, clear_ticks=1,
+            workload="batch",
+        ),
+    ])
+    server, _, url = start_server_thread(eng)
+    stop = threading.Event()
+    scrape_errors = []
+
+    def scraper(path):
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(
+                    f"{url}/{path}", timeout=10
+                ) as r:
+                    body = r.read()
+                    if path == "monitor":
+                        json.loads(body)
+                    elif b"sutro_rows_total" not in body:
+                        scrape_errors.append(f"{path}: missing metric")
+            except Exception as e:  # noqa: BLE001
+                scrape_errors.append(f"{path}: {type(e).__name__}: {e}")
+                return
+            time.sleep(0.02)
+
+    def feeder():
+        # ~0.5s of quarantine burst (rate ~0.29 >> threshold), then ok
+        # rows only until the window slides past the burst -> rate 0
+        t0 = time.monotonic()
+        while not stop.is_set() and time.monotonic() - t0 < 6.0:
+            telemetry.ROWS_TOTAL.inc(5.0, "ok")
+            if time.monotonic() - t0 < 0.5:
+                telemetry.ROWS_TOTAL.inc(2.0, "quarantined")
+            time.sleep(0.05)
+
+    threads = [
+        threading.Thread(target=scraper, args=("monitor",), daemon=True),
+        threading.Thread(target=scraper, args=("metrics",), daemon=True),
+        threading.Thread(target=feeder, daemon=True),
+    ]
+    try:
+        jid = eng.submit_batch_inference({
+            "model": "tiny-dense",
+            "inputs": [f"monitor row {i}" for i in range(128)],
+            "sampling_params": {"max_new_tokens": 16,
+                                "temperature": 0.0},
+            "tenant": "acme",
+        })
+        for t in threads:
+            t.start()
+
+        fired = resolved = verdict_seen = False
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st = JobStatus(eng.job_status(jid))
+            doc = _get_json(f"{url}/monitor")["monitor"]
+            if st.is_terminal():
+                break
+            states = {
+                (e["rule"], e["state"])
+                for e in doc["alerts"]["events"]
+            }
+            # every observation below happens while the job is
+            # provably non-terminal (status read BEFORE the scrape)
+            fired = fired or ("q_rate", "firing") in states
+            resolved = resolved or ("q_rate", "resolved") in states
+            for v in doc["verdicts"].values():
+                if v.get("in_flight"):
+                    verdict_seen = True
+            if fired and resolved and verdict_seen:
+                break
+            time.sleep(0.05)
+
+        assert fired, "alert never fired before the job terminated"
+        assert resolved, (
+            "alert never resolved before the job terminated"
+        )
+        assert verdict_seen, (
+            "no in-flight doctor verdict observed mid-job"
+        )
+
+        # NDJSON stream: bounded tick count, then a terminal record
+        with urllib.request.urlopen(
+            f"{url}/monitor/stream?ticks=3", timeout=30
+        ) as r:
+            lines = [
+                json.loads(ln)
+                for ln in r.read().decode().splitlines() if ln
+            ]
+        assert [ln["t"] for ln in lines] == [
+            "tick", "tick", "tick", "end",
+        ]
+        assert all("rates" in ln for ln in lines[:-1])
+
+        assert _wait_terminal(eng, jid) == JobStatus.SUCCEEDED
+        stop.set()
+        assert not scrape_errors, scrape_errors
+
+        # tenant attribution survived the whole path (terminal
+        # accounting lands on the NEXT tick's snapshot — poll briefly)
+        acme = {}
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            doc = _get_json(f"{url}/monitor")["monitor"]
+            acme = doc["stats"]["tenants"].get("acme", {})
+            if acme.get("rows_ok"):
+                break
+            time.sleep(0.05)
+        assert acme.get("requests_batch") == 1.0
+        assert acme.get("rows_ok") == 128.0
+        # the alert transitions also landed on the counter surface
+        text = urllib.request.urlopen(f"{url}/metrics").read().decode()
+        assert 'sutro_monitor_alerts_total{rule="q_rate",' in text
+    finally:
+        stop.set()
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# disabled semantics
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_telemetry_no_monitor_and_404(tmp_path, monkeypatch):
+    """SUTRO_TELEMETRY=0: the engine never constructs a monitor and
+    both endpoints 404 — same contract as every telemetry surface."""
+    from sutro_tpu.server import start_server_thread
+
+    monkeypatch.setenv("SUTRO_HOME", str(tmp_path / "home"))
+    (tmp_path / "home").mkdir()
+    telemetry.set_enabled(False)
+    eng = LocalEngine(
+        EngineConfig(
+            kv_page_size=8, max_pages_per_seq=16, decode_batch_size=4,
+            max_model_len=128, use_pallas=False, param_dtype="float32",
+            activation_dtype="float32",
+        )
+    )
+    server, _, url = start_server_thread(eng)
+    try:
+        assert eng.monitor is None
+        for path in ("monitor", "monitor/stream?ticks=1"):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(f"{url}/{path}", timeout=10)
+            assert exc.value.code == 404
+        with pytest.raises(KeyError):
+            eng.monitor_doc()
+    finally:
+        telemetry.set_enabled(True)
+        server.shutdown()
+        eng.close(timeout=5)
+
+
+def test_monitor_env_switch_alone_disables(tmp_path, monkeypatch):
+    """SUTRO_MONITOR=0 with telemetry ON: metrics still flow, the
+    sampler just never exists."""
+    monkeypatch.setenv("SUTRO_HOME", str(tmp_path / "home"))
+    (tmp_path / "home").mkdir()
+    monkeypatch.setenv("SUTRO_MONITOR", "0")
+    telemetry.set_enabled(True)
+    eng = LocalEngine(
+        EngineConfig(
+            kv_page_size=8, max_pages_per_seq=16, decode_batch_size=4,
+            max_model_len=128, use_pallas=False, param_dtype="float32",
+            activation_dtype="float32",
+        )
+    )
+    try:
+        assert eng.monitor is None
+        with pytest.raises(KeyError):
+            eng.monitor_doc()
+    finally:
+        eng.close(timeout=5)
+
+
+def test_stopped_monitor_with_telemetry_off_does_zero_work():
+    """A RUNNING sampler thread under SUTRO_TELEMETRY=0 must tick zero
+    times (the --monitor op-census leg asserts the same with op
+    counting; this is the cheap in-suite version)."""
+    was = telemetry.enabled()
+    telemetry.set_enabled(False)
+    mon = Monitor(interval_s=0.01)
+    try:
+        mon.start()
+        time.sleep(0.15)
+        assert mon.snapshot_doc()["ticks"] == 0
+        assert mon.failed is None
+    finally:
+        mon.stop()
+        telemetry.set_enabled(was)
