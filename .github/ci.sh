@@ -19,6 +19,11 @@ make telemetry-check
 # verdicts, tenant attribution, and the monitor tick-cost budget
 # (zero sampling work with telemetry off, asserted in code)
 make monitor-check
+# tier-1 gate: enforcement control plane — tenant admission buckets,
+# priority-ladder preemption, autotuner hysteresis, degradation to
+# pass-through under injected controller faults, and the control-on/off
+# host-overhead budget (zero cost with SUTRO_CONTROL=0)
+make control-check
 # warn-only: bench-artifact trend report (never fails the build)
 make bench-trend
 # tier-1 gate: interactive tier CPU smoke — TTFT/ITL legs + the

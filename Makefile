@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: all native test test-oneshot test-fast compile-check lint lint-baseline \
-	chaos telemetry-check monitor-check \
+	chaos telemetry-check monitor-check control-check control-bench \
 	bench bench-e2e serve-bench bench-trend dryrun chip-validate bench-8b \
 	cost golden host-profile clean
 
@@ -83,6 +83,26 @@ monitor-check:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_monitor.py \
 		-q -m "not slow" -p no:cacheprovider
 	JAX_PLATFORMS=cpu $(PY) benchmarks/profile_host_overhead.py --monitor
+
+# enforcement gate (OBSERVABILITY.md "Enforcement"): token-bucket
+# admission, priority-ladder policy, autotuner hysteresis, controller
+# degradation-to-pass-through, the control-on/off host-overhead budget
+# (zero-cost when SUTRO_CONTROL=0, asserted in code), and the
+# mixed-tenant chaos bench smoke. Tier-1 CI.
+control-check:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_control.py \
+		tests/test_chaos.py -k "control" -q -m "not slow" \
+		-p no:cacheprovider
+	JAX_PLATFORMS=cpu $(PY) benchmarks/profile_host_overhead.py --control
+	$(MAKE) control-bench
+
+# mixed-tenant chaos bench -> BENCH_CONTROL.json: a noisy tenant
+# floods the interactive tier while a victim tenant and a batch tenant
+# share the engine. The STOCK interactive_ttft_p99 rule (GET /monitor)
+# must fire with SUTRO_CONTROL=0 and never fire with token-bucket
+# admission on. Not tier-1 (~2 min wall); run on control-plane changes.
+control-bench:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/bench_control.py --smoke
 
 # raw decode microbench (one JSON line; driver contract)
 bench:

@@ -977,6 +977,150 @@ def run_monitor_compare(assert_budget: bool) -> dict:
     return out
 
 
+def run_control_compare(assert_budget: bool) -> dict:
+    """Control-plane (engine/control.py) host overhead + zero-cost-off.
+
+    Admission is per-JOB work (one bucket draw at submit, one refund at
+    terminal), and the autotuner is per-monitor-TICK work — none of it
+    is per-row. The accounting mirrors the monitor gate:
+
+    - one warm + one measured e2e leg on a ``SUTRO_CONTROL=0`` engine
+      (whose EngineConfig nevertheless says ``control="1"`` — the env
+      override must win and the engine must build NO ControlPlane)
+      gives the base us/row;
+    - one admit+terminal cycle and one no-signal autotuner tick are
+      priced on a live standalone plane; added us/row = cycle/rows +
+      tick x ticks_per_leg / rows, against the same
+      <=TEL_OVERHEAD_MAX envelope as telemetry and the monitor;
+    - zero-op check: with telemetry disabled, a plane driven through
+      admits, a rejection, a preemption note, and sustained autotuner
+      actuations fires ZERO census ops (the three
+      ``sutro_admission_rejections/preemptions/autotune_adjustments``
+      counters are all ``telemetry.ENABLED``-guarded).
+    """
+    import os
+    import tempfile
+    from types import SimpleNamespace
+
+    import sutro_tpu.engine.api as api_mod
+    import sutro_tpu.telemetry as tel
+    import sutro_tpu.telemetry.distributed as tel_distributed
+    import sutro_tpu.telemetry.registry as tel_registry
+    import sutro_tpu.telemetry.spans as tel_spans
+    from sutro_tpu.engine import control as ctl
+    from sutro_tpu.engine.config import EngineConfig
+    from sutro_tpu.models.configs import MODEL_CONFIGS
+    from sutro_tpu.telemetry import monitor as tmon
+
+    ecfg = EngineConfig(
+        kv_page_size=16,
+        max_pages_per_seq=32,
+        decode_batch_size=64,
+        max_model_len=512,
+        use_pallas=False,
+        param_dtype="float32",
+        decode_multi_step=16,
+        decode_lookahead=2,
+        max_new_tokens=32,
+        control="1",  # the env override below must beat this
+    )
+    tmp = tempfile.mkdtemp(prefix="sutro-ctl-profile-")
+    os.environ["SUTRO_CONTROL"] = "0"
+    os.environ["SUTRO_MONITOR"] = "0"
+    eng = _e2e_engine(tmp, ecfg)
+    assert eng.control is None, (
+        "SUTRO_CONTROL=0 engine still constructed a ControlPlane"
+    )
+    warm_admit_buckets(MODEL_CONFIGS["tiny-dense"].vocab_size, ecfg)
+    was_enabled = tel.enabled()
+    mods = {
+        "registry": tel_registry,
+        "spans": tel_spans,
+        "distributed": tel_distributed,
+    }
+    counts = {key: 0 for _, _, _, key in _TEL_OPS}
+    try:
+        tel.set_enabled(True)
+        _run_e2e_leg(eng, api_mod, 128, {}, max_new=32)  # warm leg
+        leg = _run_e2e_leg(eng, api_mod, 512, {}, max_new=32)
+
+        # -- price the per-job and per-tick control work ---------------
+        plane = ctl.ControlPlane(
+            "rows=1e12,tokens=1e15,wait=0", ecfg=ecfg
+        )
+        rec = SimpleNamespace(
+            job_id="bench-ctl", status="SUCCEEDED",
+            input_tokens=8192, output_tokens=4096,
+        )
+
+        def job_cycle():
+            plane.admit_batch(
+                "bench", 0, 512, 16384.0, job_id="bench-ctl"
+            )
+            plane.on_terminal(rec)
+
+        cycle_us = _unit_us(job_cycle, n=2000, reps=3)
+        tick_us = _unit_us(
+            lambda: plane.on_monitor_tick({}, [], None, []),
+            n=2000, reps=3,
+        )
+
+        interval_s = tmon.DEFAULT_INTERVAL_S
+        leg_wall_s = leg["us_per_row"] * 512.0 / 1e6
+        ticks_per_leg = max(1.0, leg_wall_s / interval_s)
+        added_us_per_row = (
+            cycle_us + tick_us * ticks_per_leg
+        ) / 512.0
+        base_us = leg["us_per_row"]
+        ratio = (base_us + added_us_per_row) / base_us
+
+        # -- zero-op check: telemetry off, every counter path driven ---
+        tel.set_enabled(False)
+        with _Census(mods, counts):
+            poor = ctl.ControlPlane(
+                "rows=1,tokens=1e9,wait=0,window=600", ecfg=ecfg
+            )
+            assert poor.admit_batch("t", 0, 1, 1.0) is None
+            assert poor.admit_batch("t", 0, 1, 1.0) is not None  # reject
+            assert poor.admit_interactive("t") is not None  # reject
+            poor.note_preemption(0, 1)
+            for _ in range(4):  # sustained signal -> an actual _apply
+                poor.on_monitor_tick(
+                    {}, [], {"j": {"verdict": "interactive_starved"}}, []
+                )
+            off_counts = dict(counts)
+        off_ops = sum(off_counts.values())
+    finally:
+        tel.set_enabled(was_enabled)
+        os.environ.pop("SUTRO_CONTROL", None)
+        eng.close()
+
+    out = {
+        "job_cycle_us": round(cycle_us, 1),
+        "tick_us": round(tick_us, 2),
+        "interval_s": interval_s,
+        "leg_us_per_row": base_us,
+        "leg_wall_s": round(leg_wall_s, 2),
+        "ticks_per_leg": round(ticks_per_leg, 2),
+        "added_us_per_row": round(added_us_per_row, 3),
+        "overhead_ratio": round(ratio, 4),
+        "budget_ratio": TEL_OVERHEAD_MAX,
+        "disabled_ops_fired": off_ops,
+        "ok": bool(ratio <= TEL_OVERHEAD_MAX and off_ops == 0),
+    }
+    if assert_budget:
+        assert off_ops == 0, (
+            f"telemetry-off control plane fired census ops: {off_counts}"
+        )
+        assert ratio <= TEL_OVERHEAD_MAX, (
+            f"control plane adds {added_us_per_row:.2f} us/row "
+            f"({cycle_us:.0f} us/job + {tick_us:.1f} us/tick x "
+            f"{ticks_per_leg:.1f} ticks) on a {base_us} us/row leg "
+            f"(ratio {ratio:.4f} > {TEL_OVERHEAD_MAX})"
+        )
+    return out
+
+
 def main() -> None:
     import jax
 
@@ -1017,6 +1161,24 @@ def main() -> None:
         base["monitor"] = mon
         path.write_text(json.dumps(base, indent=2) + "\n")
         print(json.dumps({"monitor_overhead": mon}))
+        return
+
+    if "--control" in sys.argv:
+        # standalone gate (make control-check): admission/autotuner
+        # cost + zero-cost-when-off; merge into HOST_OVERHEAD.json
+        ctl = run_control_compare(
+            assert_budget="--no-assert" not in sys.argv
+        )
+        path = REPO / "HOST_OVERHEAD.json"
+        base = {}
+        if path.exists():
+            try:
+                base = json.loads(path.read_text())
+            except ValueError:
+                base = {}
+        base["control"] = ctl
+        path.write_text(json.dumps(base, indent=2) + "\n")
+        print(json.dumps({"control_overhead": ctl}))
         return
 
     from sutro_tpu.engine.config import EngineConfig

@@ -151,6 +151,37 @@ class LocalEngine:
                 jobs_provider=self._monitor_jobs,
                 alert_dump=self._monitor_alert_dump,
             ).start()
+        # SLO enforcement control plane (engine/control.py): per-tenant
+        # admission buckets + preemptive priority ladder + closed-loop
+        # autotuner. Constructed ONLY when SUTRO_CONTROL /
+        # EngineConfig.control resolves on — at the default None every
+        # hot path is an is-None check and batch results are
+        # bit-identical. A construction failure means OFF, never a
+        # broken engine.
+        self.control = None
+        from . import control as _control
+
+        _spec = _control.resolve_spec(getattr(self.ecfg, "control", None))
+        if _spec is not None:
+            try:
+                self.control = _control.ControlPlane(
+                    _spec,
+                    ecfg=self.ecfg,
+                    jobs=self.jobs,
+                    jobs_provider=self._monitor_jobs,
+                )
+                # terminal accounting refunds the unused reserve
+                self.jobs.on_terminal = self.control.on_terminal
+                # the autotuner closes the loop off the monitor's tick
+                if self.monitor is not None:
+                    self.monitor.on_tick = self.control.on_monitor_tick
+            except Exception:  # noqa: BLE001 — enforcement is opt-in
+                # armor, never a reason the engine fails to come up
+                logger.warning(
+                    "control plane failed to construct — running "
+                    "without enforcement", exc_info=True,
+                )
+                self.control = None
         self._worker = threading.Thread(
             target=self._worker_loop, daemon=True, name="sutro-engine"
         )
@@ -213,13 +244,21 @@ class LocalEngine:
                     exc_info=True,
                 )
         tenant = str(payload.get("tenant") or "default").strip() or "default"
+        # PAPER.md semantics: job_priority indexes the quota table, so
+        # an out-of-range value is a structured caller error
+        # (jobstore.InvalidPriority -> HTTP 400) BEFORE any record
+        # exists — never silently clamped into another level's quota
+        # and queue position
+        job_priority = self.jobs.validate_priority(
+            payload.get("job_priority", 0)
+        )
         rec = self.jobs.create(
             name=payload.get("name"),
             description=payload.get("description"),
             model=model,
             engine_key=engine_key,
             num_rows=len(inputs),
-            job_priority=int(payload.get("job_priority", 0)),
+            job_priority=job_priority,
             output_schema=payload.get("output_schema"),
             system_prompt=payload.get("system_prompt"),
             sampling_params=sampling,
@@ -289,6 +328,30 @@ class LocalEngine:
                 failure_reason={"message": quota_err},
             )
             return rec.job_id
+
+        # Control-plane admission (engine/control.py): the per-SUBMIT
+        # quota above is a size cap; this is the per-tenant sustained
+        # RATE — a token-bucket draw with bounded-wait backpressure.
+        # Dry runs cost nothing real and skip the draw.
+        if self.control is not None and not rec.dry_run:
+            admit_err = self.control.admit_batch(
+                tenant, rec.job_priority, len(inputs), float(bound),
+                job_id=rec.job_id,
+            )
+            if admit_err:
+                self.jobs.append_failure_log(
+                    rec.job_id,
+                    {"event": "admission_rejected", "error": admit_err},
+                )
+                self.jobs.set_status(
+                    rec.job_id,
+                    JobStatus.FAILED,
+                    failure_reason={
+                        "message": admit_err,
+                        "code": "QUOTA_EXCEEDED",
+                    },
+                )
+                return rec.job_id
 
         self._enqueue(rec.job_priority, rec.job_id)
         return rec.job_id
@@ -381,6 +444,11 @@ class LocalEngine:
             cands = sorted(self._queue.queue)
         for item in cands:
             _prio, seq, jid = item
+            if jid is None:
+                # _WORKER_STOP sentinel (sorts first): the daemon is
+                # closing — a live session must stop adopting new jobs,
+                # not crash mid-drain on the sentinel's None job id
+                break
             if self._attach_key(jid) != engine_key:
                 if jid in self._cancel:
                     continue  # discarded at pop — doesn't hold a turn
@@ -669,7 +737,10 @@ class LocalEngine:
                 "live monitor disabled (SUTRO_TELEMETRY=0 or "
                 "SUTRO_MONITOR=0)"
             )
-        return self.monitor.snapshot_doc()
+        doc = self.monitor.snapshot_doc()
+        if self.control is not None:
+            doc["enforcement"] = self.control.snapshot()
+        return doc
 
     def job_fleet(self, job_id: str) -> Dict[str, Any]:
         """Elastic dp fleet view: the coordinator's live membership
@@ -948,6 +1019,8 @@ class LocalEngine:
             seed=self.ecfg.seed,
             token_bytes=sess.token_bytes,
         )
+        if self.control is not None:
+            batcher.ladder = self.control.ladder
         dp = DPWorld.from_env()
         with job_trace(self.ecfg.profile_dir, job_id):
             if dp is not None:
@@ -1057,6 +1130,8 @@ class LocalEngine:
             seed=self.ecfg.seed,
             token_bytes=token_bytes,
         )
+        if self.control is not None:
+            batcher.ladder = self.control.ladder
         self._run_cobatch_session(None, engine_key, None, batcher)
 
     def _run_cobatch_session(

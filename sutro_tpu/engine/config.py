@@ -164,6 +164,14 @@ class EngineConfig:
                                         # plan (DSL/JSON); None falls back
                                         # to $SUTRO_FAULT_PLAN; empty/off
                                         # means ZERO added work per row
+    control: Optional[str] = None       # SLO enforcement control plane
+                                        # (engine/control.py): "1"/"on"
+                                        # for defaults, or "k=v,..."
+                                        # (window=60,wait=2,aging=30,...).
+                                        # $SUTRO_CONTROL overrides when
+                                        # set ("0"/"off" forces off).
+                                        # None/off = ZERO added work and
+                                        # bit-identical batch results
     row_retries: int = 2                # per-row failure domain: a row
                                         # whose decode/constrain raises is
                                         # re-admitted as a fresh request up

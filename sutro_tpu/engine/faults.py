@@ -42,6 +42,17 @@ telemetry.monitor      live SLO monitor (telemetry/monitor.py): fires at
                        alert flight-recorder dump (engine/api.py); any
                        raising kind degrades the monitor to disabled —
                        a broken monitor never fails a job
+control.admit          control-plane admission (engine/control.py):
+                       fires inside every token-bucket draw (batch AND
+                       interactive); any raising kind degrades the
+                       whole control plane to pass-through — buckets
+                       and ladder off, all traffic admitted, a
+                       ``control_degraded`` event in the failure logs
+                       of in-flight jobs. Never fails a job.
+control.actuate        control-plane autotuner (engine/control.py):
+                       fires at the top of every monitor-tick
+                       actuation; same pass-through degradation as
+                       control.admit
 ====================== ====================================================
 
 Kinds: ``error`` (RuntimeError), ``oom`` (RESOURCE_EXHAUSTED-shaped

@@ -36,7 +36,7 @@ import threading
 import time
 import uuid
 from pathlib import Path
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import pandas as pd
 
@@ -92,6 +92,24 @@ DEFAULT_QUOTAS: List[Dict[str, int]] = [
     {"row_quota": 500_000, "token_quota": 500_000_000},
     {"row_quota": 5_000_000, "token_quota": 5_000_000_000},
 ]
+
+
+class InvalidPriority(ValueError):
+    """Out-of-range ``job_priority`` at submit. Structured (PAPER.md
+    quota semantics): priorities index the quota table, so a value
+    outside it is a caller error, not something to silently clamp.
+    The HTTP layer maps this to 400 with ``code=INVALID_PRIORITY``."""
+
+    code = "INVALID_PRIORITY"
+    status = 400
+
+    def __init__(self, priority: Any, n_levels: int) -> None:
+        self.priority = priority
+        self.n_levels = n_levels
+        super().__init__(
+            f"job_priority {priority!r} is out of range: the quota "
+            f"table defines priorities 0..{n_levels - 1}"
+        )
 
 
 def estimate_cost(
@@ -193,6 +211,11 @@ class JobStore:
         )
         self._lock = threading.Lock()
         self._flush_seq: Dict[str, int] = {}  # job_id -> next chunk seq
+        # terminal-transition hook (engine/control.py refunds a job's
+        # unused admission reserve here). Called once per terminal
+        # transition with the fresh JobRecord; best-effort — a hook
+        # error must never corrupt the status funnel.
+        self.on_terminal: Optional[Callable[[JobRecord], None]] = None
 
     # -- paths -----------------------------------------------------------
     def _dir(self, job_id: str) -> Path:
@@ -283,6 +306,15 @@ class JobStore:
             # "how far did it get, and why was it slow" — this is the
             # one funnel every cancel path passes through
             telemetry.dump_job(self._dir(job_id), job_id)
+        if status.is_terminal() and self.on_terminal is not None:
+            try:
+                self.on_terminal(rec)
+            except Exception:  # noqa: BLE001 — the hook (control-plane
+                # refund) is best-effort; the status funnel is not
+                logger.warning(
+                    "on_terminal hook failed for %s", job_id,
+                    exc_info=True,
+                )
 
     def status(self, job_id: str) -> JobStatus:
         return JobStatus(self.get(job_id).status)
@@ -789,11 +821,28 @@ class JobStore:
                 )
         return [dict(q) for q in DEFAULT_QUOTAS]
 
+    def validate_priority(
+        self, priority: Any, quotas: Optional[List[Dict[str, int]]] = None
+    ) -> int:
+        """The submit-time ``job_priority`` gate: an int indexing the
+        quota table, or :class:`InvalidPriority`. No clamping — a
+        priority outside the table would otherwise silently inherit
+        another level's quota AND queue position."""
+        if quotas is None:
+            quotas = self.get_quotas()
+        try:
+            p = int(priority)
+        except (TypeError, ValueError):
+            raise InvalidPriority(priority, len(quotas)) from None
+        if not 0 <= p < len(quotas):
+            raise InvalidPriority(priority, len(quotas))
+        return p
+
     def check_quota(
         self, priority: int, num_rows: int, est_tokens: int
     ) -> Optional[str]:
         quotas = self.get_quotas()
-        q = quotas[min(max(priority, 0), len(quotas) - 1)]
+        q = quotas[self.validate_priority(priority, quotas)]
         if num_rows > q["row_quota"]:
             return (
                 f"Row count {num_rows} exceeds priority-{priority} quota "

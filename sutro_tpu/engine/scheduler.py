@@ -414,6 +414,10 @@ class ContinuousBatcher:
         self._spec_win_accepted = 0
         # shared-prefix KV reuse (one per run; see _setup_prefix)
         self._prefix: Optional[_SharedPrefix] = None
+        # preemptive priority ladder (engine/control.py): installed by
+        # the engine when the control plane is on; None = the batch
+        # path is bit-identical to a ladder-less build
+        self.ladder = None
         # tokens actually sent through a prefill program this run —
         # the instrument proving the prefix cache's N-fold prefill
         # saving (input_tokens in progress streams stays the per-row
@@ -2037,6 +2041,8 @@ class ContinuousBatcher:
             self._free_prefix_pages(ctx.prefix.pages)
             ctx.prefix = None
         ctx.done = True
+        if self.ladder is not None:
+            self.ladder.forget(ctx)  # drop the aging-clock entry
         self._job_progress(ctx, force=True)
         on_job_done(ctx, outcome)
 
@@ -2125,6 +2131,79 @@ class ContinuousBatcher:
         )
         return True
 
+    def _evict_for_priority(self, ctx: JobCtx) -> bool:
+        """Priority-ladder admission (engine/control.py): when a
+        higher-priority BATCH job finds the batch full, suspend one
+        decode row of a lower-priority job — the same row-granular
+        suspend/re-admit recipe as ``_evict_for_interactive`` (pages
+        free, the row re-enters its job's pending queue and
+        regenerates). Who outranks whom — including anti-starvation
+        aging and the soft-deadline veto — is the ladder's call;
+        this method only does the slot mechanics. A ladder error
+        disables the ladder, never admission."""
+        lad = self.ladder
+        if lad is None or ctx.interactive:
+            return False
+        try:
+            if not lad.active():
+                return False
+            now = time.monotonic()
+            best: Optional[int] = None
+            best_cost = -1
+            for i, s in enumerate(self.slots):
+                if s is None or s.job is None or s.job.interactive:
+                    continue
+                if s.job is ctx:
+                    continue  # never cannibalize the preemptor itself
+                if s.req.constraint is not None and (
+                    s.req.constraint_factory is None
+                ):
+                    continue  # not rebuildable — cannot re-admit
+                if not lad.may_preempt(ctx, s.job, now):
+                    continue
+                cost = len(s.out_ids) + (
+                    s.prefill_pos if s.prefilling else 0
+                )
+                if best is None or cost < best_cost:
+                    best, best_cost = i, cost
+            if best is None:
+                return False
+            s = self.slots[best]
+            victim = s.job
+            self._unreserve(best, s.pages[s.shared_n:])
+            victim.n_slots -= 1
+            self.slots[best] = None
+            self._gen[best] += 1
+            self._needs_mask.discard(best)
+            victim.pending.insert(
+                0,
+                dataclasses.replace(
+                    s.req,
+                    constraint=None,
+                    prepped_constraint=None,
+                    prep_queued=False,
+                ),
+            )
+            victim.stats["preempted"] = (
+                victim.stats.get("preempted", 0) + 1
+            )
+            lad.record(ctx, victim)
+            logger.debug(
+                "priority ladder: P%d %s suspended row %d of P%d %s "
+                "(%d tokens regenerate)",
+                ctx.priority, ctx.job_id, s.req.row_id,
+                victim.priority, victim.job_id, best_cost,
+            )
+            return True
+        except Exception:  # noqa: BLE001 — policy errors must never
+            # break admission; the control plane degrades itself on
+            # its own sites, this is the scheduler-side backstop
+            logger.warning(
+                "priority ladder failed — disabling it", exc_info=True
+            )
+            self.ladder = None
+            return False
+
     def _admit_pending(self, order: List[JobCtx]) -> bool:
         """Admit as many pending rows as slots/pages allow, pulling from
         jobs in (priority, seq) order; rows prefill in batches of up to
@@ -2143,7 +2222,14 @@ class ContinuousBatcher:
                 if ctx is None:
                     break
                 if not ctx.prefix_ready:
-                    if not any(s is None for s in self.slots):
+                    if not any(s is None for s in self.slots) and not (
+                        # a freshly attached latency/priority job must
+                        # not wait for natural churn when it outranks a
+                        # running row — evict here or the reserve loop's
+                        # eviction path below is never reached
+                        self._evict_for_interactive(ctx)
+                        or self._evict_for_priority(ctx)
+                    ):
                         break  # no slot anyway — defer prefix setup
                     # shared-prefix KV: prefill this job's common prefix
                     # once, right when its rows first stand a chance of
@@ -2176,7 +2262,10 @@ class ContinuousBatcher:
                         req, ctx, reserved=reserved_tokens,
                         exclude=reserved_idxs,
                     )
-                    while r is None and self._evict_for_interactive(ctx):
+                    while r is None and (
+                        self._evict_for_interactive(ctx)
+                        or self._evict_for_priority(ctx)
+                    ):
                         r = self._reserve(
                             req, ctx, reserved=reserved_tokens,
                             exclude=reserved_idxs,
@@ -2205,7 +2294,10 @@ class ContinuousBatcher:
                     req, ctx, reserved=reserved_tokens,
                     exclude=reserved_idxs,
                 )
-                while r is None and self._evict_for_interactive(ctx):
+                while r is None and (
+                    self._evict_for_interactive(ctx)
+                    or self._evict_for_priority(ctx)
+                ):
                     r = self._reserve(
                         req, ctx, reserved=reserved_tokens,
                         exclude=reserved_idxs,

@@ -58,6 +58,11 @@ _FIRED = threading.Event()
 # re-signalling remains correct there.
 _DELIVERED = threading.Event()
 _ARMED = False
+# monotonic timestamp the armed deadline expires at (None when unarmed):
+# the control plane reads this via remaining_s() to cap its bounded
+# admission waits and to stop preempting when suspended rows could not
+# be resumed before the process unwinds
+_DEADLINE_AT: float | None = None
 
 
 def _watchdog(deadline_s: float, grace_s: float) -> None:
@@ -138,12 +143,24 @@ def _sigterm(_sig, _frm):
     raise SystemExit(124)
 
 
+def remaining_s() -> float | None:
+    """Seconds left on the armed soft deadline, or None when unarmed.
+
+    Clamped at 0 after expiry. Consumers (engine/control.py) use this
+    to bound waits and to refuse work that could not finish before the
+    watchdog fires; None means "no deadline pressure"."""
+    if _DEADLINE_AT is None:
+        return None
+    return max(0.0, _DEADLINE_AT - time.monotonic())
+
+
 def arm(deadline_s: float, grace_s: float = 120.0) -> None:
     """Arm the two-stage watchdog. Idempotent (first call wins)."""
-    global _ARMED
+    global _ARMED, _DEADLINE_AT
     if _ARMED or deadline_s <= 0:
         return
     _ARMED = True
+    _DEADLINE_AT = time.monotonic() + deadline_s
     try:
         signal.signal(signal.SIGTERM, _sigterm)
         signal.signal(signal.SIGINT, _sigint)

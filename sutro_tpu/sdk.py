@@ -193,8 +193,22 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
         }
 
         if self.backend == "remote":
-            body = self._remote_json("post", "batch-inference", json=payload)
-            job_id = body["results"]
+            resp = self.do_request("post", "batch-inference", json=payload)
+            if resp.status_code == 400:
+                # the daemon's structured INVALID_PRIORITY body maps
+                # back to the same typed error the local backend
+                # raises, so both paths surface one exception shape
+                try:
+                    err = resp.json().get("error") or {}
+                except ValueError:
+                    err = {}
+                if err.get("code") == "INVALID_PRIORITY":
+                    from .engine.jobstore import InvalidPriority
+
+                    hi = (err.get("valid_range") or [0, 0])[1]
+                    raise InvalidPriority(err.get("priority"), hi + 1)
+            resp.raise_for_status()
+            job_id = resp.json()["results"]
         else:
             job_id = self.engine.submit_batch_inference(payload)
 
@@ -729,7 +743,7 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
     def _await_job_start(self, job_id: str, timeout: int = 3600) -> bool:
         """Poll until RUNNING/STARTING (True) or FAILED/CANCELLED (False)
         (reference sdk.py:1677-1715)."""
-        poll = 0.1 if self.backend == "tpu" else 5.0
+        poll = self._poll_s()
         deadline = time.monotonic() + timeout
         with Spinner("Waiting for job to start...") as sp:
             while time.monotonic() < deadline:
@@ -749,17 +763,32 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
                     sp.fail()
                     return False
                 time.sleep(poll)
+                poll = self._poll_next(poll)
         sp.fail()
         return False
 
+    def _poll_s(self) -> float:
+        """Initial status-poll interval. The local backend is a direct
+        call so it polls fast; the remote backend starts fast too — a
+        tiny job finishes in well under a second and a fixed 5 s sleep
+        before the FIRST poll just burns latency — and backs off
+        geometrically to the reference's 5 s steady-state."""
+        return 0.1
+
+    def _poll_next(self, poll: float) -> float:
+        if self.backend == "tpu":
+            return poll
+        return min(5.0, poll * 1.6)
+
     def _wait_terminal(self, job_id: str, timeout: int) -> str:
-        poll = 0.1 if self.backend == "tpu" else 5.0
+        poll = self._poll_s()
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             status = self.get_job_status(job_id)
             if JobStatus(status).is_terminal():
                 return status
             time.sleep(poll)
+            poll = self._poll_next(poll)
         raise TimeoutError(f"Job {job_id} still running after {timeout}s")
 
     def await_job_completion(

@@ -186,8 +186,28 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
             elif head == "v1" and rest == "completions":
                 self._serve_openai(chat=False)
             elif head == "batch-inference":
+                from .engine.jobstore import InvalidPriority
+
                 payload = self._read_json()
-                self._json({"results": eng.submit_batch_inference(payload)})
+                try:
+                    self._json(
+                        {"results": eng.submit_batch_inference(payload)}
+                    )
+                except InvalidPriority as e:
+                    # structured 400 (PAPER.md quota semantics): the
+                    # SDK surfaces code + valid range, no job record
+                    # was created
+                    self._json(
+                        {
+                            "error": {
+                                "message": str(e),
+                                "code": e.code,
+                                "priority": e.priority,
+                                "valid_range": [0, e.n_levels - 1],
+                            }
+                        },
+                        status=e.status,
+                    )
             elif head == "job-results":
                 req = self._read_json()
                 res = eng.job_results(
@@ -383,6 +403,8 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
                 str(e),
                 "invalid_request_error"
                 if e.status in (400, 404)
+                else "rate_limit_error"
+                if e.status == 429
                 else "service_unavailable"
                 if e.status == 503
                 else "server_error",

@@ -143,6 +143,15 @@ class InteractiveGateway:
                 raise GatewayRejected(
                     503, "server is draining (shutdown in progress)"
                 )
+        # Control-plane admission (engine/control.py): per-tenant
+        # token-bucket draw, no waiting — interactive traffic is
+        # latency-sensitive, so an empty bucket is an immediate 429.
+        ctl = getattr(self.eng, "control", None)
+        if ctl is not None:
+            admit_err = ctl.admit_interactive(sreq.tenant or "default")
+            if admit_err is not None:
+                self._count_outcome("rejected")
+                raise GatewayRejected(429, admit_err)
         from ..engine.api import resolve_model
 
         try:

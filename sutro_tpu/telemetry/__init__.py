@@ -248,6 +248,25 @@ ALERTS_TOTAL = REGISTRY.counter(
     "SLO alert lifecycle transitions emitted by the live monitor",
     labels=("rule", "state"),  # state: firing | resolved
 )
+ADMISSION_REJECTIONS_TOTAL = REGISTRY.counter(
+    "sutro_admission_rejections_total",
+    "Submits rejected by the control plane's per-tenant token buckets",
+    labels=("tenant",),
+    max_series=TENANT_MAX_SERIES,
+)
+PREEMPTIONS_TOTAL = REGISTRY.counter(
+    "sutro_preemptions_total",
+    "Decode rows suspended by the priority ladder "
+    "(labels are the preemptor's and victim's job_priority)",
+    labels=("from", "to"),
+    unit="rows",
+)
+AUTOTUNE_ADJUSTMENTS_TOTAL = REGISTRY.counter(
+    "sutro_autotune_adjustments_total",
+    "Live engine-config adjustments applied by the control-plane "
+    "autotuner",
+    labels=("knob",),
+)
 
 # Span names the engine emits — OBSERVABILITY.md's span schema section
 # and tests key off this tuple, so additions land in one place.

@@ -258,6 +258,17 @@ class Monitor:
         self._wake = threading.Condition()
         self._lock = threading.Lock()  # guards published state
         self._thread: Optional[threading.Thread] = None
+        # per-tick consumer hook (the control-plane autotuner): called
+        # after each published tick with (stats, transitions, verdicts,
+        # firing_rule_names). A hook crash unhooks it — the sampler
+        # itself never degrades on a consumer's behalf.
+        self.on_tick: Optional[
+            Callable[
+                [Dict[str, Any], List[Dict[str, Any]],
+                 Optional[Dict[str, Dict[str, Any]]], List[str]],
+                None,
+            ]
+        ] = None
 
     # -- lifecycle -----------------------------------------------------
 
@@ -357,6 +368,23 @@ class Monitor:
         for ev in transitions:
             if ev["state"] == "firing":
                 self._dump_for_alert(ev)
+        hook = self.on_tick
+        if hook is not None:
+            firing = [
+                name
+                for name, s in self._rule_state.items()
+                if s.state == "firing"
+            ]
+            try:
+                hook(stats, transitions, verdicts, firing)
+            except Exception:  # noqa: BLE001 — a consumer crash must
+                # not take the sampler down; the control plane has its
+                # own degrade path, this is the backstop
+                logger.warning(
+                    "monitor on_tick hook failed — unhooking",
+                    exc_info=True,
+                )
+                self.on_tick = None
 
     # -- window statistics ---------------------------------------------
 

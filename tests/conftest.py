@@ -79,6 +79,37 @@ def tiny_runner(tiny_ecfg):
     return ModelRunner(MODEL_CONFIGS["tiny-dense"], tiny_ecfg)
 
 
+@pytest.fixture(scope="session")
+def live_engine(tmp_path_factory):
+    """ONE compiled tiny engine + HTTP daemon shared by test_sdk.py and
+    test_serving.py (tier-1 wall time: two engine builds -> one). The
+    geometry is the union of what both suites need: interactive tier on,
+    batch defaults matching the old sdk fixture. Tests that mutate
+    engine state must restore it (they do — see test_serving.py's
+    drain/disable tests)."""
+    mp = pytest.MonkeyPatch()
+    home = tmp_path_factory.mktemp("shared-live-home")
+    mp.setenv("SUTRO_HOME", str(home))
+    from sutro_tpu.engine.api import LocalEngine
+    from sutro_tpu.server import start_server_thread
+
+    ecfg = EngineConfig(
+        kv_page_size=8, max_pages_per_seq=16, decode_batch_size=4,
+        max_model_len=128, use_pallas=False, param_dtype="float32",
+        activation_dtype="float32", max_new_tokens=16,
+        interactive_slots=2,
+    )
+    engine = LocalEngine(ecfg)
+    server, thread, url = start_server_thread(engine)
+    yield engine, url, str(home)
+    from sutro_tpu.engine import faults
+
+    faults.clear()
+    server.shutdown()
+    engine.close(timeout=10)
+    mp.undo()
+
+
 def make_requests(tok, texts, **kw):
     from sutro_tpu.engine.scheduler import GenRequest
 

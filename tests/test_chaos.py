@@ -641,3 +641,110 @@ def test_retry_transient_bounded_and_backed_off(monkeypatch):
     with pytest.raises(OSError):
         faults.retry_transient(always, attempts=3, base=0.01, what="t2")
     assert calls["n"] == 3  # bounded
+
+
+# ---------------------------------------------------------------------------
+# control plane: degradation is pass-through, never a failed job
+# ---------------------------------------------------------------------------
+
+
+def test_control_admit_fault_degrades_to_pass_through(mkengine):
+    """A controller crash inside admission must not reject OR fail the
+    job: the plane flips to pass-through, the triggering job records
+    ``control_degraded`` and still SUCCEEDs with bit-identical outputs
+    and zero lost rows — even though its buckets were sized to reject
+    everything."""
+    n = 8
+    ref = _reference_outputs(mkengine, n_rows=n)
+    eng = mkengine(
+        plan="control.admit:error",
+        control="rows=1,tokens=1,wait=0,window=600",
+    )
+    assert eng.control is not None and eng.control.enabled
+    jid = _submit(eng, n_rows=n)
+    assert _wait_terminal(eng, jid) == JobStatus.SUCCEEDED
+    assert eng.control.enabled is False
+    assert "control.admit" in eng.control.degraded_reason
+    res = eng.job_results(jid)
+    assert res["outputs"] == ref  # pass-through is bit-identical
+    log = eng.jobs.get(jid).failure_log or []
+    degr = [e for e in log if e["event"] == "control_degraded"]
+    assert degr and degr[0]["site"] == "control.admit"
+    _assert_no_dup_no_drop(eng, jid, n)
+    # degraded plane keeps admitting: a second job sails through the
+    # "empty" buckets
+    jid2 = _submit(eng, n_rows=n)
+    assert _wait_terminal(eng, jid2) == JobStatus.SUCCEEDED
+
+
+def test_control_actuate_fault_degrades_to_pass_through(mkengine):
+    """A controller crash in the autotuner tick disables the WHOLE
+    plane (buckets and ladder included); jobs keep succeeding."""
+    eng = mkengine(plan="control.actuate:error", control="1")
+    assert eng.control is not None
+    eng.control.on_monitor_tick({}, [], None, [])
+    assert eng.control.enabled is False
+    assert "control.actuate" in eng.control.degraded_reason
+    assert not eng.control.ladder.active()
+    jid = _submit(eng, n_rows=4)
+    assert _wait_terminal(eng, jid) == JobStatus.SUCCEEDED
+    _assert_no_dup_no_drop(eng, jid, 4)
+
+
+def test_control_quota_rejection_and_tenant_isolation(mkengine):
+    """The enforcement path itself: a noisy tenant exhausting its
+    bucket gets a structured QUOTA_EXCEEDED failure (job record, not an
+    exception), while a victim tenant on the same engine still admits
+    and succeeds."""
+    eng = mkengine(control="rows=4,tokens=1e9,wait=0,window=600")
+    p1 = {
+        "model": "tiny-dense",
+        "inputs": [f"noisy {i}" for i in range(4)],
+        "sampling_params": {"max_new_tokens": 4, "temperature": 0.0},
+        "tenant": "noisy",
+    }
+    jid = eng.submit_batch_inference(dict(p1))
+    assert _wait_terminal(eng, jid) == JobStatus.SUCCEEDED
+    # bucket drained: the tenant's next submit fails FAST and structured
+    jid2 = eng.submit_batch_inference(dict(p1))
+    assert _wait_terminal(eng, jid2, timeout=30) == JobStatus.FAILED
+    rec = eng.jobs.get(jid2)
+    assert rec.failure_reason["code"] == "QUOTA_EXCEEDED"
+    assert "QUOTA_EXCEEDED" in rec.failure_reason["message"]
+    assert any(
+        e["event"] == "admission_rejected"
+        for e in (rec.failure_log or [])
+    )
+    # the victim tenant is untouched
+    p2 = dict(p1, tenant="victim", inputs=["victim row"])
+    jid3 = eng.submit_batch_inference(p2)
+    assert _wait_terminal(eng, jid3) == JobStatus.SUCCEEDED
+    snap = eng.control.snapshot()
+    assert snap["rejections"] >= 1
+    assert "noisy/p0" in snap["buckets"]
+
+
+def test_control_disabled_is_zero_cost_and_bit_identical(
+    mkengine, monkeypatch
+):
+    """The off contract: SUTRO_CONTROL=0 beats EngineConfig.control, the
+    engine never builds a ControlPlane, and batch outputs are
+    bit-identical to a control-on engine with headroom (the control
+    path must not perturb scheduling when it admits)."""
+    n = 8
+    ref = _reference_outputs(mkengine, n_rows=n)  # stock engine
+
+    monkeypatch.setenv("SUTRO_CONTROL", "0")
+    eng_off = mkengine(control="1")  # env forces OFF despite config
+    assert eng_off.control is None
+    jid = _submit(eng_off, n_rows=n)
+    assert _wait_terminal(eng_off, jid) == JobStatus.SUCCEEDED
+    assert eng_off.job_results(jid)["outputs"] == ref
+
+    monkeypatch.delenv("SUTRO_CONTROL")
+    eng_on = mkengine(control="1")  # defaults: ample headroom
+    assert eng_on.control is not None
+    jid = _submit(eng_on, n_rows=n)
+    assert _wait_terminal(eng_on, jid) == JobStatus.SUCCEEDED
+    assert eng_on.job_results(jid)["outputs"] == ref
+    assert eng_on.control._drawn == {}  # terminal accounting settled

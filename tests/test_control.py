@@ -1,0 +1,485 @@
+"""SLO enforcement control plane (engine/control.py): token-bucket
+admission, the preemptive priority ladder, the closed-loop autotuner,
+and the degradation contract — plus the structured INVALID_PRIORITY
+rejection that replaced the old silent clamp (jobstore.check_quota).
+
+Engine-level degradation under injected faults lives in test_chaos.py;
+here the plane is driven directly so every policy branch is cheap and
+deterministic."""
+
+import dataclasses
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from sutro_tpu.engine import control as C
+from sutro_tpu.engine import faults, softdeadline
+from sutro_tpu.engine.config import EngineConfig
+
+
+def _ecfg(**kw):
+    base = dict(interactive_slots=1, decode_batch_size=64)
+    base.update(kw)
+    return SimpleNamespace(**base)
+
+
+def _plane(spec="1", **kw):
+    return C.ControlPlane(spec, ecfg=_ecfg(), **kw)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults_and_deadline(monkeypatch):
+    monkeypatch.setattr(softdeadline, "_DEADLINE_AT", None)
+    yield
+    faults.clear()
+
+
+# -- enablement rule ---------------------------------------------------
+
+
+def test_resolve_spec_env_overrides_config(monkeypatch):
+    monkeypatch.delenv("SUTRO_CONTROL", raising=False)
+    assert C.resolve_spec(None) is None
+    assert C.resolve_spec("off") is None
+    assert C.resolve_spec("0") is None
+    assert C.resolve_spec("1") == "1"
+    assert C.resolve_spec("rows=5") == "rows=5"
+    monkeypatch.setenv("SUTRO_CONTROL", "0")
+    assert C.resolve_spec("1") is None  # env forces OFF
+    monkeypatch.setenv("SUTRO_CONTROL", "rows=2")
+    assert C.resolve_spec(None) == "rows=2"  # env forces ON
+
+
+def test_parse_spec_defaults_and_kv():
+    cfg = C.ControlConfig.parse("on")
+    assert cfg.window_s == 60.0 and cfg.wait_s == 2.0
+    cfg = C.ControlConfig.parse("rows=5,window=10,wait=0,sustain=3")
+    assert cfg.rows == 5.0 and cfg.window_s == 10.0
+    assert cfg.wait_s == 0.0 and cfg.sustain == 3
+    with pytest.raises(ValueError, match="unknown control spec key"):
+        C.ControlConfig.parse("bogus=1")
+    with pytest.raises(ValueError, match="not k=v"):
+        C.ControlConfig.parse("rows")
+
+
+# -- token buckets -----------------------------------------------------
+
+
+def test_token_bucket_take_refill_put():
+    b = C.TokenBucket(10, window_s=10)  # 1 token/s
+    t0 = 100.0
+    assert b.try_take(10, t0)
+    assert not b.try_take(1, t0)
+    assert b.time_until(2, t0) == pytest.approx(2.0)
+    assert b.time_until(11, t0) == float("inf")  # above capacity
+    assert b.try_take(3, t0 + 3.0)  # refilled 3
+    b.put(100)
+    assert b.level == b.capacity  # put caps at capacity
+
+
+def test_admit_batch_rejects_when_exhausted():
+    p = _plane("rows=4,tokens=1000,wait=0,window=600")
+    assert p.admit_batch("acme", 0, 4, 100.0, job_id="j1") is None
+    assert p._drawn["j1"] == ("acme", 0, 4.0, 100.0)
+    err = p.admit_batch("acme", 0, 4, 100.0, job_id="j2")
+    assert err is not None and C.QUOTA_EXCEEDED in err
+    assert "retry after" in err
+    assert "j2" not in p._drawn
+    assert p.snapshot()["rejections"] == 1
+
+
+def test_admit_batch_bounded_wait_admits_after_refill():
+    # capacity 4 per 0.4 s window -> 10 rows/s refill; draining then
+    # asking for 2 more must block ~0.2 s inside the wait budget
+    p = _plane("rows=4,tokens=1e9,wait=2,window=0.4")
+    assert p.admit_batch("t", 0, 4, 1.0) is None
+    t0 = time.monotonic()
+    assert p.admit_batch("t", 0, 2, 1.0) is None
+    assert time.monotonic() - t0 > 0.05
+
+
+def test_admit_batch_need_above_capacity_rejects_immediately():
+    p = _plane("rows=4,tokens=1e9,wait=5,window=60")
+    t0 = time.monotonic()
+    err = p.admit_batch("t", 0, 50, 1.0)
+    assert err is not None and C.QUOTA_EXCEEDED in err
+    assert time.monotonic() - t0 < 1.0  # inf wait: no pointless sleep
+
+
+def test_wait_budget_respects_soft_deadline(monkeypatch):
+    p = _plane("wait=10")
+    assert p._wait_budget() == 10.0
+    # armed deadline with guard headroom eaten: no waiting allowed
+    monkeypatch.setattr(
+        softdeadline, "_DEADLINE_AT",
+        time.monotonic() + C.DEADLINE_GUARD_S - 1.0,
+    )
+    assert p._wait_budget() == 0.0
+
+
+def test_tenant_and_priority_isolation():
+    p = _plane("rows=2,tokens=1e9,wait=0,window=600")
+    assert p.admit_batch("noisy", 0, 2, 1.0) is None
+    assert p.admit_batch("noisy", 0, 1, 1.0) is not None  # exhausted
+    # other tenant and other priority level are separate buckets
+    assert p.admit_batch("victim", 0, 2, 1.0) is None
+    assert p.admit_batch("noisy", 1, 2, 1.0) is None
+
+
+def test_admit_interactive_immediate_429_no_wait():
+    p = _plane("rows=1,tokens=1e9,wait=5,window=600")
+    assert p.admit_interactive("t") is None
+    t0 = time.monotonic()
+    err = p.admit_interactive("t")
+    assert err is not None and C.QUOTA_EXCEEDED in err
+    assert time.monotonic() - t0 < 0.5  # never waits
+
+
+def test_default_capacity_derives_from_quota_tables():
+    p = _plane("1")  # no absolute rows/tokens -> quota / divisor
+    from sutro_tpu.engine.jobstore import DEFAULT_QUOTAS
+
+    b = p._bucket("t", 0)
+    assert b["rows"].capacity == pytest.approx(
+        max(1.0, DEFAULT_QUOTAS[0]["row_quota"] / 1000.0)
+    )
+    assert b["tokens"].capacity == pytest.approx(
+        max(1.0, DEFAULT_QUOTAS[0]["token_quota"] / 1000.0)
+    )
+
+
+# -- terminal accounting ----------------------------------------------
+
+
+def _rec(job_id, status, in_tok=0, out_tok=0):
+    return SimpleNamespace(
+        job_id=job_id, status=status,
+        input_tokens=in_tok, output_tokens=out_tok,
+    )
+
+
+def test_on_terminal_refunds_token_overage():
+    p = _plane("rows=10,tokens=1000,wait=0,window=600")
+    assert p.admit_batch("t", 0, 2, 800.0, job_id="j") is None
+    p.on_terminal(_rec("j", "SUCCEEDED", in_tok=100, out_tok=200))
+    b = p._bucket("t", 0)
+    # 800 reserved, 300 used -> 500 back: level 200 + 500 = 700
+    assert b["tokens"].level == pytest.approx(700.0, abs=1.0)
+    assert "j" not in p._drawn
+
+
+def test_on_terminal_full_refund_for_job_that_never_ran():
+    p = _plane("rows=10,tokens=1000,wait=0,window=600")
+    assert p.admit_batch("t", 0, 4, 400.0, job_id="j") is None
+    p.on_terminal(_rec("j", "FAILED"))
+    b = p._bucket("t", 0)
+    assert b["rows"].level == pytest.approx(10.0, abs=0.1)
+    assert b["tokens"].level == pytest.approx(1000.0, abs=1.0)
+
+
+# -- priority ladder ---------------------------------------------------
+
+
+def _ctx(priority, seq, interactive=False):
+    return SimpleNamespace(
+        priority=priority, seq=seq, interactive=interactive
+    )
+
+
+def test_ladder_aging_promotes_waiting_job():
+    p = _plane("aging=10")
+    lad = p.ladder
+    now = 1000.0
+    old_p2, early_p0 = _ctx(2, 1), _ctx(0, 2)
+    assert lad.effective_priority(old_p2, now) == 2
+    # while the P2 job is young, an arriving P0 job outranks it
+    assert lad.may_preempt(early_p0, old_p2, now)
+    # 25 s later the P2 job has aged two levels (2 -> 0), so a NEWLY
+    # arriving P0 flood can no longer preempt it
+    late_p0 = _ctx(0, 3)
+    assert lad.effective_priority(old_p2, now + 25) == 0
+    assert not lad.may_preempt(late_p0, old_p2, now + 25)
+
+
+def test_ladder_excludes_interactive_and_disabled_plane():
+    p = _plane("1")
+    lad = p.ladder
+    assert not lad.may_preempt(_ctx(-1, 1), _ctx(1, 2), 0.0)
+    assert not lad.may_preempt(_ctx(0, 1), _ctx(-1, 2), 0.0)
+    assert lad.may_preempt(_ctx(0, 1), _ctx(1, 2), 0.0)
+    p.enabled = False
+    assert not lad.may_preempt(_ctx(0, 1), _ctx(1, 2), 0.0)
+    assert not lad.active()
+
+
+def test_ladder_deadline_veto(monkeypatch):
+    p = _plane("1")
+    assert p.ladder.may_preempt(_ctx(0, 1), _ctx(1, 2), 0.0)
+    monkeypatch.setattr(
+        softdeadline, "_DEADLINE_AT",
+        time.monotonic() + C.DEADLINE_GUARD_S / 2,
+    )
+    assert not p.ladder.may_preempt(_ctx(0, 1), _ctx(1, 2), 0.0)
+
+
+def test_ladder_forget_drops_aging_entry():
+    p = _plane("1")
+    ctx = _ctx(1, 7)
+    p.ladder.effective_priority(ctx, 0.0)
+    assert 7 in p.ladder._first_seen
+    p.ladder.forget(ctx)
+    assert 7 not in p.ladder._first_seen
+
+
+def test_scheduler_priority_preemption_end_to_end(tiny_ecfg, byte_tok):
+    """A P0 job attached mid-flight of a slot-saturating P1 job steals
+    decode rows through the ladder (suspend/re-admit), finishes first,
+    and the P1 job still completes EVERY row — preempted rows are
+    re-queued, not lost."""
+    from sutro_tpu.engine.runner import ModelRunner
+    from sutro_tpu.engine.scheduler import ContinuousBatcher, JobCtx
+    from sutro_tpu.models.configs import MODEL_CONFIGS
+    from tests.conftest import make_requests
+
+    runner = ModelRunner(MODEL_CONFIGS["tiny-dense"], tiny_ecfg)
+    # no stop ids: every P1 row decodes its full 40 tokens, so the
+    # batch stays saturated and a slot can ONLY come from preemption
+    b = ContinuousBatcher(runner, stop_ids=())
+    plane = C.ControlPlane("1", ecfg=dataclasses.replace(tiny_ecfg))
+    b.ladder = plane.ladder
+
+    got1, got0, done = {}, {}, []
+    ctx1 = JobCtx(
+        job_id="p1",
+        pending=make_requests(
+            byte_tok, [f"batch row {i}" for i in range(10)],
+            max_new_tokens=40, temperature=0.0,
+        ),
+        on_result=lambda r: got1.__setitem__(r.row_id, r),
+        priority=1, seq=0,
+    )
+    ctx0 = JobCtx(
+        job_id="p0",
+        pending=make_requests(
+            byte_tok, ["quick a", "quick b"],
+            max_new_tokens=4, temperature=0.0,
+        ),
+        on_result=lambda r: got0.__setitem__(r.row_id, r),
+        priority=0, seq=1,
+    )
+    handed = []
+
+    def poll_new():
+        # attach only once EVERY slot is pinned by a decoding P1 row —
+        # from then on a slot can only come from preemption (no stop
+        # ids, so no row finishes before its 40-token budget)
+        if (
+            not handed
+            and ctx1.stats["out"] >= 4
+            and all(s is not None for s in b.slots)
+        ):
+            handed.append(True)
+            return ctx0
+        return None
+
+    state = b.run_multi(
+        [ctx1],
+        on_job_done=lambda c, o: done.append((c.job_id, o)),
+        poll_new=poll_new,
+    )
+    assert state == "completed"
+    assert handed, "p0 was never attached"
+    assert done[0] == ("p0", "completed")
+    assert done[-1] == ("p1", "completed")
+    assert len(got0) == 2 and len(got1) == 10  # zero lost rows
+    # the ladder did its job: P1 decode rows were suspended (the
+    # interactive path can't have done it — ctx0 is a plain batch job)
+    assert ctx1.stats["preempted"] >= 1
+    assert plane.snapshot()["preemptions"] == ctx1.stats["preempted"]
+    # aging entries cleaned up at job finish
+    assert plane.ladder._first_seen == {}
+
+
+def test_scheduler_ladder_none_is_stock_path(tiny_runner, byte_tok):
+    """Control off: batcher.ladder stays None and outputs are
+    bit-identical to the pre-control scheduler (greedy oracle)."""
+    from sutro_tpu.engine.scheduler import ContinuousBatcher
+    from tests.conftest import make_requests
+
+    texts = [f"det row {i}" for i in range(6)]
+    outs = []
+    for _ in range(2):
+        b = ContinuousBatcher(tiny_runner, stop_ids=byte_tok.stop_ids())
+        assert b.ladder is None
+        res = {}
+        b.run(
+            make_requests(byte_tok, texts, max_new_tokens=8,
+                          temperature=0.0),
+            on_result=lambda r: res.__setitem__(r.row_id, r),
+        )
+        outs.append({i: r.token_ids for i, r in res.items()})
+    assert outs[0] == outs[1]
+
+
+# -- autotuner ---------------------------------------------------------
+
+
+def _tick(p, verdict=None, firing=()):
+    verdicts = (
+        {"job-x": {"verdict": verdict}} if verdict else None
+    )
+    p.on_monitor_tick({}, [], verdicts, list(firing))
+
+
+def test_autotuner_starved_grows_slots_with_hysteresis():
+    p = _plane("sustain=2,cooldown=2,settle=3")
+    e = p.ecfg
+    _tick(p, verdict="interactive_starved")
+    assert e.interactive_slots == 1  # one tick is not sustained
+    _tick(p, verdict="interactive_starved")
+    assert e.interactive_slots == 2  # acted, audit + cooldown set
+    _tick(p, verdict="interactive_starved")
+    _tick(p, verdict="interactive_starved")
+    assert e.interactive_slots == 2  # cooldown holds
+    audit = p.snapshot()["autotune"]["audit"]
+    assert audit[-1]["knob"] == "interactive_slots"
+    assert (audit[-1]["from"], audit[-1]["to"]) == (1, 2)
+    assert audit[-1]["reason"] == "interactive_starved"
+    # quiet spell: settle walks back toward baseline
+    for _ in range(6):
+        _tick(p)
+    assert e.interactive_slots == 1
+
+
+def test_autotuner_firing_rule_counts_as_starvation():
+    """The monitor's stock interactive_ttft_p99 rule (no doctor needed)
+    drives the same actuator."""
+    p = _plane("sustain=1,cooldown=0")
+    _tick(p, firing=["interactive_ttft_p99"])
+    assert p.ecfg.interactive_slots == 2
+
+
+def test_autotuner_slots_bounded_by_boost():
+    p = _plane("sustain=1,cooldown=0,slots_boost=2")
+    for _ in range(10):
+        _tick(p, verdict="interactive_starved")
+    assert p.ecfg.interactive_slots == 1 + 2  # base + slots_boost cap
+
+
+def test_autotuner_roofline_grows_batch_hostbound_shrinks():
+    p = _plane("sustain=1,cooldown=0")
+    e = p.ecfg
+    _tick(p, verdict="decode_below_roofline")
+    assert e.decode_batch_size == 64 + 16  # step = base // 4
+    for _ in range(20):
+        _tick(p, verdict="decode_below_roofline")
+    assert e.decode_batch_size == 128  # bounded at 2 * baseline
+    # host-bound outranks roofline and walks it back down
+    for _ in range(20):
+        _tick(p, verdict="host_bound_admit")
+    assert e.decode_batch_size == 8  # floor
+
+
+def test_autotuner_counts_reset_when_signal_clears():
+    p = _plane("sustain=2,cooldown=0")
+    _tick(p, verdict="interactive_starved")
+    _tick(p)  # gap resets the sustain counter
+    _tick(p, verdict="interactive_starved")
+    assert p.ecfg.interactive_slots == 1
+
+
+# -- degradation contract ---------------------------------------------
+
+
+def test_admit_fault_degrades_to_pass_through():
+    p = _plane("rows=1,tokens=1,wait=0,window=600")
+    assert p.admit_batch("t", 0, 1, 1.0) is None
+    faults.configure("control.admit:error")
+    # bucket is EMPTY, but the controller fault must admit, not reject
+    assert p.admit_batch("t", 0, 50, 1e9) is None
+    assert p.enabled is False
+    assert "control.admit" in p.degraded_reason
+    faults.clear()
+    # stays pass-through: no recovery, no rejections, ladder off
+    assert p.admit_batch("t", 0, 50, 1e9) is None
+    assert p.admit_interactive("t") is None
+    assert not p.ladder.active()
+    assert p.snapshot()["enabled"] is False
+
+
+def test_actuate_fault_degrades_to_pass_through():
+    p = _plane("sustain=1,cooldown=0")
+    faults.configure("control.actuate:error")
+    _tick(p, verdict="interactive_starved")
+    assert p.enabled is False
+    assert "control.actuate" in p.degraded_reason
+    faults.clear()
+    _tick(p, verdict="interactive_starved")
+    assert p.ecfg.interactive_slots == 1  # autotuner is off
+
+
+def test_degrade_writes_failure_log_trail():
+    logs = {}
+
+    class Jobs:
+        def append_failure_log(self, job_id, event):
+            logs.setdefault(job_id, []).append(event)
+
+    p = C.ControlPlane(
+        "1", ecfg=_ecfg(), jobs=Jobs(),
+        jobs_provider=lambda: [("job-running", "RUNNING")],
+    )
+    faults.configure("control.admit:error")
+    assert p.admit_batch("t", 0, 1, 1.0, job_id="job-new") is None
+    assert [e["event"] for e in logs["job-new"]] == ["control_degraded"]
+    assert [e["event"] for e in logs["job-running"]] == ["control_degraded"]
+    assert logs["job-new"][0]["site"] == "control.admit"
+
+
+# -- monitor hook ------------------------------------------------------
+
+
+def test_monitor_on_tick_hook_fires_and_unhooks_on_error():
+    from sutro_tpu.telemetry.monitor import Monitor
+
+    m = Monitor(interval_s=3600)
+    calls = []
+    m.on_tick = lambda stats, trans, verdicts, firing: calls.append(
+        (stats, trans, verdicts, firing)
+    )
+    m.tick()
+    assert len(calls) == 1
+    stats, trans, verdicts, firing = calls[0]
+    assert isinstance(stats, dict) and isinstance(firing, list)
+
+    def boom(*a):
+        raise RuntimeError("controller crashed")
+
+    m.on_tick = boom
+    m.tick()  # must not raise
+    assert m.on_tick is None  # crashing hook is unhooked
+    m.tick()
+
+
+# -- structured INVALID_PRIORITY (was: silent clamp) -------------------
+
+
+def test_jobstore_invalid_priority_rejected_not_clamped(tmp_path):
+    from sutro_tpu.engine.jobstore import InvalidPriority, JobStore
+
+    js = JobStore(root=tmp_path)
+    n = len(js.get_quotas())
+    assert js.validate_priority(0) == 0
+    assert js.validate_priority(n - 1) == n - 1
+    for bad in (-1, n, 99, "x", None, 2.5):
+        with pytest.raises(InvalidPriority) as ei:
+            js.validate_priority(bad)
+        assert ei.value.status == 400
+        assert ei.value.code == "INVALID_PRIORITY"
+    # check_quota no longer clamps out-of-range priorities silently
+    with pytest.raises(InvalidPriority):
+        js.check_quota(99, 1, 1)
+    err = js.check_quota(0, 10**9, 0)
+    assert err and "quota" in err  # in-range behavior unchanged

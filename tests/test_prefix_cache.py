@@ -216,7 +216,16 @@ def test_no_prefix_for_disjoint_prompts(byte_tok):
     )
 
 
-@pytest.mark.parametrize("native", [False, True])
+@pytest.mark.parametrize(
+    "native",
+    [
+        False,
+        # the native-allocator leg re-runs the whole prefix workload;
+        # native/python parity also rides test_native_runtime.py, so
+        # the combo is nightly, the python leg tier-1
+        pytest.param(True, marks=pytest.mark.slow),
+    ],
+)
 def test_native_and_python_paths_identical(
     byte_tok, monkeypatch, native
 ):

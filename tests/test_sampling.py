@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from sutro_tpu.ops.sampling import apply_penalties, cumulative_logprob, sample
 
@@ -208,6 +209,8 @@ def test_bfloat16_logits_supported():
     np.testing.assert_allclose(lp16, lp32, atol=0.05, rtol=0.02)
 
 
+@pytest.mark.slow  # 4000-draw statistical leg; the bf16 sampling path
+# itself is pinned fast by test_bfloat16_logits_supported
 def test_bfloat16_sampled_distribution_close():
     """Stochastic sampling from bf16 logits matches the f32 categorical
     distribution (chi-square-ish tolerance over many draws)."""

@@ -16,26 +16,17 @@ from sutro_tpu.interfaces import JobStatus
 
 
 @pytest.fixture(scope="module")
-def sdk(tmp_path_factory, monkeypatch_module):
-    home = tmp_path_factory.mktemp("sutro-home")
-    monkeypatch_module.setenv("SUTRO_HOME", str(home))
-    from sutro_tpu.engine.api import reset_engine
+def sdk(live_engine, monkeypatch_module):
+    """Local-backend SDK over the session-shared engine (conftest
+    ``live_engine``) — one tiny-model compile for this module AND
+    test_serving.py instead of one each."""
+    engine, _url, home = live_engine
+    monkeypatch_module.setenv("SUTRO_HOME", home)
     from sutro_tpu.sdk import Sutro
 
-    reset_engine()
-    client = Sutro(
-        engine_config=dict(
-            kv_page_size=8,
-            max_pages_per_seq=16,
-            decode_batch_size=4,
-            max_model_len=128,
-            use_pallas=False,
-            param_dtype="float32",
-            max_new_tokens=16,
-        )
-    )
+    client = Sutro(api_key="test-key")
+    client._engine = engine
     yield client
-    reset_engine()
 
 
 def test_infer_list_returns_ordered_results(sdk):

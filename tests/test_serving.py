@@ -8,32 +8,22 @@ import threading
 import pytest
 
 from sutro_tpu.engine import faults
-from sutro_tpu.engine.api import LocalEngine
-from sutro_tpu.engine.config import EngineConfig
 from sutro_tpu.interfaces import JobStatus
-from sutro_tpu.server import start_server_thread
 
 
 @pytest.fixture(scope="module")
-def iserved(tmp_path_factory, monkeypatch_module):
-    """A live daemon over a tiny CPU engine with the interactive tier on."""
-    home = tmp_path_factory.mktemp("iserve-home")
-    monkeypatch_module.setenv("SUTRO_HOME", str(home))
-    ecfg = EngineConfig(
-        kv_page_size=8, max_pages_per_seq=16, decode_batch_size=4,
-        max_model_len=128, use_pallas=False, param_dtype="float32",
-        activation_dtype="float32", max_new_tokens=8,
-        interactive_slots=2,
-    )
-    engine = LocalEngine(ecfg)
+def iserved(live_engine, monkeypatch_module):
+    """Remote-backend SDK over the session-shared daemon (conftest
+    ``live_engine``) — the engine and server are built once for this
+    module AND test_sdk.py."""
+    engine, url, home = live_engine
+    monkeypatch_module.setenv("SUTRO_HOME", home)
     assert engine.gateway is not None
-    server, thread, url = start_server_thread(engine)
     from sutro_tpu.sdk import Sutro
 
     sdk = Sutro(api_key="test-key", base_url=url, backend="remote")
     yield sdk, engine, url
     faults.clear()
-    server.shutdown()
 
 
 def _chat_body(prompt, **kw):
