@@ -24,6 +24,11 @@ make monitor-check
 # pass-through under injected controller faults, and the control-on/off
 # host-overhead budget (zero cost with SUTRO_CONTROL=0)
 make control-check
+# tier-1 gate: cross-job radix prefix store — repeat-template jobs must
+# prefill only the novel tail, bit-identically to the store-off engine,
+# with exact page conservation under eviction pressure and lookup
+# faults degrading to plain misses
+make prefix-check
 # warn-only: bench-artifact trend report (never fails the build)
 make bench-trend
 # tier-1 gate: interactive tier CPU smoke — TTFT/ITL legs + the

@@ -6,8 +6,8 @@ PY ?= python
 
 .PHONY: all native test test-oneshot test-fast compile-check lint lint-baseline \
 	chaos telemetry-check monitor-check control-check control-bench \
-	bench bench-e2e serve-bench bench-trend dryrun chip-validate bench-8b \
-	cost golden host-profile clean
+	prefix-check bench bench-e2e serve-bench bench-trend dryrun \
+	chip-validate bench-8b cost golden host-profile clean
 
 all: native compile-check
 
@@ -103,6 +103,16 @@ control-check:
 # admission on. Not tier-1 (~2 min wall); run on control-plane changes.
 control-bench:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/bench_control.py --smoke
+
+# prefix-store gate (OBSERVABILITY.md "Prefix store"): radix-tree
+# units (LRU order, pin refcounts, racer declines), scheduler
+# integration (second identical-template job prefills the tail only,
+# bit-identical to SUTRO_PREFIX_STORE=0), eviction-vs-admission and
+# lookup-fault chaos, and the engine close()/page-conservation
+# contract. Tier-1 CI.
+prefix-check:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_prefix_store.py \
+		-q -m "not slow" -p no:cacheprovider
 
 # raw decode microbench (one JSON line; driver contract)
 bench:

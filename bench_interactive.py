@@ -80,11 +80,15 @@ def main() -> None:
     assert gw is not None, "interactive_slots must be > 0"
     results = {}
 
-    def one_request(i, ttfts, itls):
+    def one_request(i, ttfts, itls, content=None, warm_toks=None):
         body = {
             "model": model,
             "messages": [
-                {"role": "user", "content": f"Question {i}: say something."}
+                {
+                    "role": "user",
+                    "content": content
+                    or f"Question {i}: say something.",
+                }
             ],
             "max_tokens": max_tok,
             "stream": True,
@@ -96,11 +100,22 @@ def main() -> None:
         if ttft is not None:
             ttfts.append(ttft)
         itls.extend(ir.channel.itl_samples)
+        if warm_toks is not None:
+            # submit-time store probe (serving/gateway.py): how many
+            # leading prompt tokens already had resident KV
+            warm_toks.append(ir.warm_tokens)
 
-    def latency_leg(name):
-        ttfts, itls = [], []
+    def latency_leg(name, content_fn=None):
+        ttfts, itls, warm_toks = [], [], []
         threads = [
-            threading.Thread(target=one_request, args=(i, ttfts, itls))
+            threading.Thread(
+                target=one_request,
+                args=(i, ttfts, itls),
+                kwargs={
+                    "content": content_fn(i) if content_fn else None,
+                    "warm_toks": warm_toks,
+                },
+            )
             for i in range(n_reqs)
         ]
         t0 = time.monotonic()
@@ -118,6 +133,7 @@ def main() -> None:
             "ttft_p99_s": pct(ttfts, 99),
             "itl_p50_s": pct(itls, 50),
             "itl_p99_s": pct(itls, 99),
+            "warm_prefix_tokens_total": sum(warm_toks),
         }
         results[name] = entry
         print(json.dumps({name: entry}), flush=True)
@@ -146,6 +162,35 @@ def main() -> None:
     # warm the runner so leg 1's first TTFT is not a model-load stall
     one_request(-1, [], [])
     latency_leg("idle")
+
+    # -- leg 1b: warm-prefix TTFT (engine-lifetime radix store) --------
+    # The same long prompt shell with per-request tails, twice: the
+    # cold pass prefills the shell per request, the warm pass finds its
+    # KV resident in the prefix store and prefills only the tail — the
+    # warm p99 TTFT must come in below cold (graded below). A same-
+    # length throwaway shell first primes BOTH prefill compile buckets
+    # (full shell + short tail) so neither pass eats an XLA compile.
+    if on_tpu:
+        shell = (
+            "Support agent context: orders ship within two business "
+            "days; returns are accepted for thirty days with receipt; "
+            "warranty claims need the serial number; gift wrapping is "
+            "free over fifty dollars; loyalty points expire yearly. "
+            "Answer the customer's question in one short sentence."
+        )
+    else:
+        # sized for the 128-token smoke context (shell still dominant)
+        shell = (
+            "Orders ship in two days; returns accepted for thirty "
+            "days. Reply briefly."
+        )
+    prime = ("The quick brown fox jumps over the lazy dog. " * 12)[
+        : len(shell)
+    ]
+    one_request(-2, [], [], content=prime + " a")
+    one_request(-3, [], [], content=prime + " b")
+    latency_leg("prefix_cold", lambda i: f"{shell} item {i}")
+    latency_leg("prefix_warm", lambda i: f"{shell} item {i}")
 
     # -- leg 2: batch throughput baseline ------------------------------
     # warm the batch path (prefill/decode compile at batch shapes) so
@@ -186,6 +231,8 @@ def main() -> None:
     co99 = results["cobatch"]["ttft_p99_s"] or 0.0
     base_rph = results["batch_alone"]["rows_per_hour"]
     co_rph = done["rows_per_hour"]
+    pc99 = results["prefix_cold"]["ttft_p99_s"] or 0.0
+    pw99 = results["prefix_warm"]["ttft_p99_s"] or 0.0
     results["grades"] = {
         "ttft_p99_ratio_vs_idle": (
             round(co99 / idle99, 2) if idle99 else None
@@ -193,6 +240,10 @@ def main() -> None:
         "ttft_target": "p99 cobatch < 5x idle",
         "batch_throughput_retention": round(co_rph / base_rph, 3),
         "throughput_target": "cobatch batch rows/hour >= 0.8x alone",
+        "warm_prefix_ttft_p99_ratio": (
+            round(pw99 / pc99, 3) if pc99 else None
+        ),
+        "warm_prefix_target": "p99 warm < 1x cold (shell KV resident)",
     }
     print(json.dumps({"grades": results["grades"]}), flush=True)
 

@@ -53,6 +53,11 @@ INTERACTIVE_METRICS = (
     (("legs", "cobatch", "batch", "rows_per_hour"), True),
     (("legs", "grades", "ttft_p99_ratio_vs_idle"), False),
     (("legs", "grades", "batch_throughput_retention"), True),
+    # warm-prefix serving legs (engine-lifetime radix prefix store):
+    # warm must stay below cold, and the ratio must not creep up
+    (("legs", "prefix_cold", "ttft_p99_s"), False),
+    (("legs", "prefix_warm", "ttft_p99_s"), False),
+    (("legs", "grades", "warm_prefix_ttft_p99_ratio"), False),
 )
 
 

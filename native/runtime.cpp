@@ -193,6 +193,26 @@ int32_t rt_alloc_pages(Runtime* rt, int32_t n, int32_t* out) {
     return 0;
 }
 
+// Remove SPECIFIC page ids from the free set (engine-lifetime prefix
+// store: its pages survive across sessions, so each fresh runtime must
+// take them out of circulation before any admission). Atomic: returns
+// -1 with the set untouched if any id is absent or duplicated; 0 on
+// success. Mirrors PageAllocator.reserve in engine/kvcache.py.
+int32_t rt_reserve_pages(Runtime* rt, int32_t n, const int32_t* pages) {
+    std::vector<int32_t>& fp = rt->free_pages;
+    std::vector<int32_t> want(pages, pages + n);
+    std::sort(want.begin(), want.end());
+    for (int32_t i = 1; i < n; ++i)
+        if (want[i] == want[i - 1]) return -1;
+    for (int32_t i = 0; i < n; ++i)
+        if (!std::binary_search(fp.begin(), fp.end(), want[i])) return -1;
+    for (int32_t i = 0; i < n; ++i) {
+        auto it = std::lower_bound(fp.begin(), fp.end(), want[i]);
+        fp.erase(it);
+    }
+    return 0;
+}
+
 void rt_free_pages(Runtime* rt, int32_t n, const int32_t* pages) {
     size_t mid = rt->free_pages.size();
     for (int32_t i = 0; i < n; ++i)

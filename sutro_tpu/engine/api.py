@@ -133,6 +133,13 @@ class LocalEngine:
         self._lock = threading.Lock()
         self._runner_cache: Dict[str, Tuple[ModelRunner, BaseTokenizer]] = {}
         self._tok_cache: Dict[str, BaseTokenizer] = {}
+        # Engine-lifetime radix prefix stores, one per resident runner
+        # (engine/prefixstore.py): keep template-shell KV pages warm
+        # ACROSS batcher sessions so repeat jobs/requests prefill only
+        # their novel tails. Keyed alongside _runner_cache because the
+        # pages live in that runner's KV pool — evicting the runner
+        # closes its store.
+        self._prefix_stores: Dict[str, Any] = {}
         # Interactive serving tier: constructed ONLY when the reserved
         # slot budget is on — at the default 0 the serving package is
         # never imported and every batch code path is unchanged.
@@ -861,9 +868,50 @@ class LocalEngine:
         runner = ModelRunner(mcfg, self.ecfg, params=params)
         # keep at most two runners resident (HBM budget)
         if len(self._runner_cache) >= 2:
-            self._runner_cache.pop(next(iter(self._runner_cache)))
+            evicted = next(iter(self._runner_cache))
+            self._runner_cache.pop(evicted)
+            # the evicted runner's KV pool dies with it — its prefix
+            # store's pages are gone, so the store closes too
+            store = self._prefix_stores.pop(evicted, None)
+            if store is not None:
+                store.close()
         self._runner_cache[engine_key] = (runner, tok)
         return runner, tok
+
+    def _prefix_store_for(self, engine_key: str):
+        """The engine-lifetime radix prefix store for this runner, or
+        None when the subsystem is off. ``SUTRO_PREFIX_STORE`` overrides
+        ``EngineConfig.prefix_store``; ``0``/``off`` disables — and OFF
+        means the scheduler holds None and runs the per-job path
+        bit-identically (asserted by tests/test_prefix_store.py)."""
+        import os
+
+        env = os.environ.get("SUTRO_PREFIX_STORE")
+        if env is not None:
+            enabled = env.strip().lower() not in ("0", "off", "false", "")
+        else:
+            enabled = bool(getattr(self.ecfg, "prefix_store", True))
+        if not enabled:
+            return None
+        store = self._prefix_stores.get(engine_key)
+        if store is None:
+            from .prefixstore import PrefixStore
+
+            store = PrefixStore(self.ecfg.kv_page_size)
+            self._prefix_stores[engine_key] = store
+        return store
+
+    def prefix_warm_tokens(self, engine_key: str, ids) -> int:
+        """Non-mutating warm-prefix probe for the serving gateway: how
+        many leading tokens of ``ids`` already have resident KV. Zero
+        when the store is off/cold — never raises."""
+        store = self._prefix_stores.get(engine_key)
+        if store is None:
+            return 0
+        try:
+            return store.peek(ids)
+        except Exception:  # graftlint: disable=silent-except
+            return 0
 
     def close(self, timeout: float = 10.0) -> bool:
         """Stop the worker thread with a bounded join (thread-hygiene
@@ -878,6 +926,12 @@ class LocalEngine:
             self.monitor.stop()
         self._queue.put(_WORKER_STOP)
         self._worker.join(timeout=timeout)
+        # drop every prefix store: their pinned pages die with the
+        # runners' pools, and a closed store refuses new extends, so a
+        # racing session degrades to the storeless per-job path
+        for store in self._prefix_stores.values():
+            store.close()
+        self._prefix_stores.clear()
         return not self._worker.is_alive()
 
     def _worker_loop(self) -> None:
@@ -1018,6 +1072,7 @@ class LocalEngine:
             stop_ids=getattr(tok, "stop_ids", lambda: [tok.eos_id])(),
             seed=self.ecfg.seed,
             token_bytes=sess.token_bytes,
+            prefix_store=self._prefix_store_for(engine_key),
         )
         if self.control is not None:
             batcher.ladder = self.control.ladder
@@ -1129,6 +1184,7 @@ class LocalEngine:
             stop_ids=getattr(tok, "stop_ids", lambda: [tok.eos_id])(),
             seed=self.ecfg.seed,
             token_bytes=token_bytes,
+            prefix_store=self._prefix_store_for(engine_key),
         )
         if self.control is not None:
             batcher.ladder = self.control.ladder
@@ -1271,6 +1327,16 @@ class LocalEngine:
                             )
                 return
             s = sessions[ctx.job_id]
+            if s.jtel is not None and (
+                getattr(ctx, "prefix_saved", 0)
+                or getattr(ctx, "prefix_paid", 0)
+            ):
+                # saved-vs-paid shared-prefix prefill attribution: the
+                # doctor's prefix_cold evidence line keys off this
+                s.jtel.attrs["prefix"] = {
+                    "saved_tokens": int(ctx.prefix_saved),
+                    "paid_tokens": int(ctx.prefix_paid),
+                }
             if s.jtel is not None and ctx.stats.get("preempted"):
                 ia = s.jtel.attrs.setdefault(
                     "interactive",

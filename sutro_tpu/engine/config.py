@@ -148,6 +148,20 @@ class EngineConfig:
                                     # slots reference them read-only and
                                     # prefill only their own suffix
                                     # (scheduler._setup_prefix)
+    prefix_store: bool = True       # engine-lifetime radix prefix store
+                                    # (engine/prefixstore.py): page-
+                                    # aligned template shells stay
+                                    # resident in the paged KV pool
+                                    # ACROSS jobs, co-batched jobs,
+                                    # resumes, and interactive requests
+                                    # — a repeated shell prefills only
+                                    # its novel tail. Refcount-pinned
+                                    # pages, LRU eviction under
+                                    # allocation pressure.
+                                    # $SUTRO_PREFIX_STORE overrides when
+                                    # set ("0"/"off" forces off); off =
+                                    # bit-identical to the per-job
+                                    # prefix_cache path
     tokenize_threads: int = 0       # >1 splits batched prompt encodes
                                     # across a thread pool — only pays
                                     # for tokenizers whose encode_batch

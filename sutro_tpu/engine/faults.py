@@ -53,6 +53,11 @@ control.actuate        control-plane autotuner (engine/control.py):
                        fires at the top of every monitor-tick
                        actuation; same pass-through degradation as
                        control.admit
+prefixstore.lookup     radix prefix-store lookup (scheduler
+                       _setup_prefix): any raising kind degrades to a
+                       plain cache miss — the job pays full prefill
+                       for its shell but NEVER fails, and the store
+                       stays live for later jobs
 ====================== ====================================================
 
 Kinds: ``error`` (RuntimeError), ``oom`` (RESOURCE_EXHAUSTED-shaped

@@ -149,6 +149,27 @@ class PageAllocator:
             if p != 0:
                 bisect.insort(self._free, p)
 
+    def reserve(self, pages: List[int]) -> None:
+        """Remove SPECIFIC page ids from the free list. The engine-
+        lifetime prefix store (engine/prefixstore.py) owns pages in the
+        runner's pool across batcher sessions; each new session's fresh
+        allocator must take them out of circulation before any
+        admission. Atomic: raises KeyError leaving the free list
+        untouched if any id (or duplicate) is not currently free."""
+        import bisect
+
+        free = self._free
+        want = sorted(int(p) for p in pages)
+        for a, b in zip(want, want[1:]):
+            if a == b:
+                raise KeyError(f"duplicate page id {a} in reserve()")
+        for p in want:
+            i = bisect.bisect_left(free, p)
+            if i >= len(free) or free[i] != p:
+                raise KeyError(f"page {p} is not free (cannot reserve)")
+        drop = set(want)
+        self._free = [p for p in free if p not in drop]
+
     @property
     def free_count(self) -> int:
         return len(self._free)

@@ -76,6 +76,8 @@ def _load_lib():
     lib.rt_alloc_pages.restype = i32
     lib.rt_alloc_pages.argtypes = [c_rt, i32, p_i32]
     lib.rt_free_pages.argtypes = [c_rt, i32, p_i32]
+    lib.rt_reserve_pages.restype = i32
+    lib.rt_reserve_pages.argtypes = [c_rt, i32, p_i32]
     lib.rt_arm_slot.argtypes = [c_rt, i32, i32, i32, f32, f32, i32]
     lib.rt_note_token.argtypes = [c_rt, i32, i32]
     lib.rt_note_bulk.argtypes = [c_rt, i32, i32, i32]
@@ -205,6 +207,19 @@ class NativeRuntime:
             self._rt, len(arr),
             arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         )
+
+    def reserve_pages(self, pages: List[int]) -> bool:
+        """Remove specific page ids from the free set (prefix-store
+        pages held across sessions). Atomic; False when any id is not
+        free — the runtime's free set is then untouched."""
+        if not pages:
+            return True
+        arr = np.asarray(pages, np.int32)
+        rc = self._lib.rt_reserve_pages(
+            self._rt, len(arr),
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        return int(rc) == 0
 
     def arm_slot(
         self, slot: int, pos: int, first_token: int,

@@ -227,6 +227,12 @@ class _SharedPrefix:
 
     tokens: int              # shared length, a multiple of kv_page_size
     pages: List[int]
+    # cross-job radix store (engine/prefixstore.py): ``handle`` pins the
+    # store-owned head of ``pages``; ``own_pages`` is the session-owned
+    # tail to free at release (None = the whole list, the storeless
+    # per-job path). Release via _release_prefix, never raw frees.
+    handle: Optional[Any] = None
+    own_pages: Optional[List[int]] = None
 
     @property
     def n_pages(self) -> int:
@@ -278,6 +284,13 @@ class JobCtx:
     #                             first admission opportunity — eager
     #                             setup would pin prefix pages for jobs
     #                             whose rows wait behind a full batch)
+    # honest roofline attribution (telemetry/doctor.py): prefix tokens
+    # this job got warm from the radix store vs prefix tokens it paid
+    # to prefill itself — without the split, the first job eats the
+    # whole shell cost in its spans and later jobs look faster than
+    # the hardware
+    prefix_saved: int = 0
+    prefix_paid: int = 0
     stats: Dict[str, int] = dataclasses.field(
         default_factory=lambda: {"in": 0, "out": 0, "rows": 0}
     )
@@ -337,6 +350,9 @@ class ContinuousBatcher:
         seed: int = 0,
         token_bytes=None,  # tokenizer token_bytes(id) -> bytes; enables
         #                    GenRequest.stop_seqs detection
+        prefix_store=None,  # engine-lifetime radix prefix store
+        #                     (engine/prefixstore.py); None = today's
+        #                     per-job prefix path, bit-identical
     ):
         self.runner = runner
         self.ecfg = runner.ecfg
@@ -367,6 +383,33 @@ class ContinuousBatcher:
             None if self.native is not None
             else PageAllocator(alloc_pages)
         )
+        # Cross-job radix prefix store: its pages live in THIS runner's
+        # KV pool but the store outlives the session, so the fresh free
+        # list above must give them up before any admission. A store
+        # whose pages cannot be re-reserved (pool geometry changed, or
+        # a mismatched page size) resets to empty instead of poisoning
+        # the run — the ids are already free here, so forgetting the
+        # tree is the only consistent move.
+        self._prefix_store = None
+        if (
+            prefix_store is not None
+            and prefix_store.page_size == self.ecfg.kv_page_size
+        ):
+            owned = prefix_store.owned_pages()
+            ok = all(0 < p < alloc_pages for p in owned)
+            if ok and owned:
+                if self.native is not None:
+                    ok = self.native.reserve_pages(owned)
+                else:
+                    try:
+                        self.allocator.reserve(owned)
+                    except KeyError:
+                        ok = False
+            if ok:
+                self._prefix_store = prefix_store
+            else:
+                prefix_store.reset()
+                self._prefix_store = prefix_store
         self.slots: List[Optional[_Slot]] = [None] * self.B
         # per-slot generation counter: bumped on release so a pipelined
         # window dispatched against a slot's OLD occupant fails the
@@ -488,14 +531,27 @@ class ContinuousBatcher:
         reference's classify template sends one prompt shell for every
         row). Capped at min(len)-1 so every row still prefills >= 1 own
         token (its last-position logits seed the first sample). Skipped
-        when: disabled, < 2 rows, prefix < 1 page, the pages would
-        starve admission, or under sp/pp (suffix prefill rides the
-        chunked paged path, which neither wraps). Per-JOB: co-batched
-        jobs each carry their own prefix pages."""
+        when: disabled, < 2 rows (1 with the radix store: a lone
+        interactive request can hit — and seed — a cross-job shell),
+        prefix < 1 page, the pages would starve admission, or under
+        sp/pp (suffix prefill rides the chunked paged path, which
+        neither wraps).
+
+        With the engine-lifetime radix store attached this becomes
+        LOOKUP → EXTEND → INSERT: the warm head of the shell pins
+        store pages (prefilled by an EARLIER job — only the novel tail
+        is prefilled here, at its offset), and the freshly prefilled
+        tail transfers into the tree for the next job. A store crash
+        during lookup (fault site ``prefixstore.lookup``) degrades to
+        a plain miss — the job pays full prefill but never fails.
+        Without a store: per-JOB pages, exactly the pre-store path."""
         ctx.prefix = None
         pending = ctx.pending
         ecfg = self.ecfg
-        if not getattr(ecfg, "prefix_cache", True) or len(pending) < 2:
+        store = self._prefix_store
+        if not getattr(ecfg, "prefix_cache", True):
+            return
+        if len(pending) < (1 if store is not None else 2):
             return
         if (
             getattr(self.runner, "sp", 1) > 1
@@ -517,41 +573,121 @@ class ContinuousBatcher:
         if shared < PS:
             return
         n_pages = shared // PS
-        # don't let the prefix starve admission: after taking its pages
-        # the WIDEST pending row must still fit
+        # warm head from the radix store (pins the matched path);
+        # any store raise is a plain miss — never a job failure
+        handle = None
+        if store is not None:
+            try:
+                if faults.ACTIVE is not None:
+                    faults.inject("prefixstore.lookup", job=ctx.job_id)
+                handle = store.lookup_pin(first[:shared])
+                if not handle.nodes:
+                    handle = None
+            except Exception:
+                handle = None
+        hit_pages = list(handle.pages) if handle is not None else []
+        hit = len(hit_pages) * PS
+        tail_n = n_pages - len(hit_pages)
+        # don't let the prefix starve admission: after taking its NEW
+        # pages the WIDEST pending row must still fit. Under pressure,
+        # unpinned LRU store pages are evicted back into the free list
+        # first — live jobs always win over cached shells.
         worst_own = max(
             pages_needed(self._max_total(r), PS) for r in pending
         ) - n_pages
-        if self.free_page_count < n_pages + max(worst_own, 1):
+        need_free = tail_n + max(worst_own, 1)
+        if self.free_page_count < need_free:
+            self._evict_store_pages(need_free - self.free_page_count)
+        if self.free_page_count < need_free:
+            if handle is not None:
+                store.release(handle)
+            return
+        if tail_n == 0:
+            # full warm hit: nothing to prefill, nothing to insert
+            ctx.prefix = _SharedPrefix(
+                tokens=shared, pages=hit_pages, handle=handle,
+                own_pages=[],
+            )
+            ctx.prefix_saved += shared
             return
         if self.native is not None:
-            pages = self.native.alloc_pages(n_pages)
+            pages = self.native.alloc_pages(tail_n)
             if pages is None:
+                if handle is not None:
+                    store.release(handle)
                 return
         else:
-            pages = self.allocator.alloc(n_pages)
+            pages = self.allocator.alloc(tail_n)
         table = np.zeros((self.MP,), np.int32)
-        table[:n_pages] = pages
+        table[: len(hit_pages)] = hit_pages
+        table[len(hit_pages) : n_pages] = pages
+        paid = shared - hit
         try:
             if self._tel_on:
-                self._tel_attrs["prefill"] = {"tokens": int(shared)}
+                attrs = {"tokens": int(paid)}
+                if store is not None:
+                    attrs["prefix_saved"] = int(hit)
+                    attrs["prefix_paid"] = int(paid)
+                self._tel_attrs["prefill"] = attrs
             with self.timer.time("prefill"):
                 # last-position logits are discarded: each row derives
-                # its first sample from its OWN suffix prefill
+                # its first sample from its OWN suffix prefill. Only
+                # the novel tail runs, at its global offset — the warm
+                # head is already resident in the store's pages.
                 self.runner.prefill(
-                    np.asarray(first[:shared], np.int32), table
+                    np.asarray(first[hit:shared], np.int32), table,
+                    start=hit,
                 )
         except Exception:
             self._free_prefix_pages(pages)
+            if handle is not None:
+                store.release(handle)
             raise
-        self.prefill_tokens += shared
-        ctx.prefix = _SharedPrefix(tokens=shared, pages=list(pages))
+        self.prefill_tokens += paid
+        ctx.prefix_saved += hit
+        ctx.prefix_paid += paid
+        own = list(pages)
+        if store is not None:
+            h = handle if handle is not None else store.empty_handle()
+            if store.extend(h, first[hit:shared], list(pages)):
+                handle, own = h, []  # tail ownership moved to the store
+            # extend declined (closed store): the tail stays session-
+            # owned; a non-empty original handle still pins the head
+        if handle is not None and not handle.nodes:
+            handle = None
+        ctx.prefix = _SharedPrefix(
+            tokens=shared, pages=hit_pages + list(pages),
+            handle=handle, own_pages=own,
+        )
 
     def _free_prefix_pages(self, pages: List[int]) -> None:
         if self.native is not None:
             self.native.free_pages(pages)
         else:
             self.allocator.free(pages)
+
+    def _release_prefix(self, pfx: _SharedPrefix) -> None:
+        """The ONLY way a _SharedPrefix goes away: unpin the store-owned
+        head (the pages STAY resident — and out of the allocator — for
+        the next job; that's the cache) and free the session-owned
+        remainder to the pool."""
+        if pfx.handle is not None and self._prefix_store is not None:
+            self._prefix_store.release(pfx.handle)
+        own = pfx.pages if pfx.own_pages is None else pfx.own_pages
+        if own:
+            self._free_prefix_pages(own)
+
+    def _evict_store_pages(self, n_pages: int) -> int:
+        """Allocation-pressure hook: pull up to ``n_pages`` unpinned LRU
+        pages out of the radix store and hand them back to THIS
+        session's allocator (they were reserved at construction).
+        Returns the number actually freed."""
+        if n_pages <= 0 or self._prefix_store is None:
+            return 0
+        freed = self._prefix_store.evict(n_pages)
+        if freed:
+            self._free_prefix_pages(freed)
+        return len(freed)
 
     def _reserve(
         self, req: GenRequest, ctx: JobCtx, reserved: int = 0,
@@ -569,13 +705,26 @@ class ContinuousBatcher:
         pages and only the remainder is allocated per slot."""
         n = len(req.prompt_ids)
         pfx = ctx.prefix
-        if self.native is not None:
+
+        def _admit_native():
             if pfx is not None:
-                free_idx = self.native.try_admit_pfx(
+                return self.native.try_admit_pfx(
                     n, req.max_new_tokens, pfx.pages
                 )
-            else:
-                free_idx = self.native.try_admit(n, req.max_new_tokens)
+            return self.native.try_admit(n, req.max_new_tokens)
+
+        if self.native is not None:
+            free_idx = _admit_native()
+            if free_idx < 0 and self._prefix_store is not None:
+                # allocation pressure: a page shortage may be cached
+                # shells, not live rows — evict unpinned LRU store
+                # pages into the free list and retry once
+                need = pages_needed(
+                    self._max_total(req), self.ecfg.kv_page_size
+                )
+                short = need - self.native.free_count
+                if short > 0 and self._evict_store_pages(short):
+                    free_idx = _admit_native()
             if free_idx < 0:
                 return None
             assert self.slots[free_idx] is None
@@ -606,7 +755,13 @@ class ContinuousBatcher:
             if npfx + own > self.MP:
                 return None
             if own > self.allocator.free_count:
-                return None
+                # allocation pressure: evict unpinned LRU store pages
+                # back into the free list before refusing the row
+                self._evict_store_pages(
+                    own - self.allocator.free_count
+                )
+                if own > self.allocator.free_count:
+                    return None
             inflight = self._inflight_tokens() + reserved
             if (
                 inflight > 0
@@ -1158,38 +1313,46 @@ class ContinuousBatcher:
         """Operands for Hydragen-style split decode (Pallas path,
         EngineConfig.prefix_split): a tuple of ``(pfx_pages [Pp_g]
         int32, pfx_len [B] int32)`` groups, one per distinct
-        shared-prefix job among the active rows (co-batched templated
-        jobs each get their own group; member sets are disjoint, so
-        the carries combine exactly — ops/attention.py). ``None`` when
-        disabled, on the fallback path, or when no active row belongs
-        to a prefix."""
+        shared-prefix PAGE RUN among the active rows (co-batched
+        templated jobs each get their own group UNLESS the prefix
+        store gave them the very same pages, in which case they merge
+        into one group and the shared pages are read once; member row
+        sets are disjoint, so the carries combine exactly —
+        ops/attention.py). ``None`` when disabled, on the fallback
+        path, or when no active row belongs to a prefix."""
         if not getattr(self.ecfg, "prefix_split", False):
             return None
         if not getattr(self.runner, "use_pallas", False):
             return None
         groups = []
+        by_pages = {}  # page-run tuple -> index into groups
         seen = set()
         for i in active:
             ctx = self.slots[i].job
             if ctx is None or ctx.prefix is None or id(ctx) in seen:
                 continue
             seen.add(id(ctx))
-            pfx_len = np.zeros((self.B,), np.int32)
+            pages = ctx.prefix.pages
+            key = tuple(pages)
+            gi = by_pages.get(key)
+            if gi is None:
+                by_pages[key] = len(groups)
+                # pad the page list to a power-of-two bucket so
+                # distinct template lengths don't each retrace the
+                # fused decode programs (the pad pages are the garbage
+                # page 0, fully masked by pfx_len in the carry; the
+                # kernel skips only the REAL pfx_len // PS pages)
+                cap = 1
+                while cap < len(pages):
+                    cap *= 2
+                padded = np.zeros((cap,), np.int32)
+                padded[: len(pages)] = pages
+                groups.append((padded, np.zeros((self.B,), np.int32)))
+                gi = len(groups) - 1
+            pfx_len = groups[gi][1]
             for j in active:
                 if self.slots[j].job is ctx:
                     pfx_len[j] = ctx.prefix.tokens
-            # pad the page list to a power-of-two bucket so distinct
-            # template lengths don't each retrace the fused decode
-            # programs (the pad pages are the garbage page 0, fully
-            # masked by pfx_len in the carry; the kernel skips only
-            # the REAL pfx_len // PS pages)
-            pages = ctx.prefix.pages
-            cap = 1
-            while cap < len(pages):
-                cap *= 2
-            padded = np.zeros((cap,), np.int32)
-            padded[: len(pages)] = pages
-            groups.append((padded, pfx_len))
         if not groups:
             return None
         # the tuple's pytree STRUCTURE is a jit trace key: bound the
@@ -2038,7 +2201,7 @@ class ContinuousBatcher:
                     self._emit(i, reason="cancelled")
             ctx.pending.clear()
         if ctx.prefix is not None:
-            self._free_prefix_pages(ctx.prefix.pages)
+            self._release_prefix(ctx.prefix)
             ctx.prefix = None
         ctx.done = True
         if self.ladder is not None:
@@ -2058,7 +2221,7 @@ class ContinuousBatcher:
                 self.slots[i] = None
                 self._gen[i] += 1
         if ctx.prefix is not None:
-            self._free_prefix_pages(ctx.prefix.pages)
+            self._release_prefix(ctx.prefix)
             ctx.prefix = None
         ctx.prefix_ready = False  # a resumed ctx re-detects its prefix
 
@@ -2885,5 +3048,5 @@ class ContinuousBatcher:
             self._prep_stop()
             for ctx in live:
                 if ctx.prefix is not None:
-                    self._free_prefix_pages(ctx.prefix.pages)
+                    self._release_prefix(ctx.prefix)
                     ctx.prefix = None

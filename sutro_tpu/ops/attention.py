@@ -100,11 +100,22 @@ def chunk_attention(
                 )
                 pfx_kw = {}
                 if pfx_groups:
-                    from .pallas_paged import prefix_attention_carry
+                    from .pallas_paged import (
+                        prefix_attention_carry,
+                        prefix_attention_carry_pallas,
+                        prefix_carry_supported,
+                    )
 
                     PS = past_k_pages.shape[1]
                     q_pos = past_len + (
                         win_len if win_len is not None else 0
+                    )
+                    # in-place carry kernel when shapes allow: the
+                    # shared pages are read straight from the HBM pool
+                    # (page-indexed BlockSpecs); otherwise the XLA
+                    # gather computes the identical carry
+                    in_place = prefix_carry_supported(
+                        q[:, 0], past_k_pages, past_k_scale
                     )
                     # groups have DISJOINT member rows, so per-row
                     # carries combine exactly: cold rows contribute
@@ -112,12 +123,18 @@ def chunk_attention(
                     m0 = l0 = acc0 = None
                     pfx_cnt = jnp.zeros_like(past_len)
                     for pages_g, len_g in pfx_groups:
-                        mg, lg, ag = prefix_attention_carry(
-                            q[:, 0], past_k_pages, past_v_pages,
-                            pages_g, len_g, q_pos, win,
-                            k_scale=past_k_scale,
-                            v_scale=past_v_scale,
-                        )
+                        if in_place:
+                            mg, lg, ag = prefix_attention_carry_pallas(
+                                q[:, 0], past_k_pages, past_v_pages,
+                                pages_g, len_g, q_pos, win,
+                            )
+                        else:
+                            mg, lg, ag = prefix_attention_carry(
+                                q[:, 0], past_k_pages, past_v_pages,
+                                pages_g, len_g, q_pos, win,
+                                k_scale=past_k_scale,
+                                v_scale=past_v_scale,
+                            )
                         if m0 is None:
                             m0, l0, acc0 = mg, lg, ag
                         else:

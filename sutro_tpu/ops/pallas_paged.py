@@ -478,6 +478,179 @@ def prefix_attention_carry(
     return m0, l0, acc0
 
 
+def _prefix_carry_kernel(
+    # scalar prefetch: pfx_pages [Pp] int32 (drives the K/V index maps)
+    pages_ref,
+    q_bd_ref,      # [B*NH, KD] f32 block-diagonal queries (resident)
+    k_page_ref,    # [1, PS, KD] — THE prefix page for this grid step,
+    #                fetched in place from the HBM pool by the
+    #                page-indexed BlockSpec index map (no gather)
+    v_page_ref,
+    ok_ref,        # [1, B, PS] f32 0/1 — combined len+window mask
+    m_out_ref,     # [B*NH, 128] f32 (lane-broadcast; caller takes [:,0])
+    l_out_ref,
+    acc_out_ref,   # [B*NH, KD] f32 block-diagonal accumulator
+    m_ref, l_ref, acc_ref,  # VMEM scratch carries across grid steps
+    *, scale: float, n_heads: int,
+):
+    p = pl.program_id(0)
+    BNH, KD = acc_ref.shape
+    PS = k_page_ref.shape[1]
+    B = BNH // n_heads
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q_bd = q_bd_ref[...].astype(jnp.float32)            # [BNH, KD]
+    k = k_page_ref[0].astype(jnp.float32)               # [PS, KD]
+    v = v_page_ref[0].astype(jnp.float32)
+    # [B, PS] row mask -> every head of row b shares it: sublane
+    # broadcast then leading-dim collapse (the only reshape Mosaic
+    # supports — the lane dim PS is untouched)
+    ok = jnp.broadcast_to(
+        ok_ref[0][:, None, :], (B, n_heads, PS)
+    ).reshape(BNH, PS) > 0.0
+    s = jax.lax.dot_general(
+        q_bd, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                                           # [BNH, PS]
+    s = jnp.where(ok, s, NEG_INF)
+    m_prev = m_ref[:, 0]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+    alpha = jnp.exp(m_prev - m_new)
+    # p under the mask, NOT bare exp(s - m): an all-masked step keeps
+    # m_new = -inf and exp(-inf - -inf) would contribute 1, not 0
+    pr = jnp.where(ok, jnp.exp(s - m_new[:, None]), 0.0)
+    l_ref[...] = jnp.broadcast_to(
+        (l_ref[:, 0] * alpha + jnp.sum(pr, axis=1))[:, None],
+        l_ref.shape,
+    )
+    acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+        pr, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+
+    @pl.when(p == pl.num_programs(0) - 1)
+    def _writeback():
+        m_out_ref[...] = m_ref[...]
+        l_out_ref[...] = l_ref[...]
+        acc_out_ref[...] = acc_ref[...]
+
+
+def prefix_carry_supported(
+    q: jax.Array, k_pages: jax.Array,
+    k_scale: Optional[jax.Array] = None,
+) -> bool:
+    """Gate for the in-place Pallas prefix-carry kernel. int8-KV rides
+    the XLA-gather fallback (the dequant-scale plumbing isn't worth a
+    second kernel variant for a cache whose pages are read once per
+    step either way)."""
+    Dh = q.shape[-1]
+    PS = k_pages.shape[1]
+    return Dh % 128 == 0 and PS % 8 == 0 and k_scale is None
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def prefix_attention_carry_pallas(
+    q: jax.Array,            # [B, NH, Dh]
+    k_pages: jax.Array,      # [NP, PS, KVH*Dh]
+    v_pages: jax.Array,
+    pfx_pages: jax.Array,    # [Pp] int32
+    pfx_len: jax.Array,      # [B] int32
+    q_pos: jax.Array,        # [B] int32
+    window: jax.Array,       # scalar int32; 0 => full attention
+    *,
+    interpret: bool = False,
+):
+    """``prefix_attention_carry`` with the shared pages read IN PLACE:
+    grid ``(Pp,)`` over the prefix's pages, each step's K/V block
+    fetched straight out of the HBM page pool by a page-indexed
+    BlockSpec index map (``pages_ref[p]``) — the [Pp, PS, KD] gather
+    copy the XLA path materializes per layer per step never exists.
+    Sequential grid; the online-softmax carry lives in VMEM scratch and
+    writes back on the last page. Bit-comparable to the XLA path: same
+    f32 math in the same per-page order."""
+    B, NH, Dh = q.shape
+    NP, PS, KD = k_pages.shape
+    KVH = KD // Dh
+    G = NH // KVH
+    scale = Dh ** -0.5
+    Pp = pfx_pages.shape[0]
+    Lp = Pp * PS
+
+    # block-diagonal fused queries (XLA side — reshapes are free here):
+    # row b*NH+n carries q[b, n] in lane block n // G, zeros elsewhere
+    row_head = jax.lax.broadcasted_iota(jnp.int32, (NH, KD), 0) // G
+    col_head = jax.lax.broadcasted_iota(jnp.int32, (NH, KD), 1) // Dh
+    blk = (row_head == col_head).astype(jnp.float32)     # [NH, KD]
+    q_rep = jnp.concatenate([q.astype(jnp.float32)] * KVH, axis=-1)
+    q_bd = (q_rep * blk[None]).reshape(B * NH, KD)
+
+    # combined length+window mask, page-major [Pp, B, PS] so each grid
+    # step loads its page's [B, PS] slab
+    t = jnp.arange(Lp, dtype=jnp.int32)
+    ok = t[None, :] < pfx_len[:, None]                   # [B, Lp]
+    win = jnp.asarray(window, jnp.int32)
+    ok = jnp.logical_and(
+        ok,
+        jnp.logical_or((q_pos[:, None] - t[None, :]) < win, win <= 0),
+    )
+    ok_pg = (
+        ok.astype(jnp.float32).reshape(B, Pp, PS).swapaxes(0, 1)
+    )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(Pp,),
+        in_specs=[
+            pl.BlockSpec((B * NH, KD), lambda p, pages: (0, 0)),
+            # THE in-place read: this step's block is HBM page
+            # pages[p] of the pool, DMA'd by the pipeline itself
+            pl.BlockSpec((1, PS, KD), lambda p, pages: (pages[p], 0, 0)),
+            pl.BlockSpec((1, PS, KD), lambda p, pages: (pages[p], 0, 0)),
+            pl.BlockSpec((1, B, PS), lambda p, pages: (p, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((B * NH, 128), lambda p, pages: (0, 0)),
+            pl.BlockSpec((B * NH, 128), lambda p, pages: (0, 0)),
+            pl.BlockSpec((B * NH, KD), lambda p, pages: (0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((B * NH, 128), jnp.float32),
+            pltpu.VMEM((B * NH, 128), jnp.float32),
+            pltpu.VMEM((B * NH, KD), jnp.float32),
+        ],
+    )
+    m_o, l_o, acc_o = pl.pallas_call(
+        functools.partial(
+            _prefix_carry_kernel, scale=scale, n_heads=NH
+        ),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B * NH, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B * NH, 128), jnp.float32),
+            jax.ShapeDtypeStruct((B * NH, KD), jnp.float32),
+        ],
+        # the carry threads scratch state page to page: sequential grid
+        compiler_params=_CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(pfx_pages.astype(jnp.int32), q_bd, k_pages, v_pages, ok_pg)
+    m0 = m_o[:, 0].reshape(B, NH)
+    l0 = l_o[:, 0].reshape(B, NH)
+    # the kernel's value matmul fills every lane; only each row's own
+    # head block is meaningful — zero the off-blocks (XLA side) so the
+    # carry is exactly the XLA path's block-diagonal acc0 and group
+    # sums stay garbage-free
+    acc0 = acc_o.reshape(B, NH, KD) * blk[None]
+    return m0, l0, acc0
+
+
 # Below this table capacity (tokens) the XLA gather fallback wins on
 # grid/DMA overhead. With the in-kernel page walk the kernel's work is
 # proportional to ACTUAL context, so it wins essentially everywhere —

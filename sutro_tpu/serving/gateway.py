@@ -72,6 +72,10 @@ class InteractiveRequest:
     created_unix: int
     prompt_tokens: int
     _tok: Any = None
+    # leading prompt tokens whose KV was already resident in the radix
+    # prefix store at submit time (0 = cold / store off) — the warm-vs-
+    # cold TTFT attribution the bench and doctor read
+    warm_tokens: int = 0
 
     def decoder(self) -> Callable[[Optional[int]], str]:
         """Incremental token->text decoder for this request's stream.
@@ -175,6 +179,13 @@ class InteractiveGateway:
         else:
             # /v1/completions is raw continuation: no chat scaffold
             ids = tok.encode(sreq.prompt)
+
+        # warm-prefix probe (engine/prefixstore.py): a repeated system
+        # prompt / template shell means the session will prefill only
+        # the novel tail — recorded here so TTFT is attributable
+        warm = self.eng.prefix_warm_tokens(
+            engine_key, np.asarray(ids, np.int32)
+        )
 
         ecfg = self.eng.ecfg
         max_new = int(sreq.max_tokens or ecfg.max_new_tokens)
@@ -301,6 +312,7 @@ class InteractiveGateway:
                 created_unix=int(time.time()),
                 prompt_tokens=len(ids),
                 _tok=tok,
+                warm_tokens=int(warm),
             )
             self._pending.setdefault(engine_key, deque()).append(ir)
             self._active[rid] = ir
@@ -406,6 +418,11 @@ class InteractiveGateway:
             "starved": bool(starved and final != "cancelled"),
             "tokens": ch.n_tokens,
             "preempted_rows": ctx.stats.get("preempted", 0),
+            # submit-time probe + what the scheduler actually skipped
+            "warm_prefix_tokens": ir.warm_tokens,
+            "prefix_saved_tokens": int(
+                getattr(ctx, "prefix_saved", 0)
+            ),
         }
 
     # -- drain (SIGTERM path) ------------------------------------------

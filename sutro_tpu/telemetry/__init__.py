@@ -267,6 +267,25 @@ AUTOTUNE_ADJUSTMENTS_TOTAL = REGISTRY.counter(
     "autotuner",
     labels=("knob",),
 )
+PREFIX_STORE_HITS_TOTAL = REGISTRY.counter(
+    "sutro_prefix_store_hits_total",
+    "Radix prefix-store lookups that matched at least one KV page",
+)
+PREFIX_STORE_MISSES_TOTAL = REGISTRY.counter(
+    "sutro_prefix_store_misses_total",
+    "Radix prefix-store lookups that matched nothing",
+)
+PREFIX_STORE_EVICTIONS_TOTAL = REGISTRY.counter(
+    "sutro_prefix_store_evictions_total",
+    "Unpinned prefix-store pages evicted under allocation pressure",
+    unit="pages",
+)
+PREFIX_STORE_TOKENS_SAVED_TOTAL = REGISTRY.counter(
+    "sutro_prefix_store_prefill_tokens_saved_total",
+    "Prefill tokens skipped because their KV was already resident in "
+    "the prefix store",
+    unit="tokens",
+)
 
 # Span names the engine emits — OBSERVABILITY.md's span schema section
 # and tests key off this tuple, so additions land in one place.

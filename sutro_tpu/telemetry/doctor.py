@@ -459,6 +459,27 @@ def diagnose(
             + " joined the round late and absorbed re-sharded rows"
         )
 
+    # radix prefix store (engine/prefixstore.py): api stamps
+    # attrs["prefix"] with saved-vs-paid shell prefill tokens. A fully
+    # cold shell on a warm-capable engine is evidence (a repeat of this
+    # job would hit), not a verdict — prefill may still be cheap
+    # relative to decode.
+    pa = attrs.get("prefix") or {}
+    saved = pa.get("saved_tokens", 0)
+    paid = pa.get("paid_tokens", 0)
+    if saved:
+        evidence.append(
+            f"prefix store: {saved} shell prefill token(s) skipped "
+            f"(warm KV reused; {paid} paid for the novel tail)"
+        )
+    elif paid:
+        evidence.append(
+            f"prefix_cold: {paid} shared-prefix token(s) prefilled "
+            "with zero store hits — first job for this shell (repeats "
+            "will reuse its KV), or the store evicted it under "
+            "allocation pressure (sutro_prefix_store_evictions_total)"
+        )
+
     return {
         "version": DOCTOR_VERSION,
         "job_id": job_id,

@@ -485,3 +485,138 @@ def test_paged_decode_prefix_carry_injection(window, quantized):
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
     )
+
+
+@pytest.mark.parametrize("window", [0, 7, 2])
+def test_prefix_carry_pallas_matches_xla_gather(window):
+    """In-place prefix-carry kernel (page-indexed BlockSpecs reading the
+    shared pages straight from the pool) vs the XLA gather reference —
+    same (m, l, acc) carry, including windows that cut into the prefix
+    and rows outside the group (pfx_len 0). window=2 masks the WHOLE
+    prefix for every row: both paths must agree on the all-masked carry
+    (l == 0, acc == 0)."""
+    from sutro_tpu.ops.pallas_paged import (
+        prefix_attention_carry,
+        prefix_attention_carry_pallas,
+    )
+
+    rng = np.random.default_rng(11)
+    B, NH, KVH, Dh, PS, NP = 4, 4, 2, 16, 8, 40
+    n_pfx = 3
+    q = jnp.asarray(rng.standard_normal((B, NH, Dh)), jnp.float32)
+    k_pages = jnp.asarray(
+        rng.standard_normal((NP, PS, KVH * Dh)), jnp.float32
+    )
+    v_pages = jnp.asarray(
+        rng.standard_normal((NP, PS, KVH * Dh)), jnp.float32
+    )
+    pfx_pages = jnp.asarray([1, 2, 3], jnp.int32)
+    pfx_len = jnp.asarray(
+        [n_pfx * PS, n_pfx * PS, n_pfx * PS, 0], jnp.int32
+    )
+    q_pos = jnp.asarray([29, 35, 26, 17], jnp.int32)
+    win = jnp.asarray(window, jnp.int32)
+
+    m_ref, l_ref, a_ref = prefix_attention_carry(
+        q, k_pages, v_pages, pfx_pages, pfx_len, q_pos, win
+    )
+    m_got, l_got, a_got = prefix_attention_carry_pallas(
+        q, k_pages, v_pages, pfx_pages, pfx_len, q_pos, win,
+        interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(l_got), np.asarray(l_ref), rtol=2e-5, atol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(a_got), np.asarray(a_ref), rtol=2e-5, atol=2e-5
+    )
+    # m only matters where something was in range (l > 0); all-masked
+    # rows carry an arbitrary -inf-ish max in both implementations
+    live = np.asarray(l_ref) > 0
+    np.testing.assert_allclose(
+        np.asarray(m_got)[live], np.asarray(m_ref)[live],
+        rtol=2e-5, atol=2e-5,
+    )
+
+
+@pytest.mark.parametrize("window", [0, 7])
+def test_paged_decode_with_pallas_carry_injection(window):
+    """End-to-end: the in-place kernel's carry injected into the paged
+    decode kernel must match the plain kernel walking the full table —
+    the exact composition ops/attention.py runs on the split-prefix
+    decode path when prefix_carry_supported holds."""
+    from sutro_tpu.ops.pallas_paged import prefix_attention_carry_pallas
+
+    rng = np.random.default_rng(13)
+    B, NH, KVH, Dh, PS, MP, NP = 4, 4, 2, 16, 8, 6, 40
+    n_pfx = 3
+    q = jnp.asarray(rng.standard_normal((B, NH, Dh)), jnp.float32)
+    k_cur = jnp.asarray(rng.standard_normal((B, KVH, Dh)), jnp.float32)
+    v_cur = jnp.asarray(rng.standard_normal((B, KVH, Dh)), jnp.float32)
+    k_pages = jnp.asarray(
+        rng.standard_normal((NP, PS, KVH * Dh)), jnp.float32
+    )
+    v_pages = jnp.asarray(
+        rng.standard_normal((NP, PS, KVH * Dh)), jnp.float32
+    )
+    pfx_pages = np.array([1, 2, 3], np.int32)
+    table = np.zeros((B, MP), np.int32)
+    next_p = 4
+    for b in range(B):
+        if b < 3:
+            table[b, :n_pfx] = pfx_pages
+            table[b, n_pfx:] = np.arange(
+                next_p, next_p + (MP - n_pfx)
+            )
+            next_p += MP - n_pfx
+        else:
+            table[b] = np.arange(next_p, next_p + MP)
+            next_p += MP
+    past = np.array(
+        [n_pfx * PS + 5, n_pfx * PS + 11, n_pfx * PS + 2, 17], np.int32
+    )
+    table = jnp.asarray(table)
+    past_len = jnp.asarray(past)
+    win = jnp.asarray(window, jnp.int32)
+
+    ref = paged_decode_attention(
+        q, k_pages, v_pages, table, past_len, k_cur, v_cur, win, None,
+        interpret=True, cross_row=False,
+    )
+    pfx_len = jnp.asarray(
+        [n_pfx * PS, n_pfx * PS, n_pfx * PS, 0], jnp.int32
+    )
+    pfx_cnt = jnp.asarray([n_pfx, n_pfx, n_pfx, 0], jnp.int32)
+    m0, l0, acc0 = prefix_attention_carry_pallas(
+        q, k_pages, v_pages, jnp.asarray(pfx_pages), pfx_len,
+        past_len, win, interpret=True,
+    )
+    got = paged_decode_attention(
+        q, k_pages, v_pages, table, past_len, k_cur, v_cur, win, None,
+        interpret=True, cross_row=False,
+        pfx_cnt=pfx_cnt, m0=m0, l0=l0, acc0=acc0,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_prefix_carry_supported_flags():
+    """Shape gate for the in-place kernel: lane-aligned fused KV dim,
+    sublane-aligned page size, float pool only (int8 KV rides the XLA
+    gather fallback)."""
+    from sutro_tpu.ops.pallas_paged import prefix_carry_supported
+
+    q = jnp.zeros((2, 4, 128), jnp.float32)          # Dh lane-aligned
+    good = jnp.zeros((8, 8, 256), jnp.float32)
+    assert prefix_carry_supported(q, good)
+    assert not prefix_carry_supported(
+        jnp.zeros((2, 4, 16), jnp.float32),          # Dh = 16
+        jnp.zeros((8, 8, 32), jnp.float32),
+    )
+    assert not prefix_carry_supported(
+        q, jnp.zeros((8, 6, 256), jnp.float32)       # PS % 8 != 0
+    )
+    assert not prefix_carry_supported(
+        q, good, k_scale=jnp.zeros((8, 8), jnp.float32)
+    )

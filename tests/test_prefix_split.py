@@ -191,3 +191,37 @@ def test_engine_split_decode_matches_unsplit(byte_tok, monkeypatch):
     # layer lax.scan, and later dispatches reuse the compiled program),
     # so call COUNT is compilation count — n_split >= 1 is the signal
     assert n_split >= 1
+
+
+@pytest.mark.slow  # multi-device XLA compiles: excluded from the
+#   single-process tier-1 run (in-process compile accumulation is
+#   what trips this host's XLA:CPU flake, see run_tests_chunked.sh);
+#   the chunked full-suite CI runs it per-file
+def test_engine_split_decode_in_place_kernel(byte_tok, monkeypatch):
+    """Same engine path, but with the IN-PLACE prefix-carry kernel
+    (page-indexed BlockSpecs over the pool) forced on: the shape gate
+    is opened for the tiny test heads and the kernel runs in interpret
+    mode — outputs must still match the unsplit engine and the pallas
+    carry (not the XLA gather) must have been dispatched."""
+    from sutro_tpu.ops import pallas_paged
+
+    calls = []
+    real = pallas_paged.prefix_attention_carry_pallas
+
+    def record(*a, **kw):
+        calls.append(1)
+        kw["interpret"] = True
+        return real(*a, **kw)
+
+    monkeypatch.setattr(
+        pallas_paged, "prefix_carry_supported", lambda *a, **k: True
+    )
+    monkeypatch.setattr(
+        pallas_paged, "prefix_attention_carry_pallas", record
+    )
+    on = _run(byte_tok, True, monkeypatch)
+    assert calls, "split decode never used the in-place carry kernel"
+    calls.clear()
+    off = _run(byte_tok, False, monkeypatch)
+    assert not calls, "carry kernel ran with prefix_split disabled"
+    assert on == off, "in-place split decode changed greedy outputs"

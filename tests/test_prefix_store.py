@@ -1,0 +1,485 @@
+"""Engine-lifetime radix prefix store (engine/prefixstore.py).
+
+Cross-JOB KV reuse: the store keeps template-shell pages warm across
+batcher sessions so the second job (or co-batched, resumed, or
+interactive request) with the same shell prefills only its novel tail.
+The contract under test, in order of importance:
+
+1. ``SUTRO_PREFIX_STORE=0`` / no store => bit-identical to today's
+   per-job path (batch, co-batch, resume, interactive).
+2. Store on => the second identical-template job's prefill token count
+   drops by the warm shell (the ISSUE's >= 2x shared-shell bar).
+3. Page accounting is exact: pinned nodes never evict, eviction under
+   admission pressure loses zero rows, releasing a store returns every
+   page (a fresh batcher's free count equals the pristine pool).
+4. Fault site ``prefixstore.lookup`` degrades to a plain miss.
+"""
+
+import numpy as np
+import pytest
+
+from sutro_tpu.engine import faults
+from sutro_tpu.engine.kvcache import PageAllocator
+from sutro_tpu.engine.prefixstore import PrefixStore
+from sutro_tpu.engine.scheduler import ContinuousBatcher, GenRequest, JobCtx
+
+PREFIX = "You are a terse classifier. Decide the sentiment of this: "
+TAILS = ["great!", "bad movie", "meh", "totally awesome ride"]
+
+
+def _toks(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, 200, size=(n,)).astype(np.int32)
+
+
+# ---------------------------------------------------------------------
+# radix tree units (no model)
+# ---------------------------------------------------------------------
+
+
+def test_lookup_extend_release_roundtrip():
+    s = PrefixStore(8)
+    t = _toks(20)
+    miss = s.lookup_pin(t)
+    assert miss.tokens == 0 and miss.pages == []
+    h = s.empty_handle()
+    assert s.extend(h, t[:16], [3, 4])
+    assert h.pages == [3, 4] and h.tokens == 16
+    # the whole page-aligned head is warm; the ragged 4 tokens are not
+    hit = s.lookup_pin(t)
+    assert hit.tokens == 16 and hit.pages == [3, 4]
+    assert s.peek(t) == 16
+    assert s.n_pages == 2
+    s.release(h)
+    s.release(hit)
+    assert s.hits == 1 and s.misses == 1 and s.tokens_saved == 16
+
+
+def test_partial_match_pins_only_matched_path():
+    s = PrefixStore(4)
+    a = _toks(12, seed=1)
+    h = s.empty_handle()
+    assert s.extend(h, a, [10, 11, 12])
+    s.release(h)
+    # diverges after the first page
+    b = np.concatenate([a[:4], _toks(8, seed=2)])
+    hit = s.lookup_pin(b)
+    assert hit.tokens == 4 and hit.pages == [10]
+    # extend grafts the divergent run as a sibling branch
+    assert s.extend(hit, b[4:12], [20, 21])
+    assert s.n_pages == 5
+    s.release(hit)
+    again = s.lookup_pin(b)
+    assert again.pages == [10, 20, 21]
+    s.release(again)
+
+
+def test_extend_length_mismatch_raises():
+    s = PrefixStore(8)
+    with pytest.raises(ValueError):
+        s.extend(s.empty_handle(), _toks(16), [1, 2, 3])
+
+
+def test_extend_racer_declines_and_caller_keeps_pages():
+    s = PrefixStore(8)
+    t = _toks(16, seed=3)
+    h1 = s.empty_handle()
+    assert s.extend(h1, t, [5, 6])
+    # a second session prefilled the same run concurrently: its extend
+    # must decline so the caller frees its own (duplicate) pages
+    h2 = s.empty_handle()
+    assert not s.extend(h2, t, [7, 8])
+    assert h2.pages == [] and s.n_pages == 2
+    s.release(h1)
+
+
+def test_lru_eviction_order_and_leaf_only():
+    s = PrefixStore(4)
+    t = _toks(12, seed=4)
+    h = s.empty_handle()
+    assert s.extend(h, t, [1, 2, 3])  # chain 1 -> 2 -> 3
+    s.release(h)
+    # deepest leaf goes first; evicting it exposes its parent
+    assert s.evict(2) == [3, 2]
+    assert s.n_pages == 1
+    # a fresh branch touched LATER evicts after the stale root page
+    u = np.concatenate([t[:4], _toks(4, seed=5)])
+    h2 = s.lookup_pin(u)  # touches node 1
+    assert s.extend(h2, u[4:], [9])
+    s.release(h2)
+    # leaves are 9 (stamp newer) and ... 1 is interior; only 9 is a
+    # leaf until it goes, then 1
+    assert s.evict(10) == [9, 1]
+    assert s.n_pages == 0
+    assert s.evictions == 4
+
+
+def test_pinned_nodes_never_evict():
+    s = PrefixStore(8)
+    t = _toks(24, seed=6)
+    h = s.empty_handle()
+    assert s.extend(h, t, [1, 2, 3])
+    # handle still held: nothing may evict, however large the demand
+    assert s.evict(100) == []
+    assert s.n_pages == 3
+    s.release(h)
+    assert len(s.evict(100)) == 3
+
+
+def test_peek_does_not_touch_lru_or_counters():
+    s = PrefixStore(8)
+    a, b = _toks(8, seed=7), _toks(8, seed=8)
+    ha, hb = s.empty_handle(), s.empty_handle()
+    assert s.extend(ha, a, [1]) and s.extend(hb, b, [2])
+    s.release(ha)
+    s.release(hb)
+    hits, misses = s.hits, s.misses
+    for _ in range(5):
+        assert s.peek(a) == 8  # would re-stamp node 1 if it touched
+    assert (s.hits, s.misses) == (hits, misses)
+    # LRU order unchanged: 1 is still older than 2
+    assert s.evict(1) == [1]
+
+
+def test_close_drops_tree_and_refuses_extends():
+    s = PrefixStore(8)
+    t = _toks(16, seed=9)
+    h = s.empty_handle()
+    assert s.extend(h, t, [1, 2])
+    s.close()
+    assert s.n_pages == 0
+    assert s.lookup_pin(t).tokens == 0
+    assert not s.extend(s.empty_handle(), t, [3, 4])
+    assert s.peek(t) == 0
+
+
+def test_refcounts_under_concurrent_handles():
+    s = PrefixStore(8)
+    t = _toks(32, seed=10)
+    h = s.empty_handle()
+    assert s.extend(h, t, [1, 2, 3, 4])
+    handles = [s.lookup_pin(t) for _ in range(3)]
+    s.release(h)
+    assert s.evict(100) == []  # three pins outstanding
+    for x in handles[:-1]:
+        s.release(x)
+    assert s.evict(100) == []  # one pin outstanding
+    s.release(handles[-1])
+    assert sorted(s.evict(100)) == [1, 2, 3, 4]
+    # double release is a no-op, never an underflow
+    s.release(handles[-1])
+
+
+def test_page_allocator_reserve_atomic():
+    a = PageAllocator(num_pages=8)
+    free0 = a.free_count
+    a.reserve([2, 5])
+    assert a.free_count == free0 - 2
+    # not-free id => KeyError and NO partial mutation
+    with pytest.raises(KeyError):
+        a.reserve([3, 5])
+    assert a.free_count == free0 - 2
+    with pytest.raises(KeyError):
+        a.reserve([4, 4])  # duplicate
+    assert a.free_count == free0 - 2
+    a.free([2, 5])
+    assert a.free_count == free0
+
+
+# ---------------------------------------------------------------------
+# scheduler integration (tiny model; one session runner shared)
+# ---------------------------------------------------------------------
+
+
+def _reqs(tok, tails=TAILS, **kw):
+    kw.setdefault("max_new_tokens", 6)
+    kw.setdefault("temperature", 0.0)
+    return [
+        GenRequest(
+            row_id=i,
+            prompt_ids=np.array(tok.encode(PREFIX + t), np.int32),
+            **kw,
+        )
+        for i, t in enumerate(tails)
+    ]
+
+
+def _batcher(runner, tok, store=None):
+    return ContinuousBatcher(
+        runner, stop_ids=tok.stop_ids(), prefix_store=store
+    )
+
+
+def _run(b, reqs, **kw):
+    res = {}
+    out = b.run(
+        reqs, on_result=lambda r: res.__setitem__(r.row_id, r), **kw
+    )
+    return out, {i: r.token_ids for i, r in res.items()}
+
+
+def _alloc_pages(b, n):
+    """Allocate a page block on whichever allocator the batcher runs
+    (native runtime or the pure-Python fallback)."""
+    if b.native is not None:
+        pages = b.native.alloc_pages(n)
+        assert pages is not None
+        return pages
+    return b.allocator.alloc(n)
+
+
+def test_second_job_prefills_tail_only_and_bit_identical(
+    tiny_runner, byte_tok
+):
+    """The ISSUE's shared-shell bar: the second of two identical-
+    template jobs pays >= 2x fewer prefill tokens, with outputs
+    bit-identical to the storeless engine."""
+    store = PrefixStore(8)
+    b1 = _batcher(tiny_runner, byte_tok, store)
+    pristine = b1.free_page_count
+    _, r1 = _run(b1, _reqs(byte_tok))
+    paid1 = b1.prefill_tokens
+    assert store.n_pages > 0
+    # the store's pages are out of the session free list, not leaked
+    assert b1.free_page_count == pristine - store.n_pages
+
+    b2 = _batcher(tiny_runner, byte_tok, store)
+    assert b2.free_page_count == pristine - store.n_pages
+    _, r2 = _run(b2, _reqs(byte_tok))
+    assert b2.prefill_tokens <= paid1 / 2, (paid1, b2.prefill_tokens)
+    assert store.hits >= 1 and store.tokens_saved > 0
+
+    b_off = _batcher(tiny_runner, byte_tok)  # kill switch: no store
+    _, r_off = _run(b_off, _reqs(byte_tok))
+    assert r1 == r2 == r_off
+
+
+def test_cobatched_jobs_share_store_pages_bit_identical(
+    tiny_runner, byte_tok
+):
+    """Two co-batched jobs with the SAME shell: the second pins the
+    first's freshly inserted pages (same session!) and outputs match
+    the storeless co-batch."""
+
+    def cobatch(store):
+        b = _batcher(tiny_runner, byte_tok, store)
+        ga, gb = {}, {}
+        st = b.run_multi(
+            [
+                JobCtx(
+                    job_id="A", pending=_reqs(byte_tok),
+                    on_result=lambda r: ga.__setitem__(r.row_id, r),
+                    priority=1, seq=0,
+                ),
+                JobCtx(
+                    job_id="B",
+                    pending=_reqs(byte_tok, tails=["x", "yy", "zzz"]),
+                    on_result=lambda r: gb.__setitem__(r.row_id, r),
+                    priority=1, seq=1,
+                ),
+            ],
+            on_job_done=lambda c, o: None,
+        )
+        assert st == "completed"
+        return (
+            {i: r.token_ids for i, r in ga.items()},
+            {i: r.token_ids for i, r in gb.items()},
+            b,
+        )
+
+    store = PrefixStore(8)
+    on_a, on_b, b_on = cobatch(store)
+    off_a, off_b, b_off = cobatch(None)
+    assert on_a == off_a and on_b == off_b
+    assert b_on.prefill_tokens < b_off.prefill_tokens
+    # both jobs done: every node unpinned again
+    assert store.evict(10_000), "store should hold evictable pages"
+
+
+def test_resume_after_yield_on_fresh_batcher_bit_identical(
+    tiny_runner, byte_tok
+):
+    """Preemption yield, then resume on a FRESH batcher (the crash /
+    requeue path): the new session re-reserves the store's pages and
+    the warm re-run matches the storeless outputs."""
+    store = PrefixStore(8)
+    b1 = _batcher(tiny_runner, byte_tok, store)
+    pristine = b1.free_page_count
+    out, _ = _run(b1, _reqs(byte_tok), should_yield=lambda: True)
+    assert out == "yielded"
+    # yielded rows freed their pages; the store keeps the shell
+    assert b1.free_page_count == pristine - store.n_pages
+
+    b2 = _batcher(tiny_runner, byte_tok, store)
+    out, r2 = _run(b2, _reqs(byte_tok))
+    assert out == "completed"
+    assert set(r2) == set(range(len(TAILS)))
+
+    b_off = _batcher(tiny_runner, byte_tok)
+    _, r_off = _run(b_off, _reqs(byte_tok))
+    assert r2 == r_off
+
+
+def test_sampled_outputs_identical_with_row_seeds(
+    tiny_runner, byte_tok
+):
+    """Row-seeded sampling is batch-composition independent — a warm
+    store must not change a single sampled token."""
+    store = PrefixStore(8)
+    kw = dict(max_new_tokens=6, temperature=0.9, top_p=0.9)
+
+    def seeded():
+        reqs = _reqs(byte_tok, **kw)
+        for i, r in enumerate(reqs):
+            r.row_seed = i
+        return reqs
+
+    _run(_batcher(tiny_runner, byte_tok, store), seeded())  # seed it
+    _, warm = _run(_batcher(tiny_runner, byte_tok, store), seeded())
+    _, off = _run(_batcher(tiny_runner, byte_tok), seeded())
+    assert warm == off
+
+
+# ---------------------------------------------------------------------
+# chaos: eviction racing admission, fault degradation, close()
+# ---------------------------------------------------------------------
+
+
+def test_eviction_races_admission_pinned_never_evict(
+    tiny_runner, byte_tok
+):
+    """Bloat the store until the pool can't admit, then run a real job:
+    admission pressure must evict unpinned LRU pages (zero lost rows),
+    while a concurrently pinned path survives untouched."""
+    store = PrefixStore(8)
+    b = _batcher(tiny_runner, byte_tok, store)
+    pristine = b.free_page_count
+    # hand almost the whole pool to the store (distinct fake shells),
+    # exactly as a long engine lifetime would
+    n_bloat = pristine - 4
+    pages = _alloc_pages(b, n_bloat)
+    h = store.empty_handle()
+    assert store.extend(h, _toks(8 * n_bloat, seed=11), pages)
+    store.release(h)
+    # pin one path: these pages must survive the pressure below
+    pinned = store.lookup_pin(_toks(8 * n_bloat, seed=11)[:16])
+    assert len(pinned.pages) == 2
+    assert b.free_page_count == 4
+
+    out, res = _run(b, _reqs(byte_tok))
+    assert out == "completed"
+    assert set(res) == set(range(len(TAILS)))  # zero lost rows
+    assert store.evictions > 0
+    assert all(p in store.owned_pages() for p in pinned.pages)
+    store.release(pinned)
+    # conservation: session pages all came back; store pages stayed out
+    assert b.free_page_count == pristine - store.n_pages
+
+
+def test_lookup_fault_degrades_to_miss(tiny_runner, byte_tok):
+    """Fault site prefixstore.lookup: the job pays full prefill but
+    completes with bit-identical outputs — a store crash never fails
+    a job and never loses a row."""
+    store = PrefixStore(8)
+    _, r_warm = _run(
+        _batcher(tiny_runner, byte_tok, store), _reqs(byte_tok)
+    )
+    faults.configure("prefixstore.lookup:error")
+    try:
+        b = _batcher(tiny_runner, byte_tok, store)
+        out, r_faulted = _run(b, _reqs(byte_tok))
+        assert out == "completed"
+        # degraded to a miss: the shell was re-prefilled in full
+        assert b.prefill_tokens > 0
+    finally:
+        faults.clear()
+    assert r_faulted == r_warm
+    _, r_off = _run(_batcher(tiny_runner, byte_tok), _reqs(byte_tok))
+    assert r_faulted == r_off
+
+
+def test_close_returns_every_page_to_fresh_batcher(
+    tiny_runner, byte_tok
+):
+    """The teardown contract: after close(), a new batcher over the
+    surviving pool reserves nothing — free count returns to the
+    pristine pool size (no page leaked to a dead tree)."""
+    store = PrefixStore(8)
+    b1 = _batcher(tiny_runner, byte_tok, store)
+    pristine = b1.free_page_count
+    _run(b1, _reqs(byte_tok))
+    assert store.n_pages > 0
+    store.close()
+    b2 = _batcher(tiny_runner, byte_tok, store)
+    assert b2.free_page_count == pristine
+    # the closed store stays inert but harmless for the whole session
+    out, res = _run(b2, _reqs(byte_tok))
+    assert out == "completed" and len(res) == len(TAILS)
+    assert b2.free_page_count == pristine
+
+
+def test_mismatched_page_size_store_is_ignored(tiny_runner, byte_tok):
+    """A store whose page geometry doesn't match the batcher's pool is
+    detached entirely — the session runs the storeless per-job path
+    with nothing reserved and nothing leaked."""
+    store = PrefixStore(16)  # batcher pool uses kv_page_size=8
+    b = _batcher(tiny_runner, byte_tok, store)
+    pristine = b.free_page_count
+    out, res = _run(b, _reqs(byte_tok))
+    assert out == "completed" and len(res) == len(TAILS)
+    assert b.free_page_count == pristine  # nothing reserved or leaked
+
+
+# ---------------------------------------------------------------------
+# engine level: kill switch + interactive warm path (shared fixture)
+# ---------------------------------------------------------------------
+
+
+def test_engine_kill_switch_resolution(live_engine, monkeypatch):
+    eng, _url, _home = live_engine
+    key = "tiny-dense"
+    monkeypatch.setenv("SUTRO_PREFIX_STORE", "0")
+    assert eng._prefix_store_for(key) is None
+    monkeypatch.setenv("SUTRO_PREFIX_STORE", "off")
+    assert eng._prefix_store_for(key) is None
+    monkeypatch.delenv("SUTRO_PREFIX_STORE", raising=False)
+    store = eng._prefix_store_for(key)
+    assert store is not None
+    assert eng._prefix_store_for(key) is store  # one per engine key
+    # warm-token probe is total: cold store, unknown key, raw ids
+    assert eng.prefix_warm_tokens("no-such-key", [1, 2, 3]) == 0
+
+
+def test_interactive_repeat_request_hits_warm_prefix(live_engine):
+    """Second identical /v1/completions call: same text at temp 0, and
+    the gateway's submit-time probe sees the warm shell seeded by the
+    first (the interactive leg of the bit-identity matrix)."""
+    import json
+    import urllib.request
+
+    eng, url, _home = live_engine
+    body = json.dumps(
+        {
+            "model": "tiny-dense",
+            "prompt": PREFIX + "this is a wonderful product, truly",
+            "max_tokens": 8,
+            "temperature": 0.0,
+        }
+    ).encode()
+
+    def post():
+        req = urllib.request.Request(
+            url + "/v1/completions", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return json.loads(r.read())
+
+    first = post()
+    second = post()
+    t1 = first["choices"][0]["text"]
+    t2 = second["choices"][0]["text"]
+    assert t1 == t2  # warm KV is bit-identical to cold prefill
+    store = eng._prefix_stores.get("tiny-dense")
+    if store is not None:  # gateway probe saw the first call's shell
+        assert store.hits >= 1
