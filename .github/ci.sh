@@ -7,8 +7,12 @@ cd "$(dirname "$0")/.."
 make native
 make compile-check
 # tier-1 gate: graftlint static analysis vs the committed baseline —
-# any new lock-discipline / jit-purity / hygiene finding fails CI
+# any new lock-discipline / jit-purity / hygiene / resource-lifecycle /
+# kill-switch / wire-protocol / cardinality finding fails CI
 make lint
+# tier-1 gate: the committed wire-frame schema must match what the
+# dp/elastic senders actually produce
+make lint-schema
 # tier-1 gate: seeded chaos subset — deterministic fault injection must
 # keep reaching terminal states with partial-store consistency
 make chaos

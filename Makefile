@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: all native test test-oneshot test-fast compile-check lint lint-baseline \
-	chaos telemetry-check monitor-check control-check control-bench \
+	lint-schema chaos telemetry-check monitor-check control-check control-bench \
 	prefix-check bench bench-e2e serve-bench bench-trend dryrun \
 	chip-validate bench-8b cost golden host-profile clean
 
@@ -52,6 +52,14 @@ lint:
 # sutro_tpu/analysis/baseline.json before committing!)
 lint-baseline:
 	$(PY) -m sutro_tpu.analysis sutro_tpu --write-baseline
+
+# regenerate the dp/elastic wire-frame schema from the senders and fail
+# if the committed analysis/wire_schema.json drifted (CI runs this: a
+# frame/key change must land WITH its schema update — removals are then
+# caught by the wire-key-removed lint pass)
+lint-schema:
+	$(PY) -m sutro_tpu.analysis sutro_tpu --write-wire-schema
+	git diff --exit-code -- sutro_tpu/analysis/wire_schema.json
 
 # seeded chaos suite (FAILURES.md): deterministic fault injection
 # end-to-end — row quarantine (incl. the 256-row poison-row acceptance

@@ -2,15 +2,70 @@
 
 Exit codes: 0 clean (vs baseline unless ``--no-baseline``), 1 new
 findings, 2 usage/internal error.
+
+``--diff <git-ref>`` restricts the report to findings on lines changed
+vs the ref (fast pre-commit gate); ``--write-wire-schema`` regenerates
+``analysis/wire_schema.json`` from the current senders (``make
+lint-schema`` wraps it with an uncommitted-drift check).
 """
 
 from __future__ import annotations
 
 import argparse
+import re
+import subprocess
 import sys
 from pathlib import Path
+from typing import Dict, Set
 
-from . import core
+from . import core, protocol
+
+_HUNK_RE = re.compile(r"^@@ -\d+(?:,\d+)? \+(\d+)(?:,(\d+))? @@")
+
+
+def changed_lines(ref: str) -> Dict[str, Set[int]]:
+    """Repo-root-relative path -> 1-based added/changed line numbers in
+    the working tree vs ``ref`` (zero-context unified diff)."""
+    root = subprocess.run(
+        ["git", "rev-parse", "--show-toplevel"],
+        capture_output=True,
+        text=True,
+        check=True,
+    ).stdout.strip()
+    diff = subprocess.run(
+        ["git", "-C", root, "diff", "--unified=0", ref, "--", "*.py"],
+        capture_output=True,
+        text=True,
+        check=True,
+    ).stdout
+    out: Dict[str, Set[int]] = {}
+    cur: Set[int] = set()
+    for line in diff.splitlines():
+        if line.startswith("+++ "):
+            name = line[4:].strip()
+            if name.startswith("b/"):
+                name = name[2:]
+            cur = out.setdefault(name, set()) if name != "/dev/null" else set()
+        else:
+            m = _HUNK_RE.match(line)
+            if m:
+                start = int(m.group(1))
+                count = int(m.group(2)) if m.group(2) is not None else 1
+                cur.update(range(start, start + count))
+    return out
+
+
+def _to_root_rel(path: str) -> str:
+    try:
+        root = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True,
+            text=True,
+            check=True,
+        ).stdout.strip()
+        return Path(path).resolve().relative_to(Path(root)).as_posix()
+    except (subprocess.CalledProcessError, ValueError, OSError):
+        return path
 
 
 def main(argv=None) -> int:
@@ -54,6 +109,19 @@ def main(argv=None) -> int:
         "--list-rules", action="store_true", help="print the rule catalog"
     )
     ap.add_argument(
+        "--diff",
+        metavar="GIT_REF",
+        default=None,
+        help="report only findings on lines changed vs GIT_REF "
+        "(ignores the baseline; exit 1 if any)",
+    )
+    ap.add_argument(
+        "--write-wire-schema",
+        action="store_true",
+        help="regenerate analysis/wire_schema.json from the current "
+        "dp/elastic senders and exit 0",
+    )
+    ap.add_argument(
         "-v",
         "--verbose",
         action="store_true",
@@ -78,10 +146,40 @@ def main(argv=None) -> int:
             return 2
 
     try:
-        active, suppressed, _index = core.analyze(paths, rules or None)
+        active, suppressed, index = core.analyze(paths, rules or None)
     except SyntaxError as e:
         print(f"graftlint: parse error: {e}", file=sys.stderr)
         return 2
+
+    if args.write_wire_schema:
+        doc = protocol.write_schema(index)
+        print(
+            f"graftlint: wrote {len(doc['frames'])} frame type(s) to "
+            f"{protocol.DEFAULT_SCHEMA_PATH}"
+        )
+        return 0
+
+    if args.diff is not None:
+        try:
+            changed = changed_lines(args.diff)
+        except (subprocess.CalledProcessError, OSError) as e:
+            print(f"graftlint: git diff failed: {e}", file=sys.stderr)
+            return 2
+        hits = [
+            f
+            for f in active
+            if f.line in changed.get(_to_root_rel(f.path), ())
+        ]
+        if args.format == "json":
+            print(core.render_json(hits, suppressed_count=len(suppressed)))
+        else:
+            for f in hits:
+                print(f.render())
+            print(
+                f"graftlint: {len(hits)} finding(s) on lines changed "
+                f"vs {args.diff}"
+            )
+        return 1 if hits else 0
 
     baseline_path = Path(args.baseline)
     if args.write_baseline:

@@ -9,6 +9,11 @@ resolves within the enclosing class, bare names through the lexical
 chain then module then imports, ``alias.func()`` through the import
 map. Attribute chains on arbitrary objects (``self.eng.jobs.flush``)
 do not resolve — the passes treat unresolvable calls as opaque.
+
+``FlowWalker`` adds path-sensitive return-path and exception-edge
+tracking over a single function body (loops unrolled once, Try routing
+with finalbody replay on every exit) — the substrate for the
+resource-lifecycle pass.
 """
 
 from __future__ import annotations
@@ -278,6 +283,234 @@ class _Indexer(ast.NodeVisitor):
         if node.value is not None:
             self._record_assign(node.target, node.value)
         self.generic_visit(node)
+
+
+def calls_in(node: ast.AST, skip_nested: bool = True):
+    """Every ast.Call under ``node``, excluding (by default) calls that
+    only run inside nested function/class definitions — those execute
+    later, not on this statement's path."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if skip_nested and n is not node and isinstance(
+            n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+        ):
+            continue
+        if isinstance(n, ast.Call):
+            yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def names_in(node: ast.AST) -> Set[str]:
+    """Bare names referenced anywhere under ``node`` (incl. nested
+    defs: a closure capturing a variable keeps it alive/escaped)."""
+    return {
+        n.id for n in ast.walk(node) if isinstance(n, ast.Name)
+    }
+
+
+# -- path-sensitive flow walking ---------------------------------------
+#
+# Exception-edge and return-path tracking over a function body: the
+# walker enumerates execution paths statement by statement, modelling
+# If/For/While branching (loops unrolled once), Try routing (exception
+# edges flow to handlers, finalbody runs on every exit), and the three
+# exit kinds the lifecycle passes care about — explicit ``return``,
+# explicit ``raise``, and implicit exception edges escaping from calls.
+# Subclasses own the state object and the per-statement effects; the
+# walker owns control flow.
+
+_MAX_FLOW_STATES = 48  # per-block path cap; beyond it paths are dropped
+
+# exit kinds delivered to on_exit()
+EXIT_RETURN = "return"
+EXIT_RAISE = "raise"
+EXIT_EXCEPTION = "exception"  # implicit: a call on the path may raise
+EXIT_FALLTHROUGH = "fallthrough"
+
+_LOOP_EXITS = ("break", "continue")
+
+
+class FlowWalker:
+    """Subclass contract:
+
+    - ``copy_state(state)``: independent copy for a forked path.
+    - ``state_key(state)``: hashable dedupe key (paths with equal keys
+      merge; keeps path count bounded).
+    - ``on_stmt(state, stmt)``: apply a simple statement's effects.
+    - ``stmt_may_raise(state, stmt)``: True if an exception edge should
+      fork off *before* the statement's effects apply.
+    - ``assume(state, test, truth)``: refine ``state`` under branch
+      condition ``test`` being ``truth``; return None for infeasible.
+    - ``on_exit(state, kind, node)``: a path leaves the function
+      (finalbodies already applied). ``kind`` is one of EXIT_*.
+    """
+
+    # -- subclass hooks ------------------------------------------------
+    def copy_state(self, state):  # pragma: no cover - trivial default
+        return dict(state)
+
+    def state_key(self, state):  # pragma: no cover - trivial default
+        return repr(state)
+
+    def on_stmt(self, state, stmt) -> None:
+        pass
+
+    def stmt_may_raise(self, state, stmt) -> bool:
+        return False
+
+    def assume(self, state, test, truth: bool):
+        return state
+
+    def on_exit(self, state, kind: str, node: ast.AST) -> None:
+        pass
+
+    # -- driver --------------------------------------------------------
+    def run(self, body: List[ast.stmt]) -> None:
+        """Walk a function body from a fresh initial state."""
+        states, exits = self._exec_block(body, [self.initial_state()])
+        for st in states:
+            self.on_exit(st, EXIT_FALLTHROUGH, body[-1] if body else None)
+        for kind, node, st in exits:
+            if kind in _LOOP_EXITS:  # stray break/continue: treat as end
+                self.on_exit(st, EXIT_FALLTHROUGH, node)
+            else:
+                self.on_exit(st, kind, node)
+
+    def initial_state(self):  # pragma: no cover - trivial default
+        return {}
+
+    def _dedupe(self, states):
+        out, seen = [], set()
+        for st in states:
+            k = self.state_key(st)
+            if k not in seen:
+                seen.add(k)
+                out.append(st)
+            if len(out) >= _MAX_FLOW_STATES:
+                break
+        return out
+
+    def _exec_block(self, stmts, states):
+        """Returns ``(fallthrough_states, exits)`` where exits is a list
+        of ``(kind, node, state)`` propagating past this block."""
+        exits: List[Tuple[str, ast.AST, object]] = []
+        for stmt in stmts:
+            if not states:
+                break
+            next_states: List[object] = []
+            for st in states:
+                ft, ex = self._exec_stmt(stmt, st)
+                next_states.extend(ft)
+                exits.extend(ex)
+            states = self._dedupe(next_states)
+        return states, exits
+
+    def _exec_stmt(self, stmt, state):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            self.on_stmt(state, stmt)  # closures can capture/escape vars
+            return [state], []
+        if isinstance(stmt, ast.Return):
+            return [], [(EXIT_RETURN, stmt, state)]
+        if isinstance(stmt, ast.Raise):
+            return [], [(EXIT_RAISE, stmt, state)]
+        if isinstance(stmt, ast.Break):
+            return [], [("break", stmt, state)]
+        if isinstance(stmt, ast.Continue):
+            return [], [("continue", stmt, state)]
+        if isinstance(stmt, ast.If):
+            return self._exec_if(stmt, state)
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            return self._exec_loop(stmt, state)
+        if isinstance(stmt, ast.Try):
+            return self._exec_try(stmt, state)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._exec_with(stmt, state)
+        # simple statement: fork the exception edge off the pre-effect
+        # state, then apply effects to the surviving path
+        exits = []
+        if self.stmt_may_raise(state, stmt):
+            exits.append((EXIT_EXCEPTION, stmt, self.copy_state(state)))
+        self.on_stmt(state, stmt)
+        return [state], exits
+
+    def _exec_if(self, stmt, state):
+        t = self.assume(self.copy_state(state), stmt.test, True)
+        f = self.assume(state, stmt.test, False)
+        states, exits = [], []
+        if t is not None:
+            ft, ex = self._exec_block(stmt.body, [t])
+            states.extend(ft)
+            exits.extend(ex)
+        if f is not None:
+            ft, ex = self._exec_block(stmt.orelse, [f])
+            states.extend(ft)
+            exits.extend(ex)
+        return states, exits
+
+    def _exec_loop(self, stmt, state):
+        zero = self.copy_state(state)  # zero-iteration path
+        self.on_stmt(state, stmt)  # loop header effects (For target etc.)
+        ft, ex = self._exec_block(stmt.body, [state])
+        states = [zero]
+        exits = []
+        for kind, node, st in ex:
+            if kind in _LOOP_EXITS:
+                states.append(st)  # break/continue end up after the loop
+            else:
+                exits.append((kind, node, st))
+        states.extend(ft)  # one-iteration fallthrough
+        if stmt.orelse:
+            states, ex2 = self._exec_block(stmt.orelse, self._dedupe(states))
+            exits.extend(ex2)
+        return self._dedupe(states), exits
+
+    def _exec_with(self, stmt, state):
+        exits = []
+        if self.stmt_may_raise(state, stmt):
+            exits.append((EXIT_EXCEPTION, stmt, self.copy_state(state)))
+        self.on_stmt(state, stmt)
+        ft, ex = self._exec_block(stmt.body, [state])
+        exits.extend(ex)
+        return ft, exits
+
+    def _exec_try(self, stmt, state):
+        body_ft, body_ex = self._exec_block(stmt.body, [state])
+        after: List[object] = []
+        exits: List[Tuple[str, ast.AST, object]] = []
+        caught: List[object] = []
+        for kind, node, st in body_ex:
+            if kind in (EXIT_EXCEPTION, EXIT_RAISE) and stmt.handlers:
+                caught.append(st)
+            else:
+                exits.append((kind, node, st))
+        # handlers: conservatively assume a present handler catches the
+        # edge (broad excepts dominate this codebase); a Raise inside
+        # the handler body re-escapes naturally
+        if caught and stmt.handlers:
+            for h in stmt.handlers:
+                for st in self._dedupe(caught):
+                    hst = self.copy_state(st)
+                    self.on_stmt(hst, h)  # ``except E as e:`` binding
+                    ft, ex = self._exec_block(h.body, [hst])
+                    after.extend(ft)
+                    exits.extend(ex)
+        if stmt.orelse:
+            body_ft, ex = self._exec_block(stmt.orelse, body_ft)
+            exits.extend(ex)
+        after.extend(body_ft)
+        if stmt.finalbody:
+            # finalbody runs on normal completion AND on every
+            # propagating exit; its own exits replace the pending one
+            after, fex = self._exec_block(stmt.finalbody, self._dedupe(after))
+            exits_out: List[Tuple[str, ast.AST, object]] = list(fex)
+            for kind, node, st in exits:
+                ft, fex2 = self._exec_block(stmt.finalbody, [st])
+                exits_out.extend(fex2)
+                for st2 in ft:
+                    exits_out.append((kind, node, st2))
+            exits = exits_out
+        return self._dedupe(after), exits
 
 
 class PackageIndex:

@@ -11,6 +11,15 @@ families over a shared AST index:
                decode window
 - ``hygiene``  threads that are neither daemon nor joined with a
                bounded timeout; silently swallowed exceptions
+- ``resources`` path-sensitive acquire/release lifecycle over the
+               engine's refcounted resources (pages, pins, channels,
+               sockets, threads, slots)
+- ``protocol`` dp/elastic wire-frame additivity vs the checked-in
+               ``wire_schema.json``; unknown-key-tolerant parsing
+- ``killswitch`` env-gated subsystems must gate side-effecting calls
+               behind their flag (taint-walked from the env read)
+- ``cardinality`` telemetry label values vs declared fixed-cardinality
+               series budgets
 
 Findings are fingerprinted by (rule, path, enclosing symbol, stable
 detail key) — NOT by line number — so unrelated edits don't invalidate
@@ -55,6 +64,22 @@ RULES = {
     "logging or re-raising",
     "unbounded-retry": "retry loop with no attempt/deadline bound or "
     "no (growing) backoff sleep between attempts",
+    "resource-leak": "acquired resource (pages/pin/channel/socket/"
+    "thread/slot) escapes a function exit path without its paired "
+    "release",
+    "resource-double-release": "resource released twice on one path "
+    "(free-list / refcount corruption)",
+    "wire-key-removed": "dp/elastic wire frame or key present in "
+    "wire_schema.json is no longer produced (frames are strictly "
+    "additive)",
+    "wire-strict-parse": "frame parser rejects unknown keys instead "
+    "of ignoring them (breaks protocol additivity)",
+    "killswitch-ungated": "side-effecting call into an env-gated "
+    "subsystem not dominated by its kill-switch flag check",
+    "telemetry-cardinality": "metric label value outside the declared "
+    "fixed-cardinality budget (or identifier-shaped)",
+    "stale-suppression": "graftlint disable pragma that no longer "
+    "masks any finding",
 }
 
 _SUPPRESS_RE = re.compile(
@@ -94,33 +119,108 @@ class Finding:
         )
 
 
-def _suppressed_rules(lines: Sequence[str], line: int) -> set:
-    """Rules disabled at 1-based ``line`` (same line or the line above)."""
-    out: set = set()
-    for ln in (line, line - 1):
-        if 1 <= ln <= len(lines):
-            m = _SUPPRESS_RE.search(lines[ln - 1])
-            if m:
-                out.update(
-                    r.strip() for r in m.group(1).split(",") if r.strip()
-                )
+def pragma_map(lines: Sequence[str]) -> Dict[int, List[str]]:
+    """1-based line -> rule tokens of ``# graftlint: disable=`` pragmas.
+
+    Comments only (via ``tokenize``): a pragma *example* inside a
+    docstring neither suppresses nor counts as stale. Falls back to a
+    per-line regex when tokenization fails (syntactically odd input).
+    """
+    import io
+    import tokenize
+
+    src = "\n".join(lines)
+    out: Dict[int, List[str]] = {}
+
+    def record(lineno: int, text: str) -> None:
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            toks = [r.strip() for r in m.group(1).split(",") if r.strip()]
+            if toks:
+                out.setdefault(lineno, []).extend(toks)
+
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+            if tok.type == tokenize.COMMENT:
+                record(tok.start[0], tok.string)
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        out.clear()
+        for i, text in enumerate(lines, start=1):
+            record(i, text)
     return out
+
+
+def _index_pragmas(index: PackageIndex) -> Dict[str, Dict[int, List[str]]]:
+    cache = getattr(index, "_graftlint_pragmas", None)
+    if cache is None:
+        cache = {
+            m.path: pragma_map(m.lines) for m in index.modules.values()
+        }
+        index._graftlint_pragmas = cache  # type: ignore[attr-defined]
+    return cache
 
 
 def apply_suppressions(
     index: PackageIndex, findings: Iterable[Finding]
 ) -> "tuple[List[Finding], List[Finding]]":
-    """Split findings into (active, suppressed) per inline pragmas."""
+    """Split findings into (active, suppressed) per inline pragmas (on
+    the finding's line or the line above)."""
     active: List[Finding] = []
     suppressed: List[Finding] = []
-    by_path = {m.path: m.lines for m in index.modules.values()}
+    pragmas = _index_pragmas(index)
     for f in findings:
-        rules = _suppressed_rules(by_path.get(f.path, ()), f.line)
+        per_path = pragmas.get(f.path, {})
+        rules: set = set()
+        for ln in (f.line, f.line - 1):
+            rules.update(per_path.get(ln, ()))
         if "all" in rules or f.rule in rules:
             suppressed.append(f)
         else:
             active.append(f)
     return active, suppressed
+
+
+def stale_suppression_findings(
+    index: PackageIndex, suppressed: Sequence[Finding]
+) -> List[Finding]:
+    """Pragmas whose rule tokens masked nothing: each becomes a
+    ``stale-suppression`` finding (suppressions must earn their keep,
+    or the next real finding at that site is silently eaten)."""
+    used: set = set()  # (path, pragma_line, rule_token)
+    pragmas = _index_pragmas(index)
+    for f in suppressed:
+        per_path = pragmas.get(f.path, {})
+        for ln in (f.line, f.line - 1):
+            toks = per_path.get(ln, ())
+            if f.rule in toks:
+                used.add((f.path, ln, f.rule))
+            elif "all" in toks:
+                used.add((f.path, ln, "all"))
+    out: List[Finding] = []
+    mod_names = {m.path: m.name for m in index.modules.values()}
+    for path, per_path in pragmas.items():
+        for line, toks in per_path.items():
+            for tok in toks:
+                if (path, line, tok) in used:
+                    continue
+                why = (
+                    "unknown rule"
+                    if tok != "all" and tok not in RULES
+                    else "masks no finding"
+                )
+                out.append(
+                    Finding(
+                        rule="stale-suppression",
+                        path=path,
+                        line=line,
+                        message=f"suppression `disable={tok}` {why} — "
+                        "remove it (dead pragmas silently eat the next "
+                        "real finding here)",
+                        symbol=mod_names.get(path, path),
+                        key=tok,
+                    )
+                )
+    return out
 
 
 # -- scanning ----------------------------------------------------------
@@ -150,12 +250,24 @@ def build_index(paths: Sequence[str]) -> PackageIndex:
 def run_passes(
     index: PackageIndex, rules: Optional[Sequence[str]] = None
 ) -> List[Finding]:
-    from . import hygiene, jitpure, locks
+    from . import (
+        cardinality,
+        hygiene,
+        jitpure,
+        killswitch,
+        locks,
+        protocol,
+        resources,
+    )
 
     findings: List[Finding] = []
     findings.extend(locks.run(index))
     findings.extend(jitpure.run(index))
     findings.extend(hygiene.run(index))
+    findings.extend(resources.run(index))
+    findings.extend(protocol.run(index))
+    findings.extend(killswitch.run(index))
+    findings.extend(cardinality.run(index))
     if rules:
         keep = set(rules)
         findings = [f for f in findings if f.rule in keep]
@@ -170,6 +282,11 @@ def analyze(
     index = build_index(paths)
     findings = run_passes(index, rules)
     active, suppressed = apply_suppressions(index, findings)
+    stale = stale_suppression_findings(index, suppressed)
+    if rules:
+        stale = [f for f in stale if f.rule in set(rules)]
+    active.extend(stale)
+    active.sort(key=lambda f: (f.path, f.line, f.rule, f.key))
     return active, suppressed, index
 
 

@@ -246,6 +246,13 @@ class PriorityLadder:
         self._first_seen.pop(ctx.seq, None)
 
 
+def _prio_label(p: int) -> str:
+    """Bounded label domain for priority metrics: the ladder lives in a
+    small integer window; anything outside collapses to one series so a
+    caller passing arbitrary ints can't mint unbounded label values."""
+    return str(int(p)) if -1 <= int(p) <= 8 else "other"
+
+
 class ControlPlane:
     """Admission buckets + ladder policy + autotuner, one per engine."""
 
@@ -501,7 +508,7 @@ class ControlPlane:
 
         if telemetry.ENABLED:
             telemetry.PREEMPTIONS_TOTAL.inc(
-                1.0, str(from_prio), str(to_prio)
+                1.0, _prio_label(from_prio), _prio_label(to_prio)
             )
 
     # -- autotuner -----------------------------------------------------

@@ -915,12 +915,7 @@ def serve_resume_round(
                     attempt, 0.2, 2.0, key=f"dp-resume-bind:{job_key}"
                 )
             )
-    rows = sorted(done_rows or ())
     threads: List[threading.Thread] = []
-    # OVERALL deadline, not per-accept: a foreign-job rank retrying
-    # every 0.5s would otherwise reset a per-accept timeout forever,
-    # keeping this port bound past the window
-    deadline = _time.monotonic() + grace
 
     def drain(conn: socket.socket, lines, rank: int) -> None:
         try:
@@ -946,6 +941,13 @@ def serve_resume_round(
             conn.close()
 
     try:
+        # everything from here runs under the finally that closes the
+        # listener — the bound port must never outlive this round
+        rows = sorted(done_rows or ())
+        # OVERALL deadline, not per-accept: a foreign-job rank retrying
+        # every 0.5s would otherwise reset a per-accept timeout forever,
+        # keeping this port bound past the window
+        deadline = _time.monotonic() + grace
         accepted = 0
         while accepted < world.world - 1:
             left = deadline - _time.monotonic()
@@ -996,9 +998,11 @@ def serve_resume_round(
             t.start()
             threads.append(t)
     finally:
+        # port first: the next round's bind must not wait out the
+        # drain-thread joins below (up to 60 s each)
+        listener.close()
         for t in threads:
             t.join(timeout=60.0)
-        listener.close()
     return True
 
 
@@ -1431,10 +1435,6 @@ def run_dp_coordinator(
     wire."""
     import time as _tmod
 
-    listener = socket.create_server(
-        (world.host, world.port), reuse_port=False
-    )
-    listener.settimeout(_ACCEPT_TIMEOUT_S)
     accept_stop = threading.Event()
     n_workers = world.world - 1
     conns: List[socket.socket] = []
@@ -1680,6 +1680,18 @@ def run_dp_coordinator(
         # partial merges) — the callback IS the critical section
         with emit_lock:
             on_progress(merged)  # graftlint: disable=lock-callback
+
+    # bound immediately before its consumers (the acceptor thread and
+    # the closing ``finally``) so no setup statement can raise between
+    # the bind and the paths that guarantee the port is released
+    listener = socket.create_server(
+        (world.host, world.port), reuse_port=False
+    )
+    try:
+        listener.settimeout(_ACCEPT_TIMEOUT_S)
+    except OSError:
+        listener.close()  # never strand the bound port
+        raise
 
     def accept_all() -> None:
         # synchronous handshake per connection: only hellos carrying

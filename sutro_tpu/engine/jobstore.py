@@ -330,9 +330,13 @@ class JobStore:
         ev = {"ts": _now(), **event}
         if telemetry.ENABLED:
             # the single funnel every retry/quarantine/terminal event
-            # passes through — one counter covers them all
+            # passes through — one counter covers them all. The label
+            # domain is the fixed event-kind vocabulary; a non-string
+            # (malformed caller) collapses to one series instead of
+            # str()-coercing arbitrary objects into label values.
+            kind = event.get("event")
             telemetry.ROW_EVENTS_TOTAL.inc(
-                1.0, str(event.get("event", "unknown"))
+                1.0, kind if isinstance(kind, str) else "unknown"
             )
         try:
             # inline RMW (``update`` would re-take the non-reentrant

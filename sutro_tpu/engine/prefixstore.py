@@ -144,13 +144,15 @@ class PrefixStore:
             if nodes:
                 self.hits += 1
                 self.tokens_saved += h.tokens
-                telemetry.PREFIX_STORE_HITS_TOTAL.inc(1.0)
-                telemetry.PREFIX_STORE_TOKENS_SAVED_TOTAL.inc(
-                    float(h.tokens)
-                )
+                if telemetry.ENABLED:
+                    telemetry.PREFIX_STORE_HITS_TOTAL.inc(1.0)
+                    telemetry.PREFIX_STORE_TOKENS_SAVED_TOTAL.inc(
+                        float(h.tokens)
+                    )
             else:
                 self.misses += 1
-                telemetry.PREFIX_STORE_MISSES_TOTAL.inc(1.0)
+                if telemetry.ENABLED:
+                    telemetry.PREFIX_STORE_MISSES_TOTAL.inc(1.0)
             return h
 
     def extend(
@@ -249,7 +251,7 @@ class PrefixStore:
                 self._n_pages -= 1
                 freed.append(victim.page)
                 self.evictions += 1
-            if freed:
+            if freed and telemetry.ENABLED:
                 telemetry.PREFIX_STORE_EVICTIONS_TOTAL.inc(
                     float(len(freed))
                 )

@@ -402,7 +402,10 @@ class ContinuousBatcher:
                     ok = self.native.reserve_pages(owned)
                 else:
                     try:
-                        self.allocator.reserve(owned)
+                        # the store keeps ownership of these pages (it
+                        # frees them via reset()/eviction); reserve only
+                        # marks them busy in the allocator's free list
+                        self.allocator.reserve(owned)  # graftlint: disable=resource-leak
                     except KeyError:
                         ok = False
             if ok:
@@ -584,45 +587,58 @@ class ContinuousBatcher:
                 if not handle.nodes:
                     handle = None
             except Exception:
+                logger.debug(
+                    "prefix-store lookup failed; treating as miss",
+                    exc_info=True,
+                )
                 handle = None
-        hit_pages = list(handle.pages) if handle is not None else []
-        hit = len(hit_pages) * PS
-        tail_n = n_pages - len(hit_pages)
-        # don't let the prefix starve admission: after taking its NEW
-        # pages the WIDEST pending row must still fit. Under pressure,
-        # unpinned LRU store pages are evicted back into the free list
-        # first — live jobs always win over cached shells.
-        worst_own = max(
-            pages_needed(self._max_total(r), PS) for r in pending
-        ) - n_pages
-        need_free = tail_n + max(worst_own, 1)
-        if self.free_page_count < need_free:
-            self._evict_store_pages(need_free - self.free_page_count)
-        if self.free_page_count < need_free:
-            if handle is not None:
-                store.release(handle)
-            return
-        if tail_n == 0:
-            # full warm hit: nothing to prefill, nothing to insert
-            ctx.prefix = _SharedPrefix(
-                tokens=shared, pages=hit_pages, handle=handle,
-                own_pages=[],
-            )
-            ctx.prefix_saved += shared
-            return
-        if self.native is not None:
-            pages = self.native.alloc_pages(tail_n)
-            if pages is None:
+        try:
+            hit_pages = list(handle.pages) if handle is not None else []
+            hit = len(hit_pages) * PS
+            tail_n = n_pages - len(hit_pages)
+            # don't let the prefix starve admission: after taking its
+            # NEW pages the WIDEST pending row must still fit. Under
+            # pressure, unpinned LRU store pages are evicted back into
+            # the free list first — live jobs always win over cached
+            # shells.
+            worst_own = max(
+                pages_needed(self._max_total(r), PS) for r in pending
+            ) - n_pages
+            need_free = tail_n + max(worst_own, 1)
+            if self.free_page_count < need_free:
+                self._evict_store_pages(need_free - self.free_page_count)
+            if self.free_page_count < need_free:
                 if handle is not None:
                     store.release(handle)
                 return
-        else:
-            pages = self.allocator.alloc(tail_n)
-        table = np.zeros((self.MP,), np.int32)
-        table[: len(hit_pages)] = hit_pages
-        table[len(hit_pages) : n_pages] = pages
+            if tail_n == 0:
+                # full warm hit: nothing to prefill, nothing to insert
+                ctx.prefix = _SharedPrefix(
+                    tokens=shared, pages=hit_pages, handle=handle,
+                    own_pages=[],
+                )
+                ctx.prefix_saved += shared
+                return
+            if self.native is not None:
+                pages = self.native.alloc_pages(tail_n)
+                if pages is None:
+                    if handle is not None:
+                        store.release(handle)
+                    return
+            else:
+                pages = self.allocator.alloc(tail_n)
+        except Exception:
+            # eviction/allocation raising must not strand the pin — a
+            # handle that never unpins blocks those pages from eviction
+            # for the life of the store
+            if handle is not None:
+                store.release(handle)
+            raise
         paid = shared - hit
         try:
+            table = np.zeros((self.MP,), np.int32)
+            table[: len(hit_pages)] = hit_pages
+            table[len(hit_pages) : n_pages] = pages
             if self._tel_on:
                 attrs = {"tokens": int(paid)}
                 if store is not None:
@@ -639,20 +655,36 @@ class ContinuousBatcher:
                     start=hit,
                 )
         except Exception:
-            self._free_prefix_pages(pages)
+            # pin first (a cheap decref that cannot fail), pages second
+            # — if the page free itself raises, the pin must already be
+            # returned
             if handle is not None:
                 store.release(handle)
+            self._free_prefix_pages(pages)
             raise
         self.prefill_tokens += paid
         ctx.prefix_saved += hit
         ctx.prefix_paid += paid
-        own = list(pages)
-        if store is not None:
-            h = handle if handle is not None else store.empty_handle()
-            if store.extend(h, first[hit:shared], list(pages)):
-                handle, own = h, []  # tail ownership moved to the store
-            # extend declined (closed store): the tail stays session-
-            # owned; a non-empty original handle still pins the head
+        try:
+            own = list(pages)
+            if store is not None:
+                h = (
+                    handle if handle is not None else store.empty_handle()
+                )
+                if store.extend(h, first[hit:shared], list(pages)):
+                    handle, own = h, []  # tail ownership moved to the
+                    #                      store
+                # extend declined (closed store): the tail stays
+                # session-owned; a non-empty original handle still pins
+                # the head
+        except Exception:
+            # a store raise mid-extend must not strand the pin (or the
+            # freshly prefilled tail pages, which the store declined);
+            # pin first — it cannot fail
+            if handle is not None:
+                store.release(handle)
+            self._free_prefix_pages(pages)
+            raise
         if handle is not None and not handle.nodes:
             handle = None
         ctx.prefix = _SharedPrefix(

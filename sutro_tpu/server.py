@@ -426,6 +426,13 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
             self._json(oai.collect(ir, chat=chat))
         except RuntimeError as e:
             self._openai_error(500, str(e), "server_error")
+        except Exception as e:  # noqa: BLE001 — request isolation: a
+            # non-RuntimeError (decoder bug, malformed record) used to
+            # propagate past the channel teardown and wedge the row
+            logger.warning("openai collect failed", exc_info=True)
+            self._openai_error(
+                500, f"{type(e).__name__}: {e}", "server_error"
+            )
 
     def _stream_openai(self, ir: Any, chat: bool) -> None:
         """SSE token stream over manual chunked framing (same transfer

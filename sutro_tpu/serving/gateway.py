@@ -210,12 +210,15 @@ class InteractiveGateway:
                     400, f"response_format schema rejected: {e}"
                 ) from e
 
-        channel = StreamChannel()
         stop_ids = set(
             tok.stop_ids()
             if hasattr(tok, "stop_ids")
             else [tok.eos_id]
         )
+        # created only after everything that can still raise: once the
+        # channel exists its owner is the InteractiveRequest handoff
+        # below, and an exception in between would strand an open stream
+        channel = StreamChannel()
 
         n_gen = [0]  # raw sampled count, stop tokens included — the
         # scheduler strips stop ids from token_ids, so an immediate-EOS

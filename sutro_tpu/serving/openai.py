@@ -360,28 +360,39 @@ def collect(ir: Any, *, chat: bool, timeout: float = 600.0) -> Dict[str, Any]:
     finish = "stop"
     done = False
     gen_tokens: Optional[int] = None
-    for ev in ir.channel.events(deadline=deadline):
-        if ev is None:
-            continue
-        if ev[0] == "token":
-            parts.append(decode(ev[1]))
-        elif ev[0] == "done":
-            res = ev[1]
-            if res.get("status") == "cancelled":
-                raise RuntimeError("request cancelled")
-            # the terminal result carries the authoritative rendered
-            # text (stop tokens stripped, full decode) — prefer it to
-            # our incremental reassembly when present
-            if res.get("text") is not None:
-                parts = [res["text"]]
+    try:
+        for ev in ir.channel.events(deadline=deadline):
+            if ev is None:
+                continue
+            if ev[0] == "token":
+                parts.append(decode(ev[1]))
+            elif ev[0] == "done":
+                res = ev[1]
+                if res.get("status") == "cancelled":
+                    raise RuntimeError("request cancelled")
+                # the terminal result carries the authoritative rendered
+                # text (stop tokens stripped, full decode) — prefer it
+                # to our incremental reassembly when present
+                if res.get("text") is not None:
+                    parts = [res["text"]]
+                else:
+                    parts.append(decode(None) or "")
+                finish = res.get("finish_reason") or "stop"
+                gen_tokens = res.get("gen_tokens")
+                done = True
+                break
             else:
-                parts.append(decode(None) or "")
-            finish = res.get("finish_reason") or "stop"
-            gen_tokens = res.get("gen_tokens")
-            done = True
-            break
-        else:
-            raise RuntimeError(f"interactive request failed: {ev[1]}")
+                raise RuntimeError(
+                    f"interactive request failed: {ev[1]}"
+                )
+    except Exception:
+        # a consumer-side raise mid-drain (decoder error, malformed
+        # terminal record) must stop the producer too: without cancel()
+        # the scheduler keeps generating tokens for a stream nobody
+        # reads. cancel() is an idempotent flag — calling it after a
+        # terminal event is a no-op.
+        ir.channel.cancel()
+        raise
     if not done:
         ir.channel.cancel()
         raise RuntimeError("interactive request timed out")

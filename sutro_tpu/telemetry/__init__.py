@@ -100,11 +100,13 @@ STAGE_SECONDS = REGISTRY.histogram(
     "decode_window, admit, accept, flush, finalize, dp_round, embed)",
     labels=("stage",),
     unit="seconds",
+    max_series=16,
 )
 ROWS_TOTAL = REGISTRY.counter(
     "sutro_rows_total",
     "Result rows emitted by terminal outcome",
     labels=("outcome",),  # ok | quarantined | cancelled
+    max_series=8,
 )
 TOKENS_TOTAL = REGISTRY.counter(
     "sutro_tokens_total",
@@ -116,22 +118,26 @@ JOBS_TOTAL = REGISTRY.counter(
     "sutro_jobs_total",
     "Jobs reaching a terminal status",
     labels=("status",),  # succeeded | failed | cancelled
+    max_series=8,
 )
 ROW_EVENTS_TOTAL = REGISTRY.counter(
     "sutro_failure_events_total",
     "failure_log[] events appended (row_retry, row_quarantined, "
     "io_retry, torn_chunk_quarantined, job_failed, ...)",
     labels=("event",),
+    max_series=16,
 )
 FAULTS_INJECTED_TOTAL = REGISTRY.counter(
     "sutro_faults_injected_total",
     "Deterministic fault-plan injections fired, by site",
     labels=("site",),
+    max_series=32,
 )
 IO_RETRIES_TOTAL = REGISTRY.counter(
     "sutro_io_retries_total",
     "Transient-I/O retry attempts (engine/faults.retry_transient)",
     labels=("what",),
+    max_series=16,
 )
 TOKENIZE_ROWS_TOTAL = REGISTRY.counter(
     "sutro_tokenize_rows_total",
@@ -144,6 +150,7 @@ DP_EVENTS_TOTAL = REGISTRY.counter(
     # reconnect | stall | fault_forwarded | reject | join | requeue |
     # reshard | steal | drain | dup_result | resume_port_busy
     labels=("kind",),
+    max_series=16,
 )
 DP_FLEET_SIZE = REGISTRY.gauge(
     "sutro_dp_fleet_size",
@@ -175,6 +182,7 @@ ROWS_PER_SECOND = REGISTRY.gauge(
     "pod-merged rate; interactive is the serving tier's request rate)",
     labels=("workload",),
     unit="rows/s",
+    max_series=8,
 )
 # -- interactive serving tier (serving/gateway.py, OBSERVABILITY.md) ----
 TTFT_SECONDS = REGISTRY.histogram(
@@ -193,6 +201,7 @@ INTERACTIVE_REQUESTS_TOTAL = REGISTRY.counter(
     "sutro_interactive_requests_total",
     "Interactive serving requests by terminal outcome",
     labels=("outcome",),  # ok | cancelled | error | rejected
+    max_series=8,
 )
 INTERACTIVE_ACTIVE = REGISTRY.gauge(
     "sutro_interactive_active",
@@ -247,6 +256,7 @@ ALERTS_TOTAL = REGISTRY.counter(
     "sutro_monitor_alerts_total",
     "SLO alert lifecycle transitions emitted by the live monitor",
     labels=("rule", "state"),  # state: firing | resolved
+    max_series=32,
 )
 ADMISSION_REJECTIONS_TOTAL = REGISTRY.counter(
     "sutro_admission_rejections_total",
@@ -260,12 +270,14 @@ PREEMPTIONS_TOTAL = REGISTRY.counter(
     "(labels are the preemptor's and victim's job_priority)",
     labels=("from", "to"),
     unit="rows",
+    max_series=32,
 )
 AUTOTUNE_ADJUSTMENTS_TOTAL = REGISTRY.counter(
     "sutro_autotune_adjustments_total",
     "Live engine-config adjustments applied by the control-plane "
     "autotuner",
     labels=("knob",),
+    max_series=16,
 )
 PREFIX_STORE_HITS_TOTAL = REGISTRY.counter(
     "sutro_prefix_store_hits_total",
@@ -306,7 +318,10 @@ STAGES = (
 def stage_observe(stage: str, dur_s: float) -> None:
     """One engine stage latency sample into the registry histogram
     (the flight-recorder span is the caller's concern — spans carry
-    job identity, the histogram does not)."""
+    job identity, the histogram does not). Internally gated: callers
+    on hot paths may invoke it bare and still honor the kill switch."""
+    if not ENABLED:
+        return
     STAGE_SECONDS.observe(dur_s, stage)
 
 

@@ -705,3 +705,616 @@ def test_reset_engine_closes_previous_singleton(tmp_path, monkeypatch):
     api_mod.reset_engine()
     worker.join(timeout=10.0)
     assert not worker.is_alive()
+
+
+# ------------------------------------------------- resource lifecycle
+
+
+def test_resource_leak_on_early_return():
+    active, _ = scan(
+        """
+import socket
+
+def f(flag):
+    s = socket.create_connection(("h", 1))
+    if flag:
+        return None
+    s.sendall(b"x")
+    s.close()
+    return s
+"""
+    )
+    (f,) = [f for f in active if f.rule == "resource-leak"]
+    assert f.key == "socket:s" and "early return" in f.message
+
+
+def test_resource_leak_on_exception_edge():
+    # the function owns kv-pages (it frees them on the happy path), so
+    # a call that can raise between alloc and free leaks the pages
+    active, _ = scan(
+        """
+def f(alloc, work):
+    pages = alloc.alloc(4)
+    work(1)
+    alloc.free(pages)
+"""
+    )
+    (f,) = [f for f in active if f.rule == "resource-leak"]
+    assert f.key == "kv-pages:pages" and "exception path" in f.message
+
+
+def test_resource_release_in_handler_is_clean():
+    active, _ = scan(
+        """
+def g(alloc, work):
+    pages = alloc.alloc(4)
+    try:
+        work(1)
+    except Exception:
+        alloc.free(pages)
+        raise
+    alloc.free(pages)
+"""
+    )
+    assert "resource-leak" not in rules_of(active)
+
+
+def test_resource_daemon_thread_untracked():
+    active, _ = scan(
+        """
+import threading
+
+def h(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+"""
+    )
+    assert "resource-leak" not in rules_of(active)
+
+
+def test_resource_none_branch_refined_away():
+    # `if h is None: return` is a miss, not a leak
+    active, _ = scan(
+        """
+def f(store):
+    h = store.lookup_pin("k")
+    if h is None:
+        return 0
+    store.release(h)
+    return 1
+"""
+    )
+    assert "resource-leak" not in rules_of(active)
+
+
+def test_resource_return_escape_transfers_ownership():
+    active, _ = scan(
+        """
+from serving.channel import StreamChannel
+
+def mk():
+    ch = StreamChannel()
+    return ch
+"""
+    )
+    assert "resource-leak" not in rules_of(active)
+
+
+def test_resource_leak_pragma_suppressed():
+    active, suppressed = scan(
+        """
+import socket
+
+def f(flag):
+    s = socket.create_connection(("h", 1))  # graftlint: disable=resource-leak
+    if flag:
+        return None
+    s.close()
+    return None
+"""
+    )
+    assert "resource-leak" not in rules_of(active)
+    assert "resource-leak" in rules_of(suppressed)
+
+
+def test_resource_double_release_flagged():
+    active, _ = scan(
+        """
+def f(alloc):
+    pages = alloc.alloc(2)
+    alloc.free(pages)
+    alloc.free(pages)
+"""
+    )
+    (f,) = [f for f in active if f.rule == "resource-double-release"]
+    assert f.key == "kv-pages:pages"
+
+
+def test_resource_release_on_each_branch_is_clean():
+    active, _ = scan(
+        """
+def f(alloc, ok):
+    pages = alloc.alloc(2)
+    if ok:
+        alloc.free(pages)
+    else:
+        alloc.free(pages)
+"""
+    )
+    assert "resource-double-release" not in rules_of(active)
+    assert "resource-leak" not in rules_of(active)
+
+
+# ------------------------------------------------- wire protocol
+
+
+def _wire_idx(src: str) -> PackageIndex:
+    idx = PackageIndex()
+    idx.add_source("dphost.py", src, "dphost")
+    return idx
+
+
+def test_wire_key_removed_vs_schema():
+    from sutro_tpu.analysis import protocol
+
+    idx = _wire_idx(
+        """
+def _send(sock, m):
+    pass
+
+def send_res(sock):
+    _send(sock, {"t": "res", "rows": 1})
+"""
+    )
+    schema = {
+        "version": 1,
+        "frames": {"res": ["t", "rows", "gone"], "hb": ["t"]},
+    }
+    fs = protocol.run(idx, schema=schema)
+    assert sorted(f.key for f in fs if f.rule == "wire-key-removed") == [
+        "hb",  # whole frame vanished
+        "res.gone",  # one key vanished
+    ]
+
+
+def test_wire_added_keys_are_fine():
+    from sutro_tpu.analysis import protocol
+
+    idx = _wire_idx(
+        """
+def _send(sock, m):
+    pass
+
+def send_res(sock):
+    m = {"t": "res", "rows": 1}
+    m["extra"] = 2
+    _send(sock, m)
+
+def parse(m):
+    return m.get("rows", 0)
+"""
+    )
+    schema = {"version": 1, "frames": {"res": ["t", "rows"]}}
+    assert protocol.run(idx, schema=schema) == []
+
+
+def test_wire_strict_parse_flagged():
+    from sutro_tpu.analysis import protocol
+
+    idx = _wire_idx(
+        """
+def _send(sock, m):
+    pass
+
+def parse(m):
+    if set(m) == {"t", "rows"}:
+        pass
+    for k in m:
+        if k not in ("t", "rows"):
+            raise ValueError(k)
+"""
+    )
+    fs = protocol.run(idx, schema={"version": 1, "frames": {}})
+    assert sorted(f.key for f in fs if f.rule == "wire-strict-parse") == [
+        "shape-eq",
+        "unknown-key-raise",
+    ]
+
+
+def test_wire_pass_ignores_non_wire_modules():
+    # frame-shaped dicts in ordinary modules aren't wire frames
+    active, _ = scan(
+        """
+def build():
+    return {"t": "res", "rows": 1}
+
+def parse(m):
+    if set(m) == {"t"}:
+        raise ValueError(m)
+"""
+    )
+    assert "wire-strict-parse" not in rules_of(active)
+    assert "wire-key-removed" not in rules_of(active)
+
+
+# ------------------------------------------------- kill-switch zero-op
+
+
+def test_killswitch_bare_metric_write_flagged():
+    active, _ = scan(
+        """
+import os
+import telemetry
+
+ENABLED = os.environ.get("SUTRO_TELEMETRY", "1") not in ("0",)
+
+def hot():
+    telemetry.ROWS_TOTAL.inc(1.0, "ok")
+"""
+    )
+    (f,) = [f for f in active if f.rule == "killswitch-ungated"]
+    assert f.key == "telemetry:ROWS_TOTAL.inc"
+
+
+def test_killswitch_gate_and_guard_clause_clean():
+    active, _ = scan(
+        """
+import os
+import telemetry
+
+ENABLED = os.environ.get("SUTRO_TELEMETRY", "1") not in ("0",)
+
+def gated():
+    if ENABLED:
+        telemetry.ROWS_TOTAL.inc(1.0, "ok")
+
+def guarded():
+    if not ENABLED:
+        return
+    telemetry.ROWS_TOTAL.inc(1.0, "ok")
+"""
+    )
+    assert "killswitch-ungated" not in rules_of(active)
+
+
+def test_killswitch_internally_gated_callee_clean():
+    # stage_observe checks the flag itself; callers stay bare
+    idx = PackageIndex()
+    idx.add_source(
+        "telemetry/__init__.py",
+        """
+import os
+
+ENABLED = os.environ.get("SUTRO_TELEMETRY", "1") not in ("0",)
+
+def stage_observe(stage, dur):
+    if not ENABLED:
+        return
+    STAGE.observe(dur, stage)
+""",
+        "telemetry",
+    )
+    idx.add_source(
+        "m.py",
+        """
+import telemetry
+
+def hot():
+    telemetry.stage_observe("decode", 0.1)
+""",
+        "m",
+    )
+    active, _ = core.apply_suppressions(idx, run_passes(idx))
+    assert "killswitch-ungated" not in rules_of(active)
+
+
+def test_killswitch_pragma_suppressed():
+    active, suppressed = scan(
+        """
+import os
+import telemetry
+
+ENABLED = os.environ.get("SUTRO_TELEMETRY", "1") not in ("0",)
+
+def hot():
+    telemetry.ROWS_TOTAL.inc(1.0, "ok")  # graftlint: disable=killswitch-ungated
+"""
+    )
+    assert "killswitch-ungated" not in rules_of(active)
+    assert "killswitch-ungated" in rules_of(suppressed)
+
+
+# ------------------------------------------------- telemetry cardinality
+
+
+def test_cardinality_uncapped_and_identifier_labels():
+    active, _ = scan(
+        """
+C_UNCAPPED = REGISTRY.counter("m_total", "h", labels=("stage",))
+C_CAPPED = REGISTRY.counter("n_total", "h", labels=("stage",), max_series=8)
+
+def f(stage, job_id):
+    C_UNCAPPED.inc(1.0, stage)
+    C_CAPPED.inc(1.0, job_id)
+    C_CAPPED.inc(1.0, f"job-{job_id}")
+"""
+    )
+    keys = sorted(
+        f.key for f in active if f.rule == "telemetry-cardinality"
+    )
+    assert keys == [
+        "m_total:uncapped",  # non-const label, no max_series budget
+        "n_total:identifier",  # job_id name
+        "n_total:identifier",  # f-string
+    ]
+
+
+def test_cardinality_capped_nonconst_and_const_labels_clean():
+    active, _ = scan(
+        """
+C_CAPPED = REGISTRY.counter("n_total", "h", labels=("stage",), max_series=8)
+
+def f(stage):
+    C_CAPPED.inc(1.0, stage)
+    C_CAPPED.inc(1.0, "const")
+"""
+    )
+    assert "telemetry-cardinality" not in rules_of(active)
+
+
+# ------------------------------------------------- stale suppressions
+
+
+def scan_with_stale(src: str):
+    idx = PackageIndex()
+    idx.add_source("m.py", src, "m")
+    active, suppressed = core.apply_suppressions(idx, run_passes(idx))
+    active.extend(core.stale_suppression_findings(idx, suppressed))
+    return active, suppressed
+
+
+def test_stale_suppression_flagged():
+    active, _ = scan_with_stale(
+        """
+x = 1  # graftlint: disable=lock-order
+"""
+    )
+    (f,) = [f for f in active if f.rule == "stale-suppression"]
+    assert "lock-order" in f.message
+
+
+def test_masking_suppression_is_not_stale():
+    active, suppressed = scan_with_stale(
+        """
+import socket
+
+def f(flag):
+    s = socket.create_connection(("h", 1))  # graftlint: disable=resource-leak
+    if flag:
+        return None
+    s.close()
+    return None
+"""
+    )
+    assert active == []
+    assert len(suppressed) == 1
+
+
+# --------------------------------------- injection gates: new passes
+
+
+def test_injected_wire_key_removal_fails_gate(tmp_path):
+    dst = _copy_tree(tmp_path)
+    dp = dst / "engine" / "dphost.py"
+    src = dp.read_text()
+    anchor = '{"t": "reshard", "rows": sorted(rows)}'
+    assert anchor in src
+    dp.write_text(src.replace(anchor, '{"t": "reshard"}', 1))
+    res = run_cli(
+        ["sutro_tpu", "--baseline", str(BASELINE)], cwd=tmp_path
+    )
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "wire-key-removed" in res.stdout
+    assert "reshard" in res.stdout
+
+
+def test_injected_dropped_release_fails_gate(tmp_path):
+    dst = _copy_tree(tmp_path)
+    sched = dst / "engine" / "scheduler.py"
+    src = sched.read_text()
+    anchor = "store.release(handle)"
+    assert anchor in src
+    sched.write_text(src.replace(anchor, 'logger.debug("skip")', 1))
+    res = run_cli(
+        ["sutro_tpu", "--baseline", str(BASELINE)], cwd=tmp_path
+    )
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "resource-leak" in res.stdout
+
+
+def test_injected_ungated_metric_fails_gate(tmp_path):
+    dst = _copy_tree(tmp_path)
+    js = dst / "engine" / "jobstore.py"
+    js.write_text(
+        js.read_text()
+        + """
+
+def _injected_hot(n):
+    telemetry.ROWS_TOTAL.inc(float(n), "injected")
+"""
+    )
+    res = run_cli(
+        ["sutro_tpu", "--baseline", str(BASELINE)], cwd=tmp_path
+    )
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "killswitch-ungated" in res.stdout
+
+
+def test_injected_identifier_label_fails_gate(tmp_path):
+    dst = _copy_tree(tmp_path)
+    js = dst / "engine" / "jobstore.py"
+    js.write_text(
+        js.read_text()
+        + """
+
+def _injected_label(job_id):
+    if telemetry.ENABLED:
+        telemetry.ROWS_TOTAL.inc(1.0, f"job-{job_id}")
+"""
+    )
+    res = run_cli(
+        ["sutro_tpu", "--baseline", str(BASELINE)], cwd=tmp_path
+    )
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "telemetry-cardinality" in res.stdout
+    assert "killswitch-ungated" not in res.stdout  # the gate is honored
+
+
+# --------------------------------------------------------- diff mode
+
+
+def test_diff_mode_scopes_findings_to_changed_lines(tmp_path):
+    dst = _copy_tree(tmp_path)
+
+    def git(*a):
+        subprocess.run(
+            ["git", "-c", "user.email=t@t.t", "-c", "user.name=t", *a],
+            cwd=tmp_path,
+            check=True,
+            capture_output=True,
+        )
+
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    # clean tree: baselined findings exist, but no changed lines
+    res = run_cli(["sutro_tpu", "--diff", "HEAD"], cwd=tmp_path)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "0 finding(s) on lines changed" in res.stdout
+    # a violation on a changed line is reported without a baseline
+    sched = dst / "engine" / "scheduler.py"
+    src = sched.read_text()
+    anchor = "self._prep_pump(order)"
+    src = src.replace(
+        anchor, anchor + "\n                _wall = time.time()", 1
+    )
+    sched.write_text(src)
+    res = run_cli(["sutro_tpu", "--diff", "HEAD"], cwd=tmp_path)
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "sched-nondeterminism" in res.stdout
+    assert "finding(s) on lines changed vs HEAD" in res.stdout
+
+
+# ----------------------------- engine fixes the new passes drove
+
+
+def test_openai_collect_cancels_channel_on_decoder_error():
+    from types import SimpleNamespace
+
+    from sutro_tpu.serving import openai as oai
+    from sutro_tpu.serving.channel import StreamChannel
+
+    ch = StreamChannel()
+    ch.put_token(0, 1, 0.0)
+
+    def bad_decoder():
+        def d(tok):
+            raise ValueError("decoder boom")
+
+        return d
+
+    ir = SimpleNamespace(
+        channel=ch,
+        decoder=bad_decoder,
+        prompt_tokens=1,
+        id="req-1",
+        created_unix=0,
+        model="m",
+    )
+    with pytest.raises(ValueError, match="decoder boom"):
+        oai.collect(ir, chat=False, timeout=5.0)
+    # the producer side must stop too: without cancel() the scheduler
+    # keeps generating tokens for a stream nobody reads
+    assert ch.cancelled
+
+
+def test_prefix_store_counters_gated_on_kill_switch():
+    import numpy as np
+
+    from sutro_tpu import telemetry
+    from sutro_tpu.engine.prefixstore import PrefixStore
+
+    def misses():
+        return (
+            telemetry.REGISTRY.collect()
+            .get("sutro_prefix_store_misses_total", {})
+            .get("series", {})
+            .get("", 0.0)
+        )
+
+    prev = telemetry.ENABLED
+    try:
+        telemetry.set_enabled(False)
+        s = PrefixStore(8)
+        before = misses()
+        h = s.lookup_pin(np.arange(32, dtype=np.int32))
+        s.release(h)
+        assert misses() == before  # switch off means zero work
+        telemetry.set_enabled(True)
+        h = s.lookup_pin(np.arange(64, dtype=np.int32) + 1000)
+        s.release(h)
+        assert misses() == before + 1
+    finally:
+        telemetry.set_enabled(prev)
+
+
+def test_stage_observe_is_zero_op_when_disabled():
+    from sutro_tpu import telemetry
+
+    prev = telemetry.ENABLED
+    try:
+        telemetry.set_enabled(False)
+        before = (
+            telemetry.REGISTRY.collect()
+            .get("sutro_stage_seconds", {})
+            .get("series", {})
+        )
+        telemetry.stage_observe("zz_probe_disabled", 1.0)
+        after = (
+            telemetry.REGISTRY.collect()
+            .get("sutro_stage_seconds", {})
+            .get("series", {})
+        )
+        assert before == after
+    finally:
+        telemetry.set_enabled(prev)
+
+
+def test_preemption_priority_labels_bounded():
+    from sutro_tpu.engine.control import _prio_label
+
+    assert _prio_label(3) == "3"
+    assert _prio_label(-1) == "-1"
+    assert _prio_label(0) == "0"
+    # out-of-ladder priorities collapse instead of minting new series
+    assert _prio_label(999) == "other"
+    assert _prio_label(-7) == "other"
+
+
+def test_failure_log_label_collapses_nonstring_kind(tmp_path):
+    from sutro_tpu import telemetry
+    from sutro_tpu.engine.jobstore import JobStore
+
+    prev = telemetry.ENABLED
+    try:
+        telemetry.set_enabled(True)
+        store = JobStore(root=tmp_path)
+        rec = store.create(model="m", num_rows=1)
+        store.append_failure_log(rec.job_id, {"event": 123})
+        series = telemetry.REGISTRY.collect()[
+            "sutro_failure_events_total"
+        ]["series"]
+        assert "123" not in series
+        assert series.get("unknown", 0) >= 1
+    finally:
+        telemetry.set_enabled(prev)
