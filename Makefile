@@ -74,13 +74,15 @@ chaos:
 
 # telemetry gate (OBSERVABILITY.md): exporter golden-file + flight-
 # recorder/reconciliation tests + distributed telemetry (trace
-# propagation, federation, doctor golden), then the telemetry-on vs
-# telemetry-off host-overhead comparison (< 2% delta asserted in code,
-# including the dp-coordinator wire leg). Tier-1 CI.
+# propagation, federation, doctor golden) + tail-latency forensics
+# (exemplars, request traces, Perfetto export golden), then the
+# telemetry-on vs telemetry-off host-overhead comparison (< 2% delta
+# asserted in code, including the dp-coordinator wire leg and the
+# exemplars-on forensics census). Tier-1 CI.
 telemetry-check:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_telemetry.py \
-		tests/test_distributed_telemetry.py -q -m "not slow" \
-		-p no:cacheprovider
+		tests/test_distributed_telemetry.py tests/test_traces.py \
+		-q -m "not slow" -p no:cacheprovider
 	JAX_PLATFORMS=cpu $(PY) benchmarks/profile_host_overhead.py --telemetry
 
 # live-monitor gate (OBSERVABILITY.md "Live monitor"): SLO rule

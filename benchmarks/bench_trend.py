@@ -11,21 +11,40 @@ Reads every bench artifact the repo accumulates —
   co-batched, plus the retention grades
 
 — and writes ``BENCH_TREND.md``: the round-by-round series, the
-current graded metrics, and **warnings** (never a failing exit — bench
-numbers on shared CI boxes are too noisy to gate; the report is for a
-human or the next session to read) whenever a graded metric moved
->``TREND_TOLERANCE`` in the bad direction:
+current graded metrics, and regressions whenever a graded metric moved
+in the bad direction:
 
-- between the two most recent *valid* driver rounds, and
+- between the two most recent *valid* driver rounds (always
+  warn-only — rounds come from heterogeneous driver boxes), and
 - between the current artifacts and the previous run's snapshot
   (``BENCH_TREND.json``, rewritten on every run so the comparison is
   always against the last time someone ran ``make bench-trend``).
 
-Direction matters: throughput-like metrics (rows/hour, tok/s,
-retention) warn on drops; latency- and cost-like metrics (ttft/itl
-seconds, $/1M tokens, ratio-vs-idle) warn on rises.
+Whether a cross-run regression **fails** or merely warns is decided by
+measured variance, not by fiat (ROADMAP: "promote ``make bench-trend``
+... once leg variance is characterized"). ``--characterize`` reruns
+the cheap CPU legs (``bench_e2e.py``, ``bench_interactive.py``)
+``CHARACTERIZE_RUNS`` times back-to-back on this box, computes each
+graded metric's relative spread ((max-min)/median), and persists the
+result in ``BENCH_TREND.json``:
 
-Usage: ``make bench-trend`` (or ``python benchmarks/bench_trend.py``).
+- spread <= ``GATE_MAX_SPREAD`` -> the leg is *gated*: later runs FAIL
+  (exit 1) when it regresses more than
+  max(``GATE_FLOOR``, ``GATE_MARGIN`` x spread);
+- noisier legs stay warn-only at ``TREND_TOLERANCE``, with the
+  measured spread recorded in BENCH_TREND.md so the next
+  characterization pass can revisit.
+
+Until a characterization has been run, every leg is warn-only — the
+gate is opt-in by measurement.
+
+Direction matters: throughput-like metrics (rows/hour, tok/s,
+retention) regress on drops; latency- and cost-like metrics (ttft/itl
+seconds, $/1M tokens, ratio-vs-idle) regress on rises.
+
+Usage: ``make bench-trend`` (or ``python benchmarks/bench_trend.py``);
+``python benchmarks/bench_trend.py --characterize`` to (re)measure
+variance and refresh the gate set.
 """
 
 from __future__ import annotations
@@ -38,6 +57,25 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 TREND_TOLERANCE = 0.15  # >15% move in the bad direction -> warning
+
+# --characterize: rerun the cheap CPU legs this many times and grade
+# each metric's relative spread ((max-min)/median). Legs whose spread
+# is at/below GATE_MAX_SPREAD promote to a failing gate with a
+# per-leg threshold of max(GATE_FLOOR, GATE_MARGIN x spread); the
+# rest stay warn-only with the spread published in BENCH_TREND.md.
+CHARACTERIZE_RUNS = 3
+GATE_MAX_SPREAD = 0.05
+GATE_FLOOR = 0.03
+GATE_MARGIN = 3.0
+# (script, extra env) — the producers behind the graded artifacts.
+# Both are the CPU smoke variants the Makefile runs in CI.
+CHEAP_LEGS = (
+    ("bench_e2e.py", {}),
+    ("bench_interactive.py", {"SUTRO_E2E_CPU": "1"}),
+)
+# artifacts the producers rewrite; characterization restores them so a
+# variance pass never silently moves the repo's committed numbers
+CHARACTERIZE_ARTIFACTS = ("BENCH_E2E.json", "BENCH_INTERACTIVE.json")
 
 # graded metrics: (json-path, higher_is_better)
 E2E_METRICS = (
@@ -152,12 +190,91 @@ def _direction(name: str) -> bool:
     return True
 
 
+def characterize() -> dict:
+    """Rerun the cheap legs N times, measure per-metric spread, and
+    return the variance map {metric: {samples, spread, gated,
+    threshold}}. Restores the bench artifacts afterwards."""
+    import subprocess
+
+    backups = {
+        name: (
+            (REPO / name).read_bytes()
+            if (REPO / name).exists() else None
+        )
+        for name in CHARACTERIZE_ARTIFACTS
+    }
+    pre = build_snapshot()
+    samples: list = []
+    try:
+        for i in range(CHARACTERIZE_RUNS):
+            for script, extra in CHEAP_LEGS:
+                env = dict(os.environ)
+                env.setdefault("JAX_PLATFORMS", "cpu")
+                env.update(extra)
+                proc = subprocess.run(
+                    [sys.executable, str(REPO / script)],
+                    cwd=REPO, env=env,
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                )
+                if proc.returncode != 0:
+                    tail = proc.stdout.decode(errors="replace")[-2000:]
+                    raise RuntimeError(
+                        f"characterize leg {script} failed "
+                        f"(rc={proc.returncode}):\n{tail}"
+                    )
+            snap = build_snapshot()
+            samples.append(snap)
+            print(
+                f"characterize run {i + 1}/{CHARACTERIZE_RUNS}: "
+                f"{len(snap)} graded metrics", file=sys.stderr,
+            )
+    finally:
+        for name, blob in backups.items():
+            if blob is None:
+                (REPO / name).unlink(missing_ok=True)
+            else:
+                (REPO / name).write_bytes(blob)
+
+    variance: dict = {}
+    for name in sorted(set().union(*[set(s) for s in samples])):
+        vals = [s[name] for s in samples if name in s]
+        if len(vals) < CHARACTERIZE_RUNS:
+            continue  # flickering metric: disqualified from gating
+        if all(v == pre.get(name) for v in vals):
+            # never moved off the committed artifact value: this leg
+            # was NOT remeasured by the rerun set (e.g. a workload
+            # variant merged into BENCH_E2E.json by a separate
+            # invocation) — a zero spread here is staleness, not
+            # stability, so it must not promote to a gate
+            continue
+        vals.sort()
+        med = vals[len(vals) // 2]
+        if not med:
+            continue
+        spread = (vals[-1] - vals[0]) / abs(med)
+        gated = spread <= GATE_MAX_SPREAD
+        variance[name] = {
+            "samples": [round(v, 6) for v in vals],
+            "spread": round(spread, 4),
+            "gated": gated,
+            "threshold": round(
+                max(GATE_FLOOR, GATE_MARGIN * spread), 4
+            ) if gated else TREND_TOLERANCE,
+        }
+    return variance
+
+
 def main() -> int:
     rounds = collect_rounds()
     snap = build_snapshot()
     prev_doc = _load(REPO / "BENCH_TREND.json") or {}
     prev_snap = prev_doc.get("snapshot") or {}
+    if "--characterize" in sys.argv:
+        variance = characterize()
+    else:
+        variance = prev_doc.get("variance") or {}
     warnings: list = []
+    failures: list = []
 
     # round-over-round: the two most recent valid driver rounds
     valid_rounds = [r for r in rounds if r["valid"]]
@@ -170,35 +287,75 @@ def main() -> int:
                 f"({_pct(a['value'], b['value'])} vs r{a['n']:02d})"
             )
 
-    # cross-run: current artifacts vs last snapshot
+    # cross-run: current artifacts vs last snapshot. Gated legs
+    # (variance-characterized as stable on this box) FAIL past their
+    # per-leg threshold; everything else warns at TREND_TOLERANCE.
     for name, cur in sorted(snap.items()):
         prev = prev_snap.get(name)
-        if prev is None:
+        if prev is None or not prev:
             continue
-        if _moved_badly(prev, cur, _direction(name)):
+        delta = (cur - prev) / abs(prev)
+        bad = -delta if _direction(name) else delta
+        leg = variance.get(name) or {}
+        if leg.get("gated"):
+            if bad > leg["threshold"]:
+                failures.append(
+                    f"{name}: {prev:.4g} -> {cur:.4g} "
+                    f"({_pct(prev, cur)}; gate {leg['threshold']:.0%}, "
+                    f"measured spread {leg['spread']:.1%})"
+                )
+        elif bad > TREND_TOLERANCE:
             warnings.append(
                 f"{name}: {prev:.4g} -> {cur:.4g} ({_pct(prev, cur)})"
             )
 
+    n_gated = sum(1 for v in variance.values() if v.get("gated"))
     lines = ["# Bench trend", ""]
     lines.append(
-        f"Warn-only report (`make bench-trend`); tolerance "
-        f"{TREND_TOLERANCE:.0%} in the bad direction. "
+        f"Trend gate (`make bench-trend`): {n_gated} variance-"
+        f"characterized legs fail past their per-leg threshold; the "
+        f"rest warn past {TREND_TOLERANCE:.0%} in the bad direction. "
         "Compared against the previous run's `BENCH_TREND.json` "
-        "snapshot and the prior driver round."
+        "snapshot and the prior driver round. Refresh the gate set "
+        "with `python benchmarks/bench_trend.py --characterize` "
+        f"(N={CHARACTERIZE_RUNS} reruns of the cheap CPU legs)."
     )
     lines.append("")
+    if failures:
+        lines.append(f"## Failures ({len(failures)})")
+        lines.append("")
+        for f in failures:
+            lines.append(f"- ✗ {f}")
+        lines.append("")
     if warnings:
         lines.append(f"## Warnings ({len(warnings)})")
         lines.append("")
         for w in warnings:
             lines.append(f"- ⚠ {w}")
-    else:
+    elif not failures:
         lines.append("## Warnings (0)")
         lines.append("")
         lines.append("- none — no graded metric moved "
                      f">{TREND_TOLERANCE:.0%} in the bad direction")
     lines.append("")
+
+    if variance:
+        lines.append(
+            f"## Leg variance (N={CHARACTERIZE_RUNS} back-to-back "
+            "reruns)"
+        )
+        lines.append("")
+        lines.append(
+            "| metric | spread | class | threshold |"
+        )
+        lines.append("|---|---|---|---|")
+        for name, v in sorted(variance.items()):
+            cls = "**gate**" if v.get("gated") else "warn-only"
+            lines.append(
+                f"| {name} | {v['spread']:.1%} | {cls} | "
+                f"{v['threshold']:.0%} |"
+            )
+        lines.append("")
 
     lines.append("## Driver rounds (BENCH_r*.json)")
     lines.append("")
@@ -239,18 +396,25 @@ def main() -> int:
     (REPO / "BENCH_TREND.json").write_text(json.dumps({
         "tolerance": TREND_TOLERANCE,
         "snapshot": snap,
+        "variance": variance,
         "warnings": warnings,
+        "failures": failures,
     }, indent=2) + "\n")
 
     for w in warnings:
         print(f"WARN: {w}", file=sys.stderr)
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
     print(json.dumps({
         "rounds": len(rounds),
         "graded_metrics": len(snap),
+        "gated_legs": n_gated,
         "warnings": len(warnings),
+        "failures": len(failures),
         "report": "BENCH_TREND.md",
     }))
-    return 0  # warn, never fail: bench noise must not block CI
+    # noisy legs warn and never block; variance-characterized gates do
+    return 1 if failures else 0
 
 
 if __name__ == "__main__":

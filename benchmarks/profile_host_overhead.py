@@ -476,7 +476,18 @@ _TEL_OPS = (
     ("distributed", "WorkerTelemetry", "begin", "tele_begin"),
     ("distributed", "WorkerTelemetry", "payload", "tele_payload"),
     ("distributed", "DistributedTelemetry", "ingest", "tele_ingest"),
+    # forensics traces (telemetry/traces.py): start is the ring
+    # insert, Trace.add is the single span funnel (event() and the
+    # store's id-keyed forms all land there), end flips the outcome
+    ("traces", "TraceStore", "start_trace", "trace_start"),
+    ("traces", "Trace", "add", "trace_add"),
+    ("traces", "Trace", "end", "trace_end"),
 )
+
+# Histogram.observe splits by exemplar: capturing the (value,
+# trace_id, attrs) slot is extra work on the same entry point, so
+# exemplar-carrying observations get their own count key + unit price
+_TEL_EXEMPLAR_KEY = "hist_observe_exemplar"
 
 
 class _Census:
@@ -494,11 +505,16 @@ class _Census:
         for mod, cls_name, meth, key in _TEL_OPS:
             cls = getattr(self.mods[mod], cls_name)
             orig = getattr(cls, meth)
+            split = key == "hist_observe"
 
-            def wrap(orig=orig, key=key, counts=self.counts):
+            def wrap(orig=orig, key=key, counts=self.counts,
+                     split=split):
                 @functools.wraps(orig)
                 def counting(self, *a, **kw):
-                    counts[key] += 1
+                    if split and kw.get("exemplar") is not None:
+                        counts[_TEL_EXEMPLAR_KEY] += 1
+                    else:
+                        counts[key] += 1
                     return orig(self, *a, **kw)
 
                 return counting
@@ -607,9 +623,10 @@ def run_telemetry_compare(assert_budget: bool) -> dict:
       (an off-only control run showed the same spread).
     - ``overhead_ratio`` (asserted): deterministic accounting. One
       counted on-leg records how many telemetry operations actually
-      fire (counter incs, gauge sets, histogram observes, flight-
-      recorder spans, per-job counter ops — every instrumented site
-      funnels through these six entry points); tight-loop
+      fire (counter incs, gauge sets, histogram observes — split into
+      plain and exemplar-carrying — flight-recorder spans, per-job
+      counter ops, forensics trace starts/spans/ends: every
+      instrumented site funnels through these entry points); tight-loop
       microbenchmarks price each op class plus the time.monotonic()
       reads at span sites; added host cost per row is
       sum(count x unit cost) / rows, and the budget rule asserts
@@ -626,6 +643,7 @@ def run_telemetry_compare(assert_budget: bool) -> dict:
     import sutro_tpu.telemetry.distributed as tel_distributed
     import sutro_tpu.telemetry.registry as tel_registry
     import sutro_tpu.telemetry.spans as tel_spans
+    import sutro_tpu.telemetry.traces as tel_traces
     from sutro_tpu.engine.config import EngineConfig
     from sutro_tpu.models.configs import MODEL_CONFIGS
 
@@ -668,8 +686,32 @@ def run_telemetry_compare(assert_budget: bool) -> dict:
         ),
         "jobctr_add": _unit_us(lambda: sjc.add("rows_ok")),
         "jobctr_set": _unit_us(lambda: sjc.set("input_tokens", 123.0)),
+        # exemplar capture: same entry point, plus the keep-policy
+        # check and the (value, trace_id, attrs) slot write
+        "hist_observe_exemplar": _unit_us(
+            lambda: sh.observe(
+                0.0031, "decode_window", exemplar="tr-bench-7"
+            )
+        ),
         "monotonic": _unit_us(_time.monotonic),
     }
+    # forensics trace ops on a scratch store: start prices the create
+    # path (fresh ids, ring eviction included); add round-robins over
+    # enough traces that none hits the per-trace span cap (the capped
+    # path is the CHEAP one — pricing it would flatter the budget)
+    strace = tel_traces.TraceStore(capacity=256)
+    _sn = iter(range(10**9))
+    unit_us["trace_start"] = _unit_us(
+        lambda: strace.start_trace(f"tr-b{next(_sn)}", "batch")
+    )
+    _tr_ring = [strace.start_trace(f"tr-add{i}") for i in range(256)]
+    _an = iter(range(10**9))
+    unit_us["trace_add"] = _unit_us(
+        lambda: _tr_ring[next(_an) % 256].add(
+            "decode_window", 0.0, 0.003, None
+        )
+    )
+    unit_us["trace_end"] = _unit_us(lambda: _tr_ring[0].end("ok"))
     # dp wire ops, priced on a REPRESENTATIVELY loaded scratch setup
     # (a populated registry + a few hundred spans — these fire once per
     # round, so the absolute cost matters more than the marginal one)
@@ -727,8 +769,10 @@ def run_telemetry_compare(assert_budget: bool) -> dict:
         "registry": tel_registry,
         "spans": tel_spans,
         "distributed": tel_distributed,
+        "traces": tel_traces,
     }
     counts = {key: 0 for _, _, _, key in _TEL_OPS}
+    counts[_TEL_EXEMPLAR_KEY] = 0
     try:
         for _ in range(3):
             for mode, on in (("off", False), ("on", True)):
@@ -774,10 +818,14 @@ def run_telemetry_compare(assert_budget: bool) -> dict:
         for m, ls in legs.items()
     }
     # span sites read the clock around the timed region: ~2 monotonic
-    # reads per recorded span, 1 per bare histogram observe
+    # reads per recorded span, 1 per bare histogram observe (with or
+    # without exemplar), 1 per trace span append
     ops_us = sum(on_counts[k] * unit_us[k] for k in on_counts)
     ops_us += (
-        2 * on_counts["recorder_record"] + on_counts["hist_observe"]
+        2 * on_counts["recorder_record"]
+        + on_counts["hist_observe"]
+        + on_counts["hist_observe_exemplar"]
+        + on_counts["trace_add"]
     ) * unit_us["monotonic"]
     added_us_per_row = ops_us / 512.0
     off_us = best["off"]["us_per_row"]
@@ -793,6 +841,8 @@ def run_telemetry_compare(assert_budget: bool) -> dict:
     dp_ops_us += (
         2 * dp_on_counts["recorder_record"]
         + dp_on_counts["hist_observe"]
+        + dp_on_counts["hist_observe_exemplar"]
+        + dp_on_counts["trace_add"]
     ) * unit_us["monotonic"]
     dp_added_us_per_row = dp_ops_us / DP_ROWS
     dp_off_us = dp_best["off"]["us_per_row"]
@@ -834,6 +884,17 @@ def run_telemetry_compare(assert_budget: bool) -> dict:
             f"telemetry adds {added_us_per_row:.1f} us/row "
             f"({sum(on_counts.values())} ops) on a {off_us} us/row "
             f"baseline (ratio {ratio:.4f} > {TEL_OVERHEAD_MAX})"
+        )
+        # the counted on-leg is the exemplars-on leg: the forensics
+        # path (trace spans + exemplar-carrying observations) must
+        # demonstrably fire inside the same asserted budget
+        assert on_counts["trace_add"] > 0, (
+            "telemetry-on leg recorded no trace spans — the forensics "
+            "path is not exercised by the census"
+        )
+        assert on_counts["hist_observe_exemplar"] > 0, (
+            "telemetry-on leg captured no exemplars — stage/latency "
+            "observations are not carrying trace ids"
         )
         assert dp_off_ops == 0, (
             f"dp-coordinator telemetry-off leg still fired ops: "
@@ -879,6 +940,7 @@ def run_monitor_compare(assert_budget: bool) -> dict:
     import sutro_tpu.telemetry.distributed as tel_distributed
     import sutro_tpu.telemetry.registry as tel_registry
     import sutro_tpu.telemetry.spans as tel_spans
+    import sutro_tpu.telemetry.traces as tel_traces
     from sutro_tpu.engine.config import EngineConfig
     from sutro_tpu.models.configs import MODEL_CONFIGS
     from sutro_tpu.telemetry import monitor as tmon
@@ -906,8 +968,10 @@ def run_monitor_compare(assert_budget: bool) -> dict:
         "registry": tel_registry,
         "spans": tel_spans,
         "distributed": tel_distributed,
+        "traces": tel_traces,
     }
     counts = {key: 0 for _, _, _, key in _TEL_OPS}
+    counts[_TEL_EXEMPLAR_KEY] = 0
     try:
         tel.set_enabled(True)
         _run_e2e_leg(eng, api_mod, 128, {}, max_new=32)  # warm leg
@@ -1007,6 +1071,7 @@ def run_control_compare(assert_budget: bool) -> dict:
     import sutro_tpu.telemetry.distributed as tel_distributed
     import sutro_tpu.telemetry.registry as tel_registry
     import sutro_tpu.telemetry.spans as tel_spans
+    import sutro_tpu.telemetry.traces as tel_traces
     from sutro_tpu.engine import control as ctl
     from sutro_tpu.engine.config import EngineConfig
     from sutro_tpu.models.configs import MODEL_CONFIGS
@@ -1037,8 +1102,10 @@ def run_control_compare(assert_budget: bool) -> dict:
         "registry": tel_registry,
         "spans": tel_spans,
         "distributed": tel_distributed,
+        "traces": tel_traces,
     }
     counts = {key: 0 for _, _, _, key in _TEL_OPS}
+    counts[_TEL_EXEMPLAR_KEY] = 0
     try:
         tel.set_enabled(True)
         _run_e2e_leg(eng, api_mod, 128, {}, max_new=32)  # warm leg

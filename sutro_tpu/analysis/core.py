@@ -20,6 +20,8 @@ families over a shared AST index:
                behind their flag (taint-walked from the env read)
 - ``cardinality`` telemetry label values vs declared fixed-cardinality
                series budgets
+- ``tracectx`` a bound forensics trace handle (``start_trace``) must be
+               ended on every function exit path
 
 Findings are fingerprinted by (rule, path, enclosing symbol, stable
 detail key) — NOT by line number — so unrelated edits don't invalidate
@@ -78,6 +80,10 @@ RULES = {
     "subsystem not dominated by its kill-switch flag check",
     "telemetry-cardinality": "metric label value outside the declared "
     "fixed-cardinality budget (or identifier-shaped)",
+    "trace-ctx-dropped": "bound trace handle (start_trace) escapes a "
+    "function exit path without end()/end_trace() — the trace stays "
+    "unfinished in the forensics ring",
+    "trace-ctx-double-end": "trace handle ended twice on one path",
     "stale-suppression": "graftlint disable pragma that no longer "
     "masks any finding",
 }
@@ -258,6 +264,7 @@ def run_passes(
         locks,
         protocol,
         resources,
+        tracectx,
     )
 
     findings: List[Finding] = []
@@ -268,6 +275,7 @@ def run_passes(
     findings.extend(protocol.run(index))
     findings.extend(killswitch.run(index))
     findings.extend(cardinality.run(index))
+    findings.extend(tracectx.run(index))
     if rules:
         keep = set(rules)
         findings = [f for f in findings if f.rule in keep]

@@ -147,10 +147,14 @@ def _direct_arg_names(call: ast.Call) -> Tuple[str, ...]:
     return tuple(out)
 
 
-def _acquire_kind(text: str, call: Optional[ast.Call] = None) -> Optional[Kind]:
+def _acquire_kind(
+    text: str,
+    call: Optional[ast.Call] = None,
+    kinds: Tuple[Kind, ...] = KINDS,
+) -> Optional[Kind]:
     if not text:
         return None
-    for k in KINDS:
+    for k in kinds:
         if text in k.acquire_exact:
             return k
         if any(text.endswith(s) for s in k.acquire_suffix):
@@ -182,7 +186,7 @@ class _ResourceWalker(FlowWalker):
         self.owned_kinds: Set[str] = set()
         for call in calls_in(func.node, skip_nested=False):
             text = func.module.expand(dotted(call.func) or "")
-            for k in KINDS:
+            for k in pass_.kinds:
                 if any(
                     text.endswith(s) for s in k.release_method + k.release_arg
                 ):
@@ -242,7 +246,8 @@ class _ResourceWalker(FlowWalker):
             kind = None
             if isinstance(value, ast.Call):
                 kind = _acquire_kind(
-                    expand(dotted(value.func) or ""), value
+                    expand(dotted(value.func) or ""), value,
+                    kinds=self.p.kinds,
                 )
             for t in targets:
                 if isinstance(t, ast.Name):
@@ -291,7 +296,7 @@ class _ResourceWalker(FlowWalker):
                 and var in cf.arg_names
             ):
                 return ("double" if rec.released else "release", var, k)
-        for k in KINDS:
+        for k in self.p.kinds:
             if any(cf.text.endswith(s) for s in k.acquire_arg):
                 for name in cf.arg_names:
                     if name not in state:
@@ -316,7 +321,7 @@ class _ResourceWalker(FlowWalker):
                 elif verb == "double":
                     if kind.unsafe_double:
                         self._emit(
-                            "resource-double-release",
+                            self.p.double_rule,
                             cf.node.lineno,
                             f"{kind.name}:{var}",
                             f"`{var}` ({kind.name}) is released twice on "
@@ -441,7 +446,7 @@ class _ResourceWalker(FlowWalker):
     def _leak(self, var: str, rec: _Rec, where: str, node) -> None:
         at = getattr(node, "lineno", rec.line)
         self._emit(
-            "resource-leak",
+            self.p.leak_rule,
             rec.line,
             f"{rec.kind.name}:{var}",
             f"`{var}` ({rec.kind.name}) acquired here escapes via {where} "
@@ -466,8 +471,23 @@ class _ResourceWalker(FlowWalker):
 
 
 class _ResourcePass:
-    def __init__(self, index: PackageIndex):
+    """Parameterized acquire/release engine: the resource pass proper
+    runs it over :data:`KINDS`; sibling passes (``tracectx``) reuse the
+    whole path-sensitive machinery with their own kind table and rule
+    names."""
+
+    def __init__(
+        self,
+        index: PackageIndex,
+        *,
+        kinds: Tuple[Kind, ...] = KINDS,
+        leak_rule: str = "resource-leak",
+        double_rule: str = "resource-double-release",
+    ):
         self.index = index
+        self.kinds = kinds
+        self.leak_rule = leak_rule
+        self.double_rule = double_rule
         self.stmt_facts: Dict[int, _StmtFacts] = {}
 
     def run(self) -> List[Finding]:

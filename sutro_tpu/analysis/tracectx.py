@@ -1,0 +1,52 @@
+"""Trace-context pass: a started trace span must be ended on every path.
+
+The forensics trace store (``telemetry/traces.py``) hands out ``Trace``
+handles from ``start_trace``; a handle that is never ``end()``-ed (or
+``end_trace``-d by id) leaves the trace permanently unfinished — it
+still renders, but the doctor grades it ``insufficient_data``-adjacent
+and the ring holds a request that looks in-flight forever. The naming
+contract (documented on :mod:`..telemetry.traces`) makes this a
+resource-lifecycle problem:
+
+- acquire: ``tr = <anything>.start_trace(...)`` — *binding* the handle
+  takes ownership of ending it in this function;
+- release: ``tr.end(...)`` or ``<store>.end_trace(tr)``.
+
+Sites that start and end a trace in *different* functions (the gateway
+starts, ``finish()`` ends) use a BARE ``start_trace(...)`` call and key
+the handoff by the trace_id string — the pass tracks bound handles
+only, so cross-function propagation is clean by design.
+
+Rule: ``trace-ctx-dropped``. The engine is the parameterized
+acquire/release walker from :mod:`.resources` — same escape rules
+(arg-pass, attribute/subscript store, closure capture, rebind, yield,
+``is None`` refinement), same implicit-exception-edge gating (only
+functions that end a trace somewhere get exception-path findings).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from .callgraph import PackageIndex
+from .core import Finding
+from .resources import Kind, _ResourcePass
+
+TRACE_KINDS: Tuple[Kind, ...] = (
+    Kind(
+        name="trace-ctx",
+        acquire_suffix=(".start_trace",),
+        release_method=(".end",),
+        release_arg=(".end_trace",),
+        release_hint="end()/end_trace()",
+    ),
+)
+
+
+def run(index: PackageIndex) -> List[Finding]:
+    return _ResourcePass(
+        index,
+        kinds=TRACE_KINDS,
+        leak_rule="trace-ctx-dropped",
+        double_rule="trace-ctx-double-end",
+    ).run()

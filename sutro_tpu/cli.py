@@ -269,6 +269,51 @@ def doctor(job_id: str, as_json: bool) -> None:
 
 
 @cli.command()
+@click.argument("ident")
+@click.option("-o", "--out", type=click.Path(dir_okay=False),
+              help="Write the Chrome trace JSON here (default: stdout)")
+@click.option("--json", "as_json", is_flag=True,
+              help="Same document, compact (alias for piping)")
+def trace(ident: str, out: Optional[str], as_json: bool) -> None:
+    """Tail-latency forensics: export one request's end-to-end trace
+    (admission -> queue -> prefill -> decode -> flush) or a whole job's
+    flight record as Chrome trace-event JSON. Load the file at
+    https://ui.perfetto.dev or chrome://tracing. IDENT is a trace id
+    (tr-..., e.g. from an alert's exemplar_trace_ids), a request id, or
+    a job id (OBSERVABILITY.md "Forensics")."""
+    from .telemetry import traceexport
+
+    try:
+        doc = get_sdk().get_trace(ident)
+    except KeyError as e:
+        click.echo(to_colored_text(f"✗ {e}", "fail"))
+        raise SystemExit(1)
+    except Exception as e:  # noqa: BLE001 — remote 404/conn errors
+        click.echo(to_colored_text(f"✗ trace unavailable: {e}", "fail"))
+        raise SystemExit(1)
+    text = (
+        json.dumps(doc, sort_keys=True) + "\n"
+        if as_json
+        else traceexport.render(doc)
+    )
+    if out:
+        with open(out, "w", encoding="utf-8") as fh:
+            fh.write(text)
+        n = len(doc.get("traceEvents") or [])
+        click.echo(to_colored_text(
+            f"wrote {n} events to {out} — open in ui.perfetto.dev",
+            "callout",
+        ))
+        verdict = (doc.get("otherData") or {}).get("verdict")
+        if verdict:
+            click.echo(f"verdict: {verdict.get('verdict')}")
+            for line in verdict.get("evidence") or []:
+                click.echo(f"  - {line}")
+    else:
+        click.echo(text, nl=False)
+
+
+@cli.command()
 @click.option("--interval", default=2.0, show_default=True,
               help="Seconds between dashboard refreshes")
 @click.option("--once", is_flag=True,

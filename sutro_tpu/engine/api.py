@@ -690,7 +690,41 @@ class LocalEngine:
 
     def _dump_telemetry(self, job_id: str) -> None:
         """Flight-recorder postmortem on job failure (best-effort)."""
+        if telemetry.enabled():
+            # only failure paths land here — mark the job's forensics
+            # trace (no-op if the job never got one)
+            telemetry.TRACES.end_trace(f"tr-{job_id}", "error")
         telemetry.dump_job(self.jobs._dir(job_id), job_id)
+
+    def get_trace(self, ident: str) -> Dict[str, Any]:
+        """Chrome trace-event JSON (Perfetto-loadable) for ``ident``:
+        a forensics trace id (``tr-...``, from an alert exemplar or a
+        request's telemetry), a request/job id whose trace is still in
+        the ring, or a plain job id — the latter renders the job's
+        whole flight record instead. KeyError -> 404 upstream."""
+        from ..telemetry import doctor, traceexport
+
+        doc = telemetry.TRACES.doc(ident)
+        if doc is None and not ident.startswith("tr-"):
+            doc = telemetry.TRACES.doc(f"tr-{ident}")
+        if doc is not None:
+            chrome = traceexport.trace_to_chrome(doc)
+            chrome["otherData"]["verdict"] = doctor.diagnose_request(doc)
+            return chrome
+        # fall back to the whole-job flight record
+        jid = ident[3:] if ident.startswith("tr-") else ident
+        jdoc = telemetry.job_doc(jid)
+        if not jdoc["spans"] and not jdoc["counters"]:
+            persisted = None
+            try:
+                self.jobs.get(jid)
+                persisted = telemetry.load_job_dump(self.jobs._dir(jid))
+            except KeyError:
+                pass
+            if persisted is None:
+                raise KeyError(f"no trace or job telemetry for {ident!r}")
+            jdoc = persisted
+        return traceexport.job_doc_to_chrome(jdoc)
 
     def diagnose_job(self, job_id: str) -> Dict[str, Any]:
         """Bottleneck doctor (OBSERVABILITY.md "Doctor"): analyze the
@@ -974,6 +1008,10 @@ class LocalEngine:
                     {"event": "job_failed",
                      "error": f"{type(e).__name__}: {e}"},
                 )
+                # crash-time postmortem BEFORE the status flip, same
+                # rule as the failure_log entry: a watcher that sees
+                # FAILED finds telemetry.json already in place
+                self._dump_telemetry(job_id)
                 try:
                     self.jobs.set_status(
                         job_id,
@@ -982,9 +1020,6 @@ class LocalEngine:
                     )
                 except Exception:
                     pass
-                # crash-time postmortem: the job's span timeline +
-                # counters land next to its failure_log[]
-                self._dump_telemetry(job_id)
             finally:
                 if requeue_priority is None:
                     # finish metrics BEFORE releasing _current_job:
@@ -1234,6 +1269,7 @@ class LocalEngine:
                     {"event": "job_failed",
                      "error": f"{type(e).__name__}: {e}"},
                 )
+                self._dump_telemetry(jid)
                 try:
                     self.jobs.set_status(
                         jid,
@@ -1244,7 +1280,6 @@ class LocalEngine:
                     )
                 except Exception:
                     pass
-                self._dump_telemetry(jid)
                 self.metrics.job(jid).finish()
                 with self._lock:
                     self._attached.discard(jid)
@@ -1411,6 +1446,7 @@ class LocalEngine:
                     {"event": "job_failed",
                      "error": "co-batched session error"},
                 )
+                self._dump_telemetry(jid2)
                 try:
                     self.jobs.set_status(
                         jid2,
@@ -1421,7 +1457,6 @@ class LocalEngine:
                     )
                 except Exception:
                     pass
-                self._dump_telemetry(jid2)
                 self.metrics.job(jid2).finish()
                 with self._lock:
                     self._attached.discard(jid2)
@@ -2067,6 +2102,9 @@ class _GenSession:
             seq=seq,
             row_retries=eng.ecfg.row_retries,
             on_row_event=self.on_row_event,
+            # forensics queue_wait measures from here (build complete,
+            # parked for a session) to scheduler adoption
+            trace_enq_mono=time.monotonic() if self._tel_on else 0.0,
         )
 
     def _encode_rows(self, inputs, rec, mcfg) -> List[List[int]]:
@@ -2314,6 +2352,9 @@ class _GenSession:
             self.jtel.set("output_tokens", output_tokens)
             telemetry.TOKENS_TOTAL.inc(float(self.input_tokens), "in")
             telemetry.TOKENS_TOTAL.inc(float(output_tokens), "out")
+            # close the job's forensics trace (started at scheduler
+            # adoption); interactive traces end in gateway.finish()
+            telemetry.TRACES.end_trace(f"tr-{self.job_id}", "ok")
         self.eng.jobs.update(
             self.job_id,
             input_tokens=self.input_tokens,

@@ -294,6 +294,14 @@ class JobCtx:
     stats: Dict[str, int] = dataclasses.field(
         default_factory=lambda: {"in": 0, "out": 0, "rows": 0}
     )
+    # forensics trace (telemetry/traces.py): the propagated per-request
+    # (gateway-assigned) or per-job (scheduler-assigned) trace id;
+    # ``trace_enq_mono`` is the submit/park time the queue_wait span
+    # measures from; ``trace_preempted`` holds row ids suspended by a
+    # preemption so re-admission emits the matching resume event
+    trace_id: Optional[str] = None
+    trace_enq_mono: float = 0.0
+    trace_preempted: set = dataclasses.field(default_factory=set)
     n_slots: int = 0         # live slots carrying this job
     done: bool = False
     started: float = 0.0
@@ -489,6 +497,10 @@ class ContinuousBatcher:
         # attributable per job
         self._tel_on = telemetry.enabled()
         self._tel_jobs: Tuple[str, ...] = ()
+        # live co-batched trace ids (subset of _tel_jobs' ctxs that
+        # carry one): batch-wide spans fan into each request's forensic
+        # timeline (telemetry/traces.py)
+        self._tel_traces: Tuple[str, ...] = ()
         # per-window device-time attribution (doctor roofline grades):
         # the decode/prefill loops stash {stage: {batch, steps, ...}}
         # here right before dispatch; the sink folds it into the span
@@ -499,7 +511,12 @@ class ContinuousBatcher:
 
     def _tel_sink(self, phase: str, t0: float, dt: float) -> None:
         stage = _TEL_STAGE.get(phase, phase)
-        telemetry.stage_observe(stage, dt)
+        # stage exemplar: point the aggregate histogram at one live
+        # request's trace so a slow-bucket sample is resolvable
+        telemetry.stage_observe(
+            stage, dt,
+            exemplar=self._tel_traces[0] if self._tel_traces else None,
+        )
         extra = self._tel_attrs.get(stage)
         attrs = None
         if self._tel_jobs or extra:
@@ -507,6 +524,8 @@ class ContinuousBatcher:
             if self._tel_jobs:
                 attrs["jobs"] = self._tel_jobs
         telemetry.RECORDER.record(stage, None, t0, dt, attrs)
+        for tid in self._tel_traces:
+            telemetry.TRACES.add(tid, stage, t0, dt, extra)
 
     # ------------------------------------------------------------------
 
@@ -618,6 +637,12 @@ class ContinuousBatcher:
                     own_pages=[],
                 )
                 ctx.prefix_saved += shared
+                if self._tel_on and ctx.trace_id is not None:
+                    telemetry.TRACES.event(
+                        ctx.trace_id, "prefix_hit",
+                        {"saved_tokens": int(shared),
+                         "paid_tokens": 0},
+                    )
                 return
             if self.native is not None:
                 pages = self.native.alloc_pages(tail_n)
@@ -691,6 +716,20 @@ class ContinuousBatcher:
             tokens=shared, pages=hit_pages + list(pages),
             handle=handle, own_pages=own,
         )
+        if self._tel_on and ctx.trace_id is not None:
+            if hit:
+                telemetry.TRACES.event(
+                    ctx.trace_id, "prefix_hit",
+                    {"saved_tokens": int(hit),
+                     "paid_tokens": int(paid)},
+                )
+            if store is not None and not own:
+                # freshly prefilled tail transferred into the radix
+                # tree — the next job's warm head
+                telemetry.TRACES.event(
+                    ctx.trace_id, "prefix_extend",
+                    {"tokens": int(paid)},
+                )
 
     def _free_prefix_pages(self, pages: List[int]) -> None:
         if self.native is not None:
@@ -1988,11 +2027,27 @@ class ContinuousBatcher:
         an ``accept`` span (the decode span covers only the device
         dispatch/fetch)."""
         dt = time.monotonic() - t0
-        telemetry.stage_observe("accept", dt)
+        telemetry.stage_observe(
+            "accept", dt,
+            exemplar=self._tel_traces[0] if self._tel_traces else None,
+        )
         telemetry.RECORDER.record(
             "accept", None, t0, dt,
             {"jobs": self._tel_jobs} if self._tel_jobs else None,
         )
+        for tid in self._tel_traces:
+            telemetry.TRACES.add(tid, "accept", t0, dt)
+
+    def _trace_resume(self, ctx: JobCtx, req: GenRequest) -> None:
+        """Close a preempt_suspend pair: the row a preemption suspended
+        is re-entering the batch (telemetry on, checked by caller)."""
+        rid = int(req.row_id)
+        if rid in ctx.trace_preempted:
+            ctx.trace_preempted.discard(rid)
+            if ctx.trace_id is not None:
+                telemetry.TRACES.event(
+                    ctx.trace_id, "resume", {"row_id": rid}
+                )
 
     def _accept_plain_window(
         self, idxs: List[int], toks: np.ndarray, logps: np.ndarray,
@@ -2197,6 +2252,22 @@ class ContinuousBatcher:
         # shared-prefix setup is LAZY (_admit_pending): a job attached
         # behind a full batch must not pin prefix pages while it waits
         ctx.started = ctx.t_last = time.monotonic()
+        if self._tel_on:
+            if ctx.trace_id is None:
+                # batch jobs get a per-job trace at adoption (the
+                # gateway already assigned one to interactive requests)
+                ctx.trace_id = f"tr-{ctx.job_id}"
+                telemetry.TRACES.start_trace(
+                    ctx.trace_id, "batch",
+                    {"job_id": ctx.job_id, "rows": len(ctx.pending)},
+                )
+            if ctx.trace_enq_mono:
+                # admission queue wait: from submit/park to session
+                # adoption — the leg the queue_wait_bound verdict grades
+                telemetry.TRACES.add(
+                    ctx.trace_id, "queue_wait", ctx.trace_enq_mono,
+                    ctx.started - ctx.trace_enq_mono,
+                )
 
     def _job_progress(self, ctx: JobCtx, force: bool = False) -> None:
         if ctx.on_progress is None:
@@ -2319,6 +2390,13 @@ class ContinuousBatcher:
         victim.stats["preempted"] = victim.stats.get("preempted", 0) + 1
         if self._tel_on:
             telemetry.INTERACTIVE_PREEMPTIONS_TOTAL.inc(1.0)
+            if victim.trace_id is not None:
+                victim.trace_preempted.add(int(s.req.row_id))
+                telemetry.TRACES.event(
+                    victim.trace_id, "preempt_suspend",
+                    {"row_id": int(s.req.row_id), "by": ctx.job_id,
+                     "lost_tokens": int(best_cost)},
+                )
         logger.debug(
             "interactive admit: suspended batch row %d of %s "
             "(%d tokens regenerate)",
@@ -2382,6 +2460,13 @@ class ContinuousBatcher:
             victim.stats["preempted"] = (
                 victim.stats.get("preempted", 0) + 1
             )
+            if self._tel_on and victim.trace_id is not None:
+                victim.trace_preempted.add(int(s.req.row_id))
+                telemetry.TRACES.event(
+                    victim.trace_id, "preempt_suspend",
+                    {"row_id": int(s.req.row_id), "by": ctx.job_id,
+                     "lost_tokens": int(best_cost)},
+                )
             lad.record(ctx, victim)
             logger.debug(
                 "priority ladder: P%d %s suspended row %d of P%d %s "
@@ -2468,6 +2553,8 @@ class ContinuousBatcher:
                     if r is None:
                         break
                     ctx.pending.pop()
+                    if self._tel_on and ctx.trace_preempted:
+                        self._trace_resume(ctx, req)
                     try:
                         self._materialize_constraint(req)
                     except Exception as e:  # noqa: BLE001 — row isolation
@@ -2500,6 +2587,8 @@ class ContinuousBatcher:
                 if r is None:
                     break
                 ctx.pending.pop()
+                if self._tel_on and ctx.trace_preempted:
+                    self._trace_resume(ctx, req)
                 try:
                     self._materialize_constraint(req)
                 except Exception as e:  # noqa: BLE001 — row isolation
@@ -2576,6 +2665,11 @@ class ContinuousBatcher:
                     # live job ids; a tuple rebuild per iteration is a
                     # few hundred ns against a multi-ms device window
                     self._tel_jobs = tuple(c.job_id for c in ajobs)
+                    self._tel_traces = tuple(
+                        c.trace_id
+                        for c in ajobs
+                        if c.trace_id is not None
+                    )
                 if not ajobs:
                     break
                 order = sorted(

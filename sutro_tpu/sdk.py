@@ -682,6 +682,18 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
             ]
         return self.engine.diagnose_job(job_id)
 
+    def get_trace(self, ident: str) -> Dict[str, Any]:
+        """Chrome trace-event JSON (Perfetto-loadable) for a forensics
+        trace id (``tr-...``, e.g. from an alert's
+        ``exemplar_trace_ids``), a request/job id whose trace is still
+        in the ring, or a plain job id (whole flight record). Both
+        backends; the daemon serves the raw document at
+        ``GET /trace/{id}`` so it can be piped straight into Perfetto.
+        Raises ``KeyError`` locally / 404 remotely when unknown."""
+        if self.backend == "remote":
+            return self._remote_json("get", f"trace/{ident}")
+        return self.engine.get_trace(ident)
+
     def get_job_fleet(self, job_id: str) -> Dict[str, Any]:
         """Elastic dp fleet view for a job (FAILURES.md "Elastic
         fleet"): per-rank membership state (running, idle, lost,

@@ -160,6 +160,10 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
                 self._json({"telemetry": eng.job_telemetry(rest)})
             elif head == "job-doctor" and rest:
                 self._json({"doctor": eng.diagnose_job(rest)})
+            elif head == "trace" and rest:
+                # Chrome trace-event JSON served RAW (not wrapped):
+                # `curl .../trace/<id> > t.json` loads in Perfetto as-is
+                self._json(eng.get_trace(rest))
             elif head == "job-fleet" and rest:
                 self._json({"fleet": eng.job_fleet(rest)})
             elif head == "monitor" and rest == "stream":
@@ -440,6 +444,9 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
         disconnect probes: a dead socket raises on the write, which
         cancels the request — the scheduler then frees its slot and KV
         pages on the next loop iteration."""
+        import time
+
+        from . import telemetry
         from .engine import faults
         from .serving import openai as oai
 
@@ -449,11 +456,26 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
 
+        # forensics: each SSE flush lands as a stream_flush span in the
+        # request's trace — the leg the stream_flush_bound verdict
+        # grades (a slow consumer shows up HERE, not in decode)
+        tel_tid = (
+            getattr(ir.channel, "trace_id", None)
+            if telemetry.ENABLED
+            else None
+        )
+
         def send(data: bytes) -> None:
+            t0 = time.monotonic()
             self.wfile.write(
                 f"{len(data):X}\r\n".encode() + data + b"\r\n"
             )
             self.wfile.flush()
+            if tel_tid is not None:
+                telemetry.TRACES.add(
+                    tel_tid, "stream_flush", t0,
+                    time.monotonic() - t0, {"bytes": len(data)},
+                )
 
         try:
             for obj in oai.iter_stream(ir, chat=chat):

@@ -30,6 +30,8 @@ import time
 from collections import deque
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
+from .. import telemetry
+
 #: producer-side backstop: tokens buffered with no consumer progress
 MAX_BUFFERED_EVENTS = 65536
 #: bounded inter-token-latency sample list per request
@@ -49,6 +51,9 @@ class StreamChannel:
         self.n_tokens = 0
         self.result: Optional[Dict[str, Any]] = None
         self.error: Optional[str] = None
+        # forensics trace id, set by the gateway when telemetry is on;
+        # None means every trace hook below is skipped
+        self.trace_id: Optional[str] = None
 
     # -- producer side (scheduler thread) ------------------------------
 
@@ -59,6 +64,11 @@ class StreamChannel:
                 return
             if self.first_token_at is None:
                 self.first_token_at = now
+                if self.trace_id is not None and telemetry.ENABLED:
+                    telemetry.TRACES.add(
+                        self.trace_id, "first_token", now, 0.0,
+                        {"ttft_s": round(now - self.created, 6)},
+                    )
             elif len(self.itl_samples) < MAX_ITL_SAMPLES:
                 self.itl_samples.append(now - self.last_token_at)
             self.last_token_at = now

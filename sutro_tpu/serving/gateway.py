@@ -131,6 +131,7 @@ class InteractiveGateway:
     # -- admission (HTTP handler / SDK thread) -------------------------
 
     def submit(self, sreq: ServingRequest) -> InteractiveRequest:
+        t_submit = time.monotonic()
         rid = f"ivr-{next(self._counter)}"
         if faults.ACTIVE is not None:
             try:
@@ -292,6 +293,27 @@ class InteractiveGateway:
             row_seed=sreq.seed,
             stop_seqs=[s.encode() for s in stop_strs] or None,
         )
+        trace_id = None
+        if telemetry.ENABLED:
+            # forensics trace (OBSERVABILITY.md "Forensics"): the id
+            # propagates through JobCtx into the scheduler's child
+            # spans and through the channel into the server's SSE
+            # flush spans; ended by finish(). Handle deliberately not
+            # held — the id string IS the cross-function context.
+            trace_id = f"tr-{rid}"
+            telemetry.TRACES.start_trace(
+                trace_id,
+                "interactive",
+                {"request_id": rid, "model": sreq.model,
+                 "tenant": sreq.tenant or "default"},
+                t0_mono=t_submit,
+            )
+            telemetry.TRACES.add(
+                trace_id, "admit_gateway", t_submit,
+                time.monotonic() - t_submit,
+                {"prompt_tokens": len(ids), "warm_tokens": int(warm)},
+            )
+            channel.trace_id = trace_id
         with self._lock:
             ctx = JobCtx(
                 job_id=rid,
@@ -304,6 +326,8 @@ class InteractiveGateway:
                 #               fast; the client retries, not the engine
                 on_token=on_token,
                 interactive=True,
+                trace_id=trace_id,
+                trace_enq_mono=time.monotonic(),
             )
             ir = InteractiveRequest(
                 id=rid,
@@ -398,10 +422,22 @@ class InteractiveGateway:
         )
         if telemetry.ENABLED:
             self._count_outcome(final)
+            tid = ctx.trace_id
             if ttft is not None:
-                telemetry.TTFT_SECONDS.observe(ttft)
+                # exemplar: the aggregate histogram keeps a pointer to
+                # THIS request's trace, so a firing p99 alert resolves
+                # to a concrete timeline (`sutro trace <id>`)
+                telemetry.TTFT_SECONDS.observe(ttft, exemplar=tid)
             for itl in ch.itl_samples:
-                telemetry.ITL_SECONDS.observe(itl)
+                telemetry.ITL_SECONDS.observe(itl, exemplar=tid)
+            if tid is not None:
+                telemetry.TRACES.event(
+                    tid, "finish",
+                    {"outcome": final, "tokens": ch.n_tokens,
+                     "ttft_s": ttft,
+                     "preempted_rows": ctx.stats.get("preempted", 0)},
+                )
+                telemetry.TRACES.end_trace(tid, final)
             elapsed = max(time.monotonic() - ch.created, 1e-6)
             telemetry.ROWS_PER_SECOND.set(1.0 / elapsed, "interactive")
             if ir.sreq.tenant and (ir.prompt_tokens or ch.n_tokens):

@@ -40,6 +40,7 @@ from .spans import (
     JobCounters,
     JobTelemetryStore,
 )
+from .traces import DEFAULT_TRACE_CAPACITY, TraceStore
 
 logger = logging.getLogger(__name__)
 
@@ -47,8 +48,11 @@ __all__ = [
     "REGISTRY",
     "RECORDER",
     "JOBS",
+    "TRACES",
     "distributed",
     "monitor",
+    "traces",
+    "traceexport",
     "enabled",
     "set_enabled",
     "stage_observe",
@@ -60,6 +64,7 @@ __all__ = [
     "FlightRecorder",
     "JobCounters",
     "JobTelemetryStore",
+    "TraceStore",
 ]
 
 # -- the one enable switch ---------------------------------------------
@@ -90,6 +95,11 @@ RECORDER = FlightRecorder(
 )
 JOBS = JobTelemetryStore(
     capacity=int(os.environ.get("SUTRO_TELEMETRY_JOBS", 256))
+)
+TRACES = TraceStore(
+    capacity=int(
+        os.environ.get("SUTRO_TELEMETRY_TRACES", DEFAULT_TRACE_CAPACITY)
+    )
 )
 
 # -- engine metric catalog (documented in OBSERVABILITY.md) ------------
@@ -315,14 +325,18 @@ STAGES = (
 )
 
 
-def stage_observe(stage: str, dur_s: float) -> None:
+def stage_observe(
+    stage: str, dur_s: float, exemplar: Optional[str] = None
+) -> None:
     """One engine stage latency sample into the registry histogram
     (the flight-recorder span is the caller's concern — spans carry
-    job identity, the histogram does not). Internally gated: callers
-    on hot paths may invoke it bare and still honor the kill switch."""
+    job identity, the histogram does not). ``exemplar`` optionally
+    pins a trace id to the sample's bucket (forensics). Internally
+    gated: callers on hot paths may invoke it bare and still honor
+    the kill switch."""
     if not ENABLED:
         return
-    STAGE_SECONDS.observe(dur_s, stage)
+    STAGE_SECONDS.observe(dur_s, stage, exemplar=exemplar)
 
 
 def job(job_id: str) -> JobCounters:
@@ -413,6 +427,7 @@ def reset_for_tests() -> None:
     stay). Tests only."""
     REGISTRY.reset()
     RECORDER.clear()
+    TRACES.clear()
     for jc in JOBS:
         JOBS.drop(jc.job_id)
     distributed.REMOTE.clear()
@@ -423,3 +438,4 @@ def reset_for_tests() -> None:
 # publish the names
 from . import distributed  # noqa: E402
 from . import monitor  # noqa: E402
+from . import traceexport  # noqa: E402

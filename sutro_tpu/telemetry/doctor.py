@@ -217,6 +217,138 @@ def _grade_roofline(
 #: statuses past which a job can no longer gain spans
 _TERMINAL_STATUSES = ("SUCCEEDED", "FAILED", "CANCELLED")
 
+# -- per-request verdicts (forensics traces, telemetry/traces.py) ------
+
+#: the per-request taxonomy, in priority order
+REQUEST_VERDICTS = (
+    "insufficient_data",
+    "queue_wait_bound",
+    "preemption_bound",
+    "stream_flush_bound",
+    "healthy",
+)
+
+#: a leg must cover at least this fraction of the request wall to be
+#: "bound" by it (queue wait uses the stricter QUEUE_BOUND_FRACTION)
+REQUEST_BOUND_FRACTION = 0.25
+QUEUE_BOUND_FRACTION = 0.4
+
+#: stages that are the request actually computing (device + host work)
+_REQUEST_COMPUTE = ("prefill", "decode_window", "admit", "accept")
+
+
+def diagnose_request(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """Grade ONE request's trace document (telemetry/traces.py) into a
+    per-request verdict: where did THIS request's wall time go —
+    admission queue, preemption stalls, stream flush, or honest
+    compute. Pure analysis, same contract as :func:`diagnose`: runs
+    identically on a live trace, a served ``GET /trace/{id}``'s source
+    document, or a synthetic one in tests."""
+    spans = list(doc.get("spans") or ())
+    trace_id = doc.get("trace_id")
+    out: Dict[str, Any] = {
+        "version": DOCTOR_VERSION,
+        "trace_id": trace_id,
+        "kind": doc.get("kind"),
+        "outcome": doc.get("outcome"),
+    }
+    if not spans:
+        out["verdict"] = "insufficient_data"
+        out["evidence"] = [
+            "no spans in this trace (telemetry disabled mid-request, "
+            "or the trace ring evicted it)"
+        ]
+        out["legs"] = {}
+        return out
+
+    t_lo = min(float(s["t0_s"]) for s in spans)
+    t_hi = max(float(s["t0_s"]) + float(s["dur_s"]) for s in spans)
+    wall = max(t_hi - t_lo, 1e-9)
+
+    def _leg(*names: str) -> float:
+        return sum(
+            float(s["dur_s"]) for s in spans if s["name"] in names
+        )
+
+    queue_s = _leg("queue_wait")
+    compute_s = _leg(*_REQUEST_COMPUTE)
+    flush_s = _leg("stream_flush")
+    # suspend -> resume stall per preempted row: pair each
+    # preempt_suspend with the NEXT resume carrying the same row_id
+    suspends: Dict[int, float] = {}
+    preempt_stall_s = 0.0
+    n_preempt = 0
+    for s in spans:
+        a = s.get("attrs") or {}
+        if s["name"] == "preempt_suspend":
+            n_preempt += 1
+            rid = a.get("row_id")
+            if rid is not None and rid not in suspends:
+                suspends[int(rid)] = float(s["t0_s"])
+        elif s["name"] == "resume":
+            rid = a.get("row_id")
+            t0 = suspends.pop(int(rid), None) if rid is not None else None
+            if t0 is not None:
+                preempt_stall_s += max(float(s["t0_s"]) - t0, 0.0)
+    # a suspend never resumed stalls through the end of the trace
+    for t0 in suspends.values():
+        preempt_stall_s += max(t_hi - t0, 0.0)
+
+    legs = {
+        "wall_s": round(wall, 6),
+        "queue_s": round(queue_s, 6),
+        "compute_s": round(compute_s, 6),
+        "flush_s": round(flush_s, 6),
+        "preempt_stall_s": round(preempt_stall_s, 6),
+        "preemptions": n_preempt,
+    }
+    evidence: List[str] = []
+    verdict: Optional[str] = None
+    if queue_s > compute_s and queue_s >= QUEUE_BOUND_FRACTION * wall:
+        verdict = "queue_wait_bound"
+        evidence.append(
+            f"admission queue wait {queue_s:.3f}s covers "
+            f"{100.0 * queue_s / wall:.0f}% of the request wall "
+            f"{wall:.3f}s and exceeds compute {compute_s:.3f}s: the "
+            "request waited for a session slot, not for the chip"
+        )
+    elif (
+        n_preempt
+        and preempt_stall_s > max(queue_s, flush_s)
+        and preempt_stall_s >= REQUEST_BOUND_FRACTION * wall
+    ):
+        verdict = "preemption_bound"
+        evidence.append(
+            f"{n_preempt} preemption(s) stalled this request "
+            f"{preempt_stall_s:.3f}s of its {wall:.3f}s wall "
+            "(suspended rows re-admitted row-granularly and "
+            "regenerated): lower co-tenant priority pressure or raise "
+            "interactive_slots headroom"
+        )
+    elif flush_s > compute_s and flush_s >= REQUEST_BOUND_FRACTION * wall:
+        verdict = "stream_flush_bound"
+        evidence.append(
+            f"SSE flush {flush_s:.3f}s exceeds compute {compute_s:.3f}s "
+            f"({100.0 * flush_s / wall:.0f}% of wall): the consumer "
+            "(client socket) is the bottleneck, not the engine"
+        )
+    if verdict is None:
+        verdict = "healthy"
+        evidence.append(
+            f"compute {compute_s:.3f}s dominates queue {queue_s:.3f}s, "
+            f"flush {flush_s:.3f}s and preemption stalls "
+            f"{preempt_stall_s:.3f}s over a {wall:.3f}s wall"
+        )
+    if n_preempt and verdict != "preemption_bound":
+        evidence.append(
+            f"{n_preempt} preemption(s) observed "
+            f"(total stall {preempt_stall_s:.3f}s)"
+        )
+    out["verdict"] = verdict
+    out["evidence"] = evidence
+    out["legs"] = legs
+    return out
+
 
 def diagnose(
     doc: Dict[str, Any],

@@ -625,10 +625,19 @@ class Monitor:
                 self._events.extend(out)
         return out
 
+    #: alert metric -> registry histogram carrying its exemplars; a
+    #: firing alert embeds the worst captured trace ids so `sutro
+    #: trace <id>` jumps straight from the page to the forensic trace
+    _EXEMPLAR_SOURCE: Dict[str, str] = {
+        "ttft_p99_s": "sutro_interactive_ttft_seconds",
+        "itl_p99_s": "sutro_interactive_itl_seconds",
+    }
+    _EXEMPLAR_TOP = 3
+
     def _event(
         self, rule: SLORule, state: str, value: float, now_unix: float
     ) -> Dict[str, Any]:
-        return {
+        ev = {
             "rule": rule.name,
             "state": state,
             "severity": rule.severity,
@@ -639,6 +648,28 @@ class Monitor:
             "value": round(value, 6),
             "unix": round(now_unix, 3),
         }
+        if state == "firing":
+            ids = self._exemplar_trace_ids(rule.metric)
+            if ids:
+                ev["exemplar_trace_ids"] = ids
+        return ev
+
+    def _exemplar_trace_ids(self, metric: str) -> List[str]:
+        """Worst (highest-value) exemplar trace ids for the histogram
+        backing ``metric``, deduplicated, worst first."""
+        hist = self._EXEMPLAR_SOURCE.get(metric)
+        if hist is None:
+            return []
+        from . import REGISTRY
+
+        out: List[str] = []
+        for ex in REGISTRY.exemplars(hist):
+            tid = ex.get("trace_id")
+            if tid and tid not in out:
+                out.append(tid)
+            if len(out) >= self._EXEMPLAR_TOP:
+                break
+        return out
 
     def _dump_for_alert(self, ev: Dict[str, Any]) -> None:
         """A firing alert persists the flight recorder next to every

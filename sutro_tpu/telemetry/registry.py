@@ -45,6 +45,7 @@ import bisect
 import logging
 import math
 import threading
+import time as _time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 logger = logging.getLogger(__name__)
@@ -143,12 +144,31 @@ class Gauge(_Metric):
 
 
 class Histogram(_Metric):
+    #: recency bias: a stored exemplar older than this loses its slot
+    #: to ANY new observation in the bucket, even a smaller one
+    EXEMPLAR_MAX_AGE_S = 60.0
+
     def __init__(self, *args: Any, buckets: Sequence[float] = DEFAULT_BUCKETS,
                  **kw: Any) -> None:
         super().__init__(*args, **kw)
         self.buckets = tuple(sorted(float(b) for b in buckets))
+        # exemplars: (label_values, bucket_idx) ->
+        #   (value, trace_id, attrs_or_None, unix_ts). One slot per
+        # bucket per series — bounded by construction (buckets x
+        # max_series). Stored registry-global, not in thread shards:
+        # an exemplar must survive shard folding and read identically
+        # from every concurrent scrape. Single dict assignment per
+        # capture (GIL-atomic), no lock.
+        self._exemplars: Dict[Tuple[Tuple[str, ...], int], tuple] = {}
 
-    def observe(self, value: float, *labels: str) -> None:
+    def observe(
+        self,
+        value: float,
+        *labels: str,
+        exemplar: Optional[str] = None,
+        exemplar_attrs: Optional[Dict[str, str]] = None,
+        _now: Optional[float] = None,
+    ) -> None:
         lv = tuple(str(x) for x in labels)
         self._check(lv)
         lv = self._labelvals(lv)
@@ -158,9 +178,56 @@ class Histogram(_Metric):
         if acc is None:
             acc = h[key] = [0.0] * (len(self.buckets) + 3)
         # layout: [b0..bn, +Inf, sum, count]
-        acc[bisect.bisect_left(self.buckets, value)] += 1.0
+        idx = bisect.bisect_left(self.buckets, value)
+        acc[idx] += 1.0
         acc[-2] += value
         acc[-1] += 1.0
+        if exemplar is not None:
+            self._capture_exemplar(
+                lv, idx, value, exemplar, exemplar_attrs, _now
+            )
+
+    def _capture_exemplar(
+        self,
+        lv: Tuple[str, ...],
+        idx: int,
+        value: float,
+        trace_id: str,
+        attrs: Optional[Dict[str, str]],
+        now: Optional[float],
+    ) -> None:
+        """Latency/recency-biased keep policy: within a bucket the
+        slot goes to the LARGEST value seen recently — a stale holder
+        (older than EXEMPLAR_MAX_AGE_S) yields to any newcomer, so a
+        one-off spike from an hour ago cannot pin the slot forever."""
+        ts = _time.time() if now is None else now
+        cur = self._exemplars.get((lv, idx))
+        if cur is not None:
+            if value < cur[0] and (ts - cur[3]) < self.EXEMPLAR_MAX_AGE_S:
+                return
+        self._exemplars[(lv, idx)] = (
+            float(value), str(trace_id),
+            dict(attrs) if attrs else None, ts,
+        )
+
+    def exemplars_view(self) -> Dict[Tuple[str, ...], Dict[str, Dict]]:
+        """Snapshot ``{label_values: {le_str: exemplar_dict}}`` where
+        ``exemplar_dict`` is ``{value, trace_id, ts[, attrs]}`` —
+        the JSON-snapshot shape, also what the exporter renders."""
+        les = [*self.buckets, math.inf]
+        out: Dict[Tuple[str, ...], Dict[str, Dict]] = {}
+        for (lv, idx), (value, trace_id, attrs, ts) in sorted(
+            self._exemplars.items()
+        ):
+            le = les[idx]
+            le_s = "+Inf" if math.isinf(le) else repr(le)
+            d: Dict[str, Any] = {
+                "value": value, "trace_id": trace_id, "ts": ts,
+            }
+            if attrs:
+                d["attrs"] = dict(attrs)
+            out.setdefault(lv, {})[le_s] = d
+        return out
 
 
 class MetricsRegistry:
@@ -349,7 +416,33 @@ class MetricsRegistry:
                         src[lv + (w,)] = v
             for lv in sorted(src):
                 entry["series"][",".join(lv)] = src[lv]
+            if isinstance(m, Histogram):
+                ex = m.exemplars_view()
+                if ex:
+                    # key shape matches "series" keys for the local
+                    # (non-federated) case; federation ships no
+                    # exemplars — the exporter maps worker-"0" series
+                    # back to these local keys
+                    entry["exemplars"] = {
+                        ",".join(lv): d for lv, d in sorted(ex.items())
+                    }
             out[name] = entry
+        return out
+
+    def exemplars(self, name: str) -> List[Dict[str, Any]]:
+        """Flat exemplar list for one histogram, largest value first —
+        what the monitor embeds into an alert event (top trace ids)."""
+        m = self._metrics.get(name)
+        if not isinstance(m, Histogram):
+            return []
+        out: List[Dict[str, Any]] = []
+        for lv, by_le in m.exemplars_view().items():
+            for le_s, d in by_le.items():
+                row = dict(d)
+                row["le"] = le_s
+                row["labels"] = list(lv)
+                out.append(row)
+        out.sort(key=lambda d: (-d["value"], d["trace_id"]))
         return out
 
     # -- federation (telemetry/distributed.py) -------------------------
@@ -455,6 +548,22 @@ class MetricsRegistry:
             return str(int(v))
         return repr(float(v))
 
+    @classmethod
+    def _fmt_exemplar(cls, ex: Dict[str, Any]) -> str:
+        """OpenMetrics exemplar suffix for a ``_bucket`` line:
+        `` # {trace_id="...",k="v"} value timestamp``."""
+        names = ["trace_id"]
+        values = [str(ex["trace_id"])]
+        for k in sorted(ex.get("attrs") or ()):
+            names.append(str(k))
+            values.append(str(ex["attrs"][k]))
+        return (
+            " # "
+            + cls._fmt_labels(names, values)
+            + f" {cls._fmt_value(ex['value'])}"
+            + f" {cls._fmt_value(float(ex['ts']))}"
+        )
+
     def to_prometheus(self) -> str:
         """Prometheus text exposition format 0.0.4."""
         snap = self.collect()
@@ -463,19 +572,34 @@ class MetricsRegistry:
             lines.append(f"# HELP {name} {m['help']}")
             lines.append(f"# TYPE {name} {m['type']}")
             names = m["labels"]
+            ex_map = m.get("exemplars") or {}
             for key, val in m["series"].items():
                 values = tuple(key.split(",")) if names else ()
                 if m["type"] == "histogram":
+                    ex_series = ex_map.get(key)
+                    if (
+                        ex_series is None
+                        and names
+                        and names[-1] == "worker"
+                        and values[-1:] == ("0",)
+                    ):
+                        # federated metric: exemplars live on the
+                        # coordinator's own (worker "0") series
+                        ex_series = ex_map.get(",".join(values[:-1]))
                     for le, n in val["buckets"].items():
                         le_s = le if le == "+Inf" else self._fmt_value(
                             float(le)
                         )
-                        lines.append(
+                        line = (
                             f"{name}_bucket"
                             + self._fmt_labels(names, values,
                                                ("le", le_s))
                             + f" {n}"
                         )
+                        ex = (ex_series or {}).get(le)
+                        if ex is not None:
+                            line += self._fmt_exemplar(ex)
+                        lines.append(line)
                     lines.append(
                         f"{name}_sum"
                         + self._fmt_labels(names, values)
@@ -509,6 +633,8 @@ class MetricsRegistry:
             self._local = threading.local()
             for m in self._metrics.values():
                 m._series = set()
+                if isinstance(m, Histogram):
+                    m._exemplars = {}
 
 
 def snapshot_delta(

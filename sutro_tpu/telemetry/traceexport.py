@@ -1,0 +1,200 @@
+"""Render traces (and whole-job flight records) as Chrome trace-event
+JSON — the format Perfetto (https://ui.perfetto.dev) and
+chrome://tracing load directly.
+
+Two inputs, one output:
+
+- a per-request trace document from :mod:`.traces` (``sutro trace
+  <trace_id>``) — one process, one lane per stage family, so the
+  admission→queue→prefill→decode→flush waterfall reads left to right;
+- a whole-job telemetry document from :func:`telemetry.job_doc`
+  (``sutro trace <job_id>``) — the flight recorder's spans for that
+  job, same lane layout.
+
+The rendering is pure and deterministic (sorted keys, stable lane
+assignment, microsecond integers) so the export is golden-pinnable.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+# Lane (Chrome "tid") per span-name family, in waterfall order. Spans
+# whose name has no family land in the overflow lane after these.
+_LANES = (
+    ("admit", ("admit_gateway", "admit")),
+    ("queue", ("queue_wait",)),
+    ("prefill", ("prefill", "prefix_hit", "prefix_extend")),
+    (
+        "decode",
+        ("decode_window", "accept", "preempt_suspend", "resume"),
+    ),
+    ("stream", ("stream_flush", "first_token", "finish")),
+)
+
+_PID = 1
+
+
+def _lane_of(name: str) -> int:
+    for i, (_, members) in enumerate(_LANES):
+        if name in members:
+            return i
+    return len(_LANES)
+
+
+def _us(seconds: float) -> int:
+    return int(round(seconds * 1e6))
+
+
+def trace_to_chrome(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """Per-request trace document -> Chrome trace-event JSON dict."""
+    events: List[Dict[str, Any]] = [
+        {
+            "ph": "M",
+            "pid": _PID,
+            "tid": 0,
+            "name": "process_name",
+            "args": {
+                "name": "{} {}".format(
+                    doc.get("kind", "trace"), doc["trace_id"]
+                )
+            },
+        }
+    ]
+    lanes_used = set()
+    for span in doc.get("spans", ()):
+        tid = _lane_of(span["name"])
+        lanes_used.add(tid)
+        ev: Dict[str, Any] = {
+            "ph": "X",
+            "pid": _PID,
+            "tid": tid,
+            "name": span["name"],
+            "ts": _us(span["t0_s"]),
+            # Perfetto renders dur=0 slices invisibly; give instants
+            # one tick so suspend/hit markers stay clickable.
+            "dur": max(_us(span["dur_s"]), 1),
+        }
+        if span.get("attrs"):
+            ev["args"] = dict(span["attrs"])
+        events.append(ev)
+    for i, (lane_name, _) in enumerate(_LANES):
+        if i in lanes_used:
+            events.append(
+                {
+                    "ph": "M",
+                    "pid": _PID,
+                    "tid": i,
+                    "name": "thread_name",
+                    "args": {"name": lane_name},
+                }
+            )
+    if len(_LANES) in lanes_used:
+        events.append(
+            {
+                "ph": "M",
+                "pid": _PID,
+                "tid": len(_LANES),
+                "name": "thread_name",
+                "args": {"name": "other"},
+            }
+        )
+    out: Dict[str, Any] = {
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "trace_id": doc["trace_id"],
+            "kind": doc.get("kind"),
+            "outcome": doc.get("outcome"),
+            "dropped": doc.get("dropped", 0),
+        },
+        "traceEvents": events,
+    }
+    if doc.get("attrs"):
+        out["otherData"]["attrs"] = dict(doc["attrs"])
+    return out
+
+
+def job_doc_to_chrome(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """Whole-job telemetry document (telemetry.job_doc) -> Chrome
+    trace-event JSON: the flight-recorder spans become complete events
+    in the same lane layout."""
+    job_id = doc.get("job_id", "?")
+    events: List[Dict[str, Any]] = [
+        {
+            "ph": "M",
+            "pid": _PID,
+            "tid": 0,
+            "name": "process_name",
+            "args": {"name": "job {}".format(job_id)},
+        }
+    ]
+    lanes_used = set()
+    for span in doc.get("spans", ()):
+        name = span.get("name", "?")
+        tid = _lane_of(name)
+        lanes_used.add(tid)
+        ev: Dict[str, Any] = {
+            "ph": "X",
+            "pid": _PID,
+            "tid": tid,
+            "name": name,
+            "ts": _us(span.get("t0_s", 0.0)),
+            "dur": max(_us(span.get("dur_s", 0.0)), 1),
+        }
+        if span.get("attrs"):
+            ev["args"] = dict(span["attrs"])
+        events.append(ev)
+    for i, (lane_name, _) in enumerate(_LANES):
+        if i in lanes_used:
+            events.append(
+                {
+                    "ph": "M",
+                    "pid": _PID,
+                    "tid": i,
+                    "name": "thread_name",
+                    "args": {"name": lane_name},
+                }
+            )
+    if len(_LANES) in lanes_used:
+        events.append(
+            {
+                "ph": "M",
+                "pid": _PID,
+                "tid": len(_LANES),
+                "name": "thread_name",
+                "args": {"name": "other"},
+            }
+        )
+    return {
+        "displayTimeUnit": "ms",
+        "otherData": {"job_id": job_id},
+        "traceEvents": events,
+    }
+
+
+def render(chrome_doc: Dict[str, Any]) -> str:
+    """Deterministic JSON text for files/goldens (sorted keys,
+    2-space indent, trailing newline)."""
+    return json.dumps(chrome_doc, indent=2, sort_keys=True) + "\n"
+
+
+def largest_gap_s(doc: Dict[str, Any]) -> float:
+    """Largest uncovered stretch between consecutive span starts in a
+    per-request trace document — the acceptance criterion's
+    "no gaps > one decode window" measure."""
+    spans = doc.get("spans", ())
+    if not spans:
+        return 0.0
+    covered_until = None
+    worst = 0.0
+    for span in spans:  # already sorted by t0_s
+        t0 = span["t0_s"]
+        t1 = t0 + span["dur_s"]
+        if covered_until is None:
+            covered_until = t1
+            continue
+        if t0 > covered_until:
+            worst = max(worst, t0 - covered_until)
+        covered_until = max(covered_until, t1)
+    return worst

@@ -10,6 +10,19 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # SUTRO_HOME that gets deleted at teardown (engine/config.py
 # enable_compile_cache; its own tests monkeypatch this off)
 os.environ.setdefault("SUTRO_COMPILE_CACHE", "0")
+# ... but the suite still wants compiled-program sharing: every
+# ModelRunner builds fresh jit closures, so the scheduler+pallas
+# region recompiles identical tiny-model programs dozens of times.
+# A session-private cache dir is safe where enable_compile_cache's
+# CPU opt-out is not — the SIGILL hazard there is CROSS-process
+# (host-feature detection can differ between processes); here the
+# one pytest process that wrote an entry is the only reader.
+import atexit  # noqa: E402
+import shutil  # noqa: E402
+import tempfile  # noqa: E402
+
+_xla_cache_dir = tempfile.mkdtemp(prefix="sutro-test-xla-cache-")
+atexit.register(shutil.rmtree, _xla_cache_dir, ignore_errors=True)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -22,6 +35,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# threshold 0 so the sub-second tiny-model compiles actually persist
+# (the 2.0 s production floor in enable_compile_cache would keep the
+# cache empty for every program this suite builds)
+jax.config.update("jax_compilation_cache_dir", _xla_cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

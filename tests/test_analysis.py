@@ -845,6 +845,78 @@ def f(alloc, ok):
     assert "resource-leak" not in rules_of(active)
 
 
+# ------------------------------------------------- trace context
+
+
+def test_trace_ctx_dropped_on_early_return():
+    active, _ = scan(
+        """
+def f(store, flag):
+    tr = store.start_trace("tr-1", "interactive")
+    if flag:
+        return None
+    tr.end("ok")
+    return None
+"""
+    )
+    (f,) = [f for f in active if f.rule == "trace-ctx-dropped"]
+    assert f.key == "trace-ctx:tr" and "early return" in f.message
+
+
+def test_trace_ctx_ended_by_id_or_method_is_clean():
+    active, _ = scan(
+        """
+def f(store, flag):
+    tr = store.start_trace("tr-1")
+    if flag:
+        store.end_trace(tr)
+        return 1
+    tr.end("err")
+    return 0
+"""
+    )
+    assert "trace-ctx-dropped" not in rules_of(active)
+
+
+def test_trace_ctx_bare_start_is_cross_function_handoff():
+    # the gateway pattern: no handle bound, the id string IS the
+    # propagated context — finish() ends it elsewhere
+    active, _ = scan(
+        """
+def submit(store, rid):
+    store.start_trace(f"tr-{rid}", "interactive")
+    return rid
+"""
+    )
+    assert "trace-ctx-dropped" not in rules_of(active)
+
+
+def test_trace_ctx_return_escape_transfers_ownership():
+    active, _ = scan(
+        """
+def start(store):
+    tr = store.start_trace("tr-1")
+    return tr
+"""
+    )
+    assert "trace-ctx-dropped" not in rules_of(active)
+
+
+def test_trace_ctx_pragma_suppressed():
+    active, suppressed = scan(
+        """
+def f(store, flag):
+    tr = store.start_trace("tr-1")  # graftlint: disable=trace-ctx-dropped
+    if flag:
+        return None
+    tr.end("ok")
+    return None
+"""
+    )
+    assert "trace-ctx-dropped" not in rules_of(active)
+    assert "trace-ctx-dropped" in rules_of(suppressed)
+
+
 # ------------------------------------------------- wire protocol
 
 
@@ -1148,6 +1220,28 @@ def _injected_hot(n):
     )
     assert res.returncode == 1, res.stdout + res.stderr
     assert "killswitch-ungated" in res.stdout
+
+
+def test_injected_dropped_trace_handle_fails_gate(tmp_path):
+    dst = _copy_tree(tmp_path)
+    gw = dst / "serving" / "gateway.py"
+    gw.write_text(
+        gw.read_text()
+        + """
+
+def _injected_trace(flag):
+    tr = telemetry.TRACES.start_trace("tr-injected")
+    if flag:
+        return None
+    tr.end("ok")
+    return None
+"""
+    )
+    res = run_cli(
+        ["sutro_tpu", "--baseline", str(BASELINE)], cwd=tmp_path
+    )
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "trace-ctx-dropped" in res.stdout
 
 
 def test_injected_identifier_label_fails_gate(tmp_path):

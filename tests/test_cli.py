@@ -190,6 +190,34 @@ def test_doctor_command_renders_verdict(live):
     assert diag["job_id"] == jid and diag["verdict"]
 
 
+def test_trace_command_writes_perfetto_json(live, tmp_path):
+    """`sutro trace <job_id> -o out.json` exports the job's forensics
+    trace as Chrome trace-event JSON (Perfetto-loadable) and prints
+    the embedded per-request verdict."""
+    import json
+
+    runner, sdk, _ = live
+    jid = _submitted_job(sdk)
+    out = tmp_path / "trace.json"
+    res = runner.invoke(cli, ["trace", jid, "-o", str(out)])
+    assert res.exit_code == 0, res.output
+    assert "ui.perfetto.dev" in res.output
+    assert "verdict:" in res.output
+    doc = json.loads(out.read_text())
+    events = doc["traceEvents"]
+    assert any(e.get("ph") == "X" for e in events)
+    names = {e.get("name") for e in events}
+    assert "decode_window" in names and "queue_wait" in names
+    assert doc["otherData"]["verdict"]["trace_id"] == f"tr-{jid}"
+    # --json prints the document to stdout instead
+    res = runner.invoke(cli, ["trace", f"tr-{jid}", "--json"])
+    assert res.exit_code == 0
+    assert json.loads(res.output)["otherData"]["verdict"]
+    # unknown ids exit non-zero, like every other id-taking command
+    res = runner.invoke(cli, ["trace", "tr-nope"])
+    assert res.exit_code != 0
+
+
 def test_jobs_status_hints_at_telemetry_dump(live):
     runner, sdk, _ = live
     jid = _submitted_job(sdk)

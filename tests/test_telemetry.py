@@ -68,12 +68,18 @@ def test_exporter_matches_golden():
 
 
 # one exposition line: name{labels} value  (labels optional; value is
-# an int/float, inf or NaN)
+# an int/float, inf or NaN), optionally followed by an OpenMetrics
+# exemplar: ` # {labels} value timestamp`
+_LABELSET = (
+    r"\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\""
+    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\")*\}"
+)
+_NUMBER = r"-?\d+(\.\d+)?([eE]-?\d+)?"
 _PROM_LINE = re.compile(
     r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
-    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\""
-    r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\")*\})?"
-    r" (-?\d+(\.\d+)?([eE]-?\d+)?|\+Inf|-Inf|NaN)$"
+    rf"({_LABELSET})?"
+    rf" ({_NUMBER}|\+Inf|-Inf|NaN)"
+    rf"( # {_LABELSET} {_NUMBER} {_NUMBER})?$"
 )
 
 
@@ -110,7 +116,23 @@ def assert_valid_prometheus(text: str) -> None:
             assert re.fullmatch(
                 r'(?:[^\\]|\\\\|\\"|\\n)*', lv
             ), f"bad escaping in label value {lv!r}"
-        samples.append(line.split("{")[0].split(" ")[0])
+        name = line.split("{")[0].split(" ")[0]
+        if " # " in line:
+            # exemplar semantics: bucket samples only; the exemplar
+            # labelset carries the forensics trace id; its value fits
+            # inside the bucket's le bound
+            assert name.endswith("_bucket"), (
+                f"exemplar on non-bucket sample: {line!r}"
+            )
+            body, ex = line.split(" # ", 1)
+            assert 'trace_id="' in ex, f"exemplar without trace_id: {ex!r}"
+            le = re.search(r'le="([^"]*)"', body).group(1)
+            ex_val = float(ex.rsplit(" ", 2)[-2])
+            if le != "+Inf":
+                assert ex_val <= float(le), (
+                    f"exemplar value {ex_val} outside bucket le={le}"
+                )
+        samples.append(name)
     for name in samples:
         base = re.sub(r"_(bucket|sum|count)$", "", name)
         assert base in types or name in types, f"sample {name} untyped"

@@ -1,0 +1,500 @@
+"""Tail-latency forensics (sutro_tpu/telemetry/traces.py +
+traceexport.py, OBSERVABILITY.md "Forensics").
+
+Covers the PR's acceptance criteria and test satellites:
+
+1. trace store units — bounded ring eviction, per-trace span cap with a
+   dropped counter, idempotent ``start_trace``;
+2. Perfetto export golden — a deterministic request timeline renders to
+   byte-identical Chrome trace-event JSON
+   (``tests/data/trace_export.golden``; regen with
+   ``python tests/test_traces.py --regen-golden``), and the timeline
+   covers admission -> queue -> prefill -> decode -> flush with no gap
+   wider than one decode window;
+3. per-request doctor — the ``diagnose_request`` verdict matrix
+   (queue_wait_bound / preemption_bound / stream_flush_bound / healthy
+   / insufficient_data) over synthetic trace docs;
+4. exemplars — OpenMetrics exemplar syntax on ``/metrics`` validated by
+   the pure-python prom validator, capture determinism under concurrent
+   scrapes (latency-biased keep policy converges to the max), and no
+   exemplar output unless a call site opts in;
+5. the live acceptance run — a real streamed chat request through the
+   shared daemon; a fired ``interactive_ttft_p99`` alert carries an
+   exemplar trace id that resolves via ``GET /trace/{id}`` to a
+   Perfetto document whose spans cover the whole request.
+"""
+
+import json
+import sys
+import threading
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from sutro_tpu import telemetry
+from sutro_tpu.telemetry import traceexport
+from sutro_tpu.telemetry.doctor import diagnose_request
+from sutro_tpu.telemetry.registry import MetricsRegistry
+from sutro_tpu.telemetry.traces import (
+    MAX_SPANS_PER_TRACE,
+    TraceStore,
+)
+from tests.test_telemetry import assert_valid_prometheus
+
+GOLDEN = Path(__file__).parent / "data" / "trace_export.golden"
+
+
+# ---------------------------------------------------------------------------
+# trace store units
+# ---------------------------------------------------------------------------
+
+
+def test_trace_ring_evicts_oldest():
+    store = TraceStore(capacity=8)
+    for i in range(12):
+        store.start_trace(f"tr-{i}", t0_mono=float(i))
+    assert store.ids() == [f"tr-{i}" for i in range(4, 12)]
+    assert store.doc("tr-0") is None
+    assert store.doc("tr-11") is not None
+
+
+def test_trace_span_cap_counts_drops():
+    store = TraceStore()
+    store.start_trace("tr-a", t0_mono=0.0)
+    for i in range(MAX_SPANS_PER_TRACE + 10):
+        store.add("tr-a", "accept", float(i), 0.001)
+    doc = store.doc("tr-a")
+    assert len(doc["spans"]) == MAX_SPANS_PER_TRACE
+    assert doc["dropped"] == 10
+
+
+def test_start_trace_idempotent_and_end():
+    store = TraceStore()
+    a = store.start_trace("tr-a", "batch", {"job_id": "j"}, t0_mono=1.0)
+    b = store.start_trace("tr-a", "interactive", t0_mono=99.0)
+    assert a is b and a.kind == "batch" and a.t0_mono == 1.0
+    store.add("tr-a", "prefill", 1.5, 0.25)
+    store.end_trace("tr-a", "err")
+    doc = store.doc("tr-a")
+    assert doc["finished"] and doc["outcome"] == "err"
+    assert doc["spans"][0] == {
+        "name": "prefill", "t0_s": 0.5, "dur_s": 0.25,
+    }
+    # unknown ids are no-ops, not errors (the store is fire-and-forget)
+    store.add("tr-missing", "prefill", 0.0, 0.1)
+    store.end_trace("tr-missing")
+    store.event("tr-missing", "finish")
+
+
+# ---------------------------------------------------------------------------
+# Perfetto export: deterministic golden + coverage-gap criterion
+# ---------------------------------------------------------------------------
+
+
+def _golden_trace_doc():
+    """One interactive request's full lifecycle with pinned clocks:
+    admission, queue, prefill, windowed decode with one preemption
+    suspend/resume, prefix hit, SSE flushes, finish."""
+    store = TraceStore(capacity=8)
+    t0 = 100.0
+    store.start_trace(
+        "tr-ivr-7",
+        "interactive",
+        {"request_id": "ivr-7", "model": "tiny-dense", "tenant": "acme"},
+        t0_mono=t0,
+        created_unix=1700000000.0,
+    )
+    a = lambda *args, **kw: store.add("tr-ivr-7", *args, **kw)  # noqa: E731
+    e = lambda n, at, **kw: store.event(  # noqa: E731
+        "tr-ivr-7", n, kw or None, t_mono=t0 + at
+    )
+    a("admit_gateway", t0, 0.002, {"prompt_tokens": 24, "warm_tokens": 16})
+    a("queue_wait", t0 + 0.002, 0.014)
+    e("prefix_hit", 0.016, saved_tokens=16, paid_tokens=8)
+    a("prefill", t0 + 0.016, 0.080)
+    a("accept", t0 + 0.096, 0.001)
+    a("decode_window", t0 + 0.097, 0.040)
+    e("preempt_suspend", 0.137, row_id=0, by="job-b", lost_tokens=2)
+    e("resume", 0.150, row_id=0)
+    a("decode_window", t0 + 0.150, 0.040)
+    a("accept", t0 + 0.190, 0.001)
+    e("first_token", 0.191, ttft_s=0.191)
+    a("stream_flush", t0 + 0.191, 0.0005, {"bytes": 120})
+    a("decode_window", t0 + 0.1915, 0.040)
+    a("stream_flush", t0 + 0.2315, 0.0004, {"bytes": 96})
+    e("finish", 0.232, outcome="ok", tokens=3)
+    store.end_trace("tr-ivr-7", "ok")
+    return store.doc("tr-ivr-7")
+
+
+def test_trace_export_matches_golden():
+    assert GOLDEN.exists(), (
+        "golden file missing (regen: python tests/test_traces.py "
+        "--regen-golden)"
+    )
+    doc = _golden_trace_doc()
+    assert traceexport.render(
+        traceexport.trace_to_chrome(doc)
+    ) == GOLDEN.read_text()
+
+
+def test_trace_covers_request_without_decode_window_gaps():
+    """Acceptance criterion: spans cover admission -> queue -> prefill
+    -> decode -> flush and no coverage gap exceeds one decode window."""
+    doc = _golden_trace_doc()
+    assert {
+        "admit_gateway", "queue_wait", "prefill", "decode_window",
+        "stream_flush", "finish",
+    } <= set(doc["stages"])
+    one_window = max(
+        s["dur_s"] for s in doc["spans"] if s["name"] == "decode_window"
+    )
+    assert traceexport.largest_gap_s(doc) <= one_window
+
+
+def test_chrome_doc_shape_and_lanes():
+    chrome = traceexport.trace_to_chrome(_golden_trace_doc())
+    evs = chrome["traceEvents"]
+    xs = [ev for ev in evs if ev["ph"] == "X"]
+    metas = [ev for ev in evs if ev["ph"] == "M"]
+    # every span event: µs timestamps, ≥1µs duration (instants must
+    # stay visible in Perfetto), one process, named lanes
+    assert all(ev["pid"] == 1 and ev["dur"] >= 1 for ev in xs)
+    lane_names = {
+        m["args"]["name"] for m in metas if m["name"] == "thread_name"
+    }
+    assert {"admit", "queue", "prefill", "decode", "stream"} <= lane_names
+    other = chrome["otherData"]
+    assert other["trace_id"] == "tr-ivr-7"
+    assert other["kind"] == "interactive" and other["outcome"] == "ok"
+    assert chrome["displayTimeUnit"] == "ms"
+    # rendering is stable: sorted keys, trailing newline
+    text = traceexport.render(chrome)
+    assert text.endswith("\n") and json.loads(text) == chrome
+
+
+# ---------------------------------------------------------------------------
+# per-request doctor
+# ---------------------------------------------------------------------------
+
+
+def _doc(spans, trace_id="tr-x"):
+    return {
+        "trace_id": trace_id, "kind": "interactive", "outcome": "ok",
+        "spans": [
+            {"name": n, "t0_s": t0, "dur_s": d, "attrs": a}
+            for (n, t0, d, a) in spans
+        ],
+    }
+
+
+def test_diagnose_request_verdict_matrix():
+    # queue dominates: waited for a slot, not the chip
+    q = diagnose_request(_doc([
+        ("queue_wait", 0.0, 0.8, None),
+        ("prefill", 0.8, 0.1, None),
+        ("decode_window", 0.9, 0.1, None),
+    ]))
+    assert q["verdict"] == "queue_wait_bound"
+    assert q["legs"]["queue_s"] == pytest.approx(0.8)
+
+    # suspend -> resume stall dominates
+    p = diagnose_request(_doc([
+        ("prefill", 0.0, 0.1, None),
+        ("preempt_suspend", 0.1, 0.0, {"row_id": 1, "lost_tokens": 4}),
+        ("resume", 0.9, 0.0, {"row_id": 1}),
+        ("decode_window", 0.9, 0.1, None),
+    ]))
+    assert p["verdict"] == "preemption_bound"
+    assert p["legs"]["preemptions"] == 1
+    assert p["legs"]["preempt_stall_s"] == pytest.approx(0.8)
+
+    # SSE flush (slow client socket) dominates
+    f = diagnose_request(_doc([
+        ("prefill", 0.0, 0.1, None),
+        ("stream_flush", 0.1, 0.9, {"bytes": 1}),
+    ]))
+    assert f["verdict"] == "stream_flush_bound"
+
+    # honest compute
+    h = diagnose_request(_doc([
+        ("queue_wait", 0.0, 0.01, None),
+        ("prefill", 0.01, 0.5, None),
+        ("decode_window", 0.51, 0.5, None),
+        ("stream_flush", 1.01, 0.001, None),
+    ]))
+    assert h["verdict"] == "healthy"
+
+    empty = diagnose_request({"trace_id": "tr-e", "spans": []})
+    assert empty["verdict"] == "insufficient_data"
+
+
+def test_diagnose_request_unresumed_suspend_stalls_to_end():
+    d = diagnose_request(_doc([
+        ("prefill", 0.0, 0.1, None),
+        ("preempt_suspend", 0.1, 0.0, {"row_id": 2}),
+        ("decode_window", 0.9, 0.1, None),
+    ]))
+    assert d["legs"]["preempt_stall_s"] == pytest.approx(0.9)
+    assert d["verdict"] == "preemption_bound"
+
+
+# ---------------------------------------------------------------------------
+# exemplars
+# ---------------------------------------------------------------------------
+
+
+def _reg_with_exemplars():
+    r = MetricsRegistry()
+    h = r.histogram(
+        "fx_ttft_seconds", "x", buckets=(0.25, 1.0, 10.0),
+        unit="seconds",
+    )
+    h.observe(0.2, exemplar="tr-fast", _now=1000.0)
+    h.observe(6.0, exemplar="tr-slow", exemplar_attrs={"tenant": "acme"},
+              _now=1001.0)
+    return r, h
+
+
+def test_exemplar_openmetrics_syntax_on_buckets():
+    r, _ = _reg_with_exemplars()
+    text = r.to_prometheus()
+    assert_valid_prometheus(text)
+    assert (
+        'fx_ttft_seconds_bucket{le="0.25"} 1 '
+        '# {trace_id="tr-fast"} 0.2 1000'
+    ) in text
+    assert (
+        'fx_ttft_seconds_bucket{le="10"} 2 '
+        '# {trace_id="tr-slow",tenant="acme"} 6 1001'
+    ) in text
+    # flat view for the monitor: worst first
+    flat = r.exemplars("fx_ttft_seconds")
+    assert [e["trace_id"] for e in flat] == ["tr-slow", "tr-fast"]
+
+
+def test_exemplar_opt_in_only():
+    r = MetricsRegistry()
+    h = r.histogram("fx_plain_seconds", "x", buckets=(1.0,))
+    h.observe(0.5)
+    text = r.to_prometheus()
+    assert " # " not in text
+    assert all("exemplars" not in m for m in r.collect())
+    assert r.exemplars("fx_plain_seconds") == []
+
+
+def test_exemplar_keep_policy_latency_biased():
+    r = MetricsRegistry()
+    h = r.histogram("fx_keep_seconds", "x", buckets=(10.0,))
+    h.observe(5.0, exemplar="tr-big", _now=1000.0)
+    # smaller + recent: kept out (the tail is what forensics wants)
+    h.observe(1.0, exemplar="tr-small", _now=1001.0)
+    assert r.exemplars("fx_keep_seconds")[0]["trace_id"] == "tr-big"
+    # smaller but the held exemplar has aged out: recency wins
+    h.observe(1.0, exemplar="tr-fresh", _now=1200.0)
+    assert r.exemplars("fx_keep_seconds")[0]["trace_id"] == "tr-fresh"
+
+
+def test_exemplar_determinism_under_concurrent_scrapes():
+    """Writers race observations (same bucket, fixed clock) while
+    scrapers hammer the exporter: every scrape parses as valid
+    exposition, and the keep policy converges on the max value
+    regardless of interleaving."""
+    r = MetricsRegistry()
+    h = r.histogram("fx_race_seconds", "x", buckets=(10.0,))
+    stop = threading.Event()
+    errors = []
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                assert_valid_prometheus(r.to_prometheus())
+            except Exception as exc:  # noqa: BLE001
+                errors.append(repr(exc))
+                return
+
+    def writer(seed):
+        vals = [(seed * 7 + i * 3) % 90 / 10.0 for i in range(400)]
+        for i, v in enumerate(vals):
+            h.observe(v, exemplar=f"tr-{seed}-{i}", _now=1000.0)
+
+    scr = [threading.Thread(target=scraper) for _ in range(3)]
+    wrs = [threading.Thread(target=writer, args=(s,)) for s in range(4)]
+    for t in scr + wrs:
+        t.start()
+    for t in wrs:
+        t.join()
+    stop.set()
+    for t in scr:
+        t.join()
+    assert not errors, errors
+    (top,) = r.exemplars("fx_race_seconds")
+    assert top["value"] == 8.9  # max of every writer's sequence
+
+
+def test_monitor_firing_event_embeds_exemplar_trace_ids():
+    from sutro_tpu.telemetry.monitor import Monitor, SLORule
+
+    telemetry.reset_for_tests()
+    telemetry.TTFT_SECONDS.observe(7.0, exemplar="tr-worst")
+    telemetry.TTFT_SECONDS.observe(0.1, exemplar="tr-fine")
+    rule = SLORule(
+        "interactive_ttft_p99", metric="ttft_p99_s", op=">",
+        threshold=5.0, for_ticks=1, clear_ticks=1,
+        workload="interactive",
+    )
+    mon = Monitor(rules=[rule])
+    (ev,) = mon._evaluate_rules({"ttft_p99_s": 7.0}, 0.0)
+    assert ev["state"] == "firing"
+    assert ev["exemplar_trace_ids"][0] == "tr-worst"
+    # resolved events carry no exemplars (nothing to chase)
+    (ev2,) = mon._evaluate_rules({"ttft_p99_s": 0.0}, 1.0)
+    assert ev2["state"] == "resolved"
+    assert "exemplar_trace_ids" not in ev2
+    telemetry.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# live acceptance: alert exemplar -> GET /trace/{id} -> full coverage
+# ---------------------------------------------------------------------------
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_live_alert_exemplar_resolves_to_full_trace(live_engine):
+    """Acceptance criterion verbatim: stream a real chat request
+    through the shared daemon, force the ``interactive_ttft_p99`` rule
+    to fire, follow the alert's exemplar trace id through
+    ``GET /trace/{id}``, and assert the Perfetto document covers
+    admission -> queue -> prefill -> decode -> flush with no gap wider
+    than one decode window."""
+    from sutro_tpu.telemetry.monitor import SLORule
+
+    engine, url, _home = live_engine
+    assert telemetry.ENABLED and engine.monitor is not None
+    saved_rules = list(engine.monitor._rules)
+    engine.monitor.set_rules([
+        SLORule(
+            "interactive_ttft_p99", metric="ttft_p99_s", op=">",
+            threshold=0.0, for_ticks=1, clear_ticks=10_000,
+            workload="interactive",
+        ),
+    ])
+    try:
+        body = json.dumps({
+            "model": "tiny-dense",
+            "messages": [{"role": "user", "content": "trace me"}],
+            "temperature": 0.0,
+            "max_tokens": 4,
+            "stream": True,
+        }).encode()
+        req = urllib.request.Request(
+            f"{url}/v1/chat/completions", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            stream = resp.read().decode()
+        assert "data: [DONE]" in stream
+
+        # the monitor tick picks up the windowed TTFT and fires; the
+        # firing event must carry the request's exemplar trace id
+        ids = []
+        import time as _t
+        deadline = _t.monotonic() + 30
+        while _t.monotonic() < deadline and not ids:
+            doc = _get_json(f"{url}/monitor")["monitor"]
+            for ev in doc["alerts"]["events"]:
+                if (
+                    ev["rule"] == "interactive_ttft_p99"
+                    and ev["state"] == "firing"
+                ):
+                    ids = ev.get("exemplar_trace_ids") or []
+            _t.sleep(0.05)
+        assert ids, "firing alert never carried an exemplar trace id"
+
+        chrome = _get_json(f"{url}/trace/{ids[0]}")
+        assert chrome["otherData"]["trace_id"] == ids[0]
+        names = {
+            ev["name"] for ev in chrome["traceEvents"]
+            if ev["ph"] == "X"
+        }
+        assert {
+            "admit_gateway", "queue_wait", "prefill", "decode_window",
+            "stream_flush", "finish",
+        } <= names
+        # per-request doctor rides in otherData
+        verdict = chrome["otherData"]["verdict"]
+        assert verdict["verdict"] in (
+            "healthy", "queue_wait_bound", "preemption_bound",
+            "stream_flush_bound",
+        )
+        # coverage: no gap wider than one decode window (source doc is
+        # in-process — the daemon shares our interpreter)
+        src = telemetry.TRACES.doc(ids[0])
+        one_window = max(
+            s["dur_s"] for s in src["spans"]
+            if s["name"] == "decode_window"
+        )
+        assert traceexport.largest_gap_s(src) <= one_window + 0.05
+
+        # sdk surface (remote backend) returns the same document
+        from sutro_tpu.sdk import Sutro
+
+        sdk = Sutro(api_key="k", base_url=url, backend="remote")
+        assert sdk.get_trace(ids[0]) == _get_json(
+            f"{url}/trace/{ids[0]}"
+        )
+
+        # unknown ids 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{url}/trace/tr-nope", timeout=10)
+        assert ei.value.code == 404
+    finally:
+        engine.monitor.set_rules(saved_rules)
+
+
+def test_batch_job_flight_record_export(live_engine):
+    """A plain job id exports the whole-job flight record; the batch
+    trace (tr-<job>) records queue wait and per-window stages."""
+    engine, url, _home = live_engine
+    jid = engine.submit_batch_inference({
+        "model": "tiny-dense",
+        "inputs": ["flight record row"],
+        "sampling_params": {"max_new_tokens": 4, "temperature": 0.0},
+    })
+    import time as _t
+    deadline = _t.monotonic() + 120
+    while _t.monotonic() < deadline:
+        if engine.job_status(jid) in ("SUCCEEDED", "FAILED"):
+            break
+        _t.sleep(0.05)
+    assert engine.job_status(jid) == "SUCCEEDED"
+
+    # the batch trace by id
+    chrome = _get_json(f"{url}/trace/tr-{jid}")
+    names = {
+        ev["name"] for ev in chrome["traceEvents"] if ev["ph"] == "X"
+    }
+    assert "queue_wait" in names and "decode_window" in names
+    # bare job id -> same trace (ring hit wins over flight record)
+    assert _get_json(f"{url}/trace/{jid}")["otherData"][
+        "trace_id"
+    ] == f"tr-{jid}"
+
+
+if __name__ == "__main__":
+    if "--regen-golden" in sys.argv:
+        GOLDEN.parent.mkdir(parents=True, exist_ok=True)
+        GOLDEN.write_text(
+            traceexport.render(
+                traceexport.trace_to_chrome(_golden_trace_doc())
+            )
+        )
+        print(f"wrote {GOLDEN}")
+    else:
+        sys.exit(pytest.main([__file__, "-v"]))
