@@ -33,6 +33,12 @@ make control-check
 # with exact page conservation under eviction pressure and lookup
 # faults degrading to plain misses
 make prefix-check
+# tier-1 gate: tiered paged-KV pool + session hibernation — demote/
+# promote and hibernate/resume must be bit-identical on the int8 pool,
+# SUTRO_KV_TIERS=0 must be bit-identical with a zero tier-op census,
+# and torn migrations (demote/promote/disk-write) must never corrupt
+# or lose a row
+make tier-check
 # warn-only: bench-artifact trend report (never fails the build)
 make bench-trend
 # tier-1 gate: interactive tier CPU smoke — TTFT/ITL legs + the

@@ -6,7 +6,7 @@ PY ?= python
 
 .PHONY: all native test test-oneshot test-fast compile-check lint lint-baseline \
 	lint-schema chaos telemetry-check monitor-check control-check control-bench \
-	prefix-check bench bench-e2e serve-bench bench-trend dryrun \
+	prefix-check tier-check bench bench-e2e serve-bench bench-trend dryrun \
 	chip-validate bench-8b cost golden host-profile clean
 
 all: native compile-check
@@ -122,6 +122,17 @@ control-bench:
 # contract. Tier-1 CI.
 prefix-check:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_prefix_store.py \
+		-q -m "not slow" -p no:cacheprovider
+
+# tiered-KV gate (OBSERVABILITY.md "KV tiers"): pool units (quantized
+# payload parity, host LRU + disk spill, pinned hibernated rows),
+# scheduler integration (demote->promote and hibernate->resume
+# bit-identical on the int8 pool, SUTRO_KV_TIERS=0 bit-identical with
+# a zero op census), tier-hop chaos (torn demote/promote/disk-write),
+# exact page conservation, and the sticky-session chat checkpoint/
+# resume path over the live gateway. Tier-1 CI.
+tier-check:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_kv_tiers.py \
 		-q -m "not slow" -p no:cacheprovider
 
 # raw decode microbench (one JSON line; driver contract)

@@ -192,6 +192,99 @@ def main() -> None:
     latency_leg("prefix_cold", lambda i: f"{shell} item {i}")
     latency_leg("prefix_warm", lambda i: f"{shell} item {i}")
 
+    # -- leg 1c: session hibernation (tiered KV pool) ------------------
+    # S sticky chat sessions each hold a turn of transcript KV — far
+    # more KV than the HBM pool holds at once, so finished turns
+    # checkpoint into the prefix store and pressure-demote host-ward
+    # (SUTRO_KV_TIERS). An idle sweep (gateway.checkpoint_idle) then
+    # hibernates every session, and turn 2 resumes each by prefix-hit
+    # or tier promotion instead of re-prefilling its history. Graded:
+    # resume p99 TTFT vs cold p99 TTFT, the sessions' total KV pages
+    # vs the HBM page budget (the >= 10x session-scale bar), and zero
+    # lost turns.
+    n_sessions = int(
+        os.environ.get(
+            "SUTRO_IBENCH_SESSIONS", "256" if on_tpu else "144"
+        )
+    )
+    os.environ["SUTRO_KV_TIERS"] = "1"
+    try:
+        if on_tpu:
+            opener = (
+                "My order number is 81{i:04d} and my favorite color "
+                "is teal. Remember both and acknowledge briefly."
+            )
+            follow = "What is my order number?"
+        else:  # sized for the 128-token smoke context, two turns deep
+            opener = "Order 81{i:03d}, color teal. Remember."
+            follow = "Order number?"
+
+        def session_turn(sid, content):
+            body = {
+                "model": model,
+                "messages": [{"role": "user", "content": content}],
+                "max_tokens": max_tok,
+                "temperature": 0.0,
+                "stream": True,
+                "session_id": sid,
+            }
+            ir = gw.submit(parse_request(body, chat=True))
+            fin = None
+            for chunk in oai.iter_stream(ir, chat=True):
+                if chunk is None:  # heartbeat gap
+                    continue
+                fin = chunk["choices"][0].get("finish_reason") or fin
+            return ir.channel.ttft_s(), fin
+
+        # a LOST row is a turn that never reached a clean terminal
+        # state; an empty completion (immediate stop) is legal and
+        # simply contributes no TTFT sample
+        cold_ttfts, resume_ttfts, lost = [], [], 0
+        for i in range(n_sessions):
+            ttft, fin = session_turn(
+                f"bench-s{i}", opener.format(i=i)
+            )
+            if fin not in ("stop", "length"):
+                lost += 1
+            elif ttft is not None:
+                cold_ttfts.append(ttft)
+        sess_pages = sum(
+            len(s.ids) // ecfg["kv_page_size"]
+            for k, s in gw._sessions.items()
+            if k[1].startswith("bench-s")
+        )
+        posted = gw.checkpoint_idle(idle_s=0.0)
+        for i in range(n_sessions):
+            ttft, fin = session_turn(f"bench-s{i}", follow)
+            if fin not in ("stop", "length"):
+                lost += 1
+            elif ttft is not None:
+                resume_ttfts.append(ttft)
+        pool = eng._kv_tiers.get(model)
+        census = pool.op_census() if pool is not None else {}
+        runner_tok = eng._runner_cache.get(model)
+        hbm_pages = None
+        if runner_tok is not None:
+            r0 = runner_tok[0]
+            hbm_pages = int(getattr(r0, "alloc_pages", r0.num_pages))
+        entry = {
+            "n_sessions": n_sessions,
+            "idle_checkpoints_posted": posted,
+            "session_kv_pages": sess_pages,
+            "hbm_pool_pages": hbm_pages,
+            "cold_ttft_p50_s": pct(cold_ttfts, 50),
+            "cold_ttft_p99_s": pct(cold_ttfts, 99),
+            "resume_ttft_p50_s": pct(resume_ttfts, 50),
+            "resume_ttft_p99_s": pct(resume_ttfts, 99),
+            "lost_rows": lost,
+            "tier_census": census,
+        }
+        results["hibernate_resume"] = entry
+        print(json.dumps({"hibernate_resume": entry}), flush=True)
+        assert lost == 0, "hibernate/resume leg lost session turns"
+    finally:
+        os.environ.pop("SUTRO_KV_TIERS", None)
+
     # -- leg 2: batch throughput baseline ------------------------------
     # warm the batch path (prefill/decode compile at batch shapes) so
     # the baseline leg measures steady-state throughput, not JIT —
@@ -233,6 +326,9 @@ def main() -> None:
     co_rph = done["rows_per_hour"]
     pc99 = results["prefix_cold"]["ttft_p99_s"] or 0.0
     pw99 = results["prefix_warm"]["ttft_p99_s"] or 0.0
+    hib = results["hibernate_resume"]
+    hc99 = hib["cold_ttft_p99_s"] or 0.0
+    hr99 = hib["resume_ttft_p99_s"] or 0.0
     results["grades"] = {
         "ttft_p99_ratio_vs_idle": (
             round(co99 / idle99, 2) if idle99 else None
@@ -244,6 +340,17 @@ def main() -> None:
             round(pw99 / pc99, 3) if pc99 else None
         ),
         "warm_prefix_target": "p99 warm < 1x cold (shell KV resident)",
+        "resume_ttft_p99_ratio_vs_cold": (
+            round(hr99 / hc99, 3) if hc99 else None
+        ),
+        "resume_target": "p99 resume <= 0.5x cold (upload, not re-prefill)",
+        "session_kv_vs_hbm_pages": (
+            round(hib["session_kv_pages"] / hib["hbm_pool_pages"], 2)
+            if hib["hbm_pool_pages"]
+            else None
+        ),
+        "session_scale_target": "session KV >= 10x the HBM page budget",
+        "session_lost_rows": hib["lost_rows"],
     }
     print(json.dumps({"grades": results["grades"]}), flush=True)
 

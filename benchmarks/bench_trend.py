@@ -96,6 +96,12 @@ INTERACTIVE_METRICS = (
     (("legs", "prefix_cold", "ttft_p99_s"), False),
     (("legs", "prefix_warm", "ttft_p99_s"), False),
     (("legs", "grades", "warm_prefix_ttft_p99_ratio"), False),
+    # session hibernate/resume legs (tiered KV pool, SUTRO_KV_TIERS):
+    # resuming a hibernated session must stay cheaper than its cold
+    # prefill; warn-only until a characterization run gates them
+    (("legs", "hibernate_resume", "cold_ttft_p99_s"), False),
+    (("legs", "hibernate_resume", "resume_ttft_p99_s"), False),
+    (("legs", "grades", "resume_ttft_p99_ratio_vs_cold"), False),
 )
 
 
@@ -356,6 +362,20 @@ def main() -> int:
                 f"{v['threshold']:.0%} |"
             )
         lines.append("")
+        # graded metrics the characterization run predates have no
+        # measured spread yet — they stay warn-only at the default
+        # tolerance until the next `--characterize` refresh
+        uncharacterized = sorted(
+            name for name in snap if name not in variance
+        )
+        if uncharacterized:
+            lines.append(
+                "Not yet characterized (warn-only at "
+                f"{TREND_TOLERANCE:.0%} until the next "
+                "`--characterize` run measures their spread): "
+                + ", ".join(f"`{n}`" for n in uncharacterized)
+            )
+            lines.append("")
 
     lines.append("## Driver rounds (BENCH_r*.json)")
     lines.append("")

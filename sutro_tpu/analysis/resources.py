@@ -71,7 +71,13 @@ KINDS: Tuple[Kind, ...] = (
         name="kv-pages",
         acquire_suffix=(".alloc", ".alloc_pages"),
         acquire_arg=(".reserve",),
-        release_arg=(".free", ".free_pages"),
+        # ``.promote``/``.reserve_pages`` are the KV-tier ownership
+        # transfers (scheduler._promote_prefix/_release): pages handed
+        # to the prefix store or re-adopted by the native allocator
+        # count as released — a later free of the same var is the
+        # double-free the tiering paths must never perform
+        release_arg=(".free", ".free_pages", ".promote",
+                     ".reserve_pages"),
         unsafe_double=True,
         release_hint="free()",
     ),

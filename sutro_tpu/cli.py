@@ -132,9 +132,14 @@ def serve(host: str, port: int, quiet: bool, interactive_slots: int) -> None:
 @click.option("--interactive-slots", default=None, type=int,
               help="Local backend only: enable the interactive tier "
               "with this reserved-slot budget")
+@click.option("--session", "session_id", default=None,
+              help="Sticky conversation id: turns reusing the same id "
+              "keep their server-side transcript and tiered KV, so "
+              "each call sends only the new user message")
 def chat(prompt: str, model: str, system_prompt: Optional[str],
          no_stream: bool, schema_file: Optional[str],
-         interactive_slots: Optional[int]) -> None:
+         interactive_slots: Optional[int],
+         session_id: Optional[str]) -> None:
     """One interactive chat completion (tokens stream to stdout)."""
     sdk = get_sdk()
     if interactive_slots is not None and sdk.backend != "remote":
@@ -150,13 +155,14 @@ def chat(prompt: str, model: str, system_prompt: Optional[str],
         if no_stream:
             resp = sdk.chat(
                 prompt, model=model, system_prompt=system_prompt,
-                response_format=response_format,
+                response_format=response_format, session_id=session_id,
             )
             click.echo(resp["choices"][0]["message"]["content"])
             return
         for chunk in sdk.chat(
             prompt, model=model, system_prompt=system_prompt,
             response_format=response_format, stream=True,
+            session_id=session_id,
         ):
             content = chunk["choices"][0]["delta"].get("content")
             if content:

@@ -140,6 +140,11 @@ class LocalEngine:
         # pages live in that runner's KV pool — evicting the runner
         # closes its store.
         self._prefix_stores: Dict[str, Any] = {}
+        # Tiered KV pools (engine/kvtier.py): host/disk backing for the
+        # runner's paged-KV HBM pool — cold prefix-store leaves demote
+        # instead of dropping, preempted rows hibernate for page-upload
+        # resume. Same lifetime story as _prefix_stores.
+        self._kv_tiers: Dict[str, Any] = {}
         # Interactive serving tier: constructed ONLY when the reserved
         # slot budget is on — at the default 0 the serving package is
         # never imported and every batch code path is unchanged.
@@ -909,6 +914,9 @@ class LocalEngine:
             store = self._prefix_stores.pop(evicted, None)
             if store is not None:
                 store.close()
+            tier = self._kv_tiers.pop(evicted, None)
+            if tier is not None:
+                tier.close()
         self._runner_cache[engine_key] = (runner, tok)
         return runner, tok
 
@@ -934,6 +942,38 @@ class LocalEngine:
             store = PrefixStore(self.ecfg.kv_page_size)
             self._prefix_stores[engine_key] = store
         return store
+
+    def _kv_tier_for(self, engine_key: str):
+        """The engine-lifetime tiered KV pool (HBM → pinned host →
+        disk) for this runner, or None when tiering is off.
+        ``SUTRO_KV_TIERS`` overrides ``EngineConfig.kv_tiers``; the
+        default is OFF — and OFF means the scheduler holds None and
+        every demote/promote/hibernate path is dead code, bit-identical
+        to the pre-tier engine (asserted by tests/test_kv_tiers.py)."""
+        import os
+
+        env = os.environ.get("SUTRO_KV_TIERS")
+        if env is not None:
+            enabled = env.strip().lower() not in ("0", "off", "false", "")
+        else:
+            enabled = bool(getattr(self.ecfg, "kv_tiers", False))
+        if not enabled:
+            return None
+        tier = self._kv_tiers.get(engine_key)
+        if tier is None:
+            from .config import sutro_home
+            from .kvtier import KVTierPool
+
+            disk_dir = None
+            if getattr(self.ecfg, "kv_tier_disk", True):
+                disk_dir = sutro_home() / "kvtier"
+            tier = KVTierPool(
+                self.ecfg.kv_page_size,
+                host_pages=getattr(self.ecfg, "kv_tier_host_pages", 4096),
+                disk_dir=disk_dir,
+            )
+            self._kv_tiers[engine_key] = tier
+        return tier
 
     def prefix_warm_tokens(self, engine_key: str, ids) -> int:
         """Non-mutating warm-prefix probe for the serving gateway: how
@@ -966,6 +1006,12 @@ class LocalEngine:
         for store in self._prefix_stores.values():
             store.close()
         self._prefix_stores.clear()
+        # tier pools park their migration worker; queued async demotes
+        # are dropped (lossy by contract — the HBM copy was freed by
+        # the store, these were cache-only pages)
+        for tier in self._kv_tiers.values():
+            tier.close()
+        self._kv_tiers.clear()
         return not self._worker.is_alive()
 
     def _worker_loop(self) -> None:
@@ -1108,6 +1154,7 @@ class LocalEngine:
             seed=self.ecfg.seed,
             token_bytes=sess.token_bytes,
             prefix_store=self._prefix_store_for(engine_key),
+            kv_tier=self._kv_tier_for(engine_key),
         )
         if self.control is not None:
             batcher.ladder = self.control.ladder
@@ -1220,6 +1267,7 @@ class LocalEngine:
             seed=self.ecfg.seed,
             token_bytes=token_bytes,
             prefix_store=self._prefix_store_for(engine_key),
+            kv_tier=self._kv_tier_for(engine_key),
         )
         if self.control is not None:
             batcher.ladder = self.control.ladder
@@ -1378,6 +1426,21 @@ class LocalEngine:
                     {"requests": 0, "starved": 0, "ttft_max_s": 0.0},
                 )
                 ia["preempted_rows"] = ctx.stats["preempted"]
+            if s.jtel is not None and (
+                getattr(batcher, "_kv_tier", None) is not None
+            ):
+                # doctor evidence: kv_pressure / resume_bound verdicts
+                # key off this (telemetry/doctor.py)
+                s.jtel.attrs["kv_tier"] = {
+                    "demotes": int(batcher.tier_demotes),
+                    "promotes": int(batcher.tier_promotes),
+                    "resumes_upload": int(
+                        ctx.stats.get("resumes_upload", 0)
+                    ),
+                    "resumes_reprefill": int(
+                        ctx.stats.get("resumes_reprefill", 0)
+                    ),
+                }
             # NO try/finally: a raised finalize (e.g. the store's
             # bounded I/O retries exhausted) must leave ``finalized``
             # False so the session-error path below — or the worker

@@ -162,6 +162,26 @@ class EngineConfig:
                                     # set ("0"/"off" forces off); off =
                                     # bit-identical to the per-job
                                     # prefix_cache path
+    kv_tiers: bool = False          # tiered paged-KV pool (engine/
+                                    # kvtier.py): HBM -> pinned host RAM
+                                    # -> disk. Cold unpinned prefix-store
+                                    # leaves DEMOTE to an int8 host tier
+                                    # instead of evicting; preemption
+                                    # victims hibernate their pages and
+                                    # resume by page-upload instead of
+                                    # full re-prefill; session-id chat
+                                    # checkpoints idle conversations down
+                                    # the tiers. $SUTRO_KV_TIERS
+                                    # overrides when set ("0"/"off"
+                                    # forces off); off = bit-identical,
+                                    # ZERO tier ops (tests/test_kv_tiers)
+    kv_tier_host_pages: int = 4096  # host-tier budget in KV pages
+                                    # (int8: ~page_size*KD bytes/page/
+                                    # layer); overflow spills to disk
+    kv_tier_disk: bool = True       # disk tier under sutro_home()/
+                                    # kvtier (jobstore partial-store
+                                    # idiom: atomic rename, torn bundles
+                                    # quarantined); off = host-only
     tokenize_threads: int = 0       # >1 splits batched prompt encodes
                                     # across a thread pool — only pays
                                     # for tokenizers whose encode_batch

@@ -58,6 +58,20 @@ prefixstore.lookup     radix prefix-store lookup (scheduler
                        plain cache miss — the job pays full prefill
                        for its shell but NEVER fails, and the store
                        stays live for later jobs
+kvtier.demote          tiered-KV demotion (engine/kvtier.py): fires in
+                       the synchronous hibernation path AND in the
+                       async migration worker. A torn demotion drops
+                       the tier entry — the HBM copy (hibernation: the
+                       regenerate path) stays authoritative; pages are
+                       never freed before the host copy landed
+kvtier.promote         tiered-KV promotion (get_page/take_row): a
+                       raising kind retries ONCE, then degrades to a
+                       miss — the caller re-prefills the tokens it
+                       asked for (resume falls back to regenerate)
+kvtier.disk_write      host->disk spill (``torn`` lands a truncated
+                       npz bundle at its final name, quarantined at
+                       read time): the host copy stays authoritative —
+                       a failed spill never loses the entry
 ====================== ====================================================
 
 Kinds: ``error`` (RuntimeError), ``oom`` (RESOURCE_EXHAUSTED-shaped

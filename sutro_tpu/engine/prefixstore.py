@@ -106,6 +106,12 @@ class PrefixStore:
         self.misses = 0
         self.evictions = 0
         self.tokens_saved = 0
+        # tiered variants (engine/kvtier.py): leaves demoted to the
+        # host tier instead of dropped, and pages re-grafted on a tier
+        # hit. Disjoint from evictions/extends so the migration loop
+        # is visible in stats even when the tier nets out to zero.
+        self.demotions = 0
+        self.promotions = 0
 
     # -- internals ------------------------------------------------------
 
@@ -256,6 +262,67 @@ class PrefixStore:
                     float(len(freed))
                 )
         return freed
+
+    def demote(self, n_pages: int) -> List[tuple]:
+        """Tiered eviction (engine/kvtier.py): remove up to ``n_pages``
+        UNPINNED LRU leaves exactly like :meth:`evict`, but return
+        ``(path_bytes, page_id)`` pairs, where ``path_bytes`` is the
+        raw int32 bytes of the FULL token prefix through that page
+        (root path keys concatenated) — the content key the tier pool
+        stores the page payload under. A node's KV is only valid joined
+        with its ancestors, so the key must cover the whole path, never
+        the leaf's single-page run. The caller reads the page payloads
+        out of the runner BEFORE handing the ids back to its allocator."""
+        out: List[tuple] = []
+        with self._lock:
+            while len(out) < n_pages:
+                victim: Optional[_Node] = None
+                stack = list(self._children.values())
+                while stack:
+                    node = stack.pop()
+                    if node.children:
+                        stack.extend(node.children.values())
+                    elif node.refs == 0 and (
+                        victim is None or node.stamp < victim.stamp
+                    ):
+                        victim = node
+                if victim is None:
+                    break
+                path: List[bytes] = []
+                n: Optional[_Node] = victim
+                while n is not None:
+                    path.append(n.key)
+                    n = n.parent
+                path.reverse()
+                parent = victim.parent
+                siblings = (
+                    parent.children if parent else self._children
+                )
+                del siblings[victim.key]
+                self._n_pages -= 1
+                out.append((b"".join(path), victim.page))
+                self.demotions += 1
+            if out and telemetry.ENABLED:
+                telemetry.PREFIX_STORE_EVICTIONS_TOTAL.inc(
+                    float(len(out))
+                )
+        return out
+
+    def promote(
+        self, handle: PrefixHandle, tail_tokens: np.ndarray,
+        pages: List[int],
+    ) -> bool:
+        """Re-graft pages whose payloads were just uploaded from a
+        lower tier (scheduler ``_promote_prefix``) under ``handle`` —
+        the exact :meth:`extend` ownership transfer, counted
+        separately so the tier round-trip is visible next to plain
+        extends. Returns False (caller keeps the pages) when the store
+        is closed or a racer re-inserted the run first."""
+        ok = self.extend(handle, tail_tokens, pages)
+        if ok:
+            with self._lock:
+                self.promotions += len(pages)
+        return ok
 
     def owned_pages(self) -> List[int]:
         """Every page id the tree owns (batcher constructors reserve

@@ -247,6 +247,75 @@ class ModelRunner:
             )
         return (cache.k_pages, cache.v_pages, page_table)
 
+    # ------------------------------------------------------------------
+    # tiered-KV page migration (engine/kvtier.py)
+    # ------------------------------------------------------------------
+
+    def read_pages(self, page_ids) -> dict:
+        """Materialized HOST copies of ``page_ids``'s K/V payloads —
+        the only device->host read path the tiered pool uses. Shapes:
+        ``k``/``v`` ``[L, n, PS, KD]`` in the pool dtype (int8 when the
+        pool is quantized, plus ``ks``/``vs`` per-token scales). The
+        returned arrays are synchronously fetched, so the caller may
+        free/reuse the pages the moment this returns."""
+        ids = jnp.asarray(np.asarray(page_ids, np.int32))
+        c = self.cache
+        out = {
+            "k": np.asarray(c.k_pages[:, ids]),
+            "v": np.asarray(c.v_pages[:, ids]),
+        }
+        if c.quantized:
+            out["ks"] = np.asarray(c.k_scale[:, ids])
+            out["vs"] = np.asarray(c.v_scale[:, ids])
+        return out
+
+    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
+    def _upload_pages_jit(self, cache: KVCache, ids, k, v):
+        return KVCache(
+            k_pages=cache.k_pages.at[:, ids].set(k),
+            v_pages=cache.v_pages.at[:, ids].set(v),
+            k_scale=cache.k_scale,
+            v_scale=cache.v_scale,
+        )
+
+    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(1,))
+    def _upload_pages_q_jit(self, cache: KVCache, ids, k, v, ks, vs):
+        return KVCache(
+            k_pages=cache.k_pages.at[:, ids].set(k),
+            v_pages=cache.v_pages.at[:, ids].set(v),
+            k_scale=cache.k_scale.at[:, ids].set(ks),
+            v_scale=cache.v_scale.at[:, ids].set(vs),
+        )
+
+    def write_pages(self, page_ids, payload: dict) -> None:
+        """Upload tier payloads into freshly allocated pages (promotion
+        / hibernation resume). ``payload`` is the tier's canonical int8
+        form (values + per-token scales) or a raw-dtype payload from
+        ``read_pages``; an int8 payload promotes into an unquantized
+        pool by dequantizing on the way up (the round-4 int8 bound is
+        the parity contract, tests/test_kv_tiers.py)."""
+        ids = jnp.asarray(np.asarray(page_ids, np.int32))
+        c = self.cache
+        if c.quantized:
+            self.cache = self._upload_pages_q_jit(
+                c, ids,
+                jnp.asarray(payload["k"]), jnp.asarray(payload["v"]),
+                jnp.asarray(payload["ks"]), jnp.asarray(payload["vs"]),
+            )
+            return
+        pool_dt = c.k_pages.dtype
+        if payload["k"].dtype == np.int8:
+            from .kvtier import dequantize_payload
+
+            vals = dequantize_payload(payload, np.float32)
+        else:
+            vals = payload
+        self.cache = self._upload_pages_jit(
+            c, ids,
+            jnp.asarray(vals["k"]).astype(pool_dt),
+            jnp.asarray(vals["v"]).astype(pool_dt),
+        )
+
     @staticmethod
     def _resolve_pallas(ecfg: EngineConfig) -> bool:
         if ecfg.use_pallas is not None:

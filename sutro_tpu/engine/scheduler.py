@@ -278,6 +278,13 @@ class JobCtx:
     # rows inside the EngineConfig.interactive_slots budget.
     on_token: Optional[Callable[[int, int, float], None]] = None
     interactive: bool = False
+    # Session KV checkpointing (serving/gateway.py chat sessions): when
+    # True — set by the gateway only while the tiered pool is on — a
+    # finished row's page-aligned KV transfers into the radix prefix
+    # store at release instead of being freed, so the session's NEXT
+    # turn resumes by prefix hit (and tier promotion once the pages
+    # demote) instead of re-prefilling the whole conversation.
+    kv_checkpoint: bool = False
     # -- internal session state --
     prefix: Optional[_SharedPrefix] = None
     prefix_ready: bool = False  # _setup_prefix attempted (lazily, at
@@ -349,6 +356,34 @@ class _Slot:
     seen_bits: Optional[np.ndarray] = None  # uint8 [ceil(V/8)]
 
 
+@dataclasses.dataclass
+class _Hib:
+    """A preempted slot's full host state, parked while its page-aligned
+    KV sits in the tiered pool under ``key`` (engine/kvtier.py). Resume
+    re-reserves pages, uploads the payload, re-prefills ONLY the
+    sub-page tail (pos % page_size tokens), and arms the slot exactly
+    where it stopped — the request's live constraint object continues
+    in place, so nothing rewinds. A tier miss at resume falls back to
+    the pre-tier path: the row regenerates from scratch (its constraint
+    rebuilt from the factory, which victim selection guarantees
+    exists)."""
+
+    key: bytes               # tier-pool entry key (opaque, unique)
+    pos: int                 # tokens whose KV was resident at suspend
+    last_token: int
+    out_ids: List[int]
+    logprob_sum: float
+    tail: bytes
+    hit_stop_seq: bool
+    stop_longest: int
+    counts: Dict[int, int]
+    seen_bits: Optional[np.ndarray]
+    shared_tokens: int       # job shared-prefix coverage at suspend —
+    #                          resume requires the SAME coverage (the
+    #                          stored payload holds own pages only)
+    n_pages: int             # own aligned pages stored under ``key``
+
+
 class ContinuousBatcher:
     def __init__(
         self,
@@ -361,6 +396,9 @@ class ContinuousBatcher:
         prefix_store=None,  # engine-lifetime radix prefix store
         #                     (engine/prefixstore.py); None = today's
         #                     per-job prefix path, bit-identical
+        kv_tier=None,  # tiered paged-KV pool (engine/kvtier.py):
+        #                HBM -> host -> disk page migration + session
+        #                hibernation; None = untiered, bit-identical
     ):
         self.runner = runner
         self.ecfg = runner.ecfg
@@ -421,6 +459,32 @@ class ContinuousBatcher:
             else:
                 prefix_store.reset()
                 self._prefix_store = prefix_store
+        # Tiered paged-KV pool (engine/kvtier.py): page payloads below
+        # HBM. Cold prefix-store leaves DEMOTE into it instead of
+        # evicting, suspended rows HIBERNATE their pages there and
+        # resume by page-upload, and completed session turns checkpoint
+        # through the prefix store into it. A geometry mismatch
+        # disables tiering for this session (the payloads would not be
+        # page-compatible); hibernation additionally needs the plain
+        # single-device prefill path (runner.prefill start>0).
+        self._kv_tier = None
+        if kv_tier is not None and kv_tier.page_size == self.ecfg.kv_page_size:
+            self._kv_tier = kv_tier
+        self._can_hibernate = (
+            self._kv_tier is not None
+            and getattr(runner, "sp", 1) == 1
+            and getattr(runner, "pp", 1) == 1
+        )
+        # hibernated rows: (id(ctx), row_id) -> _Hib. Entries live only
+        # while their ctx is live in THIS session (purged at job finish
+        # / session suspend / run_multi exit), so id() reuse is safe.
+        self._hibernated: Dict[Tuple[int, int], "_Hib"] = {}
+        self._hib_seq = 0
+        # session-level tier op counters (api.py stamps them into the
+        # job's flight-recorder attrs for the doctor's kv_pressure /
+        # resume_bound verdicts)
+        self.tier_demotes = 0
+        self.tier_promotes = 0
         self.slots: List[Optional[_Slot]] = [None] * self.B
         # per-slot generation counter: bumped on release so a pipelined
         # window dispatched against a slot's OLD occupant fails the
@@ -612,6 +676,18 @@ class ContinuousBatcher:
                 )
                 handle = None
         try:
+            if (
+                store is not None
+                and self._kv_tier is not None
+                and (len(handle.nodes) if handle else 0) < n_pages
+            ):
+                # tier promotion: pages past the store hit may be warm
+                # in the host/disk tiers (demoted earlier under
+                # pressure, or an idle session's checkpoint) — upload
+                # them into fresh pages instead of re-prefilling. Net
+                # zero page pressure: each promoted page replaces a
+                # tail page the prefill below would have allocated.
+                handle = self._promote_prefix(ctx, first, n_pages, handle)
             hit_pages = list(handle.pages) if handle is not None else []
             hit = len(hit_pages) * PS
             tail_n = n_pages - len(hit_pages)
@@ -752,13 +828,107 @@ class ContinuousBatcher:
         """Allocation-pressure hook: pull up to ``n_pages`` unpinned LRU
         pages out of the radix store and hand them back to THIS
         session's allocator (they were reserved at construction).
-        Returns the number actually freed."""
+        Returns the number actually freed. With the tiered pool on,
+        victims DEMOTE — their payloads migrate to host RAM keyed by
+        full token prefix — instead of being dropped, so a later job's
+        lookup can promote them back instead of re-prefilling."""
         if n_pages <= 0 or self._prefix_store is None:
             return 0
+        if self._kv_tier is not None:
+            return self._demote_store_pages(n_pages)
         freed = self._prefix_store.evict(n_pages)
         if freed:
             self._free_prefix_pages(freed)
         return len(freed)
+
+    def _demote_store_pages(self, n_pages: int) -> int:
+        """Tiered eviction: pull unpinned LRU leaves out of the radix
+        store, read their payloads off the device (one batched
+        synchronous fetch — the ids go back to the allocator the moment
+        it returns), and stage them into the tier pool asynchronously.
+        A read/stage failure degrades that page to a plain eviction;
+        the freed count is what matters to the caller either way."""
+        pairs = self._prefix_store.demote(n_pages)
+        if not pairs:
+            return 0
+        ids = [p for _, p in pairs]
+        with self.timer.time("kv_demote"):
+            try:
+                raw = self.runner.read_pages(ids)
+                for j, (path_bytes, _) in enumerate(pairs):
+                    per = {
+                        k: np.ascontiguousarray(v[:, j : j + 1])
+                        for k, v in raw.items()
+                    }
+                    self._kv_tier.put_page(path_bytes, per)
+                self.tier_demotes += len(pairs)
+            except Exception:  # noqa: BLE001 — a failed read degrades
+                # to a plain eviction; the pages still free below
+                logger.warning(
+                    "kv tier demotion read failed; evicting plainly",
+                    exc_info=True,
+                )
+        self._free_prefix_pages(ids)
+        return len(pairs)
+
+    def _promote_prefix(self, ctx: JobCtx, first, n_pages: int, handle):
+        """Probe the tier pool for consecutive prefix pages past the
+        radix-store hit, upload them into freshly allocated pages, and
+        graft them onto ``handle`` (``store.promote``). Returns the
+        possibly-extended handle; every failure path returns the
+        original handle and the caller pays plain tail prefill — a
+        tier problem never fails a job."""
+        store = self._prefix_store
+        tier = self._kv_tier
+        PS = self.ecfg.kv_page_size
+        k = len(handle.nodes) if handle is not None else 0
+        hits: List[Tuple[bytes, dict]] = []
+        while k + len(hits) < n_pages:
+            key = tier.prefix_key(first[: (k + len(hits) + 1) * PS])
+            p = tier.get_page(key)
+            if p is None:
+                break  # consecutive run only: page i is useless
+                #        without page i-1 (causal attention)
+            hits.append((key, p))
+        if not hits:
+            return handle
+        n = len(hits)
+        if self.native is not None:
+            pages = self.native.alloc_pages(n)
+            if pages is None:
+                return handle
+            pages = list(pages)
+        else:
+            if n > self.allocator.free_count:
+                return handle
+            pages = self.allocator.alloc(n)
+        try:
+            payload = {
+                pk: np.concatenate([p[pk] for _, p in hits], axis=1)
+                for pk in hits[0][1]
+            }
+            with self.timer.time("kv_promote"):
+                self.runner.write_pages(pages, payload)
+        except Exception:  # noqa: BLE001 — degrade to re-prefill
+            self._free_prefix_pages(pages)
+            logger.warning(
+                "kv tier promotion upload failed; re-prefilling",
+                exc_info=True,
+            )
+            return handle
+        h = handle if handle is not None else store.empty_handle()
+        if not store.promote(h, first[k * PS : (k + n) * PS], pages):
+            # racer re-inserted the run / store closed: keep the tier
+            # copy, return our upload, pay the plain tail prefill
+            self._free_prefix_pages(pages)
+            return handle
+        tier.discard([key for key, _ in hits])
+        self.tier_promotes += n
+        if self._tel_on and ctx.trace_id is not None:
+            telemetry.TRACES.event(
+                ctx.trace_id, "kv_promote", {"pages": n}
+            )
+        return h
 
     def _reserve(
         self, req: GenRequest, ctx: JobCtx, reserved: int = 0,
@@ -1844,6 +2014,58 @@ class ContinuousBatcher:
             return bool(fn(tok))
         return bool(self._constraint_mask(c, remaining)[tok])
 
+    def _checkpoint_slot(self, slot: _Slot) -> Optional[set]:
+        """Session KV checkpoint (``JobCtx.kv_checkpoint``): transfer
+        the finished row's page-aligned OWN pages into the radix prefix
+        store keyed by its full (prompt + emitted) token sequence, so
+        the session's next turn — whose prompt extends this sequence —
+        admits by prefix hit instead of re-prefilling the whole
+        conversation. Once store-owned the pages age like any other
+        leaves: under pressure they demote down the tiers rather than
+        being dropped. Returns the set of page ids now store-owned (the
+        caller must keep them out of the allocator), or None."""
+        store = self._prefix_store
+        PS = self.ecfg.kv_page_size
+        try:
+            full = np.concatenate(
+                [
+                    np.asarray(slot.req.prompt_ids, np.int32),
+                    np.asarray(slot.out_ids, np.int32),
+                ]
+            )
+            # positions [0, pos) hold KV for full[:pos] — the last
+            # sampled token's KV was never written
+            aligned = min(slot.pos, len(full)) // PS
+            if aligned <= slot.shared_n:
+                return None  # nothing beyond the shared head to keep
+            handle = store.lookup_pin(full[: aligned * PS])
+            try:
+                d = len(handle.nodes)
+                if d < slot.shared_n or d >= aligned:
+                    # the store path stops inside the job-owned prefix
+                    # head (pages we cannot transfer) or already covers
+                    # everything this row could contribute
+                    return None
+                pages = [int(p) for p in slot.pages[d:aligned]]
+                if not store.extend(
+                    handle, full[d * PS : aligned * PS], pages
+                ):
+                    return None
+                if self._tel_on and slot.job.trace_id is not None:
+                    telemetry.TRACES.event(
+                        slot.job.trace_id, "kv_checkpoint",
+                        {"row_id": int(slot.req.row_id),
+                         "pages": len(pages)},
+                    )
+                return set(pages)
+            finally:
+                store.release(handle)
+        except Exception:  # noqa: BLE001 — a checkpoint is an
+            # optimization; on any failure the pages free normally and
+            # the next turn re-prefills (the pre-tier behavior)
+            logger.warning("kv checkpoint failed", exc_info=True)
+            return None
+
     def _release(self, i: int) -> GenResult:
         """Free slot ``i``'s pages and emit its result.
 
@@ -1862,12 +2084,34 @@ class ContinuousBatcher:
         ``_pipe_capacity_ok`` for the companion invariant)."""
         slot = self.slots[i]
         assert slot is not None
+        kept = None
+        if (
+            slot.job is not None
+            and slot.job.kv_checkpoint
+            and self._kv_tier is not None
+            and self._prefix_store is not None
+            and not slot.prefilling
+        ):
+            kept = self._checkpoint_slot(slot)
         if self.native is not None:
             self.native.release(i)
+            if kept and not self.native.reserve_pages(
+                sorted(kept)
+            ):  # pragma: no cover — release just freed exactly these
+                # ids; a failure would mean the store and the allocator
+                # both think they own them, so drop the store wholesale
+                logger.warning(
+                    "kv checkpoint re-reserve failed; resetting store"
+                )
+                self._prefix_store.reset()
         else:
             # shared-prefix pages at the table head belong to the JOB
-            # (freed once at end of run), not this slot
-            self.allocator.free(slot.pages[slot.shared_n :])
+            # (freed once at end of run), not this slot; checkpointed
+            # pages now belong to the prefix store
+            own = slot.pages[slot.shared_n :]
+            self.allocator.free(
+                [p for p in own if int(p) not in kept] if kept else own
+            )
         if slot.job is not None:
             slot.job.n_slots -= 1
         self.slots[i] = None
@@ -2306,6 +2550,8 @@ class ContinuousBatcher:
         if ctx.prefix is not None:
             self._release_prefix(ctx.prefix)
             ctx.prefix = None
+        if self._hibernated:
+            self._purge_hibernated(ctx)
         ctx.done = True
         if self.ladder is not None:
             self.ladder.forget(ctx)  # drop the aging-clock entry
@@ -2326,6 +2572,10 @@ class ContinuousBatcher:
         if ctx.prefix is not None:
             self._release_prefix(ctx.prefix)
             ctx.prefix = None
+        if self._hibernated:
+            # the session layer rebuilds pending on resume; a stale
+            # hibernation entry must not shadow those fresh requests
+            self._purge_hibernated(ctx)
         ctx.prefix_ready = False  # a resumed ctx re-detects its prefix
 
     def _sweep_done(self, live: List[JobCtx], on_job_done) -> None:
@@ -2339,6 +2589,205 @@ class ContinuousBatcher:
             for s in self.slots
             if s is not None and s.job is not None and s.job.interactive
         )
+
+    def _hibernate_slot(self, i: int) -> bool:
+        """Suspend slot ``i`` by demoting its page-aligned own KV into
+        the tiered pool instead of discarding it — the preempted row
+        later resumes by page-upload plus a sub-page tail prefill
+        (``pos % page_size`` tokens) rather than regenerating its whole
+        prompt and partial output. The demote is SYNCHRONOUS and
+        pinned: the device pages free only after the pool owns the
+        payload, so a torn demotion (fault site ``kvtier.demote``)
+        degrades to the caller's plain regenerate suspend — never a
+        corrupt row. Returns True when the slot was hibernated and its
+        ORIGINAL request (live constraint and all) re-queued."""
+        if not self._can_hibernate:
+            return False
+        s = self.slots[i]
+        if s is None or s.prefilling or s.job is None:
+            return False
+        ctx = s.job
+        PS = self.ecfg.kv_page_size
+        aligned = s.pos // PS
+        own_aligned = [int(p) for p in s.pages[s.shared_n : aligned]]
+        key = b""
+        if own_aligned:
+            self._hib_seq += 1
+            key = b"hib:%d:%d:%d" % (
+                id(ctx), int(s.req.row_id), self._hib_seq,
+            )
+            try:
+                with self.timer.time("kv_demote"):
+                    raw = self.runner.read_pages(own_aligned)
+                    self._kv_tier.put_row(key, raw)
+                self.tier_demotes += len(own_aligned)
+            except Exception:  # noqa: BLE001 — HBM copy stays
+                # authoritative: fall back to the plain suspend
+                logger.warning(
+                    "hibernation demote failed; row %d regenerates",
+                    s.req.row_id, exc_info=True,
+                )
+                return False
+        self._hibernated[(id(ctx), int(s.req.row_id))] = _Hib(
+            key=key,
+            pos=s.pos,
+            last_token=s.last_token,
+            out_ids=list(s.out_ids),
+            logprob_sum=s.logprob_sum,
+            tail=s.tail,
+            hit_stop_seq=s.hit_stop_seq,
+            stop_longest=s.stop_longest,
+            counts=dict(s.counts),
+            seen_bits=s.seen_bits,
+            shared_tokens=s.shared_n * PS,
+            n_pages=len(own_aligned),
+        )
+        self._unreserve(i, s.pages[s.shared_n :])
+        ctx.n_slots -= 1
+        self.slots[i] = None
+        self._gen[i] += 1
+        self._needs_mask.discard(i)
+        # the ORIGINAL request re-queues — its live constraint object
+        # continues in place at resume (the stripped retry-style copy
+        # is built only if the tier loses the payload)
+        ctx.pending.insert(0, s.req)
+        return True
+
+    def _resume_hibernated(
+        self, req: GenRequest, ctx: JobCtx, r, hib: _Hib
+    ) -> Optional[GenRequest]:
+        """Re-admit a hibernated row into reservation ``r``: upload its
+        tier payload into the fresh pages, re-prefill only the sub-page
+        tail, and arm the slot exactly where it stopped. Returns None
+        on success (the slot is live); on a tier miss — torn demotion,
+        host-LRU drop without a disk tier, or a shared-prefix coverage
+        change across a session suspend — returns a FRESH request for
+        the caller to admit through the normal path (the pre-tier
+        full-regenerate behavior)."""
+        slot_idx, own_pages, table = r
+        PS = self.ecfg.kv_page_size
+        shared = ctx.prefix.tokens if ctx.prefix is not None else 0
+        payload = None
+        ok = shared == hib.shared_tokens
+        if ok and hib.n_pages:
+            payload = self._kv_tier.take_row(hib.key)
+            ok = (
+                payload is not None
+                and int(payload["k"].shape[1]) == hib.n_pages
+            )
+        start = shared + hib.n_pages * PS
+        if ok:
+            try:
+                with self.timer.time("kv_promote"):
+                    if payload is not None:
+                        self.runner.write_pages(
+                            [int(p) for p in own_pages[: hib.n_pages]],
+                            payload,
+                        )
+                    if hib.pos > start:
+                        full = np.concatenate(
+                            [
+                                np.asarray(req.prompt_ids, np.int32),
+                                np.asarray(hib.out_ids, np.int32),
+                            ]
+                        )
+                        # the truly novel tail: KV for the sub-page
+                        # positions the aligned payload cannot carry
+                        self.runner.prefill(
+                            full[start : hib.pos],
+                            np.asarray(table, np.int32),
+                            start=start,
+                        )
+            except Exception:  # noqa: BLE001 — the reservation stays;
+                # normal admission below overwrites every position
+                logger.warning(
+                    "hibernation resume failed; row %d regenerates",
+                    req.row_id, exc_info=True,
+                )
+                ok = False
+        if not ok:
+            ctx.stats["resumes_reprefill"] = (
+                ctx.stats.get("resumes_reprefill", 0) + 1
+            )
+            if self._tel_on:
+                telemetry.KV_RESUMES_TOTAL.inc(1.0, "reprefill")
+            # victim selection guaranteed the constraint is rebuildable
+            return dataclasses.replace(
+                req,
+                constraint=None,
+                prepped_constraint=None,
+                prep_queued=False,
+            )
+        pfx = ctx.prefix
+        slot = _Slot(
+            req=req,
+            pages=(
+                (list(pfx.pages) + list(own_pages))
+                if pfx is not None
+                else list(own_pages)
+            ),
+            pos=hib.pos,
+            last_token=hib.last_token,
+            job=ctx,
+            shared_n=pfx.n_pages if pfx is not None else 0,
+            out_ids=list(hib.out_ids),
+            logprob_sum=hib.logprob_sum,
+            tail=hib.tail,
+            hit_stop_seq=hib.hit_stop_seq,
+            stop_longest=hib.stop_longest,
+            counts=dict(hib.counts),
+            seen_bits=hib.seen_bits,
+        )
+        self.slots[slot_idx] = slot
+        ctx.n_slots += 1
+        if self.native is not None:
+            self.native.arm_slot(
+                slot_idx, hib.pos, hib.last_token,
+                req.temperature, req.top_p, req.top_k,
+            )
+        self.tier_promotes += hib.n_pages
+        ctx.stats["resumes_upload"] = (
+            ctx.stats.get("resumes_upload", 0) + 1
+        )
+        if self._tel_on:
+            telemetry.KV_RESUMES_TOTAL.inc(1.0, "upload")
+            if ctx.trace_id is not None:
+                telemetry.TRACES.event(
+                    ctx.trace_id, "hibernate_resume",
+                    {"row_id": int(req.row_id),
+                     "pages": int(hib.n_pages),
+                     "reprefilled_tokens": int(hib.pos - start)},
+                )
+        return None
+
+    def _purge_hibernated(self, ctx: JobCtx) -> None:
+        """Drop every hibernated entry of ``ctx`` (job finished, or the
+        whole session is suspending). Pending requests for those rows
+        carry LIVE advanced constraints that only a resume could have
+        continued — with the host state gone they must re-admit as
+        fresh requests, exactly the retry-path rebuild."""
+        stale = [k for k in self._hibernated if k[0] == id(ctx)]
+        if not stale:
+            return
+        rows = set()
+        keys: List[bytes] = []
+        for k in stale:
+            h = self._hibernated.pop(k)
+            rows.add(k[1])
+            if h.key:
+                keys.append(h.key)
+        if self._kv_tier is not None and keys:
+            self._kv_tier.discard(keys)
+        for j, r in enumerate(ctx.pending):
+            if int(r.row_id) in rows and (
+                r.constraint is not None or r.prep_queued
+            ):
+                ctx.pending[j] = dataclasses.replace(
+                    r,
+                    constraint=None,
+                    prepped_constraint=None,
+                    prep_queued=False,
+                )
 
     def _evict_for_interactive(self, ctx: JobCtx) -> bool:
         """Latency-priority admission (Sarathi-style mixed windows): when
@@ -2370,23 +2819,25 @@ class ContinuousBatcher:
             return False
         s = self.slots[best]
         victim = s.job
-        self._unreserve(best, s.pages[s.shared_n:])
-        victim.n_slots -= 1
-        self.slots[best] = None
-        self._gen[best] += 1
-        self._needs_mask.discard(best)
-        # fresh request at the HEAD of pending (admission pops the tail),
-        # so the victim's other rows keep their order and this one
-        # re-admits once the batch has room again
-        victim.pending.insert(
-            0,
-            dataclasses.replace(
-                s.req,
-                constraint=None,
-                prepped_constraint=None,
-                prep_queued=False,
-            ),
-        )
+        hibernated = self._hibernate_slot(best)
+        if not hibernated:
+            self._unreserve(best, s.pages[s.shared_n:])
+            victim.n_slots -= 1
+            self.slots[best] = None
+            self._gen[best] += 1
+            self._needs_mask.discard(best)
+            # fresh request at the HEAD of pending (admission pops the
+            # tail), so the victim's other rows keep their order and
+            # this one re-admits once the batch has room again
+            victim.pending.insert(
+                0,
+                dataclasses.replace(
+                    s.req,
+                    constraint=None,
+                    prepped_constraint=None,
+                    prep_queued=False,
+                ),
+            )
         victim.stats["preempted"] = victim.stats.get("preempted", 0) + 1
         if self._tel_on:
             telemetry.INTERACTIVE_PREEMPTIONS_TOTAL.inc(1.0)
@@ -2395,12 +2846,14 @@ class ContinuousBatcher:
                 telemetry.TRACES.event(
                     victim.trace_id, "preempt_suspend",
                     {"row_id": int(s.req.row_id), "by": ctx.job_id,
-                     "lost_tokens": int(best_cost)},
+                     "lost_tokens": 0 if hibernated else int(best_cost),
+                     "hibernated": bool(hibernated)},
                 )
         logger.debug(
-            "interactive admit: suspended batch row %d of %s "
-            "(%d tokens regenerate)",
-            s.req.row_id, victim.job_id, best_cost,
+            "interactive admit: suspended batch row %d of %s (%s)",
+            s.req.row_id, victim.job_id,
+            "hibernated" if hibernated
+            else "%d tokens regenerate" % best_cost,
         )
         return True
 
@@ -2443,20 +2896,22 @@ class ContinuousBatcher:
                 return False
             s = self.slots[best]
             victim = s.job
-            self._unreserve(best, s.pages[s.shared_n:])
-            victim.n_slots -= 1
-            self.slots[best] = None
-            self._gen[best] += 1
-            self._needs_mask.discard(best)
-            victim.pending.insert(
-                0,
-                dataclasses.replace(
-                    s.req,
-                    constraint=None,
-                    prepped_constraint=None,
-                    prep_queued=False,
-                ),
-            )
+            hibernated = self._hibernate_slot(best)
+            if not hibernated:
+                self._unreserve(best, s.pages[s.shared_n:])
+                victim.n_slots -= 1
+                self.slots[best] = None
+                self._gen[best] += 1
+                self._needs_mask.discard(best)
+                victim.pending.insert(
+                    0,
+                    dataclasses.replace(
+                        s.req,
+                        constraint=None,
+                        prepped_constraint=None,
+                        prep_queued=False,
+                    ),
+                )
             victim.stats["preempted"] = (
                 victim.stats.get("preempted", 0) + 1
             )
@@ -2465,14 +2920,16 @@ class ContinuousBatcher:
                 telemetry.TRACES.event(
                     victim.trace_id, "preempt_suspend",
                     {"row_id": int(s.req.row_id), "by": ctx.job_id,
-                     "lost_tokens": int(best_cost)},
+                     "lost_tokens": 0 if hibernated else int(best_cost),
+                     "hibernated": bool(hibernated)},
                 )
             lad.record(ctx, victim)
             logger.debug(
-                "priority ladder: P%d %s suspended row %d of P%d %s "
-                "(%d tokens regenerate)",
+                "priority ladder: P%d %s suspended row %d of P%d %s (%s)",
                 ctx.priority, ctx.job_id, s.req.row_id,
-                victim.priority, victim.job_id, best_cost,
+                victim.priority, victim.job_id,
+                "hibernated" if hibernated
+                else "%d tokens regenerate" % best_cost,
             )
             return True
         except Exception:  # noqa: BLE001 — policy errors must never
@@ -2555,6 +3012,18 @@ class ContinuousBatcher:
                     ctx.pending.pop()
                     if self._tel_on and ctx.trace_preempted:
                         self._trace_resume(ctx, req)
+                    if self._hibernated:
+                        hib = self._hibernated.pop(
+                            (id(ctx), int(req.row_id)), None
+                        )
+                        if hib is not None:
+                            req2 = self._resume_hibernated(
+                                req, ctx, r, hib
+                            )
+                            if req2 is None:
+                                admitted = True
+                                continue  # armed in place — no prefill
+                            req = req2  # tier miss: admit from scratch
                     try:
                         self._materialize_constraint(req)
                     except Exception as e:  # noqa: BLE001 — row isolation
@@ -2589,6 +3058,16 @@ class ContinuousBatcher:
                 ctx.pending.pop()
                 if self._tel_on and ctx.trace_preempted:
                     self._trace_resume(ctx, req)
+                if self._hibernated:
+                    hib = self._hibernated.pop(
+                        (id(ctx), int(req.row_id)), None
+                    )
+                    if hib is not None:
+                        req2 = self._resume_hibernated(req, ctx, r, hib)
+                        if req2 is None:
+                            admitted = True
+                            continue  # armed in place — no prefill
+                        req = req2  # tier miss: admit from scratch
                 try:
                     self._materialize_constraint(req)
                 except Exception as e:  # noqa: BLE001 — row isolation
@@ -2659,6 +3138,14 @@ class ContinuousBatcher:
                         if not ctx.done:
                             self._suspend_job(ctx)
                     return "yielded"
+                if self._kv_tier is not None:
+                    # serving-side idle-session checkpoints: demote the
+                    # coldest unpinned store leaves host-ward so a long
+                    # think-time session stops holding HBM pages
+                    for toks in self._kv_tier.pop_demote_requests():
+                        self._demote_store_pages(
+                            max(len(toks) // self.ecfg.kv_page_size, 1)
+                        )
                 ajobs = [c for c in live if not c.done]
                 if self._tel_on:
                     # batch-wide spans (prefill/decode/accept) carry the
@@ -3176,3 +3663,12 @@ class ContinuousBatcher:
                 if ctx.prefix is not None:
                     self._release_prefix(ctx.prefix)
                     ctx.prefix = None
+                if self._hibernated:
+                    self._purge_hibernated(ctx)
+            if self._hibernated and self._kv_tier is not None:
+                # entries of jobs no longer in ``live`` (defensive —
+                # purge runs on every terminal transition above)
+                self._kv_tier.discard(
+                    [h.key for h in self._hibernated.values() if h.key]
+                )
+                self._hibernated.clear()

@@ -126,6 +126,39 @@ class BaseTokenizer:
             out += "<|im_start|>assistant\n"
         return out
 
+    def render_chat_continuation(
+        self, user: str, template: str = "chatml"
+    ) -> str:
+        """Render the NEXT user turn of a running conversation — the
+        text appended after an assistant reply whose terminal stop
+        token was stripped from the stream (serving sessions store
+        ``prompt_ids + token_ids``, which end mid-assistant-turn). The
+        scaffold therefore re-supplies the assistant-end marker, then
+        the user turn, then the generation prompt, so that
+        ``stored_text + continuation`` is exactly the multi-turn render
+        and the stored ids stay a strict prefix of the next prompt
+        (the property session KV checkpointing rides on)."""
+        if template == "plain":
+            return "\n\n" + user
+        if template == "gemma":
+            return (
+                "<end_of_turn>\n"
+                f"<start_of_turn>user\n{user}<end_of_turn>\n"
+                "<start_of_turn>model\n"
+            )
+        if template == "llama3":
+            return (
+                "<|eot_id|>"
+                "<|start_header_id|>user<|end_header_id|>\n\n"
+                f"{user}<|eot_id|>"
+                "<|start_header_id|>assistant<|end_header_id|>\n\n"
+            )
+        # chatml (default)
+        return (
+            f"<|im_end|>\n<|im_start|>user\n{user}<|im_end|>\n"
+            "<|im_start|>assistant\n"
+        )
+
 
 class ByteTokenizer(BaseTokenizer):
     """Byte-level tokenizer: ids 0..255 are raw bytes; specials follow.

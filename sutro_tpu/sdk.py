@@ -321,8 +321,15 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
         top_p: Optional[float] = None,
         stop: Optional[Union[str, List[str]]] = None,
         seed: Optional[int] = None,
+        session_id: Optional[str] = None,
     ) -> Any:
         """One interactive chat completion against the serving tier.
+
+        ``session_id`` makes the conversation sticky: the server keeps
+        the token transcript (and its KV, tiered HBM→host→disk), so
+        each later call with the same id sends ONLY the new user turn
+        and resumes in milliseconds instead of re-prefilling the
+        history.
 
         ``messages`` is a string (one user turn) or an OpenAI-style
         message list. Non-streaming returns the ``chat.completion``
@@ -362,6 +369,8 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
             body["stop"] = stop
         if seed is not None:
             body["seed"] = int(seed)
+        if session_id is not None:
+            body["session_id"] = str(session_id)
 
         if self.backend == "remote":
             resp = self.do_request(

@@ -52,6 +52,31 @@ logger = logging.getLogger(__name__)
 #: tokenless) counts as starved — doctor verdict ``interactive_starved``
 STARVED_TTFT_S = 5.0
 
+#: sticky chat sessions kept server-side (token transcripts only — a
+#: few KB each); the oldest is dropped past the cap, and a dropped
+#: session's next turn simply re-renders as a fresh conversation
+SESSION_CAP = 512
+
+#: a session untouched this long has its KV pages demoted host-ward on
+#: the next sweep (submit-time opportunistic; tests call it directly)
+SESSION_IDLE_CHECKPOINT_S = 30.0
+
+
+@dataclasses.dataclass
+class _ChatSession:
+    """Server-side transcript of one sticky conversation: the exact
+    token ids the engine has KV for (prompt render + every emitted
+    token, stop ids stripped). The next turn appends a continuation
+    render, so the stored ids stay a strict token-level prefix of the
+    next prompt — which is what lets the prefix store / KV tiers serve
+    the whole history from cache."""
+
+    ids: List[int]
+    last_used: float
+    turns: int = 0
+    # demote already requested since last use (dedup for the sweep)
+    checkpointed: bool = False
+
 
 class GatewayRejected(Exception):
     """Admission refused: carries the HTTP status the server maps it to."""
@@ -127,6 +152,8 @@ class InteractiveGateway:
         self._kicked: set = set()
         self._counter = itertools.count(1)
         self.draining = False
+        # sticky chat sessions by (engine_key, session_id)
+        self._sessions: Dict[tuple, _ChatSession] = {}
 
     # -- admission (HTTP handler / SDK thread) -------------------------
 
@@ -171,12 +198,32 @@ class InteractiveGateway:
             )
         tok = self.eng._get_tokenizer(engine_key, mcfg)
 
+        skey = None
+        sess_prev_tokens = 0
         if sreq.kind == "chat":
             from ..engine.tokenizer import encode_chat_batch
 
-            ids = encode_chat_batch(
-                tok, [sreq.prompt], sreq.system_prompt, mcfg.chat_template
-            )[0]
+            prev = None
+            if sreq.session_id is not None:
+                skey = (engine_key, sreq.session_id)
+                prev = self._session_ids(skey)
+                # opportunistic idle sweep: session traffic is exactly
+                # when think-time gaps appear, so piggyback here
+                self.checkpoint_idle()
+            if prev is not None:
+                # warm session: the engine already holds KV for every
+                # stored id — render ONLY the new user turn
+                ids = list(prev) + tok.encode(
+                    tok.render_chat_continuation(
+                        sreq.prompt, mcfg.chat_template
+                    )
+                )
+                sess_prev_tokens = len(prev)
+            else:
+                ids = encode_chat_batch(
+                    tok, [sreq.prompt], sreq.system_prompt,
+                    mcfg.chat_template,
+                )[0]
         else:
             # /v1/completions is raw continuation: no chat scaffold
             ids = tok.encode(sreq.prompt)
@@ -240,6 +287,14 @@ class InteractiveGateway:
             if res.finish_reason.startswith("error"):
                 channel.fail(res.error or res.finish_reason)
                 return
+            if skey is not None and res.finish_reason != "cancelled":
+                # the transcript the engine now has KV for: our prompt
+                # ids plus every emitted token (stop ids were stripped
+                # by the release path, matching the continuation
+                # render's re-supplied end-of-turn marker)
+                self._session_update(
+                    skey, list(ids) + [int(t) for t in res.token_ids]
+                )
             text: Optional[str] = None
             try:
                 text = tok.decode(res.token_ids)
@@ -308,10 +363,12 @@ class InteractiveGateway:
                  "tenant": sreq.tenant or "default"},
                 t0_mono=t_submit,
             )
+            attrs = {"prompt_tokens": len(ids), "warm_tokens": int(warm)}
+            if skey is not None:
+                attrs["session_tokens"] = int(sess_prev_tokens)
             telemetry.TRACES.add(
                 trace_id, "admit_gateway", t_submit,
-                time.monotonic() - t_submit,
-                {"prompt_tokens": len(ids), "warm_tokens": int(warm)},
+                time.monotonic() - t_submit, attrs,
             )
             channel.trace_id = trace_id
         with self._lock:
@@ -328,6 +385,14 @@ class InteractiveGateway:
                 interactive=True,
                 trace_id=trace_id,
                 trace_enq_mono=time.monotonic(),
+                # session turns checkpoint their KV into the prefix
+                # store at release (scheduler._checkpoint_slot) so the
+                # NEXT turn admits by prefix hit; requires the tier
+                # pool (checkpointed pages must demote, not pin HBM)
+                kv_checkpoint=(
+                    skey is not None
+                    and self.eng._kv_tier_for(engine_key) is not None
+                ),
             )
             ir = InteractiveRequest(
                 id=rid,
@@ -358,6 +423,70 @@ class InteractiveGateway:
             # session, which also polls take_pending directly)
             self.eng._enqueue_serving(engine_key)
         return ir
+
+    # -- sticky chat sessions ------------------------------------------
+
+    def _session_ids(self, skey: tuple) -> Optional[List[int]]:
+        """The stored transcript for ``skey`` (marks it hot), or None
+        for a new/expired session."""
+        with self._lock:
+            s = self._sessions.get(skey)
+            if s is None:
+                return None
+            s.last_used = time.monotonic()
+            s.checkpointed = False
+            return list(s.ids)
+
+    def _session_update(self, skey: tuple, ids: List[int]) -> None:
+        with self._lock:
+            s = self._sessions.get(skey)
+            if s is None:
+                if len(self._sessions) >= SESSION_CAP:
+                    oldest = min(
+                        self._sessions,
+                        key=lambda k: self._sessions[k].last_used,
+                    )
+                    del self._sessions[oldest]
+                s = _ChatSession(ids=[], last_used=0.0)
+                self._sessions[skey] = s
+            s.ids = ids
+            s.last_used = time.monotonic()
+            s.turns += 1
+            s.checkpointed = False
+
+    def session_count(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+    def checkpoint_idle(
+        self, idle_s: float = SESSION_IDLE_CHECKPOINT_S
+    ) -> int:
+        """Hibernate idle conversations: for every session untouched
+        for ``idle_s``, ask its engine's KV tier pool to demote that
+        many cold pages host-ward (the live scheduler session drains
+        the queue at its loop top — kvtier.pop_demote_requests). The
+        next turn promotes them back in milliseconds instead of
+        re-prefilling the whole history. Returns requests posted."""
+        now = time.monotonic()
+        with self._lock:
+            idle = [
+                (k, s)
+                for k, s in self._sessions.items()
+                if not s.checkpointed and now - s.last_used >= idle_s
+            ]
+        posted = 0
+        for (ekey, _sid), s in idle:
+            tier = self.eng._kv_tiers.get(ekey)
+            if tier is None:
+                continue
+            try:
+                tier.request_demote(np.asarray(s.ids, np.int32))
+                s.checkpointed = True
+                posted += 1
+            except Exception:  # noqa: BLE001 — a hibernation sweep
+                # must never break a submit riding on it
+                logger.warning("idle checkpoint failed", exc_info=True)
+        return posted
 
     # -- scheduler side (engine worker thread) -------------------------
 

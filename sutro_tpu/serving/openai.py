@@ -51,6 +51,12 @@ class ServingRequest:
     # tenant attribution: the OpenAI `user` field, threaded into the
     # capped per-tenant telemetry series (telemetry/monitor.py)
     tenant: Optional[str] = None
+    # sticky conversation handle (non-OpenAI extension): turns of the
+    # same session_id reuse the session's KV — the gateway stores the
+    # running token transcript, renders only the new user turn as a
+    # continuation, and the engine admits it by prefix hit; idle
+    # sessions checkpoint their pages down the KV tiers
+    session_id: Optional[str] = None
 
 
 def _content_text(content: Any) -> str:
@@ -170,6 +176,25 @@ def parse_request(body: Any, *, chat: bool) -> ServingRequest:
     if tenant is not None and not isinstance(tenant, str):
         raise BadServingRequest("user must be a string")
 
+    session_id = body.get("session_id")
+    if session_id is not None:
+        if not chat:
+            raise BadServingRequest(
+                "session_id is only supported on /v1/chat/completions"
+            )
+        if not isinstance(session_id, str) or not session_id.strip():
+            raise BadServingRequest("session_id must be a non-empty string")
+        session_id = session_id.strip()
+        # sticky sessions carry the history server-side: the NEW user
+        # turn is the last user message; earlier turns in the payload
+        # are ignored on a warm session (the transcript is ours)
+        users = [t for r, t in turns if r == "user"]
+        if not users:
+            raise BadServingRequest(
+                "session requests need a user message"
+            )
+        prompt = users[-1]
+
     return ServingRequest(
         model=model,
         prompt=prompt,
@@ -184,6 +209,7 @@ def parse_request(body: Any, *, chat: bool) -> ServingRequest:
         seed=_num("seed", int),
         kind="chat" if chat else "completion",
         tenant=(tenant.strip() or None) if tenant else None,
+        session_id=session_id,
     )
 
 

@@ -308,6 +308,31 @@ PREFIX_STORE_TOKENS_SAVED_TOTAL = REGISTRY.counter(
     "the prefix store",
     unit="tokens",
 )
+# -- tiered paged-KV pool (engine/kvtier.py, OBSERVABILITY.md) ----------
+KV_TIER_PAGES = REGISTRY.gauge(
+    "sutro_kv_tier_pages",
+    "KV pages resident per below-HBM tier (host = int8 page payloads "
+    "in pinned RAM, disk = npz bundles under sutro_home()/kvtier)",
+    labels=("tier",),  # host | disk
+    unit="pages",
+    max_series=4,
+)
+KV_MIGRATIONS_TOTAL = REGISTRY.counter(
+    "sutro_kv_migrations_total",
+    "Tier-hop page migrations by direction (demote = device->host, "
+    "promote = host/disk->device, disk_write/disk_read = host<->disk)",
+    labels=("dir",),  # demote | promote | disk_write | disk_read
+    max_series=8,
+)
+KV_RESUMES_TOTAL = REGISTRY.counter(
+    "sutro_kv_resumes_total",
+    "Preempted-row resumes by mechanism: 'upload' re-admits from a "
+    "hibernated host/disk payload (page-upload, no prefill); "
+    "'reprefill' regenerates from scratch (tier miss / torn promotion)",
+    labels=("kind",),  # upload | reprefill
+    unit="rows",
+    max_series=4,
+)
 
 # Span names the engine emits — OBSERVABILITY.md's span schema section
 # and tests key off this tuple, so additions land in one place.
@@ -322,6 +347,11 @@ STAGES = (
     "finalize",
     "dp_round",
     "embed",
+    # tiered-KV migration hops (engine/kvtier.py): device<->host page
+    # payload moves on the scheduler thread (disk writes happen on the
+    # migration worker and surface as kv_demote queue time only)
+    "kv_demote",
+    "kv_promote",
 )
 
 

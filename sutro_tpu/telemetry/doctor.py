@@ -55,7 +55,7 @@ DOCTOR_VERSION = 1
 DEVICE_STAGES = ("prefill", "decode_window", "admit", "embed")
 #: host-side pipeline stages (the chip idle or overlapped)
 HOST_STAGES = ("tokenize", "constraint_compile", "accept", "flush",
-               "finalize")
+               "finalize", "kv_demote", "kv_promote")
 #: I/O subset of the host stages (jobstore writes)
 IO_STAGES = ("flush", "finalize")
 #: round envelopes — excluded from attribution (they CONTAIN stages)
@@ -69,6 +69,8 @@ VERDICTS = (
     "straggler_worker",
     "io_bound",
     "host_bound_admit",
+    "kv_pressure",
+    "resume_bound",
     "decode_below_roofline",
     "healthy",
 )
@@ -521,6 +523,52 @@ def diagnose(
             f"time {device_s:.3f}s (largest: {top} {top_s:.3f}s): the "
             "chip starves behind the host"
         )
+
+    # tiered-KV pool health (engine/kvtier.py stamps attrs["kv_tier"]
+    # at job end): migration time competing with device time means the
+    # pool is thrashing between tiers; preempted rows that mostly
+    # RE-PREFILL instead of resuming by page-upload mean the host/disk
+    # tiers are losing the state they exist to keep
+    kvt = attrs.get("kv_tier") or {}
+    if kvt:
+        migrate_s = round(
+            sum(
+                a["stages"].get(st, {}).get("total_s", 0.0)
+                for a in processes.values()
+                for st in ("kv_demote", "kv_promote")
+            ),
+            6,
+        )
+        if (
+            verdict is None
+            and device_s > 0
+            and migrate_s > 0.25 * device_s
+        ):
+            verdict = "kv_pressure"
+            evidence.append(
+                f"tier migrations spent {migrate_s:.3f}s against "
+                f"{device_s:.3f}s of device time "
+                f"({kvt.get('demotes', 0)} demotion(s), "
+                f"{kvt.get('promotes', 0)} promotion(s)): the paged "
+                "pool is thrashing across tiers — grow the HBM pool, "
+                "raise kv_tier_host_pages, or lower resident sessions"
+            )
+        reup = kvt.get("resumes_upload", 0)
+        repre = kvt.get("resumes_reprefill", 0)
+        if verdict is None and repre > reup and repre > 0:
+            verdict = "resume_bound"
+            evidence.append(
+                f"{repre} preempted row(s) re-prefilled from scratch "
+                f"vs {reup} resumed by page-upload: hibernated state "
+                "is falling out of the host/disk tiers before resume "
+                "(raise kv_tier_host_pages or enable kv_tier_disk)"
+            )
+        elif reup or repre:
+            evidence.append(
+                f"kv tiers: {reup} page-upload resume(s), {repre} "
+                f"re-prefill(s), {kvt.get('demotes', 0)} demotion(s), "
+                f"{kvt.get('promotes', 0)} promotion(s)"
+            )
 
     if verdict is None:
         pcts = [

@@ -1,0 +1,651 @@
+"""Tiered paged-KV pool + session hibernation (engine/kvtier.py).
+
+HBM -> pinned host RAM -> disk page migration: cold prefix-store
+leaves DEMOTE instead of evicting, preempted rows HIBERNATE their
+pages and resume by page-upload + sub-page tail prefill, and chat
+sessions checkpoint their transcript KV between turns. The contract
+under test, in order of importance:
+
+1. ``SUTRO_KV_TIERS=0`` / no pool => bit-identical to the untiered
+   engine, with ZERO ops in the pool's census.
+2. Demoted pages store int8 regardless of pool dtype (half the host
+   bytes of bf16); the quantize/dequantize error is bounded by half a
+   step of each token's scale. On an int8 pool the round trip is
+   bit-exact, so demote->promote and hibernate->resume reproduce the
+   untiered outputs EXACTLY at temperature 0.
+3. Page accounting is exact across every hop: demotion frees device
+   pages only after the pool owns the payload, pinned (hibernated)
+   entries never drop, and a close returns every page.
+4. Fault sites ``kvtier.demote`` / ``kvtier.promote`` /
+   ``kvtier.disk_write`` degrade to regenerate / re-prefill / plain
+   eviction — mid-flight migration kills never corrupt a row.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from sutro_tpu.engine import faults
+from sutro_tpu.engine.config import EngineConfig
+from sutro_tpu.engine.kvtier import (
+    KVTierPool,
+    dequantize_payload,
+    quantize_payload,
+)
+from sutro_tpu.engine.prefixstore import PrefixStore
+from sutro_tpu.engine.scheduler import ContinuousBatcher, GenRequest, JobCtx
+from sutro_tpu.models.configs import MODEL_CONFIGS
+
+PREFIX = "You are a terse classifier. Decide the sentiment of this: "
+TAILS = ["great!", "bad movie", "meh", "totally awesome ride"]
+
+
+@pytest.fixture()
+def mktier():
+    """Factory for pools that are always closed (the migration worker
+    is a daemon thread, but tests must not leak inflight state)."""
+    pools = []
+
+    def make(page_size=8, **kw):
+        p = KVTierPool(page_size, **kw)
+        pools.append(p)
+        return p
+
+    yield make
+    faults.clear()
+    for p in pools:
+        p.close(timeout=5)
+
+
+@pytest.fixture(scope="module")
+def int8_runner():
+    """A tiny runner over an int8-quantized KV pool: tier payloads ARE
+    the pool format, so every migration hop is bit-exact and the
+    hibernate/demote bit-identity legs assert token equality."""
+    from sutro_tpu.engine.runner import ModelRunner
+
+    ecfg = EngineConfig(
+        kv_page_size=8, max_pages_per_seq=16, decode_batch_size=4,
+        max_model_len=128, use_pallas=False, param_dtype="float32",
+        activation_dtype="float32", kv_quantize="int8",
+        interactive_slots=2,
+    )
+    return ModelRunner(MODEL_CONFIGS["tiny-dense"], ecfg)
+
+
+def _payload(n_pages=1, seed=0, L=2, PS=8, KD=4):
+    rng = np.random.default_rng(seed)
+    return {
+        "k": rng.standard_normal((L, n_pages, PS, KD)).astype(np.float32),
+        "v": rng.standard_normal((L, n_pages, PS, KD)).astype(np.float32),
+    }
+
+
+def _payload_equal(a, b):
+    return set(a) == set(b) and all(
+        np.array_equal(a[k], b[k]) for k in a
+    )
+
+
+# ---------------------------------------------------------------------
+# payload quantization units (satellite: int8 below HBM, always)
+# ---------------------------------------------------------------------
+
+
+def test_quantize_parity_bound_and_capacity():
+    """A float payload quantizes to int8 + f32 per-token scales with
+    error <= half a quantization step — and at half the value bytes
+    (the host-tier capacity win PERF.md claims)."""
+    raw = _payload(n_pages=3, seed=1)
+    q = quantize_payload(raw)
+    assert q["k"].dtype == np.int8 and q["v"].dtype == np.int8
+    assert q["ks"].dtype == np.float32 and q["vs"].dtype == np.float32
+    assert q["ks"].shape == raw["k"].shape[:-1]
+    deq = dequantize_payload(q, np.float32)
+    for vk, sk in (("k", "ks"), ("v", "vs")):
+        tol = q[sk][..., None] * 0.5 + 1e-6
+        assert (np.abs(raw[vk] - deq[vk]) <= tol).all()
+    # int8 values are half the f32 bytes; scales add 1/KD overhead
+    assert q["k"].nbytes * 4 == raw["k"].nbytes
+    assert (q["ks"].nbytes + q["k"].nbytes) < raw["k"].nbytes
+
+
+def test_quantize_int8_passthrough_is_bit_exact():
+    """An int8 pool's payload (values + scales) passes through
+    untouched — the demote path adds no second quantization."""
+    q0 = quantize_payload(_payload(n_pages=2, seed=2))
+    again = quantize_payload(q0)
+    assert again is q0  # same object: zero-copy passthrough
+
+
+# ---------------------------------------------------------------------
+# pool units (no model)
+# ---------------------------------------------------------------------
+
+
+def test_put_get_page_roundtrip_and_census(mktier):
+    pool = mktier(8, host_pages=64)
+    raw = _payload(seed=3)
+    key = b"page:a"
+    pool.put_page(key, raw)
+    assert pool.drain(10)
+    got = pool.get_page(key)
+    assert got is not None
+    assert _payload_equal(got, quantize_payload(raw))
+    c = pool.op_census()
+    assert c["demotes"] == 1 and c["promotes"] == 1
+    assert c["dropped"] == 0 and c["disk_writes"] == 0
+    assert pool.get_page(b"page:missing") is None
+
+
+def test_prefix_key_is_exact_token_content():
+    a = np.arange(16, dtype=np.int32)
+    assert KVTierPool.prefix_key(a) == KVTierPool.prefix_key(a.copy())
+    b = a.copy()
+    b[-1] += 1
+    assert KVTierPool.prefix_key(a) != KVTierPool.prefix_key(b)
+
+
+def test_host_lru_spills_to_disk_and_reads_back(mktier, tmp_path):
+    pool = mktier(8, host_pages=2, disk_dir=tmp_path / "kvtier")
+    raws = {b"p%d" % i: _payload(seed=10 + i) for i in range(4)}
+    for key, raw in raws.items():
+        pool.put_page(key, raw)
+        assert pool.drain(10)
+    assert pool.pages("host") <= 2
+    assert pool.pages("disk") >= 2
+    # every page is still promotable, wherever it landed
+    for key, raw in raws.items():
+        got = pool.get_page(key)
+        assert got is not None and _payload_equal(
+            got, quantize_payload(raw)
+        )
+    c = pool.op_census()
+    assert c["disk_writes"] >= 2 and c["disk_reads"] >= 1
+    assert c["dropped"] == 0
+
+
+def test_pinned_rows_never_drop_without_disk(mktier):
+    """A hibernated row's payload is pinned: host pressure sheds
+    unpinned prefix pages around it, never the row itself."""
+    pool = mktier(8, host_pages=1)
+    row = _payload(n_pages=2, seed=20)
+    pool.put_row(b"row:1", row)  # 2 pages, already over budget
+    for i in range(3):
+        pool.put_page(b"p%d" % i, _payload(seed=30 + i))
+        assert pool.drain(10)
+    assert pool.op_census()["dropped"] >= 1  # unpinned pressure victims
+    got = pool.take_row(b"row:1")
+    assert got is not None and _payload_equal(got, quantize_payload(row))
+    # take_row removed it: a resumed row re-demotes fresh next time
+    assert pool.get_page(b"row:1") is None
+
+
+def test_take_row_after_discard_misses(mktier):
+    pool = mktier(8, host_pages=8)
+    pool.put_row(b"row:x", _payload(seed=4))
+    pool.discard([b"row:x", b"never-there"])
+    assert pool.take_row(b"row:x") is None
+
+
+def test_disk_tier_persists_across_pools(mktier, tmp_path):
+    d = tmp_path / "kvtier"
+    pool1 = mktier(8, host_pages=1, disk_dir=d)
+    raws = {b"a": _payload(seed=40), b"b": _payload(seed=41)}
+    for key, raw in raws.items():
+        pool1.put_page(key, raw)
+        assert pool1.drain(10)
+    # push both to disk (host budget 1 forces the spill)
+    assert pool1.pages("disk") >= 1
+    pool1.close(timeout=5)
+    pool2 = mktier(8, host_pages=4, disk_dir=d)
+    hits = sum(
+        1
+        for key, raw in raws.items()
+        if (got := pool2.get_page(key)) is not None
+        and _payload_equal(got, quantize_payload(raw))
+    )
+    assert hits >= 1  # the spilled bundle survived the process "restart"
+
+
+def test_closed_pool_drops_async_and_refuses_rows(mktier):
+    pool = mktier(8)
+    pool.close(timeout=5)
+    pool.put_page(b"late", _payload(seed=5))  # silently dropped
+    assert pool.get_page(b"late") is None
+    with pytest.raises(RuntimeError):
+        pool.put_row(b"row", _payload(seed=6))
+
+
+def test_demote_request_queue_roundtrip(mktier):
+    pool = mktier(8)
+    toks = np.arange(24, dtype=np.int32)
+    pool.request_demote(toks)
+    pool.request_demote(toks[:8])
+    got = pool.pop_demote_requests()
+    assert len(got) == 2 and np.array_equal(got[0], toks)
+    assert pool.pop_demote_requests() == []
+
+
+# ---------------------------------------------------------------------
+# chaos: the three tier-hop fault sites (units)
+# ---------------------------------------------------------------------
+
+
+def test_torn_async_demotion_drops_entry_never_blocks(mktier):
+    pool = mktier(8)
+    faults.configure("kvtier.demote:error")
+    try:
+        pool.put_page(b"torn", _payload(seed=7))
+        assert pool.drain(10)
+    finally:
+        faults.clear()
+    assert pool.get_page(b"torn") is None  # plain eviction semantics
+    assert pool.op_census()["dropped"] == 1
+
+
+def test_torn_promotion_retries_once_then_misses(mktier):
+    pool = mktier(8)
+    pool.put_page(b"k", _payload(seed=8))
+    assert pool.drain(10)
+    faults.configure("kvtier.promote:error:times=1")
+    try:
+        got = pool.get_page(b"k")  # first attempt torn, retry lands
+        assert got is not None
+    finally:
+        faults.clear()
+    faults.configure("kvtier.promote:error")
+    try:
+        assert pool.get_page(b"k") is None  # both attempts torn: miss
+    finally:
+        faults.clear()
+    assert pool.get_page(b"k") is not None  # the entry itself survived
+
+
+def test_torn_disk_write_keeps_host_copy_and_quarantines(
+    mktier, tmp_path
+):
+    """A spill that dies between write and rename leaves a truncated
+    bundle at the final name. The host copy stays authoritative (the
+    entry never leaves RAM) and the next scan quarantines the torn
+    file instead of serving it."""
+    d = tmp_path / "kvtier"
+    pool = mktier(8, host_pages=1, disk_dir=d)
+    raw_a, raw_b = _payload(seed=50), _payload(seed=51)
+    faults.configure("kvtier.disk_write:torn")
+    try:
+        pool.put_page(b"a", raw_a)
+        assert pool.drain(10)
+        pool.put_page(b"b", raw_b)  # forces the (torn) spill of a
+        assert pool.drain(10)
+    finally:
+        faults.clear()
+    # both entries still promotable from host; nothing made it to disk
+    assert pool.pages("disk") == 0
+    for key, raw in ((b"a", raw_a), (b"b", raw_b)):
+        got = pool.get_page(key)
+        assert got is not None and _payload_equal(
+            got, quantize_payload(raw)
+        )
+    pool.close(timeout=5)
+    # the truncated bundle at the final name quarantines on scan
+    pool2 = mktier(8, disk_dir=d)
+    assert pool2.pages("disk") == 0
+    corrupt = list((d / ".corrupt").glob("*.npz"))
+    assert len(corrupt) >= 1
+
+
+# ---------------------------------------------------------------------
+# scheduler level (tiny model)
+# ---------------------------------------------------------------------
+
+
+def _reqs(tok, tails=TAILS, row_base=0, **kw):
+    kw.setdefault("max_new_tokens", 6)
+    kw.setdefault("temperature", 0.0)
+    return [
+        GenRequest(
+            row_id=row_base + i,
+            prompt_ids=np.array(tok.encode(PREFIX + t), np.int32),
+            **kw,
+        )
+        for i, t in enumerate(tails)
+    ]
+
+
+def _batcher(runner, tok, store=None, tier=None):
+    return ContinuousBatcher(
+        runner, stop_ids=tok.stop_ids(), prefix_store=store,
+        kv_tier=tier,
+    )
+
+
+def _run(b, reqs, **kw):
+    res = {}
+    out = b.run(
+        reqs, on_result=lambda r: res.__setitem__(r.row_id, r), **kw
+    )
+    return out, {i: r.token_ids for i, r in res.items()}
+
+
+def test_kill_switch_off_bit_identical_zero_ops(
+    tiny_runner, byte_tok, mktier
+):
+    """The acceptance bar: no pool (and an attached-but-unexercised
+    pool) produce EXACTLY the untiered outputs, and the pool's op
+    census reads zero everywhere."""
+    _, r_plain = _run(_batcher(tiny_runner, byte_tok), _reqs(byte_tok))
+    pool = mktier(8)
+    b = _batcher(tiny_runner, byte_tok, tier=pool)
+    out, r_tier = _run(b, _reqs(byte_tok))
+    assert out == "completed" and r_tier == r_plain
+    assert all(v == 0 for v in pool.op_census().values())
+    assert b.tier_demotes == 0 and b.tier_promotes == 0
+    # a geometry-mismatched pool detaches entirely (tiering off)
+    pool16 = mktier(16)
+    b16 = _batcher(tiny_runner, byte_tok, tier=pool16)
+    assert b16._kv_tier is None
+    out16, r16 = _run(b16, _reqs(byte_tok))
+    assert out16 == "completed" and r16 == r_plain
+    assert all(v == 0 for v in pool16.op_census().values())
+
+
+def test_store_demotion_frees_pages_conserved(
+    tiny_runner, byte_tok, mktier
+):
+    """Demoting cold store leaves moves their payloads host-ward and
+    returns the device pages to the allocator — the pool-wide page sum
+    stays exact through every hop, and a close returns everything."""
+    pool = mktier(8, host_pages=64)
+    store = PrefixStore(8)
+    b = _batcher(tiny_runner, byte_tok, store, pool)
+    pristine = b.free_page_count
+    out, _ = _run(b, _reqs(byte_tok))
+    assert out == "completed" and store.n_pages > 0
+    assert b.free_page_count + store.n_pages == pristine
+    freed = b._demote_store_pages(2)
+    assert freed > 0
+    assert pool.drain(10)
+    assert pool.pages("host") >= freed
+    assert b.tier_demotes == freed and store.demotions == freed
+    assert b.free_page_count + store.n_pages == pristine
+    # the next identical job promotes (or re-prefills) and re-extends
+    out2, _ = _run(b, _reqs(byte_tok))
+    assert out2 == "completed"
+    assert b.free_page_count + store.n_pages == pristine
+    store.close()
+    b2 = _batcher(tiny_runner, byte_tok, store, pool)
+    assert b2.free_page_count == pristine
+
+
+def test_demote_promote_roundtrip_bit_identical_int8(
+    int8_runner, byte_tok, mktier
+):
+    """On the int8 pool the tier payload IS the pool format: demoting
+    the whole store and re-running the job promotes pages back with
+    outputs bit-identical to the storeless untiered run."""
+    _, r_plain = _run(_batcher(int8_runner, byte_tok), _reqs(byte_tok))
+    pool = mktier(8, host_pages=256)
+    store = PrefixStore(8)
+    b1 = _batcher(int8_runner, byte_tok, store, pool)
+    out, r1 = _run(b1, _reqs(byte_tok))
+    assert out == "completed" and r1 == r_plain
+    n_before = store.n_pages
+    freed = b1._demote_store_pages(n_before)
+    assert freed > 0 and pool.drain(10)
+    b2 = _batcher(int8_runner, byte_tok, store, pool)
+    out2, r2 = _run(b2, _reqs(byte_tok))
+    assert out2 == "completed"
+    assert r2 == r_plain  # bit-identity through the host tier
+    assert b2.tier_promotes > 0 and store.promotions > 0
+    c = pool.op_census()
+    assert c["demotes"] >= freed and c["promotes"] > 0
+
+
+# -- hibernation: preemption suspends by demote, resumes by upload ----
+
+
+def _preempt_session(runner, tok, tier, *, batch_max_new=24):
+    """A 4-row batch job fills every slot; one interactive request
+    arrives mid-flight and preempts a victim inside the
+    interactive_slots budget. Returns (state, batch ctx, batch
+    results, interactive results, batcher)."""
+    b = _batcher(runner, tok, tier=tier)
+    got, igot, done = {}, {}, []
+    bctx = JobCtx(
+        job_id="batch",
+        pending=_reqs(
+            tok, max_new_tokens=batch_max_new, temperature=0.0
+        ),
+        on_result=lambda r: got.__setitem__(r.row_id, r),
+        priority=1,
+        seq=0,
+    )
+    ictx = JobCtx(
+        job_id="chat",
+        pending=_reqs(
+            tok, tails=["quick probe"], row_base=100,
+            max_new_tokens=4, temperature=0.0,
+        ),
+        on_result=lambda r: igot.__setitem__(r.row_id, r),
+        priority=-1,
+        seq=1,
+        interactive=True,
+    )
+    handed = []
+
+    def poll_new():
+        if not handed and bctx.stats.get("out", 0) > 8:
+            handed.append(True)
+            return ictx
+        return None
+
+    state = b.run_multi(
+        [bctx],
+        on_job_done=lambda c, o: done.append((c.job_id, o)),
+        poll_new=poll_new,
+    )
+    assert handed, "interactive ctx was never attached"
+    assert dict(done) == {"batch": "completed", "chat": "completed"}
+    return state, bctx, got, igot, b
+
+
+def test_hibernate_resume_bit_identical_int8(
+    int8_runner, byte_tok, mktier
+):
+    """The tentpole bar: a preempted row hibernates its aligned pages
+    into the pool and resumes by page-upload + sub-page tail prefill —
+    with outputs BIT-IDENTICAL to the uninterrupted run, zero lost
+    rows, and the migration recorded in the census."""
+    _, r_solo = _run(
+        _batcher(int8_runner, byte_tok),
+        _reqs(byte_tok, max_new_tokens=24, temperature=0.0),
+    )
+    _, r_isolo = _run(
+        _batcher(int8_runner, byte_tok),
+        _reqs(byte_tok, tails=["quick probe"], row_base=100,
+              max_new_tokens=4, temperature=0.0),
+    )
+    pool = mktier(8, host_pages=256)
+    state, bctx, got, igot, b = _preempt_session(
+        int8_runner, byte_tok, pool
+    )
+    assert state == "completed"
+    assert {i: r.token_ids for i, r in got.items()} == r_solo
+    assert {i: r.token_ids for i, r in igot.items()} == r_isolo
+    assert bctx.stats.get("resumes_upload", 0) >= 1
+    assert bctx.stats.get("resumes_reprefill", 0) == 0
+    assert b.tier_demotes > 0 and b.tier_promotes > 0
+    c = pool.op_census()
+    assert c["demotes"] >= 1 and c["promotes"] >= 1
+    # take_row semantics: nothing lingers once every row resumed
+    assert pool.pages("host") == 0 and b._hibernated == {}
+
+
+def test_torn_hibernation_demote_falls_back_to_regenerate(
+    int8_runner, byte_tok, mktier
+):
+    """Fault site kvtier.demote: the synchronous put_row raises BEFORE
+    the device pages free, so the preemption degrades to the plain
+    regenerate suspend — outputs identical, zero lost rows, nothing
+    half-demoted in the pool."""
+    _, r_solo = _run(
+        _batcher(int8_runner, byte_tok),
+        _reqs(byte_tok, max_new_tokens=24, temperature=0.0),
+    )
+    pool = mktier(8)
+    faults.configure("kvtier.demote:error")
+    try:
+        state, bctx, got, _igot, _b = _preempt_session(
+            int8_runner, byte_tok, pool
+        )
+    finally:
+        faults.clear()
+    assert state == "completed"
+    assert {i: r.token_ids for i, r in got.items()} == r_solo
+    assert bctx.stats.get("resumes_upload", 0) == 0
+    c = pool.op_census()
+    assert c["demotes"] == 0 and pool.pages("host") == 0
+
+
+def test_torn_hibernation_promote_degrades_to_reprefill(
+    int8_runner, byte_tok, mktier
+):
+    """Fault site kvtier.promote: the resume's take_row retries once
+    then misses; the row re-admits through the normal path and
+    regenerates — outputs identical, zero lost rows."""
+    _, r_solo = _run(
+        _batcher(int8_runner, byte_tok),
+        _reqs(byte_tok, max_new_tokens=24, temperature=0.0),
+    )
+    pool = mktier(8)
+    faults.configure("kvtier.promote:error")
+    try:
+        state, bctx, got, _igot, _b = _preempt_session(
+            int8_runner, byte_tok, pool
+        )
+    finally:
+        faults.clear()
+    assert state == "completed"
+    assert {i: r.token_ids for i, r in got.items()} == r_solo
+    assert bctx.stats.get("resumes_reprefill", 0) >= 1
+    assert bctx.stats.get("resumes_upload", 0) == 0
+
+
+# ---------------------------------------------------------------------
+# engine + serving level (shared live fixture)
+# ---------------------------------------------------------------------
+
+
+def test_engine_kill_switch_resolution(live_engine, monkeypatch):
+    eng, _url, _home = live_engine
+    key = "tiny-dense"
+    monkeypatch.delenv("SUTRO_KV_TIERS", raising=False)
+    assert eng._kv_tier_for(key) is None  # default is OFF
+    monkeypatch.setenv("SUTRO_KV_TIERS", "0")
+    assert eng._kv_tier_for(key) is None
+    monkeypatch.setenv("SUTRO_KV_TIERS", "1")
+    tier = eng._kv_tier_for(key)
+    assert tier is not None
+    assert eng._kv_tier_for(key) is tier  # one pool per engine key
+    monkeypatch.setenv("SUTRO_KV_TIERS", "off")
+    assert eng._kv_tier_for(key) is None
+
+
+def _post_chat(url, prompt, session_id=None, max_tokens=8):
+    body = {
+        "model": "tiny-dense",
+        "messages": [{"role": "user", "content": prompt}],
+        "temperature": 0.0,
+        "max_tokens": max_tokens,
+    }
+    if session_id is not None:
+        body["session_id"] = session_id
+    req = urllib.request.Request(
+        url + "/v1/chat/completions",
+        data=json.dumps(body).encode(),
+        headers={
+            "Content-Type": "application/json",
+            "Authorization": "Bearer test-key",
+        },
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        out = json.loads(resp.read())
+    return out
+
+
+def test_session_chat_checkpoints_and_resumes(live_engine, monkeypatch):
+    """Sticky chat sessions: ``session_id`` carries the transcript
+    server-side, the finished turn's KV checkpoints into the prefix
+    store (tier pool on), and an idle sweep demotes it host-ward. A
+    replayed session produces the same answers at temperature 0."""
+    eng, url, _home = live_engine
+    monkeypatch.setenv("SUTRO_KV_TIERS", "1")
+    gw = eng.gateway
+    assert gw is not None
+    store = eng._prefix_store_for("tiny-dense")
+    pages0 = store.n_pages
+
+    t1 = _post_chat(url, "my favorite color is teal", session_id="s-a")
+    c1 = t1["choices"][0]["message"]["content"]
+    assert t1["choices"][0]["finish_reason"] in ("stop", "length")
+    # the turn's KV checkpointed into the radix store at release
+    deadline = time.monotonic() + 30
+    while store.n_pages <= pages0 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert store.n_pages > pages0
+
+    t2 = _post_chat(url, "what color did I say?", session_id="s-a")
+    c2 = t2["choices"][0]["message"]["content"]
+    # the server-side transcript grew: turn 2's prompt covers turn 1
+    assert (
+        t2["usage"]["prompt_tokens"]
+        > t1["usage"]["prompt_tokens"] + t1["usage"]["completion_tokens"]
+    )
+    assert ("tiny-dense", "s-a") in gw._sessions
+    assert gw._sessions[("tiny-dense", "s-a")].turns == 2
+
+    # replayed session: same prompts, same answers (temp 0 — the warm
+    # checkpointed pages are bit-identical store promotions)
+    r1 = _post_chat(url, "my favorite color is teal", session_id="s-b")
+    r2 = _post_chat(url, "what color did I say?", session_id="s-b")
+    assert r1["choices"][0]["message"]["content"] == c1
+    assert r2["choices"][0]["message"]["content"] == c2
+    assert gw.session_count() >= 2
+
+    # idle sweep: both sessions post demote requests; the next turn's
+    # serving session drains them and demotes the cold pages host-ward
+    posted = gw.checkpoint_idle(idle_s=0.0)
+    assert posted >= 1
+    pool = eng._kv_tiers.get("tiny-dense")
+    assert pool is not None
+    t3 = _post_chat(url, "and my favorite number is 41", session_id="s-a")
+    assert t3["choices"][0]["message"]["content"]
+    deadline = time.monotonic() + 30
+    while (
+        pool.op_census()["demotes"] == 0
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.05)
+    assert pool.op_census()["demotes"] > 0
+
+
+def test_session_id_rejected_outside_chat(live_engine):
+    _eng, url, _home = live_engine
+    req = urllib.request.Request(
+        url + "/v1/completions",
+        data=json.dumps(
+            {"model": "tiny-dense", "prompt": "x", "session_id": "s"}
+        ).encode(),
+        headers={
+            "Content-Type": "application/json",
+            "Authorization": "Bearer test-key",
+        },
+    )
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=60)
+    assert e.value.code == 400
