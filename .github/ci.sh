@@ -10,6 +10,10 @@ make compile-check
 # any new lock-discipline / jit-purity / hygiene / resource-lifecycle /
 # kill-switch / wire-protocol / cardinality finding fails CI
 make lint
+# code-scanning artifact: the same findings as SARIF 2.1.0 for upload
+# (warn-only — `make lint` above is the gate)
+python -m sutro_tpu.analysis sutro_tpu --no-baseline --format sarif \
+    > graftlint.sarif || true
 # tier-1 gate: the committed wire-frame schema must match what the
 # dp/elastic senders actually produce
 make lint-schema

@@ -45,8 +45,11 @@ compile-check:
 # graftlint: engine-aware static analysis (lock discipline, jit purity,
 # thread/exception hygiene) gated against the committed baseline —
 # non-zero exit on any NEW finding (README "Static analysis")
+# wall-time budget: the whole-tree scan (all passes, including the
+# inter-procedural data-race walk) must stay under 60s to hold its
+# place as a tier-1 gate
 lint:
-	$(PY) -m sutro_tpu.analysis sutro_tpu
+	timeout -k 5 60 $(PY) -m sutro_tpu.analysis sutro_tpu
 
 # accept the current findings as the new baseline (review the diff of
 # sutro_tpu/analysis/baseline.json before committing!)

@@ -6,7 +6,10 @@ findings, 2 usage/internal error.
 ``--diff <git-ref>`` restricts the report to findings on lines changed
 vs the ref (fast pre-commit gate); ``--write-wire-schema`` regenerates
 ``analysis/wire_schema.json`` from the current senders (``make
-lint-schema`` wraps it with an uncommitted-drift check).
+lint-schema`` wraps it with an uncommitted-drift check);
+``--format sarif`` emits SARIF 2.1.0 for code-scanning upload;
+``--threads`` dumps the thread-root inventory the data-race pass
+analyzes over.
 """
 
 from __future__ import annotations
@@ -83,7 +86,13 @@ def main(argv=None) -> int:
         help="files or directories to scan (default: sutro_tpu)",
     )
     ap.add_argument(
-        "--format", choices=("text", "json"), default="text"
+        "--format", choices=("text", "json", "sarif"), default="text"
+    )
+    ap.add_argument(
+        "--threads",
+        action="store_true",
+        help="print the thread-root inventory (every Thread/Timer "
+        "spawn site with its resolved target) and exit 0",
     )
     ap.add_argument(
         "--baseline",
@@ -145,6 +154,20 @@ def main(argv=None) -> int:
             print(f"graftlint: no such path {p!r}", file=sys.stderr)
             return 2
 
+    if args.threads:
+        # inventory needs only the index, not the finding passes
+        from . import races
+
+        try:
+            roots = races.inventory(core.build_index(paths))
+        except SyntaxError as e:
+            print(f"graftlint: parse error: {e}", file=sys.stderr)
+            return 2
+        for root in roots:
+            print(root.describe())
+        print(f"graftlint: {len(roots)} thread root(s)")
+        return 0
+
     try:
         active, suppressed, index = core.analyze(paths, rules or None)
     except SyntaxError as e:
@@ -172,6 +195,8 @@ def main(argv=None) -> int:
         ]
         if args.format == "json":
             print(core.render_json(hits, suppressed_count=len(suppressed)))
+        elif args.format == "sarif":
+            print(core.render_sarif(hits))
         else:
             for f in hits:
                 print(f.render())
@@ -197,6 +222,8 @@ def main(argv=None) -> int:
                     active, suppressed_count=len(suppressed)
                 )
             )
+        elif args.format == "sarif":
+            print(core.render_sarif(active))
         else:
             print(
                 core.render_text(
@@ -222,6 +249,8 @@ def main(argv=None) -> int:
                 suppressed_count=len(suppressed),
             )
         )
+    elif args.format == "sarif":
+        print(core.render_sarif(active if args.verbose else new))
     else:
         if args.verbose:
             for f in active:

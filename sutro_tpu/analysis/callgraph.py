@@ -30,6 +30,13 @@ QUEUE_CTORS = {
     "queue.LifoQueue",
     "queue.SimpleQueue",
 }
+THREAD_CTORS = {"threading.Thread", "threading.Timer"}
+EVENT_CTORS = {
+    "threading.Event",
+    "threading.Semaphore",
+    "threading.BoundedSemaphore",
+    "threading.Barrier",
+}
 
 # names that look like a lock when we cannot see the constructor
 # (e.g. ``with self._queue.mutex:`` — queue.Queue's internal lock)
@@ -93,6 +100,11 @@ class ModuleInfo:
     )
     attr_locks: Dict[str, str] = dataclasses.field(default_factory=dict)
     module_locks: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # "{Class}.{attr}" -> expanded ctor text for every ``self.x = Ctor()``
+    # assignment seen in the class (first ctor wins) — the races pass
+    # uses it to tell sync objects (queues/events/threads) from plain
+    # shared state
+    attr_ctors: Dict[str, str] = dataclasses.field(default_factory=dict)
     rlock_ids: Set[str] = dataclasses.field(default_factory=set)
     classes: Dict[str, List[str]] = dataclasses.field(
         default_factory=dict
@@ -198,6 +210,15 @@ class _Indexer(ast.NodeVisitor):
         name = dotted(target)
         if name is None:
             return
+        if (
+            ctor is not None
+            and self.class_stack
+            and name.startswith("self.")
+            and "." not in name[5:]
+        ):
+            self.mod.attr_ctors.setdefault(
+                f"{self.class_stack[-1]}.{name[5:]}", ctor
+            )
         if ctor in LOCK_CTORS:
             lock_id: Optional[str] = None
             if ctor == "threading.Condition" and isinstance(
@@ -602,3 +623,84 @@ class PackageIndex:
                 if tgt is not None:
                     return tgt.label, tgt
         return expanded, None
+
+    def resolve_callable_ref(
+        self, func: FunctionInfo, expr: ast.AST
+    ) -> Tuple[str, Optional[FunctionInfo]]:
+        """Resolve a *reference* to a callable (a ``Thread(target=...)``
+        operand, not a call site): ``self.method``, bare names through
+        the lexical chain / module / imports / ``functools.partial``
+        bindings, ``alias.func`` through the import map."""
+        if isinstance(expr, ast.Call):
+            # functools.partial(fn, ...) passed inline as the target
+            if func.module.expand(
+                dotted(expr.func) or ""
+            ) == "functools.partial" and expr.args:
+                return self.resolve_callable_ref(func, expr.args[0])
+            return dotted(expr.func) or "", None
+        if isinstance(expr, ast.Lambda):
+            return "<lambda>", None
+        text = dotted(expr)
+        if text is None:
+            return "", None
+        mod = func.module
+        if text.startswith("self.") and func.class_name:
+            rest = text[5:]
+            if "." not in rest:
+                tgt = mod.functions.get(f"{func.class_name}.{rest}")
+                if tgt is not None:
+                    return tgt.label, tgt
+            return mod.expand(text), None
+        if "." not in text:
+            f: Optional[FunctionInfo] = func
+            while f is not None:
+                tgt = mod.functions.get(f"{f.qualname}.{text}")
+                if tgt is not None:
+                    return tgt.label, tgt
+                if text in f.partial_targets:
+                    inner = f.partial_targets[text]
+                    tgt = mod.functions.get(inner)
+                    if tgt is None and func.class_name:
+                        tgt = mod.functions.get(
+                            f"{func.class_name}.{inner}"
+                        )
+                    if tgt is not None:
+                        return tgt.label, tgt
+                f = f.parent
+            tgt = mod.functions.get(text)
+            if tgt is None and func.class_name:
+                tgt = mod.functions.get(f"{func.class_name}.{text}")
+            if tgt is not None:
+                return tgt.label, tgt
+            imp = mod.imports.get(text)
+            if imp and "." in imp:
+                owner, _, sym = imp.rpartition(".")
+                target_mod = self.find_module(owner)
+                if target_mod is not None:
+                    tgt = target_mod.functions.get(sym)
+                    if tgt is not None:
+                        return tgt.label, tgt
+            return mod.expand(text), None
+        head, _, rest = text.partition(".")
+        imp = mod.imports.get(head)
+        if imp and "." not in rest:
+            target_mod = self.find_module(imp)
+            if target_mod is not None:
+                tgt = target_mod.functions.get(rest)
+                if tgt is not None:
+                    return tgt.label, tgt
+        return mod.expand(text), None
+
+    def called_labels(self) -> Set[str]:
+        """Labels of every function that is the resolved target of at
+        least one call anywhere in the scanned tree. Functions *not* in
+        this set have no visible in-package caller — the races pass
+        treats them as reachable from the main thread."""
+        out: Set[str] = set()
+        for mod in self.modules.values():
+            for func in mod.functions.values():
+                for call in calls_in(func.node, skip_nested=False):
+                    _, tgt = self.resolve_call(func, call)
+                    if tgt is not None:
+                        out.add(tgt.label)
+        return out

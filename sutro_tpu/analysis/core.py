@@ -84,6 +84,15 @@ RULES = {
     "function exit path without end()/end_trace() — the trace stays "
     "unfinished in the forensics ring",
     "trace-ctx-double-end": "trace handle ended twice on one path",
+    "shared-state-unlocked": "field reachable from two thread roots "
+    "with a write that holds no lock and no happens-before edge "
+    "(queue/event handoff, pre-start publication, bounded join)",
+    "lockset-inconsistent": "field reachable from two thread roots "
+    "whose accesses are each locked — but never by a common lock "
+    "(empty lockset intersection)",
+    "check-then-act": "value read from a field under a lock is used "
+    "to write the field back after the lock was released and "
+    "re-acquired (lost-update window)",
     "stale-suppression": "graftlint disable pragma that no longer "
     "masks any finding",
 }
@@ -263,6 +272,7 @@ def run_passes(
         killswitch,
         locks,
         protocol,
+        races,
         resources,
         tracectx,
     )
@@ -276,6 +286,7 @@ def run_passes(
     findings.extend(killswitch.run(index))
     findings.extend(cardinality.run(index))
     findings.extend(tracectx.run(index))
+    findings.extend(races.run(index))
     if rules:
         keep = set(rules)
         findings = [f for f in findings if f.rule in keep]
@@ -390,3 +401,45 @@ def render_json(
         payload["new"] = [f.to_dict() for f in new]
         payload["stale_baseline"] = stale or {}
     return json.dumps(payload, indent=2)
+
+
+def render_sarif(findings: Sequence[Finding]) -> str:
+    """SARIF 2.1.0 document for diff-annotation tooling (one run, one
+    result per finding, fingerprints carried for dedupe)."""
+    rules_seen = sorted({f.rule for f in findings})
+    driver = {
+        "name": "graftlint",
+        "informationUri": "https://github.com/sutro-sh/sutro",
+        "rules": [
+            {
+                "id": r,
+                "shortDescription": {"text": RULES.get(r, r)},
+            }
+            for r in rules_seen
+        ],
+    }
+    results = [
+        {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "partialFingerprints": {
+                "graftlint/v1": f.fingerprint()
+            },
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {"startLine": max(1, f.line)},
+                    }
+                }
+            ],
+        }
+        for f in findings
+    ]
+    doc = {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{"tool": {"driver": driver}, "results": results}],
+    }
+    return json.dumps(doc, indent=2)

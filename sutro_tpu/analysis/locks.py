@@ -82,6 +82,47 @@ def _short(lock_id: str) -> str:
     return lock_id.split(":", 1)[-1]
 
 
+def resolve_lock_expr(
+    func: FunctionInfo, expr: ast.AST
+) -> Optional[str]:
+    """Stable identity of the lock named by ``expr`` in ``func``, or
+    None when the expression doesn't look like a lock. Shared by the
+    lock-discipline and data-race passes so both agree on which lock a
+    ``with`` statement acquires."""
+    text = dotted(expr)
+    if text is None:
+        return None
+    mod = func.module
+    if text.startswith("self.") and func.class_name:
+        rest = text[5:]
+        known = mod.attr_locks.get(f"{func.class_name}.{rest}")
+        if known:
+            return known
+        if looks_like_lock(rest.split(".")[-1]):
+            return f"{mod.name}:{func.class_name}.{rest}"
+        return None
+    if "." not in text:
+        f: Optional[FunctionInfo] = func
+        while f is not None:
+            if text in f.local_locks:
+                return f.local_locks[text]
+            f = f.parent
+        if text in mod.module_locks:
+            return mod.module_locks[text]
+        if looks_like_lock(text):
+            return f"{mod.name}:{func.qualname}.{text}"
+        return None
+    # attribute chain on an arbitrary object: only accept clearly
+    # lock-ish tails (e.g. ``jm.lock``, ``self._queue.mutex``).
+    # Module-scoped identity (not per-function): the same chain text
+    # in two functions is taken to mean the same lock, which is what
+    # lets cross-function inversions on shared objects surface.
+    tail = text.split(".")[-1]
+    if looks_like_lock(tail):
+        return f"{mod.name}:{text}"
+    return None
+
+
 class _LockWalker:
     def __init__(self, index: PackageIndex):
         self.index = index
@@ -98,38 +139,7 @@ class _LockWalker:
     def _resolve_lock(
         self, func: FunctionInfo, expr: ast.AST
     ) -> Optional[str]:
-        text = dotted(expr)
-        if text is None:
-            return None
-        mod = func.module
-        if text.startswith("self.") and func.class_name:
-            rest = text[5:]
-            known = mod.attr_locks.get(f"{func.class_name}.{rest}")
-            if known:
-                return known
-            if looks_like_lock(rest.split(".")[-1]):
-                return f"{mod.name}:{func.class_name}.{rest}"
-            return None
-        if "." not in text:
-            f: Optional[FunctionInfo] = func
-            while f is not None:
-                if text in f.local_locks:
-                    return f.local_locks[text]
-                f = f.parent
-            if text in mod.module_locks:
-                return mod.module_locks[text]
-            if looks_like_lock(text):
-                return f"{mod.name}:{func.qualname}.{text}"
-            return None
-        # attribute chain on an arbitrary object: only accept clearly
-        # lock-ish tails (e.g. ``jm.lock``, ``self._queue.mutex``).
-        # Module-scoped identity (not per-function): the same chain text
-        # in two functions is taken to mean the same lock, which is what
-        # lets cross-function inversions on shared objects surface.
-        tail = text.split(".")[-1]
-        if looks_like_lock(tail):
-            return f"{mod.name}:{text}"
-        return None
+        return resolve_lock_expr(func, expr)
 
     # -- finding emission ---------------------------------------------
     def _emit(self, f: Finding) -> None:

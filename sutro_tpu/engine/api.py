@@ -181,6 +181,7 @@ class LocalEngine:
                     ecfg=self.ecfg,
                     jobs=self.jobs,
                     jobs_provider=self._monitor_jobs,
+                    tier_pools=self._live_kv_tiers,
                 )
                 # terminal accounting refunds the unused reserve
                 self.jobs.on_terminal = self.control.on_terminal
@@ -532,7 +533,10 @@ class LocalEngine:
         status = self.jobs.status(job_id)
         if status.is_terminal():
             return {"status": status.value}
-        self._cancel.add(job_id)
+        # monotonic one-way flag: GIL-atomic set membership, polled by
+        # the worker at pop/row boundaries; staleness is bounded by the
+        # next poll and the flag never un-sets while a job is live
+        self._cancel.add(job_id)  # graftlint: disable=shared-state-unlocked
         if status == JobStatus.QUEUED:
             self.jobs.set_status(job_id, JobStatus.CANCELLED)
             return {"status": JobStatus.CANCELLED.value}
@@ -762,6 +766,12 @@ class LocalEngine:
             if not jid.startswith("serve:")
         ]
 
+    def _live_kv_tiers(self) -> List[Any]:
+        """Live tier pools for the autotuner's kv_tier_host_pages
+        actuation (pools built after a move read the knob off ecfg)."""
+        with self._lock:
+            return list(self._kv_tiers.values())
+
     def _monitor_alert_dump(
         self, job_id: str, alert: Dict[str, Any]
     ) -> None:
@@ -878,25 +888,35 @@ class LocalEngine:
         self, engine_key: str, mcfg: ModelConfig
     ) -> BaseTokenizer:
         """Tokenizer WITHOUT building the runner (quota gate / dry runs
-        must not pay model init)."""
-        cached = self._runner_cache.get(engine_key)
-        if cached is not None:
-            return cached[1]
-        tok = self._tok_cache.get(engine_key)
+        must not pay model init). Called from the worker loop AND the
+        overlapped session-build thread: cache lookups/publishes hold
+        ``self._lock``; the build itself runs unlocked (a lost build
+        race costs one redundant tokenizer load, and ``setdefault``
+        keeps the first published instance)."""
+        with self._lock:
+            cached = self._runner_cache.get(engine_key)
+            if cached is not None:
+                return cached[1]
+            tok = self._tok_cache.get(engine_key)
         if tok is None:
             tok = load_tokenizer(
                 self._weights_dir_for(engine_key),
                 vocab_size=mcfg.vocab_size,
             )
-            self._tok_cache[engine_key] = tok
+            with self._lock:
+                tok = self._tok_cache.setdefault(engine_key, tok)
         return tok
 
     def _get_runner(
         self, engine_key: str, mcfg: ModelConfig
     ) -> Tuple[ModelRunner, BaseTokenizer]:
-        cached = self._runner_cache.get(engine_key)
+        with self._lock:
+            cached = self._runner_cache.get(engine_key)
         if cached is not None:
             return cached
+        # only the worker thread builds runners, so the unlocked build
+        # below cannot double-build; the lock covers the cache maps the
+        # session-build thread and gateway probe read concurrently
         weights_dir = self._weights_dir_for(engine_key)
         tok = self._get_tokenizer(engine_key, mcfg)
         params = None
@@ -905,19 +925,22 @@ class LocalEngine:
 
             params = load_checkpoint(weights_dir, mcfg, self.ecfg)
         runner = ModelRunner(mcfg, self.ecfg, params=params)
-        # keep at most two runners resident (HBM budget)
-        if len(self._runner_cache) >= 2:
-            evicted = next(iter(self._runner_cache))
-            self._runner_cache.pop(evicted)
-            # the evicted runner's KV pool dies with it — its prefix
-            # store's pages are gone, so the store closes too
-            store = self._prefix_stores.pop(evicted, None)
-            if store is not None:
-                store.close()
-            tier = self._kv_tiers.pop(evicted, None)
-            if tier is not None:
-                tier.close()
-        self._runner_cache[engine_key] = (runner, tok)
+        evicted_store = evicted_tier = None
+        with self._lock:
+            # keep at most two runners resident (HBM budget)
+            if len(self._runner_cache) >= 2:
+                evicted = next(iter(self._runner_cache))
+                self._runner_cache.pop(evicted)
+                # the evicted runner's KV pool dies with it — its
+                # prefix store's pages are gone, so the store closes
+                # too
+                evicted_store = self._prefix_stores.pop(evicted, None)
+                evicted_tier = self._kv_tiers.pop(evicted, None)
+            self._runner_cache[engine_key] = (runner, tok)
+        if evicted_store is not None:
+            evicted_store.close()
+        if evicted_tier is not None:
+            evicted_tier.close()
         return runner, tok
 
     def _prefix_store_for(self, engine_key: str):
@@ -935,12 +958,13 @@ class LocalEngine:
             enabled = bool(getattr(self.ecfg, "prefix_store", True))
         if not enabled:
             return None
-        store = self._prefix_stores.get(engine_key)
-        if store is None:
-            from .prefixstore import PrefixStore
+        with self._lock:
+            store = self._prefix_stores.get(engine_key)
+            if store is None:
+                from .prefixstore import PrefixStore
 
-            store = PrefixStore(self.ecfg.kv_page_size)
-            self._prefix_stores[engine_key] = store
+                store = PrefixStore(self.ecfg.kv_page_size)
+                self._prefix_stores[engine_key] = store
         return store
 
     def _kv_tier_for(self, engine_key: str):
@@ -959,27 +983,31 @@ class LocalEngine:
             enabled = bool(getattr(self.ecfg, "kv_tiers", False))
         if not enabled:
             return None
-        tier = self._kv_tiers.get(engine_key)
-        if tier is None:
-            from .config import sutro_home
-            from .kvtier import KVTierPool
+        with self._lock:
+            tier = self._kv_tiers.get(engine_key)
+            if tier is None:
+                from .config import sutro_home
+                from .kvtier import KVTierPool
 
-            disk_dir = None
-            if getattr(self.ecfg, "kv_tier_disk", True):
-                disk_dir = sutro_home() / "kvtier"
-            tier = KVTierPool(
-                self.ecfg.kv_page_size,
-                host_pages=getattr(self.ecfg, "kv_tier_host_pages", 4096),
-                disk_dir=disk_dir,
-            )
-            self._kv_tiers[engine_key] = tier
+                disk_dir = None
+                if getattr(self.ecfg, "kv_tier_disk", True):
+                    disk_dir = sutro_home() / "kvtier"
+                tier = KVTierPool(
+                    self.ecfg.kv_page_size,
+                    host_pages=getattr(
+                        self.ecfg, "kv_tier_host_pages", 4096
+                    ),
+                    disk_dir=disk_dir,
+                )
+                self._kv_tiers[engine_key] = tier
         return tier
 
     def prefix_warm_tokens(self, engine_key: str, ids) -> int:
         """Non-mutating warm-prefix probe for the serving gateway: how
         many leading tokens of ``ids`` already have resident KV. Zero
         when the store is off/cold — never raises."""
-        store = self._prefix_stores.get(engine_key)
+        with self._lock:
+            store = self._prefix_stores.get(engine_key)
         if store is None:
             return 0
         try:
@@ -1003,15 +1031,18 @@ class LocalEngine:
         # drop every prefix store: their pinned pages die with the
         # runners' pools, and a closed store refuses new extends, so a
         # racing session degrades to the storeless per-job path
-        for store in self._prefix_stores.values():
+        with self._lock:
+            stores = list(self._prefix_stores.values())
+            self._prefix_stores.clear()
+            tiers = list(self._kv_tiers.values())
+            self._kv_tiers.clear()
+        for store in stores:
             store.close()
-        self._prefix_stores.clear()
         # tier pools park their migration worker; queued async demotes
         # are dropped (lossy by contract — the HBM copy was freed by
         # the store, these were cache-only pages)
-        for tier in self._kv_tiers.values():
+        for tier in tiers:
             tier.close()
-        self._kv_tiers.clear()
         return not self._worker.is_alive()
 
     def _worker_loop(self) -> None:
@@ -1038,7 +1069,9 @@ class LocalEngine:
                         self._current_job = None
                 continue
             if telemetry.enabled():
-                telemetry.JOBS_RUNNING.set(1 + len(self._attached))
+                with self._lock:
+                    n_attached = len(self._attached)
+                telemetry.JOBS_RUNNING.set(1 + n_attached)
             requeue_priority = None
             try:
                 if job_id in self._cancel:
@@ -1084,7 +1117,9 @@ class LocalEngine:
                 with self._lock:
                     self._current_job = None
                 if telemetry.enabled():
-                    telemetry.JOBS_RUNNING.set(len(self._attached))
+                    with self._lock:
+                        n_attached = len(self._attached)
+                    telemetry.JOBS_RUNNING.set(n_attached)
 
     def _run_job(self, job_id: str) -> Optional[int]:
         """Run one job to a terminal state. Returns None normally, or

@@ -265,6 +265,7 @@ class ControlPlane:
         jobs_provider: Optional[
             Callable[[], List[Tuple[str, str]]]
         ] = None,
+        tier_pools: Optional[Callable[[], List[Any]]] = None,
     ) -> None:
         self.cfg = ControlConfig.parse(spec)
         self.ecfg = ecfg
@@ -283,6 +284,14 @@ class ControlPlane:
         self._base_slots = int(getattr(ecfg, "interactive_slots", 0))
         self._base_batch = int(getattr(ecfg, "decode_batch_size", 64))
         self._batch_step = max(8, self._base_batch // 4)
+        # kv_tier_host_pages: bounded-notch growth off the doctor's
+        # kv_pressure verdict. New pools read the knob from ecfg at
+        # construction; live pools are pushed through ``tier_pools``.
+        self._tier_pools = tier_pools
+        self._base_kv_pages = int(
+            getattr(ecfg, "kv_tier_host_pages", 4096)
+        )
+        self._kv_step = max(256, self._base_kv_pages // 4)
         self._sustain: Dict[str, int] = {}
         self._quiet = 0
         self._cooldown = 0
@@ -544,6 +553,7 @@ class ControlPlane:
                 ),
                 "roofline": "decode_below_roofline" in names,
                 "hostbound": "host_bound_admit" in names,
+                "kvpressure": "kv_pressure" in names,
             }
             any_signal = any(signals.values())
             for k, on in signals.items():
@@ -559,6 +569,21 @@ class ControlPlane:
                 acted = self._apply(
                     "interactive_slots", cur, new, "interactive_starved"
                 )
+            elif self._sustain.get("kvpressure", 0) >= self.cfg.sustain:
+                # tier thrash: widen the host tier so demoted pages
+                # stay promotable instead of falling through to disk
+                cur = int(
+                    getattr(
+                        self.ecfg, "kv_tier_host_pages",
+                        self._base_kv_pages,
+                    )
+                )
+                new = min(4 * self._base_kv_pages, cur + self._kv_step)
+                acted = self._apply(
+                    "kv_tier_host_pages", cur, new, "kv_pressure"
+                )
+                if acted:
+                    self._push_kv_budget(new)
             elif self._sustain.get("hostbound", 0) >= self.cfg.sustain:
                 # host-bound admit outranks roofline: shrinking the
                 # batch relieves the host, growing it makes it worse
@@ -593,8 +618,37 @@ class ControlPlane:
                     step = min(self._batch_step, abs(cur - self._base_batch))
                     new = cur - step if cur > self._base_batch else cur + step
                     self._apply("decode_batch_size", cur, new, "settle")
+                cur = int(
+                    getattr(
+                        self.ecfg, "kv_tier_host_pages",
+                        self._base_kv_pages,
+                    )
+                )
+                if cur != self._base_kv_pages:
+                    step = min(
+                        self._kv_step, abs(cur - self._base_kv_pages)
+                    )
+                    new = (
+                        cur - step
+                        if cur > self._base_kv_pages
+                        else cur + step
+                    )
+                    if self._apply(
+                        "kv_tier_host_pages", cur, new, "settle"
+                    ):
+                        self._push_kv_budget(new)
         except Exception as e:  # noqa: BLE001 — pass-through contract
             self._degrade("control.actuate", e)
+
+    def _push_kv_budget(self, pages: int) -> None:
+        """Propagate a ``kv_tier_host_pages`` move to every live tier
+        pool; pools constructed later read the knob off ecfg. Raises
+        propagate to the actuate degrade path — a broken pool must not
+        keep absorbing autotuner moves."""
+        if self._tier_pools is None:
+            return
+        for pool in self._tier_pools():
+            pool.set_host_budget(pages)
 
     def _apply(self, knob: str, cur: int, new: int, reason: str) -> bool:
         if new == cur:
@@ -645,6 +699,7 @@ class ControlPlane:
                 "baseline": {
                     "interactive_slots": self._base_slots,
                     "decode_batch_size": self._base_batch,
+                    "kv_tier_host_pages": self._base_kv_pages,
                 },
                 "current": {
                     "interactive_slots": int(
@@ -652,6 +707,12 @@ class ControlPlane:
                     ),
                     "decode_batch_size": int(
                         getattr(self.ecfg, "decode_batch_size", 0)
+                    ),
+                    "kv_tier_host_pages": int(
+                        getattr(
+                            self.ecfg, "kv_tier_host_pages",
+                            self._base_kv_pages,
+                        )
                     ),
                 },
                 "audit": list(self._audit),

@@ -153,10 +153,6 @@ class KVTierPool:
         )
         self._inflight = 0
         self._idle = threading.Condition(self._lock)
-        self._worker = threading.Thread(
-            target=self._run_worker, daemon=True, name="sutro-kv-migrate"
-        )
-        self._worker.start()
         if self.disk_dir is not None:
             try:
                 self.disk_dir.mkdir(parents=True, exist_ok=True)
@@ -167,6 +163,14 @@ class KVTierPool:
                     exc_info=True,
                 )
                 self.disk_dir = None
+        # the worker starts only once the disk tier is decided: it
+        # reads ``disk_dir``/``_disk`` without the lock, so both must
+        # be fully published before the thread exists (the old order
+        # let the OSError fallback above race the first migration)
+        self._worker = threading.Thread(
+            target=self._run_worker, daemon=True, name="sutro-kv-migrate"
+        )
+        self._worker.start()
 
     # -- key helpers ----------------------------------------------------
 
@@ -327,6 +331,22 @@ class KVTierPool:
                 "disk_reads": self.disk_reads,
                 "dropped": self.dropped,
             }
+
+    def set_host_budget(self, pages: int) -> int:
+        """Re-budget the pinned-host tier live (the control plane's
+        ``kv_tier_host_pages`` knob actuates through here). Shrinking
+        evicts LRU entries immediately — spilled to disk when a disk
+        tier exists, else unpinned entries drop; pinned entries without
+        a disk tier stay resident over budget (a hibernated row is
+        never lost). Returns the applied budget."""
+        pages = max(1, int(pages))
+        with self._lock:
+            if self._closed:
+                return self.host_pages
+            self.host_pages = pages
+            self._evict_host_locked()
+            self._set_gauges()
+        return pages
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Block until the migration worker has consumed every staged

@@ -552,6 +552,12 @@ class ContinuousBatcher:
         self.prep_overlap_s = 0.0
         self.prep_inline_s = 0.0
         self.prep_rows_overlapped = 0
+        import threading as _threading
+
+        # guards the overlap counters: _prep_stop joins with a timeout,
+        # so a wedged worker can briefly coexist with its replacement —
+        # two threads may then bump these counters concurrently
+        self._prep_lock = _threading.Lock()
         from .profiling import StepTimer
 
         # telemetry latch (one decision per batcher, zero per-step cost
@@ -1063,12 +1069,14 @@ class ContinuousBatcher:
                     # consumes it (worst race: the scheduler admitted
                     # the row mid-build and this FSM is dropped)
                     req.prepped_constraint = req.constraint_factory()
-                    self.prep_rows_overlapped += 1
+                    with self._prep_lock:
+                        self.prep_rows_overlapped += 1
             except Exception:
                 logger.exception("admission prep failed; admission "
                                  "will rebuild inline")
             dt = time.perf_counter() - t0
-            self.prep_overlap_s += dt
+            with self._prep_lock:
+                self.prep_overlap_s += dt
             if self._tel_on:
                 # overlapped builds hide behind device windows but are
                 # still real work on the timeline

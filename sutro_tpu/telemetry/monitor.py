@@ -247,6 +247,10 @@ class Monitor:
         # series every window computation subtracts across
         self._ring: deque = deque(maxlen=max(self.history, 2))
         self._events: deque = deque(maxlen=EVENT_CAP)
+        # monotonic count of events ever appended to ``_events`` — the
+        # deque drops old entries at EVENT_CAP, so stream cursors track
+        # this counter instead of indexing into the ring
+        self._events_seen = 0
         self._trail: deque = deque(maxlen=max(self.history, 2))
         self._verdicts: Dict[str, Dict[str, Any]] = {}
         self._stats: Dict[str, Any] = {}
@@ -291,13 +295,14 @@ class Monitor:
     @property
     def running(self) -> bool:
         t = self._thread
-        return t is not None and t.is_alive() and self._failed is None
+        return t is not None and t.is_alive() and self.failed is None
 
     @property
     def failed(self) -> Optional[str]:
         """The degradation reason once the sampler has given up (an
         injected or real tick error), else None."""
-        return self._failed
+        with self._lock:
+            return self._failed
 
     def set_rules(self, rules: Sequence[SLORule]) -> None:
         """Swap the rule set (tests / operator reconfiguration). Resets
@@ -319,7 +324,8 @@ class Monitor:
                     self.tick()
                 except Exception as e:  # noqa: BLE001 — the monitor
                     # degrades to disabled, it never takes a job down
-                    self._failed = f"{type(e).__name__}: {e}"
+                    with self._lock:
+                        self._failed = f"{type(e).__name__}: {e}"
                     logger.warning(
                         "monitor sampler failed — degrading to "
                         "disabled: %s", e, exc_info=True,
@@ -343,16 +349,26 @@ class Monitor:
         snap = REGISTRY.export_snapshot()
         self._ring.append((now_mono, now_unix, snap))
         stats = self._window_stats()
-        transitions = self._evaluate_rules(stats, now_unix)
+        # rule state is shared with set_rules / snapshot_doc / stream:
+        # advance the state machines and publish their transition events
+        # under the same lock those readers take
+        with self._lock:
+            transitions = self._evaluate_rules(stats, now_unix)
+            if transitions:
+                self._events.extend(transitions)
+                self._events_seen += len(transitions)
+            firing = [
+                name
+                for name, s in self._rule_state.items()
+                if s.state == "firing"
+            ]
         verdicts = self._run_doctor()
         trail_entry = {
             "unix": round(now_unix, 3),
             "rates": stats.get("rates", {}),
             "gauges": stats.get("gauges", {}),
             "percentiles": stats.get("percentiles", {}),
-            "alerts_firing": sum(
-                1 for s in self._rule_state.values() if s.state == "firing"
-            ),
+            "alerts_firing": len(firing),
         }
         with self._lock:
             self._stats = stats
@@ -370,11 +386,6 @@ class Monitor:
                 self._dump_for_alert(ev)
         hook = self.on_tick
         if hook is not None:
-            firing = [
-                name
-                for name, s in self._rule_state.items()
-                if s.state == "firing"
-            ]
             try:
                 hook(stats, transitions, verdicts, firing)
             except Exception:  # noqa: BLE001 — a consumer crash must
@@ -567,7 +578,9 @@ class Monitor:
         self, stats: Dict[str, Any], now_unix: float
     ) -> List[Dict[str, Any]]:
         """Advance every rule's hysteresis/debounce state machine one
-        tick; returns the transition events appended this tick."""
+        tick; returns the transition events for this tick. ``tick``
+        calls this (and publishes the events) under ``self._lock``; the
+        method itself must therefore never take the lock."""
         from . import ALERTS_TOTAL, ENABLED
 
         out: List[Dict[str, Any]] = []
@@ -620,9 +633,6 @@ class Monitor:
                 # the threshold produces exactly one fire/resolve pair
                 st.breach_streak = 0
                 st.clear_streak = 0
-        if out:
-            with self._lock:
-                self._events.extend(out)
         return out
 
     #: alert metric -> registry histogram carrying its exemplars; a
@@ -745,12 +755,14 @@ class Monitor:
                 for r in self._rules
             ]
             ticks = self._ticks
+            failed = self._failed
         active = [r for r in rule_view if r["state"] == "firing"]
+        t = self._thread
         return {
             "version": MONITOR_VERSION,
             "enabled": True,
-            "running": self.running,
-            "degraded": self._failed,
+            "running": t is not None and t.is_alive() and failed is None,
+            "degraded": failed,
             "interval_s": self.interval_s,
             "window_s": self.window_s,
             "ticks": ticks,
@@ -771,18 +783,22 @@ class Monitor:
         ``timeout_s``."""
         sent = 0
         last_seq = -1
-        last_events = 0
+        # cursor over the monotonic event counter, not the deque index:
+        # once the ring saturates at EVENT_CAP older entries shift out,
+        # so a positional cursor would replay or skip events
+        last_seen = 0
         while max_ticks is None or sent < max_ticks:
             deadline = time.monotonic() + timeout_s
             with self._wake:
                 while True:
                     with self._lock:
                         seq = self._seq
+                        failed = self._failed
                     if seq != last_seq:
                         break
                     if (
                         self._stop.is_set()
-                        or self._failed is not None
+                        or failed is not None
                         or time.monotonic() >= deadline
                     ):
                         return
@@ -792,8 +808,9 @@ class Monitor:
                 stats = dict(self._stats)
                 verdicts = dict(self._verdicts)
                 events = list(self._events)
-                new_events = events[last_events:]
-                last_events = len(events)
+                n_new = min(len(events), self._events_seen - last_seen)
+                new_events = events[len(events) - n_new:] if n_new else []
+                last_seen = self._events_seen
                 firing = [
                     r.name
                     for r in self._rules

@@ -483,3 +483,67 @@ def test_jobstore_invalid_priority_rejected_not_clamped(tmp_path):
         js.check_quota(99, 1, 1)
     err = js.check_quota(0, 10**9, 0)
     assert err and "quota" in err  # in-range behavior unchanged
+
+
+# ------------------------------------------------ kv_tier_host_pages knob
+
+
+class _FakeTierPool:
+    def __init__(self):
+        self.calls = []
+
+    def set_host_budget(self, pages):
+        self.calls.append(pages)
+        return pages
+
+
+def test_autotuner_kv_pressure_grows_host_tier_then_settles():
+    pool = _FakeTierPool()
+    p = C.ControlPlane(
+        "sustain=2,cooldown=0,settle=2",
+        ecfg=_ecfg(kv_tier_host_pages=1024),
+        tier_pools=lambda: [pool],
+    )
+    e = p.ecfg
+    _tick(p, verdict="kv_pressure")
+    assert e.kv_tier_host_pages == 1024  # one tick is not sustained
+    _tick(p, verdict="kv_pressure")
+    assert e.kv_tier_host_pages == 1280  # +max(256, base // 4)
+    assert pool.calls == [1280]  # pushed to the live pool
+    audit = p.snapshot()["autotune"]["audit"]
+    assert audit[-1]["knob"] == "kv_tier_host_pages"
+    assert (audit[-1]["from"], audit[-1]["to"]) == (1024, 1280)
+    assert audit[-1]["reason"] == "kv_pressure"
+    # quiet spell: settle walks the budget back toward baseline
+    _tick(p)
+    _tick(p)
+    assert e.kv_tier_host_pages == 1024
+    assert pool.calls == [1280, 1024]
+
+
+def test_autotuner_kv_host_pages_capped_at_4x_baseline():
+    pool = _FakeTierPool()
+    p = C.ControlPlane(
+        "sustain=1,cooldown=0,settle=99",
+        ecfg=_ecfg(kv_tier_host_pages=256),
+        tier_pools=lambda: [pool],
+    )
+    for _ in range(16):
+        _tick(p, verdict="kv_pressure")
+    assert p.ecfg.kv_tier_host_pages == 4 * 256
+    assert max(pool.calls) == 4 * 256
+
+
+def test_autotuner_kv_push_failure_degrades_to_pass_through():
+    class _Wedged:
+        def set_host_budget(self, pages):
+            raise RuntimeError("pool wedged")
+
+    p = C.ControlPlane(
+        "sustain=1,cooldown=0",
+        ecfg=_ecfg(kv_tier_host_pages=512),
+        tier_pools=lambda: [_Wedged()],
+    )
+    _tick(p, verdict="kv_pressure")
+    assert not p.enabled
+    assert "control.actuate" in p.degraded_reason

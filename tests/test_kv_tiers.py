@@ -649,3 +649,58 @@ def test_session_id_rejected_outside_chat(live_engine):
     with pytest.raises(urllib.error.HTTPError) as e:
         urllib.request.urlopen(req, timeout=60)
     assert e.value.code == 400
+
+
+def test_set_host_budget_shrink_evicts_immediately(mktier, tmp_path):
+    """The control plane's kv_tier_host_pages knob: shrinking the
+    budget live evicts LRU entries down to the new cap (spilled to the
+    disk tier here, so nothing is lost)."""
+    pool = mktier(8, host_pages=8, disk_dir=tmp_path / "kvtier")
+    raws = {b"b%d" % i: _payload(seed=30 + i) for i in range(6)}
+    for key, raw in raws.items():
+        pool.put_page(key, raw)
+        assert pool.drain(10)
+    assert pool.pages("host") == 6
+    applied = pool.set_host_budget(2)
+    assert applied == 2
+    # evicted entries stage for the async disk spill; once the worker
+    # drains, the resident footprint is back under the new budget
+    assert pool.drain(10)
+    assert pool.pages("host") <= 2
+    # every page is still promotable after the squeeze
+    for key, raw in raws.items():
+        got = pool.get_page(key)
+        assert got is not None and _payload_equal(
+            got, quantize_payload(raw)
+        )
+
+
+def test_set_host_budget_grow_and_floor(mktier):
+    pool = mktier(8, host_pages=2)
+    assert pool.set_host_budget(16) == 16
+    assert pool.host_pages == 16
+    # floor at one page; a closed pool refuses the move
+    assert pool.set_host_budget(0) == 1
+    pool.close(timeout=5)
+    assert pool.set_host_budget(64) == 1  # unchanged: closed
+
+
+def test_migration_worker_starts_after_disk_tier_published(
+    tmp_path, monkeypatch
+):
+    """Publication order regression: the migration worker reads
+    disk_dir/_disk unlocked, so the ctor must fully decide the disk
+    tier (including the OSError fallback) before the thread exists."""
+    seen = {}
+    orig = KVTierPool._scan_disk
+
+    def probe(self):
+        seen["worker_exists"] = hasattr(self, "_worker")
+        return orig(self)
+
+    monkeypatch.setattr(KVTierPool, "_scan_disk", probe)
+    pool = KVTierPool(8, host_pages=4, disk_dir=tmp_path / "kvtier")
+    try:
+        assert seen == {"worker_exists": False}
+    finally:
+        pool.close(timeout=5)

@@ -509,3 +509,38 @@ def test_stopped_monitor_with_telemetry_off_does_zero_work():
     finally:
         mon.stop()
         telemetry.set_enabled(was)
+
+
+def test_stream_event_cursor_survives_ring_saturation(monkeypatch):
+    """Alert events live in a bounded deque: once it saturates, old
+    entries shift out and a positional stream cursor would silently
+    replay or drop events. The cursor tracks the monotonic appended
+    count instead."""
+    from sutro_tpu.telemetry import monitor as monitor_mod
+
+    monkeypatch.setattr(monitor_mod, "EVENT_CAP", 4)
+    mon = Monitor(rules=[])
+
+    def publish(events):
+        with mon._lock:
+            mon._events.extend(events)
+            mon._events_seen += len(events)
+            mon._seq += 1
+
+    gen = mon.stream(max_ticks=3, timeout_s=2.0)
+    publish([{"rule": f"r{i}"} for i in range(3)])
+    rec = next(gen)
+    assert [e["rule"] for e in rec["alert_events"]] == ["r0", "r1", "r2"]
+    # six more events overflow the cap-4 ring: the stream must deliver
+    # the four newest (the overflowed two are genuinely gone), not the
+    # index-shifted tail a positional cursor would compute
+    publish([{"rule": f"r{i}"} for i in range(3, 9)])
+    rec = next(gen)
+    assert [e["rule"] for e in rec["alert_events"]] == [
+        "r5", "r6", "r7", "r8",
+    ]
+    # caught up: a tick with no fresh events carries none, even though
+    # the ring still holds four entries
+    publish([])
+    rec = next(gen)
+    assert rec["alert_events"] == []
