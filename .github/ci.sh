@@ -43,6 +43,13 @@ make prefix-check
 # and torn migrations (demote/promote/disk-write) must never corrupt
 # or lose a row
 make tier-check
+# tier-1 gate: replica fleet front door — breaker discipline, health-
+# checked routing with warm-prefix affinity, batch-job failover with
+# zero rows lost or duplicated (bit-identical at temperature 0),
+# mid-stream structured errors instead of silent hangs, protocol-skew
+# degradation to probe-only routing, and the per-request routing-
+# decision host budget (zero telemetry ops when off)
+make fleet-check
 # warn-only: bench-artifact trend report (never fails the build)
 make bench-trend
 # tier-1 gate: interactive tier CPU smoke — TTFT/ITL legs + the

@@ -6,8 +6,8 @@ PY ?= python
 
 .PHONY: all native test test-oneshot test-fast compile-check lint lint-baseline \
 	lint-schema chaos telemetry-check monitor-check control-check control-bench \
-	prefix-check tier-check bench bench-e2e serve-bench bench-trend dryrun \
-	chip-validate bench-8b cost golden host-profile clean
+	prefix-check tier-check fleet-check bench bench-e2e bench-fleet serve-bench \
+	bench-trend dryrun chip-validate bench-8b cost golden host-profile clean
 
 all: native compile-check
 
@@ -69,11 +69,15 @@ lint-schema:
 # case), transient I/O retry, torn chunks, device errors + resume
 # bit-identity, crash-mid-finalize, dp liveness, plus the elastic
 # fleet gate (worker crash/hang/mid-frame drop, SIGTERM preemption
-# drain, late join, steal race, coordinator crash + resume). A tier-1
-# CI step.
+# drain, late join, steal race, coordinator crash + resume), plus the
+# replica-fleet chaos/degradation subset (replica kill mid-job with
+# bit-identical failover, mid-stream crash -> structured error,
+# old/new protocol skew -> probe-only routing). A tier-1 CI step.
 chaos:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py tests/test_elastic.py \
 		-q -m "not slow" -p no:cacheprovider
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet.py -q -m "not slow" \
+		-p no:cacheprovider -k "chaos or degradation"
 
 # telemetry gate (OBSERVABILITY.md): exporter golden-file + flight-
 # recorder/reconciliation tests + distributed telemetry (trace
@@ -137,6 +141,27 @@ prefix-check:
 tier-check:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_kv_tiers.py \
 		-q -m "not slow" -p no:cacheprovider
+
+# replica-fleet gate (FAILURES.md "Replica fleet"): breaker state
+# machine + bounded backoff + flap detection, health-checked routing
+# (warm-prefix affinity, least-loaded, drain exclusion), batch-job
+# failover over the shared jobstore (zero rows lost or duplicated,
+# bit-identical at temperature 0), mid-stream structured errors,
+# protocol-skew degradation, SDK reconnect-with-cursor — then the
+# --fleet op census (per-request routing decision under the same 2%
+# host-overhead envelope as telemetry; zero ops when off). Tier-1 CI.
+fleet-check:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet.py \
+		-q -m "not slow" -p no:cacheprovider
+	JAX_PLATFORMS=cpu $(PY) benchmarks/profile_host_overhead.py --fleet
+
+# replica-fleet scaling bench -> BENCH_FLEET.json: 1- vs 3-replica
+# batch throughput through the router (device-time-emulating stub
+# replicas; grade >=2x) + warm-prefix routed hit rate over two real
+# engines. Grades are warn-only in `make bench-trend`; not tier-1
+# (~40 s wall) — run on fleet/router changes.
+bench-fleet:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/bench_fleet.py
 
 # raw decode microbench (one JSON line; driver contract)
 bench:

@@ -1,0 +1,343 @@
+"""Replica-fleet scaling bench -> BENCH_FLEET.json.
+
+Grades the two things the fleet front door exists for, end to end
+through the real router HTTP path (fleet/router.py):
+
+- **Batch scale-out**: the same 6-job workload submitted through a
+  1-replica router and a 3-replica router. Each replica is a real
+  LocalEngine (scheduler, jobstore, progress streams) over a stub
+  runner whose decode windows *sleep* the measured device time —
+  emulating the chip regime where replica scaling pays: device-bound
+  jobs, one serial job worker per engine, GIL released during device
+  waits exactly like a real dispatch. Grade:
+  ``batch_speedup_3v1 >= 2.0`` (3 replicas must at least double
+  single-replica throughput; routing/failover bookkeeping is the
+  overhead under test).
+- **Warm-prefix routing**: two real tiny-dense engines (live
+  gateway + prefix store — warmth must come from actual KV, not a
+  mock); a chat session warmed on one replica, then follow-up turns
+  sent through the router. Grade: ``routed_prefix_hit_rate`` — the
+  fraction of routed interactive requests that landed on a
+  warm-scoring replica (target 1.0; every follow-up should follow its
+  session's KV).
+
+Both grades are recorded warn-only in ``make bench-trend`` (the fleet
+legs join the trend snapshot like every other bench artifact); the
+hard fleet gates live in tests/test_fleet.py and the
+profile_host_overhead.py ``--fleet`` census.
+
+Usage: ``make bench-fleet`` (or
+``JAX_PLATFORMS=cpu python benchmarks/bench_fleet.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "benchmarks"))
+
+from profile_host_overhead import _StubRunner  # noqa: E402
+
+#: emulated fused-window device time (s). PERF.md round-4 measured
+#: ~10.9 ms at B=64; 150 ms keeps the leg device-dominated (>70% of a
+#: job's wall) even with 3 co-resident replica schedulers sharing this
+#: host's GIL-bound Python runtime, so the speedup measures replica
+#: scaling, not host contention noise.
+DEVICE_S_PER_WINDOW = 0.15
+BATCH_JOBS = 6
+BATCH_ROWS = 256
+BATCH_MAX_NEW = 32
+AFFINITY_TURNS = 8
+SPEEDUP_TARGET = 2.0
+
+
+class _DeviceStubRunner(_StubRunner):
+    """Stub runner with emulated device time: each decode window
+    sleeps (releasing the GIL, like a real async dispatch wait), so
+    jobs cost wall time proportional to their token volume and
+    replicas genuinely run concurrently."""
+
+    def decode_multi_async(self, *a, **k):
+        time.sleep(DEVICE_S_PER_WINDOW)
+        return super().decode_multi_async(*a, **k)
+
+
+def _stub_engine(ecfg):
+    from sutro_tpu.engine.api import LocalEngine
+    from sutro_tpu.engine.tokenizer import ByteTokenizer
+
+    eng = LocalEngine(ecfg)
+
+    def _get_runner(engine_key, mcfg, _eng=eng):
+        cached = _eng._runner_cache.get(engine_key)
+        if cached is not None:
+            return cached
+        runner = _DeviceStubRunner(ecfg, vocab=mcfg.vocab_size)
+        tok = ByteTokenizer(vocab_size=mcfg.vocab_size)
+        _eng._runner_cache[engine_key] = (runner, tok)
+        return runner, tok
+
+    eng._get_runner = _get_runner
+    return eng
+
+
+def _wait_all_succeeded(furl, jids, timeout_s=600.0):
+    import requests
+
+    from sutro_tpu.interfaces import JobStatus
+
+    deadline = time.monotonic() + timeout_s
+    pending = set(jids)
+    while pending:
+        assert time.monotonic() < deadline, (
+            f"jobs not terminal in {timeout_s}s: {sorted(pending)}"
+        )
+        for jid in sorted(pending):
+            resp = requests.get(
+                f"{furl}/job-status/{jid}", timeout=(5.0, 30.0)
+            )
+            status = (resp.json().get("job_status") or {}).get(jid)
+            if status is None:
+                continue
+            if JobStatus(status).is_terminal():
+                assert status == JobStatus.SUCCEEDED.value, (jid, status)
+                pending.discard(jid)
+        time.sleep(0.05)
+
+
+def _run_batch_leg(furl, n_jobs, n_rows):
+    import requests
+
+    payload = {
+        "model": "tiny-dense",
+        "inputs": [
+            f"fleet bench row {i}: rate this product review"
+            for i in range(n_rows)
+        ],
+        "sampling_params": {
+            "max_new_tokens": BATCH_MAX_NEW,
+            "temperature": 0.7,
+        },
+    }
+    t0 = time.perf_counter()
+    jids = []
+    for _ in range(n_jobs):
+        resp = requests.post(
+            f"{furl}/batch-inference", json=payload, timeout=(5.0, 120.0)
+        )
+        assert resp.status_code == 200, resp.text[:500]
+        jids.append(resp.json()["results"])
+    _wait_all_succeeded(furl, jids)
+    wall = time.perf_counter() - t0
+    total = n_jobs * n_rows
+    return {
+        "jobs": n_jobs,
+        "rows_total": total,
+        "wall_s": round(wall, 3),
+        "rows_per_s": round(total / wall, 2),
+    }
+
+
+def run_batch_legs() -> dict:
+    """1-replica vs 3-replica throughput over the same job mix."""
+    from sutro_tpu.engine.config import EngineConfig
+    from sutro_tpu.fleet.router import start_fleet_thread
+    from sutro_tpu.server import start_server_thread
+
+    ecfg = EngineConfig(
+        kv_page_size=16,
+        max_pages_per_seq=32,
+        decode_batch_size=64,
+        max_model_len=512,
+        use_pallas=False,
+        param_dtype="float32",
+        decode_multi_step=16,
+        decode_lookahead=2,
+        max_new_tokens=BATCH_MAX_NEW,
+        interactive_slots=0,
+    )
+    engines = [_stub_engine(ecfg) for _ in range(3)]
+    started = [start_server_thread(eng) for eng in engines]
+    urls = [url for _, _, url in started]
+    out = {}
+    routers = []
+    try:
+        # warm leg: first-use paths (merge_last, parquet writers) off
+        # the clock on every engine
+        for url in urls:
+            r, srv, _t, furl = start_fleet_thread(
+                [url], probe_interval=0.2
+            )
+            routers.append((r, srv))
+            _run_batch_leg(furl, 1, 64)
+            r.stop()
+            srv.shutdown()
+
+        r1, srv1, _t1, furl1 = start_fleet_thread(
+            [urls[0]], probe_interval=0.2
+        )
+        routers.append((r1, srv1))
+        out["batch_1replica"] = _run_batch_leg(
+            furl1, BATCH_JOBS, BATCH_ROWS
+        )
+        r1.stop()
+        srv1.shutdown()
+
+        r3, srv3, _t3, furl3 = start_fleet_thread(
+            urls, probe_interval=0.2
+        )
+        routers.append((r3, srv3))
+        out["batch_3replica"] = _run_batch_leg(
+            furl3, BATCH_JOBS, BATCH_ROWS
+        )
+        out["batch_3replica"]["per_replica_jobs"] = {
+            rid: sum(
+                1 for o in r3._job_owner.values() if o == rid
+            )
+            for rid in ("r0", "r1", "r2")
+        }
+        r3.stop()
+        srv3.shutdown()
+    finally:
+        for r, srv in routers:
+            try:
+                r.stop()
+                srv.shutdown()
+                srv.server_close()
+            except OSError:
+                pass
+        for _srv, _thread, _url in started:
+            _srv.shutdown()
+            _srv.server_close()
+        for eng in engines:
+            eng.close()
+    return out
+
+
+def run_affinity_leg() -> dict:
+    """Session warmed on one replica; follow-up turns through the
+    router must land there (prefix_hits per routed request)."""
+    import requests
+
+    from sutro_tpu.engine.api import LocalEngine
+    from sutro_tpu.engine.config import EngineConfig
+    from sutro_tpu.fleet.router import start_fleet_thread
+    from sutro_tpu.server import start_server_thread
+
+    ecfg = EngineConfig(
+        kv_page_size=8,
+        max_pages_per_seq=32,
+        decode_batch_size=4,
+        max_model_len=256,
+        use_pallas=False,
+        param_dtype="float32",
+        activation_dtype="float32",
+        max_new_tokens=8,
+        interactive_slots=2,
+    )
+    engines = [LocalEngine(ecfg) for _ in range(2)]
+    started = [start_server_thread(eng) for eng in engines]
+    urls = [url for _, _, url in started]
+    router, fsrv, _t, furl = start_fleet_thread(urls, probe_interval=0.2)
+    try:
+        deadline = time.monotonic() + 60.0
+        while router.membership.snapshot()["n_healthy"] < 2:
+            assert time.monotonic() < deadline, "replicas never healthy"
+            time.sleep(0.05)
+        base = {
+            "model": "tiny-dense",
+            "session_id": "bench-fleet-affinity",
+            "max_tokens": 4,
+            "temperature": 0,
+        }
+        # warm replica B directly (compile + session KV off the clock)
+        warm = dict(
+            base,
+            messages=[{"role": "user", "content": "affinity warmup turn"}],
+        )
+        resp = requests.post(
+            f"{urls[1]}/v1/chat/completions", json=warm, timeout=300
+        )
+        assert resp.status_code == 200, resp.text[:500]
+        t0 = time.perf_counter()
+        for i in range(AFFINITY_TURNS):
+            turn = dict(
+                base,
+                messages=[
+                    {"role": "user", "content": f"follow-up turn {i}"}
+                ],
+            )
+            resp = requests.post(
+                f"{furl}/v1/chat/completions", json=turn, timeout=300
+            )
+            assert resp.status_code == 200, resp.text[:500]
+        wall = time.perf_counter() - t0
+        counters = dict(router.counters)
+        routed = counters["interactive_routed"]
+        hits = counters["prefix_hits"]
+        return {
+            "turns": AFFINITY_TURNS,
+            "interactive_routed": routed,
+            "prefix_hits": hits,
+            "hit_rate": round(hits / max(routed, 1), 4),
+            "wall_s": round(wall, 3),
+        }
+    finally:
+        router.stop()
+        fsrv.shutdown()
+        fsrv.server_close()
+        for srv, _thread, _url in started:
+            srv.shutdown()
+            srv.server_close()
+        for eng in engines:
+            eng.close()
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    os.environ["SUTRO_HOME"] = tempfile.mkdtemp(prefix="sutro-bench-fleet-")
+
+    legs = run_batch_legs()
+    legs["affinity"] = run_affinity_leg()
+
+    speedup = (
+        legs["batch_3replica"]["rows_per_s"]
+        / legs["batch_1replica"]["rows_per_s"]
+    )
+    hit_rate = legs["affinity"]["hit_rate"]
+    out = {
+        "device_s_per_window": DEVICE_S_PER_WINDOW,
+        "legs": legs,
+        "grades": {
+            "batch_speedup_3v1": round(speedup, 3),
+            "speedup_target": SPEEDUP_TARGET,
+            "routed_prefix_hit_rate": hit_rate,
+            "ok": bool(speedup >= SPEEDUP_TARGET and hit_rate >= 0.9),
+        },
+    }
+    (REPO / "BENCH_FLEET.json").write_text(
+        json.dumps(out, indent=2) + "\n"
+    )
+    print(json.dumps({"bench_fleet": out["grades"]}))
+    # grades are warn-only (bench-trend); a failed grade here still
+    # exits 0 so heterogeneous driver boxes never hard-fail the build
+    if not out["grades"]["ok"]:
+        print(
+            f"WARN: fleet grades below target (speedup {speedup:.2f} "
+            f"vs {SPEEDUP_TARGET}, hit_rate {hit_rate})",
+            file=sys.stderr,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

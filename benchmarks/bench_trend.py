@@ -103,6 +103,16 @@ INTERACTIVE_METRICS = (
     (("legs", "hibernate_resume", "resume_ttft_p99_s"), False),
     (("legs", "grades", "resume_ttft_p99_ratio_vs_cold"), False),
 )
+# replica-fleet legs (BENCH_FLEET.json, `make bench-fleet`): 3-replica
+# batch scale-out and warm-prefix routing through the fleet router.
+# Warn-only (not in CHEAP_LEGS, so never variance-gated): the hard
+# fleet gates are tests/test_fleet.py + the --fleet op census.
+FLEET_METRICS = (
+    (("grades", "batch_speedup_3v1"), True),
+    (("grades", "routed_prefix_hit_rate"), True),
+    (("legs", "batch_1replica", "rows_per_s"), True),
+    (("legs", "batch_3replica", "rows_per_s"), True),
+)
 
 
 def _load(path: Path):
@@ -182,6 +192,12 @@ def build_snapshot() -> dict:
             v = _dig(inter, path)
             if v is not None:
                 snap["interactive." + ".".join(path)] = v
+    flt = _load(REPO / "BENCH_FLEET.json")
+    if isinstance(flt, dict):
+        for path, _hb in FLEET_METRICS:
+            v = _dig(flt, path)
+            if v is not None:
+                snap["fleet." + ".".join(path)] = v
     return snap
 
 
@@ -192,6 +208,9 @@ def _direction(name: str) -> bool:
             return hb
     for path, hb in INTERACTIVE_METRICS:
         if name == "interactive." + ".".join(path):
+            return hb
+    for path, hb in FLEET_METRICS:
+        if name == "fleet." + ".".join(path):
             return hb
     return True
 

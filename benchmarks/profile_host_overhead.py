@@ -182,6 +182,15 @@ FLAT_SCALING_MAX = 1.25
 # telemetry budget: instrumentation (spans + sharded counters) may add
 # at most 2% to the per-row host cost of the 512-row e2e leg
 TEL_OVERHEAD_MAX = 1.02
+# fleet-router budget: the per-request routing decision (membership
+# read + candidate sort + bookkeeping) must stay under this many
+# microseconds of host CPU — at the interactive tier's ~50 ms TTFT
+# floor that is <0.5%, comfortably inside the same 2% envelope
+FLEET_ROUTE_BUDGET_US = 200.0
+# nominal cheapest request the router fronts (idle interactive TTFT,
+# BENCH_INTERACTIVE idle leg order of magnitude) — the denominator for
+# the fleet overhead_ratio
+NOMINAL_INTERACTIVE_TTFT_US = 50_000.0
 
 
 def warm_admit_buckets(vocab: int, ecfg) -> None:
@@ -1041,6 +1050,156 @@ def run_monitor_compare(assert_budget: bool) -> dict:
     return out
 
 
+def run_fleet_census(assert_budget: bool) -> dict:
+    """Fleet-router host cost per routing decision + zero-op-when-off.
+
+    The router (fleet/router.py) adds pure host work to every request
+    it fronts: a membership snapshot read (lock + row copies), a
+    deterministic candidate sort (``pick_batch`` /
+    ``pick_interactive``), and counter/load/owner bookkeeping.
+    Tight-loop pricing over a fully-healthy 8-replica table — the
+    worst sort the defaults ever see; the budget asserts the whole
+    per-request decision stays under ``FLEET_ROUTE_BUDGET_US``, and
+    the ratio against the cheapest request the router fronts (idle
+    interactive TTFT) stays inside the same <=2% envelope as
+    telemetry. Warm-affinity probe round-trips are network IO bounded
+    by their own timeout, not host CPU — they are excluded here and
+    graded end to end by benchmarks/bench_fleet.py.
+
+    Zero-op check (asserted, not assumed): with telemetry disabled,
+    driving picks, counters, owner bookkeeping and the ``/fleet``
+    snapshot — including its doctor pass — fires ZERO census ops (the
+    fleet counters/gauges are all ``telemetry.ENABLED``-guarded).
+    """
+    import sutro_tpu.telemetry as tel
+    import sutro_tpu.telemetry.distributed as tel_distributed
+    import sutro_tpu.telemetry.registry as tel_registry
+    import sutro_tpu.telemetry.spans as tel_spans
+    import sutro_tpu.telemetry.traces as tel_traces
+    from sutro_tpu.fleet.router import (
+        FleetRouter,
+        pick_batch,
+        pick_interactive,
+    )
+
+    n_replicas = 8
+    urls = [f"http://10.0.0.{i}:8642" for i in range(n_replicas)]
+    # prober never started: probe outcomes are fed directly, so the
+    # census prices exactly the request-path work and nothing else
+    router = FleetRouter(urls, probe_interval=3600.0)
+    m = router.membership
+    for i in range(n_replicas):
+        m.note_probe_success(
+            "r%d" % i,
+            {
+                "ready": True,
+                "draining": False,
+                "load": {
+                    "queued_jobs": i % 3,
+                    "running_jobs": (i * 5) % 2,
+                    "interactive_active": i % 2,
+                },
+                "models": ["tiny-dense"],
+                "fleet_protocol": True,
+                "warm_probe": True,
+            },
+        )
+    healthy = m.healthy()
+    assert len(healthy) == n_replicas, healthy
+    scores = {r["rid"]: (3 * i) % 5 for i, r in enumerate(healthy)}
+
+    unit_us = {
+        "healthy_read": _unit_us(m.healthy),
+        "pick_batch": _unit_us(lambda: pick_batch(healthy)),
+        "pick_interactive": _unit_us(
+            lambda: pick_interactive(healthy, scores)
+        ),
+        "count": _unit_us(lambda: router._count("interactive_routed")),
+        "bump_load": _unit_us(lambda: m.bump_load("r3", 0)),
+        "owner_set_get": _unit_us(
+            lambda: (
+                router.set_job_owner("bench-j", "r1"),
+                router.job_owner("bench-j"),
+            )
+        ),
+        # /fleet status doc incl. the doctor pass: per status poll,
+        # not per routed request — priced for visibility
+        "snapshot": _unit_us(router.snapshot, n=2000),
+    }
+    interactive_route_us = (
+        unit_us["healthy_read"]
+        + unit_us["pick_interactive"]
+        + unit_us["count"]
+        + unit_us["bump_load"]
+    )
+    batch_route_us = (
+        unit_us["healthy_read"]
+        + unit_us["pick_batch"]
+        + unit_us["count"]
+        + unit_us["bump_load"]
+        + unit_us["owner_set_get"]
+    )
+    worst_route_us = max(interactive_route_us, batch_route_us)
+    ratio = 1.0 + worst_route_us / NOMINAL_INTERACTIVE_TTFT_US
+
+    # -- zero-op check: telemetry off, every bookkeeping path driven ---
+    mods = {
+        "registry": tel_registry,
+        "spans": tel_spans,
+        "distributed": tel_distributed,
+        "traces": tel_traces,
+    }
+    counts = {key: 0 for _, _, _, key in _TEL_OPS}
+    counts[_TEL_EXEMPLAR_KEY] = 0
+    was_enabled = tel.enabled()
+    try:
+        tel.set_enabled(False)
+        with _Census(mods, counts):
+            m.healthy()
+            pick_batch(healthy)
+            pick_interactive(healthy, scores)
+            router._count("interactive_routed")
+            m.bump_load("r1", 0)
+            router.set_job_owner("bench-j2", "r2")
+            router.snapshot()
+            off_counts = dict(counts)
+    finally:
+        tel.set_enabled(was_enabled)
+    off_ops = sum(off_counts.values())
+
+    out = {
+        "n_replicas": n_replicas,
+        "op_unit_us": {k: round(v, 3) for k, v in unit_us.items()},
+        "interactive_route_us": round(interactive_route_us, 2),
+        "batch_route_us": round(batch_route_us, 2),
+        "route_budget_us": FLEET_ROUTE_BUDGET_US,
+        "nominal_ttft_us": NOMINAL_INTERACTIVE_TTFT_US,
+        "overhead_ratio": round(ratio, 4),
+        "budget_ratio": TEL_OVERHEAD_MAX,
+        "disabled_ops_fired": off_ops,
+        "ok": bool(
+            worst_route_us <= FLEET_ROUTE_BUDGET_US
+            and ratio <= TEL_OVERHEAD_MAX
+            and off_ops == 0
+        ),
+    }
+    if assert_budget:
+        assert off_ops == 0, (
+            f"telemetry-off fleet router fired census ops: {off_counts}"
+        )
+        assert worst_route_us <= FLEET_ROUTE_BUDGET_US, (
+            f"fleet routing decision costs {worst_route_us:.1f} us "
+            f"(interactive {interactive_route_us:.1f}, batch "
+            f"{batch_route_us:.1f}) > budget {FLEET_ROUTE_BUDGET_US} us"
+        )
+        assert ratio <= TEL_OVERHEAD_MAX, (
+            f"fleet routing adds {worst_route_us:.1f} us on a "
+            f"{NOMINAL_INTERACTIVE_TTFT_US:.0f} us nominal request "
+            f"(ratio {ratio:.4f} > {TEL_OVERHEAD_MAX})"
+        )
+    return out
+
+
 def run_control_compare(assert_budget: bool) -> dict:
     """Control-plane (engine/control.py) host overhead + zero-cost-off.
 
@@ -1228,6 +1387,25 @@ def main() -> None:
         base["monitor"] = mon
         path.write_text(json.dumps(base, indent=2) + "\n")
         print(json.dumps({"monitor_overhead": mon}))
+        return
+
+    if "--fleet" in sys.argv:
+        # standalone gate (make fleet-check): per-request routing
+        # decision cost + zero-op-when-off; merge into
+        # HOST_OVERHEAD.json
+        fleet = run_fleet_census(
+            assert_budget="--no-assert" not in sys.argv
+        )
+        path = REPO / "HOST_OVERHEAD.json"
+        base = {}
+        if path.exists():
+            try:
+                base = json.loads(path.read_text())
+            except ValueError:
+                base = {}
+        base["fleet"] = fleet
+        path.write_text(json.dumps(base, indent=2) + "\n")
+        print(json.dumps({"fleet_overhead": fleet}))
         return
 
     if "--control" in sys.argv:

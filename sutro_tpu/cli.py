@@ -90,7 +90,7 @@ def set_base_url(url: str) -> None:
 
 
 @cli.command("set-backend")
-@click.argument("backend", type=click.Choice(["tpu", "remote"]))
+@click.argument("backend", type=click.Choice(["tpu", "remote", "fleet"]))
 def set_backend(backend: str) -> None:
     cfg = load_config()
     cfg["backend"] = backend
@@ -116,6 +116,81 @@ def serve(host: str, port: int, quiet: bool, interactive_slots: int) -> None:
 
         ecfg = load_engine_config(interactive_slots=interactive_slots)
     _serve(host=host, port=port, ecfg=ecfg, verbose=not quiet)
+
+
+# ---------------------------------------------------------------------------
+# replica fleet (fleet/router.py)
+# ---------------------------------------------------------------------------
+
+
+@cli.group()
+def fleet() -> None:
+    """Replica fleet front door: route one API over N engine daemons."""
+
+
+@fleet.command("serve")
+@click.option("--host", default="127.0.0.1", show_default=True)
+@click.option("--port", default=8640, show_default=True)
+@click.option("--replica", "replicas", multiple=True, required=True,
+              help="Engine daemon base URL (repeatable), e.g. "
+              "--replica http://127.0.0.1:8642")
+@click.option("--probe-interval", default=1.0, show_default=True,
+              help="Seconds between health probes per replica")
+@click.option("--quiet", is_flag=True, help="Suppress per-request logging")
+def fleet_serve(host: str, port: int, replicas: tuple,
+                probe_interval: float, quiet: bool) -> None:
+    """Run the fleet router: health-checked, warm-prefix-affine routing
+    over N `sutro serve` replicas sharing one SUTRO_HOME, with circuit
+    breakers and jobstore-backed batch failover. Clients point
+    `sutro set-backend fleet` + `set-base-url` at it."""
+    from .fleet.router import serve_fleet
+
+    serve_fleet(
+        list(replicas), host=host, port=port,
+        probe_interval=probe_interval, verbose=not quiet,
+    )
+
+
+@fleet.command("status")
+@click.option("--json", "as_json", is_flag=True,
+              help="Raw /fleet document instead of rendered output")
+def fleet_status(as_json: bool) -> None:
+    """Fleet membership + breaker states + failover counters + the
+    fleet doctor verdict (requires base_url to point at a router)."""
+    doc = get_sdk().get_fleet()
+    if doc is None:
+        click.echo(to_colored_text(
+            "no fleet router at this base_url (single daemon?)", "fail"))
+        sys.exit(1)
+    if as_json:
+        click.echo(json.dumps(doc, indent=2))
+        return
+    doctor_doc = doc.get("doctor") or {}
+    click.echo(to_colored_text(
+        f"fleet: {doc.get('n_healthy')}/{doc.get('n_replicas')} healthy"
+        f" — verdict: {doctor_doc.get('verdict', '?')}", "callout"))
+    for line in doctor_doc.get("evidence") or ():
+        click.echo(f"  {line}")
+    rows = [
+        {
+            "rid": r.get("rid"),
+            "url": r.get("url"),
+            "state": r.get("state"),
+            "draining": r.get("draining"),
+            "load": r.get("load"),
+            "flaps": r.get("transitions_in_window"),
+            "models": ",".join(r.get("models") or []),
+        }
+        for r in doc.get("replicas") or ()
+    ]
+    if rows:
+        click.echo(tabulate(rows, headers="keys",
+                            tablefmt="rounded_outline"))
+    counters = doc.get("counters") or {}
+    if counters:
+        click.echo(to_colored_text(
+            "counters: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(counters.items())), ))
 
 
 @cli.command()

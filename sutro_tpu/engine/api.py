@@ -749,6 +749,40 @@ class LocalEngine:
             num_rows=rec.num_rows,
         )
 
+    # -- fleet router load report (fleet/frames.py) --------------------
+
+    def fleet_state(self) -> Dict[str, Any]:
+        """Load + readiness report the fleet router's least-loaded
+        policy consumes (served as a ``fleet_state`` frame by
+        ``GET /fleet-state``). Cheap: lock-held counter reads only."""
+        with self._lock:
+            queued = len(
+                [j for j in self._queued if not j.startswith("serve:")]
+            )
+            running = len(
+                [j for j in self._attached if not j.startswith("serve:")]
+            )
+            cur = self._current_job
+            if cur is not None and not cur.startswith("serve:"):
+                running += 1
+            models = sorted(self._runner_cache.keys())
+        gw = self.gateway
+        return {
+            "ready": True,
+            "draining": bool(gw is not None and gw.draining),
+            "load": {
+                "jobs_queued": queued,
+                "jobs_running": running,
+                "interactive_active": (
+                    gw.active_count() if gw is not None else 0
+                ),
+                "interactive_slots": int(
+                    getattr(self.ecfg, "interactive_slots", 0)
+                ),
+            },
+            "models": models,
+        }
+
     # -- live monitor (telemetry/monitor.py) ---------------------------
 
     def _monitor_jobs(self) -> List[Tuple[str, str]]:

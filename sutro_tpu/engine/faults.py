@@ -72,6 +72,23 @@ kvtier.disk_write      host->disk spill (``torn`` lands a truncated
                        npz bundle at its final name, quarantined at
                        read time): the host copy stays authoritative —
                        a failed spill never loses the entry
+fleet.probe            fleet router health probe (fleet/health.py), per
+                       probe attempt; ``job=`` matches the replica id.
+                       A raising kind counts as a probe failure and
+                       drives the per-replica circuit breaker —
+                       deterministic breaker/flap chaos without killing
+                       the replica process
+fleet.route            fleet router replica pick (fleet/router.py): a
+                       raising kind fails the chosen replica for this
+                       request only, forcing the pre-first-token
+                       transparent-retry path onto another replica
+fleet.replica_crash    replica-side (server.py): at request dispatch
+                       AND per streamed frame inside the SSE/progress
+                       loops. A firing spec closes the connection
+                       abruptly WITHOUT a terminal frame and shuts the
+                       HTTP server down — the daemon acts dead, the
+                       router's breaker + jobstore failover must absorb
+                       it (``job=`` matches the request path / id)
 ====================== ====================================================
 
 Kinds: ``error`` (RuntimeError), ``oom`` (RESOURCE_EXHAUSTED-shaped

@@ -465,16 +465,15 @@ class ContinuousBatcher:
         # resume by page-upload, and completed session turns checkpoint
         # through the prefix store into it. A geometry mismatch
         # disables tiering for this session (the payloads would not be
-        # page-compatible); hibernation additionally needs the plain
-        # single-device prefill path (runner.prefill start>0).
+        # page-compatible). Hibernation captures the partial tail page
+        # too (ceil(pos/PS) own pages), so resume is a PURE page-upload
+        # — no suffix prefill — which is why multi-page-group (sp/pp)
+        # rows hibernate as well: read_pages/write_pages are
+        # sharding-agnostic host copies, unlike runner.prefill(start>0).
         self._kv_tier = None
         if kv_tier is not None and kv_tier.page_size == self.ecfg.kv_page_size:
             self._kv_tier = kv_tier
-        self._can_hibernate = (
-            self._kv_tier is not None
-            and getattr(runner, "sp", 1) == 1
-            and getattr(runner, "pp", 1) == 1
-        )
+        self._can_hibernate = self._kv_tier is not None
         # hibernated rows: (id(ctx), row_id) -> _Hib. Entries live only
         # while their ctx is live in THIS session (purged at job finish
         # / session suspend / run_multi exit), so id() reuse is safe.
@@ -2599,12 +2598,14 @@ class ContinuousBatcher:
         )
 
     def _hibernate_slot(self, i: int) -> bool:
-        """Suspend slot ``i`` by demoting its page-aligned own KV into
-        the tiered pool instead of discarding it — the preempted row
-        later resumes by page-upload plus a sub-page tail prefill
-        (``pos % page_size`` tokens) rather than regenerating its whole
-        prompt and partial output. The demote is SYNCHRONOUS and
-        pinned: the device pages free only after the pool owns the
+        """Suspend slot ``i`` by demoting its own KV — INCLUDING the
+        partial tail page (``ceil(pos/PS)`` own pages) — into the
+        tiered pool instead of discarding it, so the preempted row
+        resumes by pure page-upload with zero re-prefilled tokens.
+        (Positions >= pos inside the tail page are garbage, but
+        attention masks to pos and the resumed decode overwrites them
+        in place through the page table.) The demote is SYNCHRONOUS
+        and pinned: the device pages free only after the pool owns the
         payload, so a torn demotion (fault site ``kvtier.demote``)
         degrades to the caller's plain regenerate suspend — never a
         corrupt row. Returns True when the slot was hibernated and its
@@ -2616,8 +2617,10 @@ class ContinuousBatcher:
             return False
         ctx = s.job
         PS = self.ecfg.kv_page_size
-        aligned = s.pos // PS
-        own_aligned = [int(p) for p in s.pages[s.shared_n : aligned]]
+        end = -(-s.pos // PS)  # ceil: the partial tail page rides along
+        own_aligned = [
+            int(p) for p in s.pages[s.shared_n : max(s.shared_n, end)]
+        ]
         key = b""
         if own_aligned:
             self._hib_seq += 1
@@ -2665,9 +2668,12 @@ class ContinuousBatcher:
         self, req: GenRequest, ctx: JobCtx, r, hib: _Hib
     ) -> Optional[GenRequest]:
         """Re-admit a hibernated row into reservation ``r``: upload its
-        tier payload into the fresh pages, re-prefill only the sub-page
-        tail, and arm the slot exactly where it stopped. Returns None
-        on success (the slot is live); on a tier miss — torn demotion,
+        tier payload into the fresh pages and arm the slot exactly
+        where it stopped — a pure upload, since hibernation captures
+        the partial tail page (the legacy sub-page re-prefill branch
+        survives only for aligned-capture entries, and is refused under
+        sp/pp where suffix prefill is unsupported). Returns None on
+        success (the slot is live); on a tier miss — torn demotion,
         host-LRU drop without a disk tier, or a shared-prefix coverage
         change across a session suspend — returns a FRESH request for
         the caller to admit through the normal path (the pre-tier
@@ -2684,6 +2690,14 @@ class ContinuousBatcher:
                 and int(payload["k"].shape[1]) == hib.n_pages
             )
         start = shared + hib.n_pages * PS
+        if ok and hib.pos > start and (
+            getattr(self.runner, "sp", 1) != 1
+            or getattr(self.runner, "pp", 1) != 1
+        ):
+            # aligned-capture entry on a sharded runner: the sub-page
+            # tail would need prefill(start>0), which sp/pp forbids —
+            # treat as a miss and regenerate rather than assert
+            ok = False
         if ok:
             try:
                 with self.timer.time("kv_promote"):
@@ -2764,7 +2778,7 @@ class ContinuousBatcher:
                     ctx.trace_id, "hibernate_resume",
                     {"row_id": int(req.row_id),
                      "pages": int(hib.n_pages),
-                     "reprefilled_tokens": int(hib.pos - start)},
+                     "reprefilled_tokens": max(0, int(hib.pos - start))},
                 )
         return None
 

@@ -1,0 +1,45 @@
+"""Replica fleet front door (ISSUE: serve.sutro.sh tier).
+
+One stable batch + OpenAI endpoint over N engine replicas: health-
+checked routing with per-replica circuit breakers (membership.py,
+health.py), SGLang-style warm-prefix affinity (affinity.py), and
+jobstore-backed batch failover with zero lost or duplicated rows
+(router.py). Wire frames between router and replica live in frames.py
+and are registered in the graftlint wire schema.
+
+Import surface is lazy on purpose: the router pulls in ``requests``
+and telemetry; replicas import only ``fleet.frames``.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "FleetRouter",
+    "FleetMembership",
+    "HealthProber",
+    "WarmAffinity",
+    "make_fleet_server",
+    "serve_fleet",
+    "start_fleet_thread",
+]
+
+
+def __getattr__(name: str):
+    if name in ("FleetRouter", "make_fleet_server", "serve_fleet",
+                "start_fleet_thread"):
+        from . import router
+
+        return getattr(router, name)
+    if name == "FleetMembership":
+        from .membership import FleetMembership
+
+        return FleetMembership
+    if name == "HealthProber":
+        from .health import HealthProber
+
+        return HealthProber
+    if name == "WarmAffinity":
+        from .affinity import WarmAffinity
+
+        return WarmAffinity
+    raise AttributeError(name)

@@ -79,7 +79,9 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
         self.api_key = api_key or check_for_api_key()
         self.base_url = base_url
         self.serving_base_url = serving_base_url
-        self.backend = backend
+        # "fleet" targets a fleet router (sutro fleet serve): identical
+        # wire contract to a single daemon, so it IS the remote transport
+        self.backend = "remote" if backend == "fleet" else backend
         self._engine_config = engine_config or {}
         self._engine = None
         check_version()
@@ -98,9 +100,9 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
         self.serving_base_url = serving_base_url
 
     def set_backend(self, backend: str) -> None:
-        if backend not in ("tpu", "remote"):
-            raise ValueError("backend must be 'tpu' or 'remote'")
-        self.backend = backend
+        if backend not in ("tpu", "remote", "fleet"):
+            raise ValueError("backend must be 'tpu', 'remote', or 'fleet'")
+        self.backend = "remote" if backend == "fleet" else backend
 
     # ------------------------------------------------------------------
     # transports
@@ -124,8 +126,13 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
         base_url: Optional[str] = None,
         **kwargs: Any,
     ):
-        """Authenticated HTTP dispatch for the remote backend — retries only
-        HTTP 524 with exponential backoff, max 5 (reference sdk.py:103-172)."""
+        """Authenticated HTTP dispatch for the remote backend — retries
+        HTTP 524 with exponential backoff, max 5 (reference
+        sdk.py:103-172), and connection-level failures on IDEMPOTENT
+        reads (GET/HEAD) with bounded backoff, so a daemon restart or a
+        fleet-router failover under a polling client resumes instead of
+        raising. Non-idempotent verbs never replay — a connection error
+        on a submit is surfaced, not retried into a duplicate job."""
         import requests
 
         url = f"{(base_url or self.base_url).rstrip('/')}/{endpoint.lstrip('/')}"
@@ -133,8 +140,18 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
         if self.api_key:
             headers["Authorization"] = f"Key {self.api_key}"
         fn = getattr(requests, method.lower())
+        idempotent = method.lower() in ("get", "head")
         for attempt in range(5):
-            resp = fn(url, headers=headers, **kwargs)
+            try:
+                resp = fn(url, headers=headers, **kwargs)
+            except (
+                requests.exceptions.ConnectionError,
+                requests.exceptions.Timeout,
+            ):
+                if not idempotent or attempt == 4:
+                    raise
+                time.sleep(min(0.2 * (2 ** attempt), 2.0))
+                continue
             if resp.status_code != 524:
                 return resp
             time.sleep(2 ** attempt)
@@ -289,20 +306,69 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
 
     def _iter_progress(self, job_id: str):
         if self.backend == "remote":
-            resp = self.do_request(
-                "get", f"stream-job-progress/{job_id}", stream=True
-            )
-            for line in resp.iter_lines():
-                if not line:
-                    continue
-                update = json.loads(line)
-                if update.get("t") == "end":
-                    # explicit terminal frame (newer servers); older
-                    # servers just close the stream — both end here
-                    break
-                yield update
+            yield from self._iter_progress_remote(job_id)
         else:
             yield from self.engine.stream_job_progress(job_id)
+
+    def _iter_progress_remote(self, job_id: str):
+        """Remote progress tail with reconnect-by-cursor: a stream that
+        closes WITHOUT the terminal ``{"t":"end"}`` frame means the
+        daemon died (or a fleet replica crashed) mid-poll — reconnect
+        with ``?cursor=<rows done>`` so the resumed stream carries on
+        where the last one dropped instead of raising or replaying.
+        The tqdm consumer's monotone ``update(done - pbar.n)`` merge
+        makes any overlap harmless on old servers that ignore the
+        cursor parameter."""
+        import requests
+
+        cursor = 0
+        retries = 0
+        while True:
+            try:
+                resp = self.do_request(
+                    "get",
+                    f"stream-job-progress/{job_id}?cursor={cursor}",
+                    stream=True,
+                )
+                resp.raise_for_status()
+                for line in resp.iter_lines():
+                    if not line:
+                        continue
+                    update = json.loads(line)
+                    if update.get("t") == "end":
+                        # explicit terminal frame (newer servers);
+                        # older servers just close the stream
+                        return
+                    if update.get("update_type") == "progress":
+                        try:
+                            cursor = max(
+                                cursor, int(update.get("result") or 0)
+                            )
+                        except (TypeError, ValueError):
+                            pass
+                    retries = 0
+                    yield update
+                # closed with no end frame: either an old server that
+                # finished, or a mid-stream death — disambiguate below
+            except (
+                requests.exceptions.ConnectionError,
+                requests.exceptions.ChunkedEncodingError,
+                requests.exceptions.Timeout,
+            ):
+                pass
+            retries += 1
+            try:
+                status = self.get_job_status(job_id)
+            except (requests.exceptions.RequestException, ValueError):
+                status = None  # daemon still restarting
+            if status is not None and JobStatus(status).is_terminal():
+                return
+            if retries > 6:
+                raise RuntimeError(
+                    f"progress stream for {job_id} lost after "
+                    f"{retries} reconnect attempts"
+                )
+            time.sleep(min(0.2 * (2 ** retries), 2.0))
 
     # ------------------------------------------------------------------
     # interactive serving API (the serving/ tier's OpenAI surface)
@@ -1108,6 +1174,19 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
         if self.backend == "remote":
             return self._remote_json("get", "get-quotas")["quotas"]
         return self.engine.get_quotas()
+
+    def get_fleet(self) -> Optional[Dict[str, Any]]:
+        """Fleet router snapshot (fleet/remote backend pointed at a
+        ``sutro fleet`` router): replica membership, breaker states,
+        failover counters, and the fleet doctor verdict. None when the
+        endpoint doesn't exist (single daemon / local backend)."""
+        if self.backend != "remote":
+            return None
+        resp = self.do_request("get", "fleet")
+        if resp.status_code == 404:
+            return None
+        resp.raise_for_status()
+        return resp.json().get("fleet")
 
     def clear_job_results_cache(self) -> int:
         """Remove ~/.sutro/job-results (reference sdk.py:1640-1675)."""

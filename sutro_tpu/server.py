@@ -44,6 +44,7 @@ from email.parser import BytesParser
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
+from .engine import faults
 from .engine.api import LocalEngine
 from .interfaces import JobStatus
 
@@ -89,7 +90,10 @@ def _parse_multipart(content_type: str, body: bytes) -> Dict[str, Any]:
 
 
 class EngineHTTPHandler(BaseHTTPRequestHandler):
-    engine: LocalEngine  # set by make_server
+    # set by make_server; None until the engine is warm (serve() binds
+    # the socket before the slow engine build so /healthz can answer
+    # 503-warming instead of connection-refused)
+    engine: Optional[LocalEngine]
     protocol_version = "HTTP/1.1"
     server_version = "sutro-tpu-engine"
 
@@ -130,12 +134,59 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
         head, _, rest = path.partition("/")
         return head, (rest or None)
 
+    def _query(self) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for kv in self.path.partition("?")[2].split("&"):
+            k, _, v = kv.partition("=")
+            if k:
+                out[k] = v
+        return out
+
+    # -- chaos: simulated replica death (fleet.replica_crash) ----------
+
+    def _crash_fault(self, job: str) -> bool:
+        """fleet.replica_crash fault site: a firing spec makes this
+        daemon act dead — connection closed abruptly with NO response
+        or terminal frame, HTTP loop shut down. ``job`` is
+        ``dispatch:<path>`` at request entry or ``stream:<id>`` inside
+        a streaming loop, so plans can pin either."""
+        spec = faults.fire("fleet.replica_crash", job=job)
+        if spec is None:
+            return False
+        self._simulate_crash()
+        return True
+
+    def _simulate_crash(self) -> None:
+        threading.Thread(
+            target=self.server.shutdown, daemon=True, name="fleet-crash"
+        ).start()
+        self.close_connection = True
+        try:
+            self.connection.close()
+        except OSError:
+            pass  # already torn down — the point is an abrupt close
+
+    def _warming_503(self, head: str) -> None:
+        """Socket is up but the engine is still building (compile /
+        weight load): readiness gate for routers and external LBs."""
+        if head == "healthz" or head == "fleet-state":
+            self._json({"ok": False, "state": "warming", "v": 1}, status=503)
+        else:
+            self._error(503, "engine warming up")
+
     # -- verbs ---------------------------------------------------------
 
     def do_GET(self) -> None:  # noqa: N802 (stdlib naming)
         try:
             head, rest = self._route()
+            if faults.ACTIVE is not None and self._crash_fault(
+                "dispatch:" + self.path
+            ):
+                return
             eng = self.engine
+            if eng is None:
+                self._warming_503(head)
+                return
             if head == "stream-job-progress" and rest:
                 self._stream_progress(rest)
             elif head == "jobs" and rest:
@@ -173,7 +224,9 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
                 # same surface as the serving tier when it's off
                 self._json({"monitor": eng.monitor_doc()})
             elif head == "healthz":
-                self._json({"ok": True})
+                self._healthz()
+            elif head == "fleet-state":
+                self._fleet_state()
             else:
                 self._error(404, f"Unknown endpoint GET /{head}")
         except (KeyError, FileNotFoundError) as e:
@@ -184,8 +237,17 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:  # noqa: N802
         try:
             head, rest = self._route()
+            if faults.ACTIVE is not None and self._crash_fault(
+                "dispatch:" + self.path
+            ):
+                return
             eng = self.engine
-            if head == "v1" and rest == "chat/completions":
+            if eng is None:
+                self._warming_503(head)
+                return
+            if head == "fleet-warm":
+                self._fleet_warm()
+            elif head == "v1" and rest == "chat/completions":
                 self._serve_openai(chat=True)
             elif head == "v1" and rest == "completions":
                 self._serve_openai(chat=False)
@@ -268,6 +330,68 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
 
     # -- endpoint bodies ----------------------------------------------
 
+    def _is_draining(self) -> bool:
+        gw = getattr(self.engine, "gateway", None)
+        return bool(
+            getattr(self.server, "draining", False)
+            or (gw is not None and gw.draining)
+        )
+
+    def _healthz(self) -> None:
+        """3-state readiness: 200 ready, 503 draining (SIGTERM drain in
+        progress — stop sending new work, in-flight finishes), 503
+        warming (handled before dispatch when engine is None). The
+        legacy ``ok`` key keeps pre-fleet probes working."""
+        if self._is_draining():
+            self._json(
+                {"ok": False, "state": "draining", "v": 1}, status=503
+            )
+        else:
+            self._json({"ok": True, "state": "ready", "v": 1})
+
+    def _fleet_state(self) -> None:
+        """Fleet router probe: readiness + load report + model list
+        (fleet/frames.py ``fleet_state`` frame). 503 while draining so
+        plain HTTP health checks agree with the in-band state."""
+        from .fleet import frames as fleet_frames
+
+        doc = self.engine.fleet_state()
+        draining = self._is_draining() or bool(doc.get("draining"))
+        frame = fleet_frames.fleet_state_frame(
+            state="draining" if draining else "ready",
+            draining=draining,
+            ready=bool(doc.get("ready", True)),
+            load=doc.get("load") or {},
+            models=doc.get("models") or [],
+        )
+        self._json(frame, status=503 if draining else 200)
+
+    def _fleet_warm(self) -> None:
+        """Warm-prefix probe (fleet/frames.py ``warm_probe`` ->
+        ``warm_report``): tokenizes the carried OpenAI body exactly as
+        submit would and peeks the radix prefix store — side-effect
+        free, no admission, no KV mutation. 404 when the interactive
+        tier is off (the router treats that as no-affinity)."""
+        gw = getattr(self.engine, "gateway", None)
+        if gw is None:
+            self._error(404, "interactive serving is disabled")
+            return
+        from .fleet import frames as fleet_frames
+        from .serving import openai as oai
+
+        req = self._read_json()
+        body = req.get("body")
+        if not isinstance(body, dict):
+            self._error(400, "warm_probe frame needs a 'body' object")
+            return
+        try:
+            sreq = oai.parse_request(body, chat=bool(req.get("chat", True)))
+        except oai.BadServingRequest as e:
+            self._error(400, str(e))
+            return
+        warm, total = gw.probe_warm(sreq)
+        self._json(fleet_frames.warm_report_frame(warm, total))
+
     def _metrics(self) -> None:
         """Prometheus text exposition (0.0.4) of the engine registry."""
         from . import telemetry
@@ -282,8 +406,16 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
         self.wfile.write(data)
 
     def _stream_progress(self, job_id: str) -> None:
-        """NDJSON progress stream (chunked) — reference sdk.py:311-367."""
+        """NDJSON progress stream (chunked) — reference sdk.py:311-367.
+        ``?cursor=N`` suppresses progress records at or below N rows
+        done, so a reconnecting client (SDK restart-resume, fleet
+        router failover) resumes where its last stream dropped instead
+        of replaying the history."""
         self.engine.job_status(job_id)  # 404 before headers if unknown
+        cursor = 0
+        cq = self._query().get("cursor", "")
+        if cq.isdigit():
+            cursor = int(cq)
         self.send_response(200)
         self.send_header("Content-Type", "application/x-ndjson")
         self.send_header("Transfer-Encoding", "chunked")
@@ -297,6 +429,16 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
         status: Optional[str] = None
         try:
             for update in self.engine.stream_job_progress(job_id):
+                if faults.ACTIVE is not None and self._crash_fault(
+                    "stream:" + job_id
+                ):
+                    return
+                if cursor and update.get("update_type") == "progress":
+                    try:
+                        if int(update.get("result") or 0) <= cursor:
+                            continue
+                    except (TypeError, ValueError):
+                        pass
                 send_chunk(update)
         except (BrokenPipeError, ConnectionResetError):
             return  # client detached — job keeps running
@@ -479,7 +621,13 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
 
         try:
             for obj in oai.iter_stream(ir, chat=chat):
-                if faults.ACTIVE is not None:
+                # fault sites count TOKEN frames only: heartbeat pings
+                # (obj None) are timing-dependent, and a seeded plan's
+                # nth must mean the same frame on every run
+                if obj is not None and faults.ACTIVE is not None:
+                    if self._crash_fault("stream:" + ir.id):
+                        ir.channel.cancel()
+                        return
                     faults.inject("serving.stream", job=ir.id)
                 send(oai.sse_frame(obj))
         except (BrokenPipeError, ConnectionResetError):
@@ -546,18 +694,27 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
 
 
 def make_server(
-    engine: LocalEngine,
+    engine: Optional[LocalEngine],
     host: str = "127.0.0.1",
     port: int = DEFAULT_PORT,
     verbose: bool = False,
 ) -> ThreadingHTTPServer:
+    """engine=None binds the socket in the warming state (healthz 503);
+    flip it live later with ``bind_engine``."""
     handler = type(
         "BoundEngineHandler", (EngineHTTPHandler,), {"engine": engine}
     )
     server = ThreadingHTTPServer((host, port), handler)
     server.verbose = verbose  # type: ignore[attr-defined]
+    server.draining = False  # type: ignore[attr-defined]
     server.daemon_threads = True
     return server
+
+
+def bind_engine(server: ThreadingHTTPServer, engine: LocalEngine) -> None:
+    """Attach a warm engine to a server started with engine=None:
+    /healthz flips from 503-warming to 200-ready."""
+    server.RequestHandlerClass.engine = engine  # type: ignore[attr-defined]
 
 
 def start_server_thread(
@@ -582,6 +739,9 @@ def _graceful_shutdown(
     the final SSE ``[DONE]``); stragglers are hard-cancelled so the
     scheduler frees their slots before the server stops. Idempotent —
     ``server.shutdown()`` is a no-op once the serve loop has exited."""
+    # flip /healthz to 503-draining FIRST so fleet routers / LBs stop
+    # sending new work before the gateway starts refusing it
+    server.draining = True  # type: ignore[attr-defined]
     gw = getattr(engine, "gateway", None)
     if gw is not None:
         gw.begin_drain()
@@ -638,17 +798,27 @@ def serve(
     """Blocking entry point (``sutro serve``)."""
     from .engine.api import get_engine
 
+    # bind + answer BEFORE the slow engine build: /healthz serves
+    # 503-warming during compile/weight-load, so a fleet router or LB
+    # gates traffic on readiness instead of seeing connection-refused
+    server = make_server(None, host, port, verbose=verbose)
+    http_thread = threading.Thread(
+        target=server.serve_forever, daemon=True, name="sutro-http"
+    )
+    http_thread.start()
+    print(f"sutro-tpu engine daemon listening on http://{host}:{port} "
+          "(warming)")
     engine = get_engine(ecfg)
-    server = make_server(engine, host, port, verbose=verbose)
+    bind_engine(server, engine)
     # drain budget mirrors the dp stall policy, capped for interactive
     # use (a 10-minute SIGTERM drain would outlive most supervisors)
     grace = min(float(engine.ecfg.dp_stall_timeout or 30.0), 30.0)
     install_graceful_sigterm(engine, server, grace)
-    print(f"sutro-tpu engine daemon listening on http://{host}:{port}")
-    print("point clients at it with: sutro set-base-url "
+    print("engine ready; point clients at it with: sutro set-base-url "
           f"http://{host}:{port} && sutro set-backend remote")
     try:
-        server.serve_forever()
+        while http_thread.is_alive():
+            http_thread.join(timeout=1.0)
     except KeyboardInterrupt:
         _graceful_shutdown(engine, server, grace)
     except SystemExit:

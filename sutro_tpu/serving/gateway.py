@@ -593,6 +593,55 @@ class InteractiveGateway:
             ),
         }
 
+    # -- fleet router probes (fleet/frames.py) -------------------------
+
+    def probe_warm(self, sreq: ServingRequest) -> tuple:
+        """Side-effect-free warm-prefix probe for the fleet router:
+        tokenize exactly as ``submit`` would (same chat scaffold, same
+        session-continuation rendering) and peek the radix prefix
+        store. Returns ``(warm_tokens, prompt_tokens)``. No admission,
+        no KV mutation, no session checkpoint sweep — a probe must
+        never change what it measures."""
+        from ..engine.api import resolve_model
+
+        try:
+            engine_key, mcfg, meta = resolve_model(sreq.model)
+        except ValueError:
+            return 0, 0
+        if meta.get("embedding") or mcfg.head == "embedding":
+            return 0, 0
+        tok = self.eng._get_tokenizer(engine_key, mcfg)
+        sess_prev_tokens = 0
+        if sreq.kind == "chat":
+            from ..engine.tokenizer import encode_chat_batch
+
+            prev = None
+            if sreq.session_id is not None:
+                prev = self._session_ids((engine_key, sreq.session_id))
+            if prev is not None:
+                ids = list(prev) + tok.encode(
+                    tok.render_chat_continuation(
+                        sreq.prompt, mcfg.chat_template
+                    )
+                )
+                sess_prev_tokens = len(prev)
+            else:
+                ids = encode_chat_batch(
+                    tok, [sreq.prompt], sreq.system_prompt,
+                    mcfg.chat_template,
+                )[0]
+        else:
+            ids = tok.encode(sreq.prompt)
+        warm = int(
+            self.eng.prefix_warm_tokens(
+                engine_key, np.asarray(ids, np.int32)
+            )
+        )
+        # a live session IS warmth: its KV (resident or tiered) lives
+        # on this replica only, so session stickiness dominates any
+        # other replica's template-shell warmth
+        return max(warm, sess_prev_tokens), len(ids)
+
     # -- drain (SIGTERM path) ------------------------------------------
 
     def begin_drain(self) -> None:

@@ -333,6 +333,29 @@ KV_RESUMES_TOTAL = REGISTRY.counter(
     unit="rows",
     max_series=4,
 )
+FLEET_REPLICAS = REGISTRY.gauge(
+    "sutro_fleet_replicas",
+    "Fleet router replica census by state (healthy = breaker closed + "
+    "ready + not draining; open/half_open = breaker tripped; draining "
+    "= alive, refusing new work)",
+    labels=("state",),  # healthy | open | half_open | draining
+    max_series=8,
+)
+FLEET_FAILOVERS_TOTAL = REGISTRY.counter(
+    "sutro_fleet_failovers_total",
+    "Requests/jobs moved off a failed replica: 'batch' = jobstore "
+    "resume_job re-submission after a replica death mid-job, "
+    "'interactive' = transparent pre-first-token retry on another "
+    "replica, 'stream_error' = post-first-token structured mid-stream "
+    "error returned to the client (no transparent retry possible)",
+    labels=("kind",),  # batch | interactive | stream_error
+    max_series=8,
+)
+FLEET_ROUTED_PREFIX_HITS_TOTAL = REGISTRY.counter(
+    "sutro_fleet_routed_prefix_hits_total",
+    "Interactive requests routed to a replica reporting > 0 warm "
+    "prefix tokens (the SGLang-style cache-aware routing win)",
+)
 
 # Span names the engine emits — OBSERVABILITY.md's span schema section
 # and tests key off this tuple, so additions land in one place.

@@ -679,3 +679,96 @@ def diagnose(
             "io_s": io_s,
         },
     }
+
+
+# -- fleet-level diagnosis (fleet router /fleet + `sutro fleet status`) --
+
+FLEET_VERDICTS = (
+    "no_healthy_replicas",
+    "replica_flapping",
+    "fleet_degraded",
+    "healthy",
+)
+
+
+def diagnose_fleet(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """Grade a fleet membership snapshot (fleet/membership.py
+    ``snapshot()``, optionally with router counters merged in) into a
+    fleet-level verdict. Pure analysis, same contract as
+    :func:`diagnose`: runs identically on a live router's snapshot or
+    a synthetic one in tests.
+
+    Priority order: a fleet with zero routable replicas is an outage
+    regardless of why; a flapping replica (breaker cycling — probe
+    flakiness, overload, or a crash loop) outranks a plainly-open one
+    because it poisons routing decisions on every transition; any open
+    breaker with capacity remaining is degraded-but-serving.
+    """
+    replicas = list(doc.get("replicas") or ())
+    n_healthy = int(doc.get("n_healthy") or 0)
+    evidence: List[str] = []
+
+    flapping = [
+        r.get("rid")
+        for r in replicas
+        if int(r.get("transitions_in_window") or 0) >= 3
+    ]
+    broken = [
+        r.get("rid")
+        for r in replicas
+        if r.get("state") in ("open", "half_open")
+    ]
+    draining = [r.get("rid") for r in replicas if r.get("draining")]
+
+    if not replicas or n_healthy == 0:
+        verdict = "no_healthy_replicas"
+        evidence.append(
+            f"0 of {len(replicas)} replica(s) routable — every request "
+            "is refused at the front door (check replica processes and "
+            "probe reachability)"
+        )
+    elif flapping:
+        verdict = "replica_flapping"
+        evidence.append(
+            f"replica(s) {sorted(flapping)} crossed >= 3 breaker "
+            "transitions inside the flap window — probe flakiness, "
+            "overload, or a crash loop; routing churns on every flip"
+        )
+    elif broken or draining:
+        verdict = "fleet_degraded"
+        if broken:
+            evidence.append(
+                f"breaker open on {sorted(broken)}; fleet serving on "
+                f"{n_healthy}/{len(replicas)} replica(s)"
+            )
+        if draining:
+            evidence.append(
+                f"replica(s) {sorted(draining)} draining (SIGTERM "
+                "shutdown in progress) — excluded from routing while "
+                "in-flight work finishes"
+            )
+    else:
+        verdict = "healthy"
+        evidence.append(
+            f"all {len(replicas)} replica(s) routable"
+        )
+
+    failovers = doc.get("failovers") or {}
+    if isinstance(failovers, dict) and any(failovers.values()):
+        evidence.append(
+            "failovers so far: "
+            + ", ".join(
+                f"{k}={v}" for k, v in sorted(failovers.items()) if v
+            )
+        )
+
+    return {
+        "version": DOCTOR_VERSION,
+        "verdict": verdict,
+        "evidence": evidence,
+        "n_replicas": len(replicas),
+        "n_healthy": n_healthy,
+        "flapping": sorted(flapping),
+        "open": sorted(broken),
+        "draining": sorted(draining),
+    }

@@ -1,0 +1,805 @@
+"""Replica fleet front door (fleet/): health-checked routing over N
+engine daemons, per-replica circuit breakers, warm-prefix affinity,
+batch-job failover via the shared jobstore, and graceful degradation
+when router and replica disagree on protocol.
+
+Layout mirrors the fleet's layers:
+
+1. unit — breaker state machine, tolerant frame parsers, pure pick
+   policies, the fleet doctor (no HTTP, no engines);
+2. prober degradation against fake transports (old replica vs new
+   router — health-probe-only routing, never a crash);
+3. integration over TWO live engines sharing one SUTRO_HOME behind a
+   live router (the fleet topology the chaos gate grades);
+4. chaos — replica death mid-batch-job fails over with zero lost or
+   duplicated rows and bit-identical outputs; a replica death
+   mid-SSE-stream becomes a structured error frame, never a hang.
+
+Destructive tests (anything that kills a server) build their OWN
+servers/routers around the shared engines so the module fixture stays
+healthy for later tests.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+import requests
+
+from sutro_tpu import telemetry
+from sutro_tpu.engine import faults
+from sutro_tpu.engine.api import LocalEngine
+from sutro_tpu.engine.config import EngineConfig
+from sutro_tpu.fleet import frames
+from sutro_tpu.fleet.affinity import WarmAffinity
+from sutro_tpu.fleet.health import HealthProber
+from sutro_tpu.fleet.membership import FleetMembership
+from sutro_tpu.fleet.router import (
+    pick_batch,
+    pick_interactive,
+    start_fleet_thread,
+)
+from sutro_tpu.interfaces import JobStatus
+from sutro_tpu.server import (
+    EngineHTTPHandler,
+    bind_engine,
+    make_server,
+    start_server_thread,
+)
+from sutro_tpu.telemetry import doctor
+
+from .conftest import free_low_port
+
+
+def _wait(pred, timeout=30.0, interval=0.05, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+STATE_OK = {
+    "ready": True,
+    "draining": False,
+    "load": {},
+    "models": [],
+    "fleet_protocol": True,
+    "warm_probe": True,
+}
+
+
+# ---------------------------------------------------------------------
+# 1. breaker state machine + frames + pick policies (pure units)
+# ---------------------------------------------------------------------
+
+
+def test_breaker_opens_after_threshold_then_recloses():
+    trans = []
+    m = FleetMembership(
+        ["http://x:1"], probe_interval=1.0,
+        on_transition=lambda *a: trans.append(a),
+    )
+    now = 100.0
+    m.note_probe_success("r0", STATE_OK, now=now)
+    assert [r["rid"] for r in m.healthy()] == ["r0"]
+    # two failures stay closed; the third opens the breaker
+    m.note_probe_failure("r0", now=now)
+    m.note_probe_failure("r0", now=now)
+    assert m.get("r0")["state"] == "closed"
+    m.note_probe_failure("r0", now=now)
+    assert m.get("r0")["state"] == "open"
+    assert ("r0", "closed", "open") in trans
+    assert m.healthy() == []
+    # open -> half_open trial; a failed trial re-opens with backoff
+    m.note_half_open("r0", now=now)
+    assert m.get("r0")["state"] == "half_open"
+    m.note_probe_failure("r0", now=now)
+    assert m.get("r0")["state"] == "open"
+    # open_probes=1 -> next probe at interval * 2, not every sweep
+    assert m.due_probes(now=now + 1.5) == []
+    assert [d["rid"] for d in m.due_probes(now=now + 2.5)] == ["r0"]
+    # successful trial recloses and restores routability
+    m.note_half_open("r0", now=now + 2.5)
+    m.note_probe_success("r0", STATE_OK, now=now + 2.5)
+    assert m.get("r0")["state"] == "closed"
+    assert [r["rid"] for r in m.healthy()] == ["r0"]
+
+
+def test_breaker_backoff_is_bounded():
+    m = FleetMembership(
+        ["http://x:1"], probe_interval=1.0, backoff_cap=8.0,
+    )
+    now = 0.0
+    for _ in range(3):
+        m.note_probe_failure("r0", now=now)
+    # pile on failures: the probe spacing grows but caps at backoff_cap
+    for _ in range(20):
+        m.note_probe_failure("r0", now=now)
+    assert m.due_probes(now=now + 7.9) == []
+    assert [d["rid"] for d in m.due_probes(now=now + 8.1)] == ["r0"]
+
+
+def test_flap_detection_feeds_doctor_verdict():
+    m = FleetMembership(["http://x:1"], probe_interval=0.01)
+    # real monotonic timestamps: snapshot()'s flap window uses them
+    m.note_probe_success("r0", STATE_OK)
+    for _ in range(3):
+        m.note_probe_failure("r0")
+    m.note_half_open("r0")
+    m.note_probe_success("r0", STATE_OK)
+    assert m.flapping() == ["r0"]
+    snap = m.snapshot()
+    assert snap["replicas"][0]["transitions_in_window"] >= 3
+    verdict = doctor.diagnose_fleet(snap)
+    assert verdict["verdict"] == "replica_flapping"
+    assert verdict["flapping"] == ["r0"]
+
+
+def test_doctor_fleet_verdict_priorities():
+    assert (
+        doctor.diagnose_fleet({"replicas": [], "n_healthy": 0})["verdict"]
+        == "no_healthy_replicas"
+    )
+    row = {
+        "rid": "r0", "state": "closed", "ready": True, "draining": False,
+        "transitions_in_window": 0,
+    }
+    healthy = doctor.diagnose_fleet({"replicas": [row], "n_healthy": 1})
+    assert healthy["verdict"] == "healthy"
+    degraded = doctor.diagnose_fleet(
+        {
+            "replicas": [row, dict(row, rid="r1", state="open")],
+            "n_healthy": 1,
+        }
+    )
+    assert degraded["verdict"] == "fleet_degraded"
+    draining = doctor.diagnose_fleet(
+        {
+            "replicas": [row, dict(row, rid="r1", draining=True)],
+            "n_healthy": 1,
+        }
+    )
+    assert draining["verdict"] == "fleet_degraded"
+    assert any("draining" in e for e in draining["evidence"])
+
+
+def test_frame_parsers_tolerate_skew_and_junk():
+    # newer-peer frame with unknown keys parses; junk 't' is refused
+    frame = frames.fleet_state_frame(
+        "ready", False, True, {"jobs_queued": 2, "new_field": "x"}, ["m"]
+    )
+    frame["future_knob"] = {"nested": True}
+    frame["v"] = 99
+    parsed = frames.parse_fleet_state(frame)
+    assert parsed["ready"] and parsed["fleet_protocol"]
+    assert frames.load_score(parsed["load"]) == 2
+    # legacy /healthz doc (no 't'): alive, but health-probe-only
+    legacy = frames.parse_fleet_state({"ok": True, "junk": 1})
+    assert legacy["ready"] and not legacy["fleet_protocol"]
+    assert not legacy["warm_probe"]
+    assert frames.parse_fleet_state({"t": "not_fleet_state"}) is None
+    assert frames.parse_fleet_state("nonsense") is None
+    assert frames.parse_warm_report({"warm_tokens": "bogus"}) == 0
+    assert frames.parse_warm_report(None) == 0
+    assert frames.parse_warm_report({"warm_tokens": 7, "x": 1}) == 7
+    assert frames.load_score({"jobs_queued": "NaN?", "jobs_running": 3}) == 3
+
+
+def test_pick_policies_are_deterministic():
+    reps = [
+        {"rid": "r0", "load": 2},
+        {"rid": "r1", "load": 0},
+        {"rid": "r2", "load": 1},
+    ]
+    assert [r["rid"] for r in pick_batch(reps)] == ["r1", "r2", "r0"]
+    # warmth dominates load; load breaks warmth ties
+    order = pick_interactive(reps, {"r0": 64, "r2": 64})
+    assert [r["rid"] for r in order] == ["r2", "r0", "r1"]
+    assert [r["rid"] for r in pick_interactive(reps, {})] == [
+        "r1", "r2", "r0",
+    ]
+
+
+# ---------------------------------------------------------------------
+# 2. prober degradation against fake transports
+# ---------------------------------------------------------------------
+
+
+def test_degradation_old_replica_downgrades_to_healthz_probe():
+    """A replica that 404s /fleet-state (predates the fleet protocol)
+    is probed via /healthz and stays routable — with warm-probe
+    affinity disabled for it, never a crash."""
+    m = FleetMembership(["http://legacy:9"], probe_interval=0.01)
+    calls = []
+
+    def fake_send(method, url, frame=None, timeout=2.0):
+        calls.append(url)
+        if url.endswith("/fleet-state"):
+            return {"detail": "Unknown endpoint GET /fleet-state",
+                    "_status": 404}
+        if url.endswith("/healthz"):
+            return {"ok": True, "unexpected_key": [1, 2], "_status": 200}
+        raise AssertionError(f"unexpected probe url {url}")
+
+    p = HealthProber(m, send=fake_send)
+    p.sweep_once()
+    row = m.get("r0")
+    assert row["state"] == "closed" and row["ready"]
+    assert not row["fleet_protocol"] and not row["warm_probe"]
+    # the downgrade sticks: the next sweep goes straight to /healthz
+    calls.clear()
+    m.note_probe_success("r0", frames.parse_fleet_state({"ok": True}))
+    p.probe_one("r0", "http://legacy:9")
+    assert calls == ["http://legacy:9/healthz"]
+    # affinity omits legacy replicas: least-loaded routing only
+    aff = WarmAffinity(send=fake_send)
+    assert aff.scores({"model": "m", "messages": []}, True, [row]) == {}
+
+
+def test_degradation_garbage_answers_open_breaker_not_crash():
+    m = FleetMembership(["http://weird:9"], probe_interval=0.01)
+
+    def junk_send(method, url, frame=None, timeout=2.0):
+        return {"t": "completely_unknown_frame", "_status": 200}
+
+    p = HealthProber(m, send=junk_send)
+    for _ in range(5):
+        m._replicas["r0"].next_probe_at = 0.0
+        p.sweep_once()
+    assert m.get("r0")["state"] == "open"
+    assert m.healthy() == []
+
+
+def test_fleet_probe_fault_site_drives_breaker():
+    """fleet.probe with job=<rid> fails probes deterministically — the
+    chaos suite's no-real-kill way to exercise breaker transitions."""
+    m = FleetMembership(["http://a:1", "http://b:2"], probe_interval=0.01)
+
+    def ok_send(method, url, frame=None, timeout=2.0):
+        return dict(frames.fleet_state_frame("ready", False, True, {}, []),
+                    _status=200)
+
+    p = HealthProber(m, send=ok_send)
+    faults.configure("fleet.probe:error:job=r0")
+    try:
+        for _ in range(4):
+            for r in ("r0", "r1"):
+                m._replicas[r].next_probe_at = 0.0
+            p.sweep_once()
+    finally:
+        faults.clear()
+    assert m.get("r0")["state"] == "open"
+    assert [r["rid"] for r in m.healthy()] == ["r1"]
+
+
+# ---------------------------------------------------------------------
+# 3. integration: two live engines, one shared home, one router
+# ---------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory, monkeypatch_module):
+    """TWO tiny engines sharing one SUTRO_HOME (the shared-jobstore
+    fleet topology) behind a live router; r0 -> eng_a, r1 -> eng_b."""
+    home = tmp_path_factory.mktemp("fleet-home")
+    monkeypatch_module.setenv("SUTRO_HOME", str(home))
+    ecfg = EngineConfig(
+        kv_page_size=8, max_pages_per_seq=16, decode_batch_size=4,
+        max_model_len=128, use_pallas=False, param_dtype="float32",
+        activation_dtype="float32", max_new_tokens=8,
+        interactive_slots=2,
+    )
+    eng_a = LocalEngine(ecfg)
+    eng_b = LocalEngine(ecfg)
+    srv_a, _, url_a = start_server_thread(eng_a)
+    srv_b, _, url_b = start_server_thread(eng_b)
+    router, fsrv, _, furl = start_fleet_thread(
+        [url_a, url_b], probe_interval=0.2
+    )
+    from sutro_tpu.sdk import Sutro
+
+    sdk = Sutro(api_key="fleet-key", base_url=furl, backend="fleet")
+    _wait(
+        lambda: router.membership.snapshot()["n_healthy"] == 2,
+        timeout=15, what="both replicas healthy",
+    )
+
+    class F:
+        pass
+
+    f = F()
+    f.eng_a, f.eng_b = eng_a, eng_b
+    f.url_a, f.url_b = url_a, url_b
+    f.router, f.furl, f.sdk = router, furl, sdk
+    f.home = str(home)
+    yield f
+    faults.clear()
+    router.stop()
+    fsrv.shutdown()
+    srv_a.shutdown()
+    srv_b.shutdown()
+    eng_a.close(timeout=10)
+    eng_b.close(timeout=10)
+
+
+def test_healthz_warming_ready_draining(fleet):
+    """Satellite: /healthz is a 3-state readiness gate — 503 before the
+    engine is warm, 200 ready, 503 while draining."""
+    srv = make_server(None, port=0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        r = requests.get(url + "/healthz", timeout=5)
+        assert r.status_code == 503 and r.json()["state"] == "warming"
+        r = requests.get(url + "/fleet-state", timeout=5)
+        assert r.status_code == 503 and r.json()["state"] == "warming"
+        # non-health endpoints also refuse while warming (no 500s)
+        assert requests.get(url + "/list-jobs", timeout=5).status_code == 503
+        bind_engine(srv, fleet.eng_a)
+        r = requests.get(url + "/healthz", timeout=5)
+        assert r.status_code == 200
+        assert r.json() == {"ok": True, "state": "ready", "v": 1}
+        srv.draining = True
+        r = requests.get(url + "/healthz", timeout=5)
+        assert r.status_code == 503 and r.json()["state"] == "draining"
+        r = requests.get(url + "/fleet-state", timeout=5)
+        assert r.status_code == 503 and r.json()["draining"] is True
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_degradation_new_replica_answers_old_router(fleet):
+    """Vice-versa skew: an old router knows only GET /healthz — a new
+    replica still answers it with the legacy 'ok' contract."""
+    doc = requests.get(fleet.url_a + "/healthz", timeout=5).json()
+    assert doc["ok"] is True
+    # and the fleet frame is additive on top, not instead
+    state = requests.get(fleet.url_a + "/fleet-state", timeout=5).json()
+    assert state["t"] == "fleet_state" and state["ok"] is True
+    assert frames.load_score(state["load"]) >= 0
+
+
+def test_fleet_snapshot_doctor_and_metrics(fleet):
+    doc = fleet.sdk.get_fleet()
+    assert doc["n_replicas"] == 2 and doc["n_healthy"] == 2
+    assert doc["doctor"]["verdict"] == "healthy"
+    assert {r["rid"] for r in doc["replicas"]} == {"r0", "r1"}
+    assert all(r["fleet_protocol"] for r in doc["replicas"])
+    r = requests.get(fleet.furl + "/healthz", timeout=5)
+    assert r.status_code == 200 and r.json()["role"] == "fleet-router"
+    if telemetry.ENABLED:
+        text = requests.get(fleet.furl + "/metrics", timeout=5).text
+        assert 'sutro_fleet_replicas{state="healthy"} 2' in text
+
+
+def test_routed_batch_submit_progress_and_results(fleet):
+    jid = fleet.sdk.infer(
+        [f"fleet row {i}" for i in range(6)],
+        model="tiny-dense",
+        stay_attached=False,
+        sampling_params={"max_new_tokens": 5, "temperature": 0.0},
+    )
+    assert fleet.router.job_owner(jid) in ("r0", "r1")
+    df = fleet.sdk.await_job_completion(jid, timeout=300)
+    assert df is not None and len(df) == 6
+    assert fleet.router.counters["batch_routed"] >= 1
+    # job-scoped GETs route through the front door too
+    assert (
+        fleet.sdk.get_job_status(jid) == JobStatus.SUCCEEDED.value
+    )
+    rec = fleet.sdk._fetch_job(jid)
+    assert rec["num_rows"] == 6
+
+
+def test_interactive_routes_to_warm_replica(fleet):
+    """Warm-prefix affinity: a live chat session's KV pins follow-up
+    turns to the replica that holds it (probe_warm counts a session as
+    warmth), tie-breaking least-loaded for cold traffic."""
+    body = {
+        "model": "tiny-dense",
+        "messages": [{"role": "user", "content": "affinity probe turn"}],
+        "session_id": "fleet-affinity-sess",
+        "max_tokens": 4,
+        "temperature": 0,
+    }
+    # warm replica B directly (not through the router)
+    r = requests.post(
+        fleet.url_b + "/v1/chat/completions", json=body, timeout=120
+    )
+    assert r.status_code == 200
+    follow = dict(
+        body,
+        messages=[{"role": "user", "content": "second turn, same session"}],
+    )
+    cands, scores = fleet.router.candidates_interactive(follow, chat=True)
+    assert scores["r1"] > 0 and scores.get("r0", 0) == 0
+    assert cands[0]["rid"] == "r1"
+    before = fleet.router.counters["prefix_hits"]
+    r = requests.post(
+        fleet.furl + "/v1/chat/completions", json=follow, timeout=120
+    )
+    assert r.status_code == 200 and r.json()["choices"]
+    assert fleet.router.counters["prefix_hits"] == before + 1
+
+
+def test_route_fault_retries_on_next_replica_before_first_token(fleet):
+    """fleet.route failing the chosen replica pre-connect is invisible
+    to the client: the request lands on the next candidate."""
+    before = dict(fleet.router.counters)
+    faults.configure("fleet.route:error:nth=1,times=1")
+    try:
+        r = requests.post(
+            fleet.furl + "/v1/chat/completions",
+            json={
+                "model": "tiny-dense",
+                "messages": [{"role": "user", "content": "retry me"}],
+                "max_tokens": 4,
+            },
+            timeout=120,
+        )
+    finally:
+        faults.clear()
+    assert r.status_code == 200 and r.json()["choices"]
+    after = fleet.router.counters
+    assert after["failover_interactive"] == before["failover_interactive"] + 1
+    assert after["interactive_routed"] == before["interactive_routed"] + 1
+
+
+def test_drain_excludes_replica_without_failover(fleet):
+    """SIGTERM drain integration: a draining replica is alive-but-
+    unroutable — new work flows to its peers and no failover fires."""
+    failovers_before = fleet.router.counters["failover_batch"]
+    try:
+        resp = requests.get(fleet.url_a + "/fleet-state", timeout=5)
+        assert resp.status_code == 200
+        # the flag the SIGTERM drain path flips (gateway.begin_drain);
+        # the HTTP loop stays up so probes see alive-but-draining
+        fleet.eng_a.gateway.begin_drain()
+        _wait(
+            lambda: fleet.router.membership.snapshot()["n_draining"] == 1,
+            timeout=15, what="router to observe the drain",
+        )
+        snap = fleet.router.membership.snapshot()
+        r0 = next(r for r in snap["replicas"] if r["rid"] == "r0")
+        assert r0["draining"] and r0["state"] == "closed"
+        assert snap["n_healthy"] == 1
+        assert fleet.router.snapshot()["doctor"]["verdict"] == (
+            "fleet_degraded"
+        )
+        jid = fleet.sdk.infer(
+            ["drained row"], model="tiny-dense", stay_attached=False,
+            sampling_params={"max_new_tokens": 4, "temperature": 0.0},
+        )
+        assert fleet.router.job_owner(jid) == "r1"
+        fleet.sdk.await_job_completion(
+            jid, timeout=300, obtain_results=False
+        )
+    finally:
+        fleet.eng_a.gateway.draining = False
+    _wait(
+        lambda: fleet.router.membership.snapshot()["n_healthy"] == 2,
+        timeout=15, what="replica to rejoin after drain",
+    )
+    assert fleet.router.counters["failover_batch"] == failovers_before
+
+
+def test_degradation_legacy_replica_routes_probe_only(fleet):
+    """Old replica vs new router, end to end: a replica whose server
+    404s the fleet endpoints still serves traffic — probed via
+    /healthz, excluded from warm affinity, counted probe_only."""
+    eng = fleet.eng_b
+
+    class LegacyHandler(EngineHTTPHandler):
+        engine = eng
+
+        def do_GET(self):  # noqa: N802
+            head = self.path.split("?")[0].strip("/").partition("/")[0]
+            if head == "fleet-state":
+                self._error(404, f"Unknown endpoint GET /{head}")
+                return
+            super().do_GET()
+
+        def do_POST(self):  # noqa: N802
+            head = self.path.split("?")[0].strip("/").partition("/")[0]
+            if head == "fleet-warm":
+                self._error(404, f"Unknown endpoint POST /{head}")
+                return
+            super().do_POST()
+
+    from http.server import ThreadingHTTPServer
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), LegacyHandler)
+    srv.daemon_threads = True
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    legacy_url = f"http://127.0.0.1:{srv.server_address[1]}"
+    router2, fsrv2, _, furl2 = start_fleet_thread(
+        [legacy_url], probe_interval=0.2
+    )
+    try:
+        _wait(
+            lambda: router2.membership.snapshot()["n_healthy"] == 1,
+            timeout=15, what="legacy replica probed healthy",
+        )
+        row = router2.membership.get("r0")
+        assert not row["fleet_protocol"] and not row["warm_probe"]
+        r = requests.post(
+            furl2 + "/v1/chat/completions",
+            json={
+                "model": "tiny-dense",
+                "messages": [{"role": "user", "content": "legacy route"}],
+                "max_tokens": 4,
+            },
+            timeout=120,
+        )
+        assert r.status_code == 200 and r.json()["choices"]
+        assert router2.counters["probe_only_routes"] >= 1
+        assert router2.counters["prefix_hits"] == 0
+    finally:
+        router2.stop()
+        fsrv2.shutdown()
+        srv.shutdown()
+        srv.server_close()
+
+
+# ---------------------------------------------------------------------
+# 4. chaos: replica death mid-stream and mid-batch-job
+# ---------------------------------------------------------------------
+
+
+def test_chaos_midstream_crash_yields_structured_error_not_hang(fleet):
+    """A replica that dies AFTER the first streamed token cannot be
+    retried transparently (tokens would replay): the client gets a
+    structured SSE error frame + [DONE] within the stall timeout."""
+    srv, _, url = start_server_thread(fleet.eng_a)
+    router2, fsrv2, _, furl2 = start_fleet_thread(
+        [url], probe_interval=0.2, stall_timeout=10.0
+    )
+    try:
+        _wait(
+            lambda: router2.membership.snapshot()["n_healthy"] == 1,
+            timeout=15, what="replica healthy",
+        )
+        # warm the STREAMED interactive path (compiles + first-token
+        # latency) so the faulted request below emits token frames
+        # promptly instead of heartbeat pings — the fleet.replica_crash
+        # site counts every streamed object, pings included, so a cold
+        # stream would spend the nth budget on pings
+        warm = requests.post(
+            furl2 + "/v1/chat/completions",
+            json={
+                "model": "tiny-dense",
+                "messages": [{"role": "user", "content": "warmup"}],
+                "max_tokens": 4,
+                "stream": True,
+            },
+            stream=True,
+            timeout=120,
+        )
+        assert warm.status_code == 200
+        warm_lines = [ln for ln in warm.iter_lines() if ln]
+        assert warm_lines[-1] == b"data: [DONE]"
+        faults.install(faults.parse_plan(json.dumps([
+            {"site": "fleet.replica_crash", "kind": "crash",
+             "job": "stream:", "nth": 3, "times": 1}
+        ])))
+        t0 = time.monotonic()
+        r = requests.post(
+            furl2 + "/v1/chat/completions",
+            json={
+                "model": "tiny-dense",
+                "messages": [{"role": "user", "content": "stream then die"}],
+                "max_tokens": 8,
+                "stream": True,
+            },
+            stream=True,
+            timeout=(5, 60),
+        )
+        assert r.status_code == 200
+        lines = [
+            ln.decode() for ln in r.iter_lines() if ln
+        ]
+        elapsed = time.monotonic() - t0
+    finally:
+        faults.clear()
+        router2.stop()
+        fsrv2.shutdown()
+        srv.shutdown()
+        srv.server_close()
+    # at least one real frame relayed before the crash
+    assert any(
+        ln.startswith("data: {") and "error" not in ln for ln in lines
+    )
+    err_lines = [ln for ln in lines if '"error"' in ln]
+    assert err_lines, f"no structured error frame in {lines}"
+    err = json.loads(err_lines[-1][len("data: "):])["error"]
+    assert err["code"] == 502 and err["replica"] == "r0"
+    assert lines[-1] == "data: [DONE]"
+    # bounded: well inside stall_timeout + slack, never a silent hang
+    assert elapsed < 30.0
+    assert router2.counters["failover_stream_error"] == 1
+    assert fleet.router.counters["failover_stream_error"] == 0  # isolated
+
+
+def test_chaos_replica_kill_mid_job_fails_over_bit_identical(fleet):
+    """THE acceptance gate: kill a replica mid-batch-job; the router's
+    breaker opens, the job resumes on a healthy replica through the
+    shared jobstore, finishes SUCCEEDED with zero lost or duplicated
+    rows, and (temperature 0) results are bit-identical to an
+    un-killed run."""
+    n = 12
+    payload = {
+        "model": "tiny-dense",
+        "inputs": [f"failover row {i}" for i in range(n)],
+        "sampling_params": {"max_new_tokens": 5, "temperature": 0.0},
+        "job_priority": 0,
+    }
+    # reference: the same rows, no faults, straight on engine B
+    jid_ref = fleet.eng_b.submit_batch_inference(dict(payload))
+    _wait(
+        lambda: JobStatus(fleet.eng_b.job_status(jid_ref)).is_terminal(),
+        timeout=300, what="reference job",
+    )
+    assert fleet.eng_b.job_status(jid_ref) == JobStatus.SUCCEEDED.value
+    ref = fleet.eng_b.job_results(jid_ref)["outputs"]
+
+    srv_a, _, url_a = start_server_thread(fleet.eng_a)
+    srv_b, _, url_b = start_server_thread(fleet.eng_b)
+    servers = {"r0": srv_a, "r1": srv_b}
+    router2, fsrv2, _, furl2 = start_fleet_thread(
+        [url_a, url_b], probe_interval=0.2
+    )
+    from sutro_tpu.sdk import Sutro
+
+    sdk2 = Sutro(api_key="k", base_url=furl2, backend="fleet")
+    store = fleet.eng_b.jobs  # either handle: the jobstore is shared
+    try:
+        _wait(
+            lambda: router2.membership.snapshot()["n_healthy"] == 2,
+            timeout=15, what="both replicas healthy",
+        )
+        # the job dies on its first owner after partial progress
+        faults.configure("runner.decode:oom:nth=2,times=1")
+        jid = sdk2.infer(
+            payload["inputs"], model="tiny-dense", stay_attached=False,
+            sampling_params=payload["sampling_params"],
+        )
+        owner = router2.job_owner(jid)
+        assert owner in ("r0", "r1")
+        survivor = "r1" if owner == "r0" else "r0"
+        _wait(
+            lambda: store.status(jid) == JobStatus.FAILED,
+            timeout=300, what="job to fail on its first owner",
+        )
+        faults.clear()
+        # rows completed before the fault are already in the shared
+        # partial store — the resumed run must skip, not regenerate
+        partial_rows = set(store.read_partial(jid).keys())
+        # now the replica actually dies (connection refused)
+        servers[owner].shutdown()
+        servers[owner].server_close()
+        _wait(
+            lambda: router2.counters["failover_batch"] >= 1,
+            timeout=60, what="router to fail the job over",
+        )
+        assert router2.job_owner(jid) == survivor
+        _wait(
+            lambda: sdk2.get_job_status(jid)
+            == JobStatus.SUCCEEDED.value,
+            timeout=300, what="failed-over job to succeed",
+        )
+        snap = router2.snapshot()
+        assert snap["n_healthy"] == 1
+        assert snap["doctor"]["verdict"] != "healthy"
+        assert telemetry is not None  # counters live on the router too
+        assert snap["failovers"]["batch"] >= 1
+        # zero rows lost, zero duplicated (chunk-granular first-result-
+        # wins over the shared store)
+        df = store.read_results(jid)
+        assert sorted(df["row_id"].tolist()) == list(range(n))
+        # bit-identical to the un-killed reference at temperature 0
+        assert fleet.eng_b.job_results(jid)["outputs"] == ref
+        if partial_rows:
+            # the pre-crash partials survived as-is into the final set
+            assert partial_rows <= set(df["row_id"].tolist())
+    finally:
+        faults.clear()
+        router2.stop()
+        fsrv2.shutdown()
+        for srv in servers.values():
+            try:
+                srv.shutdown()
+                srv.server_close()
+            except OSError:
+                pass
+
+
+def test_chaos_sdk_progress_reconnects_with_cursor(fleet):
+    """Satellite: the SDK's progress tail survives a daemon restart —
+    reconnect with ?cursor resumes the stream monotonically instead of
+    raising or replaying rows."""
+    port = free_low_port()
+    srv, _, url = start_server_thread(fleet.eng_b, port=port)
+    from sutro_tpu.sdk import Sutro
+
+    sdk3 = Sutro(api_key="k", base_url=url, backend="remote")
+    restarted = []
+    try:
+        jid = sdk3.infer(
+            [f"reconnect row {i}" for i in range(24)],
+            model="tiny-dense", stay_attached=False,
+            sampling_params={"max_new_tokens": 8, "temperature": 0.0},
+        )
+        # the replica crashes mid-progress-stream (no terminal frame),
+        # taking its HTTP loop down with it
+        faults.install(faults.parse_plan(json.dumps([
+            {"site": "fleet.replica_crash", "kind": "crash",
+             "job": "stream:" + jid, "nth": 3, "times": 1}
+        ])))
+
+        def restarter():
+            # the crashed server's listen socket stays bound (only the
+            # accept loop died), so liveness needs a served exchange,
+            # not a bare connect
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                try:
+                    requests.get(url + "/healthz", timeout=(0.5, 0.5))
+                    time.sleep(0.02)
+                except requests.RequestException:
+                    break
+            else:
+                return
+            faults.clear()
+            srv.server_close()
+            restarted.append(start_server_thread(fleet.eng_b, port=port))
+
+        t = threading.Thread(target=restarter, daemon=True)
+        t.start()
+        progress = []
+        for update in sdk3._iter_progress(jid):
+            if update.get("update_type") == "progress":
+                progress.append(int(update.get("result") or 0))
+        t.join(timeout=60)
+        assert restarted, "server was never restarted (crash not fired?)"
+        # monotone across the reconnect: the cursor suppressed replays
+        assert progress and all(
+            b >= a for a, b in zip(progress, progress[1:])
+        )
+        sdk3.await_job_completion(jid, timeout=300, obtain_results=False)
+        assert sdk3.get_job_status(jid) == JobStatus.SUCCEEDED.value
+    finally:
+        faults.clear()
+        for extra in restarted:
+            extra[0].shutdown()
+            extra[0].server_close()
+        try:
+            srv.shutdown()
+            srv.server_close()
+        except OSError:
+            pass
+
+
+def test_cli_fleet_status_renders_router_snapshot(fleet, monkeypatch):
+    from click.testing import CliRunner
+
+    from sutro_tpu import cli as cli_mod
+
+    runner = CliRunner()
+    out = runner.invoke(
+        cli_mod.cli, ["set-base-url", fleet.furl],
+    )
+    assert out.exit_code == 0
+    out = runner.invoke(cli_mod.cli, ["set-backend", "fleet"])
+    assert out.exit_code == 0
+    out = runner.invoke(cli_mod.cli, ["fleet", "status", "--json"])
+    assert out.exit_code == 0, out.output
+    doc = json.loads(out.output)
+    assert doc["n_replicas"] == 2
+    out = runner.invoke(cli_mod.cli, ["fleet", "status"])
+    assert out.exit_code == 0, out.output
+    assert "verdict" in out.output

@@ -486,6 +486,54 @@ def test_hibernate_resume_bit_identical_int8(
     assert pool.pages("host") == 0 and b._hibernated == {}
 
 
+@pytest.mark.slow  # multi-device XLA compiles: excluded from the
+#   single-process tier-1 run (in-process compile accumulation is
+#   what trips this host's XLA:CPU flake, see run_tests_chunked.sh);
+#   the chunked full-suite CI runs it per-file
+def test_hibernate_sharded_runner_pure_upload_bit_identical(
+    byte_tok, mktier, eight_devices
+):
+    """ROADMAP KV follow-up 3: hibernation is no longer gated on
+    sp==pp==1. On a ring-attention sp=2 mesh the slot captures its
+    pages CEIL-aligned — the partial tail page rides along — so resume
+    is a PURE page upload with no sub-page tail prefill (a sharded
+    prefill cannot start mid-sequence). Outputs bit-identical to the
+    uninterrupted run on the same mesh."""
+    from sutro_tpu.engine.runner import ModelRunner
+    from sutro_tpu.parallel.mesh import make_mesh
+
+    ecfg = EngineConfig(
+        kv_page_size=8, max_pages_per_seq=16, decode_batch_size=4,
+        max_model_len=128, use_pallas=False, param_dtype="float32",
+        activation_dtype="float32", kv_quantize="int8",
+        interactive_slots=2,
+    )
+    runner = ModelRunner(
+        MODEL_CONFIGS["tiny-dense"], ecfg,
+        mesh=make_mesh(1, 1, 1, eight_devices[:2], sp=2),
+    )
+    assert runner.sp == 2 and runner.pp == 1
+    _, r_solo = _run(
+        _batcher(runner, byte_tok),
+        _reqs(byte_tok, max_new_tokens=24, temperature=0.0),
+    )
+    _, r_isolo = _run(
+        _batcher(runner, byte_tok),
+        _reqs(byte_tok, tails=["quick probe"], row_base=100,
+              max_new_tokens=4, temperature=0.0),
+    )
+    pool = mktier(8, host_pages=256)
+    state, bctx, got, igot, b = _preempt_session(runner, byte_tok, pool)
+    assert state == "completed"
+    assert b._can_hibernate  # the sp==pp==1 gate is gone
+    assert {i: r.token_ids for i, r in got.items()} == r_solo
+    assert {i: r.token_ids for i, r in igot.items()} == r_isolo
+    assert bctx.stats.get("resumes_upload", 0) >= 1
+    # the whole point of ceil-aligned capture: nothing re-prefills
+    assert bctx.stats.get("resumes_reprefill", 0) == 0
+    assert pool.pages("host") == 0 and b._hibernated == {}
+
+
 def test_torn_hibernation_demote_falls_back_to_regenerate(
     int8_runner, byte_tok, mktier
 ):
