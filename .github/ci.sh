@@ -50,6 +50,12 @@ make tier-check
 # degradation to probe-only routing, and the per-request routing-
 # decision host budget (zero telemetry ops when off)
 make fleet-check
+# tier-1 gate: server-side stage graphs — DAG validation (structured
+# INVALID_GRAPH 400), generate->score->rank bit-identity vs the
+# client-side sequence at temp 0, streaming inter-stage admission,
+# per-stage quarantine, crash/resume replaying only missing stage
+# chunks, and the zero-overhead census for stage-less jobs
+make graph-check
 # warn-only: bench-artifact trend report (never fails the build)
 make bench-trend
 # tier-1 gate: interactive tier CPU smoke — TTFT/ITL legs + the

@@ -6,8 +6,9 @@ PY ?= python
 
 .PHONY: all native test test-oneshot test-fast compile-check lint lint-baseline \
 	lint-schema chaos telemetry-check monitor-check control-check control-bench \
-	prefix-check tier-check fleet-check bench bench-e2e bench-fleet serve-bench \
-	bench-trend dryrun chip-validate bench-8b cost golden host-profile clean
+	prefix-check tier-check fleet-check graph-check bench bench-e2e bench-fleet \
+	serve-bench bench-trend dryrun chip-validate bench-8b cost golden \
+	host-profile clean
 
 all: native compile-check
 
@@ -154,6 +155,19 @@ fleet-check:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet.py \
 		-q -m "not slow" -p no:cacheprovider
 	JAX_PLATFORMS=cpu $(PY) benchmarks/profile_host_overhead.py --fleet
+
+# stage-graph gate (README "Stage graphs"): submit-time DAG validation
+# (structured INVALID_GRAPH through API + SDK), generate->score->rank
+# bit-identity vs the client-side job sequence at temp 0, streaming
+# inter-stage admission (downstream first result before upstream done,
+# asserted via stage spans), per-stage quarantine propagation, DAG
+# crash/resume chaos (only missing stage chunks replayed), the elo
+# tie-break pin, and the --stagegraph zero-overhead op census for
+# stage-less jobs. Tier-1 CI.
+graph-check:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_stagegraph.py \
+		tests/test_evals.py -q -m "not slow" -p no:cacheprovider
+	JAX_PLATFORMS=cpu $(PY) benchmarks/profile_host_overhead.py --stagegraph
 
 # replica-fleet scaling bench -> BENCH_FLEET.json: 1- vs 3-replica
 # batch throughput through the router (device-time-emulating stub

@@ -82,6 +82,12 @@ E2E_METRICS = (
     ("rows_per_hour", True),
     ("tok_s_per_chip", True),
     ("usd_per_1m_tokens", False),
+    # rank_elo stage-graph tournament leg (bench_e2e.py): one-submit
+    # DAG throughput and the prefix tokens it saves over the
+    # client-side sequential loop. Warn-only unless a --characterize
+    # run measures them stable enough to gate.
+    ("server_rows_per_hour", True),
+    ("server_prefill_tokens_saved", True),
 )
 INTERACTIVE_METRICS = (
     (("legs", "idle", "ttft_p99_s"), False),

@@ -1200,6 +1200,195 @@ def run_fleet_census(assert_budget: bool) -> dict:
     return out
 
 
+def run_stagegraph_census(assert_budget: bool) -> dict:
+    """Stage-graph subsystem host overhead for jobs that DON'T use it.
+
+    The off switch contract (README "Stage graphs"): a plain payload —
+    no ``stages`` key — must run byte-identical on the wire and
+    bit-identical in results, and the only host work the subsystem may
+    add to it is the submit-path presence checks. The accounting:
+
+    - one warm + best-of-3 plain 512-row e2e legs give the base us/row;
+    - a counted plain leg wraps every stage-graph entry point
+      (``parse_graph``/``graph_cost_bounds``/``initial_stages_state``,
+      ``StageGraphRunner`` construction, the ``stage_progress`` frame
+      constructor, and the metrics-bus ``stages`` publish) and must
+      fire ZERO of them — "no stages means no stage-graph work" is
+      asserted, not assumed;
+    - the checks a plain job DOES pay (``payload.get("stages")`` at
+      submit, the two ``graph is not None`` pricing branches, and the
+      ``rec.stages`` dispatch test in the worker) are tight-loop
+      priced; per-JOB cost / 512 rows is asserted against the same
+      <=TEL_OVERHEAD_MAX envelope as telemetry;
+    - a positive control runs a real 2-stage graph under the same
+      census and must fire the parse/runner/publish entry points —
+      proving the census actually watches the paths it claims to.
+    """
+    import tempfile
+    from types import SimpleNamespace
+
+    import sutro_tpu.engine.api as api_mod
+    import sutro_tpu.engine.metrics as metrics_mod
+    import sutro_tpu.engine.stageframes as sgf
+    import sutro_tpu.engine.stagegraph as sg
+    from sutro_tpu.engine.config import EngineConfig
+    from sutro_tpu.models.configs import MODEL_CONFIGS
+
+    ecfg = EngineConfig(
+        kv_page_size=16,
+        max_pages_per_seq=32,
+        decode_batch_size=64,
+        max_model_len=512,
+        use_pallas=False,
+        param_dtype="float32",
+        decode_multi_step=16,
+        decode_lookahead=2,
+        max_new_tokens=32,
+    )
+    tmp = tempfile.mkdtemp(prefix="sutro-stage-profile-")
+    eng = _e2e_engine(tmp, ecfg)
+    warm_admit_buckets(MODEL_CONFIGS["tiny-dense"].vocab_size, ecfg)
+    _run_e2e_leg(eng, api_mod, 128, {}, max_new=32)  # warm leg
+
+    counts = {
+        "parse_graph": 0,
+        "graph_cost_bounds": 0,
+        "initial_stages_state": 0,
+        "runner_init": 0,
+        "stage_frame": 0,
+        "bus_stages": 0,
+    }
+    # module-function shims: api.py imports these inside the call, and
+    # metrics.py resolves its module-global at call time, so patching
+    # the module attributes intercepts every live call site
+    restore = []
+
+    def _wrap_fn(mod, name, key):
+        orig = getattr(mod, name)
+
+        def counting(*a, _orig=orig, _key=key, **kw):
+            counts[_key] += 1
+            return _orig(*a, **kw)
+
+        setattr(mod, name, counting)
+        restore.append((mod, name, orig))
+
+    orig_runner_init = sg.StageGraphRunner.__init__
+
+    def counting_init(self, *a, **kw):
+        counts["runner_init"] += 1
+        return orig_runner_init(self, *a, **kw)
+
+    orig_bus_stages = metrics_mod.JobMetrics.stages
+
+    def counting_stages(self, *a, **kw):
+        counts["bus_stages"] += 1
+        return orig_bus_stages(self, *a, **kw)
+
+    _wrap_fn(sg, "parse_graph", "parse_graph")
+    _wrap_fn(sg, "graph_cost_bounds", "graph_cost_bounds")
+    _wrap_fn(sg, "initial_stages_state", "initial_stages_state")
+    _wrap_fn(sgf, "stage_progress_frame", "stage_frame")
+    _wrap_fn(metrics_mod, "stage_progress_frame", "stage_frame")
+    sg.StageGraphRunner.__init__ = counting_init
+    metrics_mod.JobMetrics.stages = counting_stages
+    try:
+        legs = [
+            _run_e2e_leg(eng, api_mod, 512, {}, max_new=32)
+            for _ in range(3)
+        ]
+        # all three plain legs ran under the census: zero-op check
+        # covers the measured runs themselves, not a separate pass
+        plain_counts = dict(counts)
+        for key in counts:
+            counts[key] = 0
+        # positive control: the census must see a graph job's parse,
+        # pricing, runner dispatch and per-stage rollup publishes
+        stages_payload = {
+            "stages": [
+                {
+                    "name": "gen",
+                    "kind": "map",
+                    "sampling_params": {"max_new_tokens": 8},
+                },
+                {
+                    "name": "score",
+                    "kind": "map",
+                    "after": ["gen"],
+                    "prompt_template": "score this: {input}",
+                    "sampling_params": {"max_new_tokens": 4},
+                },
+            ]
+        }
+        _run_e2e_leg(eng, api_mod, 16, stages_payload, max_new=8)
+        graph_counts = dict(counts)
+    finally:
+        for mod, name, orig in restore:
+            setattr(mod, name, orig)
+        sg.StageGraphRunner.__init__ = orig_runner_init
+        metrics_mod.JobMetrics.stages = orig_bus_stages
+        eng.close()
+
+    plain_ops = sum(plain_counts.values())
+    base_us = min(leg["us_per_row"] for leg in legs)
+    # the per-JOB cost a plain payload pays for the subsystem existing:
+    # one payload.get at submit, two `graph is not None` branch tests
+    # on the pricing path, one rec.stages dispatch test in the worker
+    probe_payload = {"model": "tiny-dense", "inputs": ["x"],
+                    "sampling_params": {"max_new_tokens": 4}}
+    probe_rec = SimpleNamespace(stages=None)
+    graph_obj = None
+    check_us = (
+        _unit_us(lambda: probe_payload.get("stages") is not None)
+        + 2 * _unit_us(lambda: graph_obj is not None)
+        + _unit_us(lambda: probe_rec.stages is not None)
+    )
+    added_us_per_row = check_us / 512.0
+    ratio = (base_us + added_us_per_row) / base_us
+
+    out = {
+        "plain_us_per_row": base_us,
+        "stageless_check_us_per_job": round(check_us, 4),
+        "added_us_per_row": round(added_us_per_row, 6),
+        "plain_leg_ops_fired": plain_ops,
+        "graph_leg_ops_fired": {
+            k: v for k, v in graph_counts.items() if v
+        },
+        "overhead_ratio": round(ratio, 4),
+        "budget_ratio": TEL_OVERHEAD_MAX,
+        "ok": bool(
+            ratio <= TEL_OVERHEAD_MAX
+            and plain_ops == 0
+            and graph_counts["parse_graph"] > 0
+            and graph_counts["runner_init"] > 0
+            and graph_counts["bus_stages"] > 0
+        ),
+    }
+    if assert_budget:
+        assert plain_ops == 0, (
+            f"plain (stage-less) legs fired stage-graph ops: "
+            f"{plain_counts} — no stages must mean no stage-graph work"
+        )
+        assert ratio <= TEL_OVERHEAD_MAX, (
+            f"stage-graph presence checks add {added_us_per_row:.4f} "
+            f"us/row on a {base_us} us/row baseline "
+            f"(ratio {ratio:.4f} > {TEL_OVERHEAD_MAX})"
+        )
+        assert graph_counts["parse_graph"] > 0, (
+            "census positive control: graph submit did not hit "
+            "parse_graph — the census is not watching the live paths"
+        )
+        assert graph_counts["runner_init"] > 0, (
+            "census positive control: graph job did not construct a "
+            "StageGraphRunner"
+        )
+        assert graph_counts["bus_stages"] > 0, (
+            "census positive control: graph job published no per-stage "
+            "rollups to the metrics bus"
+        )
+    return out
+
+
 def run_control_compare(assert_budget: bool) -> dict:
     """Control-plane (engine/control.py) host overhead + zero-cost-off.
 
@@ -1406,6 +1595,25 @@ def main() -> None:
         base["fleet"] = fleet
         path.write_text(json.dumps(base, indent=2) + "\n")
         print(json.dumps({"fleet_overhead": fleet}))
+        return
+
+    if "--stagegraph" in sys.argv:
+        # standalone gate (make graph-check): stage-graph subsystem
+        # must cost stage-less jobs nothing but the submit-path
+        # presence checks; merge into HOST_OVERHEAD.json
+        stage = run_stagegraph_census(
+            assert_budget="--no-assert" not in sys.argv
+        )
+        path = REPO / "HOST_OVERHEAD.json"
+        base = {}
+        if path.exists():
+            try:
+                base = json.loads(path.read_text())
+            except ValueError:
+                base = {}
+        base["stagegraph"] = stage
+        path.write_text(json.dumps(base, indent=2) + "\n")
+        print(json.dumps({"stagegraph_overhead": stage}))
         return
 
     if "--control" in sys.argv:

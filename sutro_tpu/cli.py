@@ -570,6 +570,26 @@ def jobs_status(job_id: str) -> None:
     sdk = get_sdk()
     out = sdk.get_job_status(job_id, with_failure_log=True)
     click.echo(out["status"])
+    # stage-graph rollup: best-effort decoration, same contract as the
+    # fleet view below — a plain job (or an old daemon without stage
+    # fields) prints nothing extra
+    try:
+        rec = sdk._fetch_job(job_id)
+        stages_state = rec.get("stages_state") or {}
+    except Exception:  # graftlint: disable=silent-except
+        stages_state = {}
+    if stages_state:
+        click.echo(to_colored_text("stages:", "callout"))
+        for sname, s in stages_state.items():
+            bits = [
+                f"  {sname}",
+                f"[{s.get('kind', 'map')}]",
+                str(s.get("status", "?")),
+                f"{s.get('rows_done', 0)}/{s.get('rows_total', 0)} rows",
+            ]
+            if s.get("quarantined"):
+                bits.append(f"{s['quarantined']} quarantined")
+            click.echo(" ".join(bits))
     try:
         fleet = sdk.get_job_fleet(job_id)
     # the fleet view is best-effort decoration on the status output: an

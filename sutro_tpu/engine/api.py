@@ -265,6 +265,21 @@ class LocalEngine:
         job_priority = self.jobs.validate_priority(
             payload.get("job_priority", 0)
         )
+        # Stage-graph jobs (engine/stagegraph.py): validate the DAG
+        # BEFORE any record exists — a cyclic or dangling-edge graph is
+        # a structured InvalidGraph -> HTTP 400, mirroring the
+        # InvalidPriority contract above. A stage-less payload takes
+        # none of these branches (off switch: byte-identical wire,
+        # bit-identical results).
+        graph = None
+        if payload.get("stages") is not None:
+            from .stagegraph import graph_cost_bounds, initial_stages_state
+            from .stagegraph import parse_graph
+
+            graph = parse_graph(
+                payload["stages"], default_model=model,
+                resolve=resolve_model,
+            )
         rec = self.jobs.create(
             name=payload.get("name"),
             description=payload.get("description"),
@@ -281,6 +296,11 @@ class LocalEngine:
                 payload.get("random_seed_per_input", False)
             ),
             tenant=tenant,
+            stages=graph.to_payload() if graph is not None else None,
+            stages_state=(
+                initial_stages_state(graph, len(inputs))
+                if graph is not None else None
+            ),
         )
         if telemetry.ENABLED:
             # tenant attribution starts at submit: the identity rides
@@ -301,10 +321,22 @@ class LocalEngine:
         overhead = len(
             (rec.system_prompt or "").encode("utf-8")
         ) + 64  # per-row chat-template + system-prompt bound
+        if graph is not None:
+            # price the WHOLE DAG at submit: downstream map stages add
+            # their own input (bounded by upstream max_new + template
+            # overhead) and output tokens to the quota/admission draw
+            extra_in, extra_new = graph_cost_bounds(
+                graph, len(inputs), int(sampling["max_new_tokens"])
+            )
+            max_new_total += extra_new
+            overhead_extra = extra_in
+        else:
+            overhead_extra = 0
         bound = (
             sum(len(r.encode("utf-8")) for r in inputs)
             + len(inputs) * overhead
             + max_new_total
+            + overhead_extra
         )
         # row quota first on its own: tokenizing cannot change a
         # row-count failure, so never pay the exact pass for one
@@ -327,6 +359,7 @@ class LocalEngine:
                         )
                     )
                     + max_new_total
+                    + overhead_extra  # downstream stage inputs: bound only
                 )
                 quota_err = self.jobs.check_quota(
                     rec.job_priority, 0, exact
@@ -1175,6 +1208,15 @@ class LocalEngine:
             device_info = getattr(runner, "device_info", None)
             if device_info is not None:
                 telemetry.job(job_id).attrs["device"] = device_info()
+
+        if rec.stages:
+            # stage-graph job (engine/stagegraph.py): the whole DAG —
+            # map waves, host reduces, per-stage chunk stores, resume —
+            # runs inside the runner; same return contract as below
+            # (None, or the job's priority on yield)
+            from .stagegraph import StageGraphRunner
+
+            return StageGraphRunner(self, job_id, rec).run()
 
         if rec.dry_run or mcfg.head == "embedding":
             inputs = self.jobs.read_inputs(job_id)

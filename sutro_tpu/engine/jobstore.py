@@ -159,6 +159,14 @@ class JobRecord:
     tenant: Optional[str] = None
     # per-job latency profile (engine/profiling.py StepTimer.summary())
     perf: Optional[Dict[str, Any]] = None
+    # Stage-graph job (engine/stagegraph.py): the validated stage list
+    # exactly as submitted (None for plain jobs — the off switch), plus
+    # a durable per-stage rollup {name: {status, rows_done, rows_total,
+    # quarantined}} updated as stage chunks finalize. Both ride the
+    # record's forward-compatible JSON (get() filters unknown keys), so
+    # old records and stage-less jobs round-trip untouched.
+    stages: Optional[List[Dict[str, Any]]] = None
+    stages_state: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

@@ -25,16 +25,22 @@ import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional
 
+from .stageframes import stage_progress_frame
+
 
 class _Sub:
     """One subscriber's conflated mailbox (O(1) pending state)."""
 
-    __slots__ = ("cond", "progress", "tokens", "done")
+    __slots__ = ("cond", "progress", "tokens", "stages", "done")
 
     def __init__(self, cond: threading.Condition) -> None:
         self.cond = cond
         self.progress: Optional[int] = None
         self.tokens: Optional[Dict[str, Any]] = None
+        # stage-graph per-stage rollup: conflates by dict-merge keyed on
+        # stage name (each stage's entry replaces wholesale — per-stage
+        # counts are monotonic, so latest-wins is the milestone contract)
+        self.stages: Optional[Dict[str, Any]] = None
         self.done = False
 
 
@@ -42,6 +48,7 @@ class JobMetrics:
     def __init__(self) -> None:
         self.lock = threading.Lock()
         self.latest_tokens: Dict[str, Any] = {}
+        self.latest_stages: Dict[str, Any] = {}
         self.rows_completed = 0
         self.done = False
         self._subscribers: List[_Sub] = []
@@ -65,6 +72,20 @@ class JobMetrics:
                     s.tokens.update(result)
                 s.cond.notify_all()
 
+    def stages(self, result: Dict[str, Any]) -> None:
+        """Publish a per-stage progress rollup ``{stage_name: {...}}``.
+
+        Conflating like :meth:`tokens` — a slow NDJSON consumer sees the
+        freshest per-stage counters, not every intermediate chunk."""
+        with self.lock:
+            self.latest_stages.update(result)
+            for s in self._subscribers:
+                if s.stages is None:
+                    s.stages = dict(result)
+                else:
+                    s.stages.update(result)
+                s.cond.notify_all()
+
     def finish(self) -> None:
         with self.lock:
             self.done = True
@@ -82,12 +103,17 @@ class JobMetrics:
         with self.lock:
             snapshot_rows = self.rows_completed
             snapshot_tokens = dict(self.latest_tokens)
+            snapshot_stages = dict(self.latest_stages)
             already_done = self.done
             self._subscribers.append(sub)
         try:
             yield {"update_type": "progress", "result": snapshot_rows}
             if snapshot_tokens:
                 yield {"update_type": "tokens", "result": snapshot_tokens}
+            if snapshot_stages:
+                # typed wire frame (engine/stageframes.py): carries
+                # update_type so pre-stage-graph readers skip it
+                yield stage_progress_frame(snapshot_stages)
             if already_done:
                 return
             while True:
@@ -95,16 +121,21 @@ class JobMetrics:
                     while (
                         sub.progress is None
                         and sub.tokens is None
+                        and sub.stages is None
                         and not sub.done
                     ):
                         cond.wait()
                     prog, toks, done = sub.progress, sub.tokens, sub.done
+                    stgs = sub.stages
                     sub.progress = None
                     sub.tokens = None
+                    sub.stages = None
                 if prog is not None:
                     yield {"update_type": "progress", "result": prog}
                 if toks is not None:
                     yield {"update_type": "tokens", "result": toks}
+                if stgs is not None:
+                    yield stage_progress_frame(stgs)
                 if done:
                     return
         finally:

@@ -285,6 +285,12 @@ class JobCtx:
     # turn resumes by prefix hit (and tier promotion once the pages
     # demote) instead of re-prefilling the whole conversation.
     kv_checkpoint: bool = False
+    # Stage-graph streaming handoff (engine/stagegraph.py): a downstream
+    # stage's ctx starts with an EMPTY pending list and is fed rows as
+    # upstream chunks finalize. ``hold_open() -> True`` keeps _sweep_done
+    # from declaring the ctx complete while its feeders still run; the
+    # executor flips it False once every upstream stage has drained.
+    hold_open: Optional[Callable[[], bool]] = None
     # -- internal session state --
     prefix: Optional[_SharedPrefix] = None
     prefix_ready: bool = False  # _setup_prefix attempted (lazily, at
@@ -2588,6 +2594,10 @@ class ContinuousBatcher:
     def _sweep_done(self, live: List[JobCtx], on_job_done) -> None:
         for ctx in live:
             if not ctx.done and not ctx.pending and ctx.n_slots == 0:
+                if ctx.hold_open is not None and ctx.hold_open():
+                    # stage-graph downstream ctx: drained for NOW, but
+                    # upstream feeders are still producing rows
+                    continue
                 self._finish_job(ctx, "completed", on_job_done)
 
     def _interactive_slots_used(self) -> int:
@@ -3252,6 +3262,13 @@ class ContinuousBatcher:
                     for ctx in live:
                         if not ctx.done:
                             self._job_progress(ctx)
+                    if not admitted and not any(
+                        s is not None for s in self.slots
+                    ) and all(not c.pending for c in live if not c.done):
+                        # Only held-open stage-graph ctxs remain and no
+                        # feeder can run on THIS thread until poll_new /
+                        # cancel checks fire — doze instead of spinning.
+                        time.sleep(0.0005)
                     continue
                 if self.native is not None:
                     # dense arrays live in the C++ core, always current

@@ -83,6 +83,24 @@ class BaseSutroClient(Protocol):
     ) -> Any:
         ...
 
+    def run_graph(
+        self,
+        data: Any,
+        stages: List[Dict[str, Any]],
+        model: str = "qwen-3-4b",
+        column: Optional[Union[str, List[str]]] = None,
+        output_column: str = "inference_result",
+        job_priority: int = 0,
+        name: Optional[str] = None,
+        description: Optional[str] = None,
+        dry_run: bool = False,
+        stay_attached: Optional[bool] = None,
+        truncate_rows: bool = True,
+        sampling_params: Optional[Dict[str, Any]] = None,
+        tenant: Optional[str] = None,
+    ) -> Any:
+        ...
+
     def await_job_completion(
         self,
         job_id: str,

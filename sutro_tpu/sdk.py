@@ -183,6 +183,7 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
         random_seed_per_input: bool,
         sampling_params: Optional[Dict[str, Any]],
         tenant: Optional[str] = None,
+        stages: Optional[List[Dict[str, Any]]] = None,
     ) -> Any:
         if name and len(name) > MAX_NAME_LENGTH:
             raise ValueError(
@@ -208,6 +209,10 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
             "sampling_params": sampling_params,
             "tenant": tenant,
         }
+        if stages is not None:
+            # key only present for stage-graph jobs: a plain submit's
+            # wire payload stays byte-identical (the DAG off switch)
+            payload["stages"] = stages
 
         if self.backend == "remote":
             resp = self.do_request("post", "batch-inference", json=payload)
@@ -224,6 +229,15 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
 
                     hi = (err.get("valid_range") or [0, 0])[1]
                     raise InvalidPriority(err.get("priority"), hi + 1)
+                if err.get("code") == "INVALID_GRAPH":
+                    # same typed-error parity for stage graphs: remote
+                    # and local backends raise one exception shape
+                    from .engine.stagegraph import InvalidGraph
+
+                    raise InvalidGraph(
+                        err.get("reason") or "invalid",
+                        err.get("message") or "invalid stage graph",
+                    )
             resp.raise_for_status()
             job_id = resp.json()["results"]
         else:
@@ -288,6 +302,25 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
         total = rec.get("num_rows", 0) or 0
         pbar = fancy_tqdm(total=total, desc="Rows", color="blue")
         token_state: Dict[str, Any] = {}
+        stage_state: Dict[str, Any] = {}
+
+        def postfix() -> None:
+            parts = []
+            tps = token_state.get("total_tokens_processed_per_second")
+            if tps is not None:
+                parts.append(f"{tps:,.0f} tok/s")
+            if stage_state:
+                # per-stage rollup (stage-graph jobs): gen 12/50 ...
+                parts.append(
+                    " ".join(
+                        f"{n} {s.get('rows_done', 0)}/"
+                        f"{s.get('rows_total', 0)}"
+                        for n, s in stage_state.items()
+                    )
+                )
+            if parts:
+                pbar.set_postfix_str(" | ".join(parts))
+
         try:
             for update in self._iter_progress(job_id):
                 if update.get("update_type") == "progress":
@@ -296,11 +329,16 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
                 elif update.get("update_type") == "tokens":
                     # partial dicts merge monotonically (sdk.py:354-363)
                     token_state.update(update.get("result") or {})
-                    tps = token_state.get(
-                        "total_tokens_processed_per_second"
-                    )
-                    if tps is not None:
-                        pbar.set_postfix_str(f"{tps:,.0f} tok/s")
+                    postfix()
+                elif update.get("update_type") == "stages":
+                    # conflating per-stage counters (metrics bus
+                    # "stages" channel, stage_progress wire frame) —
+                    # latest rollup wins; tolerant parse so a newer
+                    # engine's extra keys never break the bar
+                    from .engine.stageframes import parse_stage_progress
+
+                    stage_state.update(parse_stage_progress(update) or {})
+                    postfix()
         finally:
             pbar.close()
 
@@ -555,6 +593,75 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
             random_seed_per_input=random_seed_per_input,
             sampling_params=sampling_params,
             tenant=tenant,
+        )
+
+    def run_graph(
+        self,
+        data: Any,
+        stages: List[Dict[str, Any]],
+        model: ModelOptions = "gpt-oss-20b",
+        column: Optional[Union[str, List[Any]]] = None,
+        output_column: str = "inference_result",
+        job_priority: int = 0,
+        name: Optional[str] = None,
+        description: Optional[str] = None,
+        dry_run: bool = False,
+        stay_attached: Optional[bool] = None,
+        truncate_rows: bool = True,
+        sampling_params: Optional[Dict[str, Any]] = None,
+        tenant: Optional[str] = None,
+    ) -> Any:
+        """Submit a stage-graph job: a small DAG of stages executed
+        entirely server-side as ONE job (engine/stagegraph.py).
+
+        ``stages`` is a list of stage dicts — ``map`` stages carry
+        per-stage ``model`` / ``system_prompt`` / ``prompt_template``
+        (must contain ``{input}``) / ``output_schema`` /
+        ``sampling_params``; ``filter`` / ``elo`` / ``pair`` stages are
+        host-side reduces over their upstream stage. Edges are named in
+        ``after``; the single sink stage's rows become the job's
+        results. Rows stream between stages inside the engine (no
+        client round-trips, shared context rides the server's prefix
+        cache), the whole DAG is priced and quota-checked at submit,
+        and an invalid graph raises a structured ``InvalidGraph``
+        (HTTP 400 ``INVALID_GRAPH`` for remote backends).
+
+        Example — rank + ELO in one submit::
+
+            so.run_graph(df, column="pair", stages=[
+                {"name": "rank", "kind": "map",
+                 "system_prompt": "You are an expert evaluator...",
+                 "output_schema": {...}},
+                {"name": "elo", "kind": "elo", "after": ["rank"]},
+            ])
+        """
+        if stay_attached is None:
+            stay_attached = job_priority == 0
+        norm = []
+        for s in stages:
+            s = dict(s) if isinstance(s, dict) else s
+            if isinstance(s, dict) and s.get("output_schema") is not None:
+                s["output_schema"] = normalize_output_schema(
+                    s["output_schema"]
+                )
+            norm.append(s)
+        return self._run_one_batch_inference(
+            data=data,
+            model=model,
+            column=column,
+            output_column=output_column,
+            job_priority=job_priority,
+            output_schema=None,
+            system_prompt=None,
+            name=name,
+            description=description,
+            dry_run=dry_run,
+            stay_attached=stay_attached,
+            truncate_rows=truncate_rows,
+            random_seed_per_input=False,
+            sampling_params=sampling_params,
+            tenant=tenant,
+            stages=norm,
         )
 
     def infer_per_model(
@@ -985,7 +1092,9 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
             df = pd.DataFrame(cols)
             if not disable_cache:
                 # always cache (the reference's tracing-gated cache write,
-                # sdk.py:1172-1190, is a bug we don't reproduce)
+                # sdk.py:1172-1190, is a bug we don't reproduce); stage
+                # ids ("job-X/stages/rank") nest below the cache root
+                cache_path.parent.mkdir(parents=True, exist_ok=True)
                 df.to_parquet(cache_path)
             df = df.rename(columns={"outputs": output_column})
 

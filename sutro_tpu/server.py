@@ -253,6 +253,7 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
                 self._serve_openai(chat=False)
             elif head == "batch-inference":
                 from .engine.jobstore import InvalidPriority
+                from .engine.stagegraph import InvalidGraph
 
                 payload = self._read_json()
                 try:
@@ -270,6 +271,20 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
                                 "code": e.code,
                                 "priority": e.priority,
                                 "valid_range": [0, e.n_levels - 1],
+                            }
+                        },
+                        status=e.status,
+                    )
+                except InvalidGraph as e:
+                    # same contract for stage graphs: a cyclic or
+                    # dangling-edge DAG is a caller error with a
+                    # machine-readable reason, never a 500 traceback
+                    self._json(
+                        {
+                            "error": {
+                                "message": str(e),
+                                "code": e.code,
+                                "reason": e.reason,
                             }
                         },
                         status=e.status,

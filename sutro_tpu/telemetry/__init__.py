@@ -118,6 +118,14 @@ ROWS_TOTAL = REGISTRY.counter(
     labels=("outcome",),  # ok | quarantined | cancelled
     max_series=8,
 )
+STAGE_ROWS_TOTAL = REGISTRY.counter(
+    "sutro_stage_rows_total",
+    "Stage-graph rows completed per stage (engine/stagegraph.py); "
+    "labelled by the submit payload's stage name",
+    labels=("stage",),
+    unit="rows",
+    max_series=32,
+)
 TOKENS_TOTAL = REGISTRY.counter(
     "sutro_tokens_total",
     "Tokens processed by direction (accounted at job finalize)",

@@ -66,6 +66,7 @@ VERDICTS = (
     "insufficient_data",
     "warming_up",
     "interactive_starved",
+    "stage_starved",
     "straggler_worker",
     "io_bound",
     "host_bound_admit",
@@ -78,6 +79,12 @@ VERDICTS = (
 #: gateway TTFT threshold mirrored here for the evidence line
 #: (serving/gateway.py STARVED_TTFT_S stamps attrs["interactive"])
 INTERACTIVE_STARVED_TTFT_S = 5.0
+
+#: a stage-graph stage that spent more than this fraction of the job's
+#: wall waiting for its FIRST upstream row is starved (the streaming
+#: handoff degenerated into a barrier — engine/stagegraph.py stamps
+#: attrs["stages"][name]["starved_s"])
+STAGE_STARVED_FRAC = 0.5
 
 #: a decode window under this fraction of the HBM roofline is "below"
 ROOFLINE_OK_PCT = 40.0
@@ -479,6 +486,34 @@ def diagnose(
             )
             + ")"
         )
+
+    # stage starvation (stage-graph jobs): a downstream stage that sat
+    # idle waiting for its first upstream row for most of the job's
+    # wall — the streaming handoff degenerated into a full-stage
+    # barrier (upstream too slow, feed cadence too coarse, or a host
+    # stage blocking the chain)
+    sg = attrs.get("stages") or {}
+    if verdict is None and sg:
+        wall = max(
+            (s.get("done_s") or 0.0 for s in sg.values()), default=0.0
+        )
+        starved = [
+            (n, s.get("starved_s") or 0.0)
+            for n, s in sg.items()
+            if wall > 0
+            and (s.get("starved_s") or 0.0) >= STAGE_STARVED_FRAC * wall
+        ]
+        if starved:
+            verdict = "stage_starved"
+            worst = max(starved, key=lambda kv: kv[1])
+            evidence.append(
+                f"stage {worst[0]!r} waited {worst[1]:.3f}s for its "
+                f"first upstream row ({100 * worst[1] / wall:.0f}% of "
+                f"the {wall:.3f}s stage-graph wall, threshold "
+                f"{STAGE_STARVED_FRAC:.0%}): upstream decode dominates "
+                "the DAG — lower SUTRO_STAGE_FEED_EVERY, shrink the "
+                "upstream stage's max_new_tokens, or split the graph"
+            )
 
     # straggler: a rank whose wall dwarfs the median of the others
     walls = {

@@ -106,13 +106,23 @@ class Rank(BaseSutroClient):
         compute_elo: bool = False,
         output_column: str = "ranking",
         job_priority: int = 0,
+        server_side: bool = False,
         **kwargs: Any,
     ) -> Any:
         """Rank ``options`` (column names) for each row against ``criteria``.
 
         Rows are rendered as label-prefixed sections (reference
         evals.py:130-139); output is constrained to a permutation-ish array
-        of the labels."""
+        of the labels.
+
+        ``server_side=True`` with ``compute_elo=True`` submits the rank
+        map stage and the Elo reduce as ONE stage-graph job
+        (``so.run_graph``): the Elo table is computed inside the engine
+        from the rank stage's streamed rows — no client round-trip
+        between rank and Elo, one quota/admission draw for the whole
+        DAG. Results match the client-side path bit-for-bit at
+        temperature 0 (the Elo fit is the same deterministic code),
+        except the returned Elo frame has no ``strength`` column."""
         if not isinstance(data, pd.DataFrame):
             raise ValueError("rank requires a pandas DataFrame input")
         missing = [o for o in options if o not in data.columns]
@@ -136,6 +146,46 @@ class Rank(BaseSutroClient):
             "properties": {"ranking": _ranking_schema(options)},
             "required": ["ranking"],
         }
+        if compute_elo and server_side:
+            job_id = self.run_graph(
+                data,
+                stages=[
+                    {
+                        "name": "rank",
+                        "kind": "map",
+                        "system_prompt": system_prompt,
+                        "output_schema": output_schema,
+                    },
+                    {"name": "elo", "kind": "elo", "after": ["rank"]},
+                ],
+                model=model,
+                column=concat_parts,
+                job_priority=job_priority,
+                stay_attached=False,
+                **kwargs,
+            )
+            if job_id is None:
+                return None
+            # the sink (elo) stage's rows ARE the job's results
+            elo_df = self.await_job_completion(job_id, unpack_json=True)
+            if elo_df is None:
+                return None
+            # per-row rankings live in the rank stage's own result set
+            results = self.get_job_results(
+                f"{job_id}/stages/rank", unpack_json=True
+            )
+            if results is None:
+                return None
+            if "ranking" in results.columns and output_column != "ranking":
+                results = results.rename(columns={"ranking": output_column})
+            out = pd.concat(
+                [
+                    data.reset_index(drop=True),
+                    results.reset_index(drop=True),
+                ],
+                axis=1,
+            )
+            return out, elo_df
         job_id = self.infer(
             data,
             model=model,
@@ -230,9 +280,14 @@ class Rank(BaseSutroClient):
             p = p_new
 
         elo = base_rating + (k / np.log(10.0)) * np.log(p + 1e-12)
+        # deterministic tie-break: equal ratings order by player name —
+        # first-seen insertion order varied across pandas sort
+        # implementations, which made equal-win tables flap between runs
         df = pd.DataFrame(
             {"player": players, "elo": elo, "strength": p}
-        ).sort_values("elo", ascending=False, ignore_index=True)
+        ).sort_values(
+            ["elo", "player"], ascending=[False, True], ignore_index=True
+        )
         return df
 
 

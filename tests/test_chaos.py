@@ -748,3 +748,106 @@ def test_control_disabled_is_zero_cost_and_bit_identical(
     assert _wait_terminal(eng_on, jid) == JobStatus.SUCCEEDED
     assert eng_on.job_results(jid)["outputs"] == ref
     assert eng_on.control._drawn == {}  # terminal accounting settled
+
+
+# ---------------------------------------------------------------------------
+# stage-graph DAG faults (engine/stagegraph.py)
+# ---------------------------------------------------------------------------
+
+_DAG_STAGES = [
+    {"name": "gen", "kind": "map",
+     "sampling_params": {"max_new_tokens": 8}},
+    {"name": "score", "kind": "map", "after": ["gen"],
+     "prompt_template": "score this: {input}",
+     "sampling_params": {"max_new_tokens": 4}},
+]
+
+
+def _submit_graph(eng, n_rows=8):
+    return eng.submit_batch_inference(
+        {
+            "model": "tiny-dense",
+            "inputs": [f"chaos row {i}" for i in range(n_rows)],
+            "sampling_params": {"temperature": 0.0, "max_new_tokens": 8},
+            "job_priority": 0,
+            "stages": _DAG_STAGES,
+        }
+    )
+
+
+def _graph_reference(mkengine, n_rows=8):
+    eng = mkengine(plan=None)
+    jid = _submit_graph(eng, n_rows=n_rows)
+    assert _wait_terminal(eng, jid) == JobStatus.SUCCEEDED
+    return eng.job_results(jid)["outputs"]
+
+
+def test_stage_flush_fault_resume_replays_only_missing_chunks(mkengine):
+    """Scenario: a PERSISTENT partial-store fault scoped to the
+    DOWNSTREAM stage (the ``job=`` matcher keys on the nested stage job
+    id) fails the DAG after the upstream stage completed. Resume with
+    the fault cleared replays ONLY the missing stage's chunks — the
+    completed gen stage's chunk files are byte-for-byte untouched — and
+    the final results are bit-identical with zero lost/duplicated rows."""
+    from sutro_tpu.engine.stagegraph import stage_job_id
+
+    n = 8
+    ref = _graph_reference(mkengine, n_rows=n)
+    eng = mkengine(plan="jobstore.flush_partial:ioerror:job=stages/score")
+    jid = _submit_graph(eng, n_rows=n)
+    assert _wait_terminal(eng, jid) == JobStatus.FAILED
+    rec = eng.jobs.get(jid)
+    assert "injected ioerror" in rec.failure_reason["message"]
+    # the fault never touched the upstream stage: its rows are durable
+    gen_id = stage_job_id(jid, "gen")
+    gen_dir = eng.jobs._partial_dir(gen_id)
+    snap = {
+        p.name: (p.stat().st_mtime_ns, p.stat().st_size)
+        for p in gen_dir.iterdir()
+    }
+    assert snap  # gen flushed chunks before the DAG died
+    faults.clear()
+    out = eng.resume_job(jid)
+    assert out["resumed"] is True
+    assert _wait_terminal(eng, jid) == JobStatus.SUCCEEDED
+    assert eng.job_results(jid)["outputs"] == ref
+    # resume replayed ONLY the missing (score) chunks: every gen chunk
+    # file survives with the same mtime and size — never re-decoded,
+    # never re-flushed
+    assert {
+        p.name: (p.stat().st_mtime_ns, p.stat().st_size)
+        for p in gen_dir.iterdir()
+    } == snap
+    _assert_no_dup_no_drop(eng, jid, n)
+    _assert_no_dup_no_drop(eng, gen_id, n)
+    _assert_no_dup_no_drop(eng, stage_job_id(jid, "score"), n)
+
+
+def test_stage_row_decode_fault_quarantines_in_that_stage(mkengine):
+    """Scenario: a poison row in the DOWNSTREAM stage of a DAG is
+    quarantined THERE (row-level failure domain per stage): the parent
+    job still SUCCEEDs, the quarantine is attributed to the score stage
+    in the durable rollup, and every other row is bit-identical."""
+    from sutro_tpu.engine.stagegraph import stage_job_id
+
+    n = 8
+    ref = _graph_reference(mkengine, n_rows=n)
+    eng = mkengine(
+        plan="row.decode:error:rows=2,job=stages/score", row_retries=1
+    )
+    jid = _submit_graph(eng, n_rows=n)
+    assert _wait_terminal(eng, jid) == JobStatus.SUCCEEDED
+    res = eng.job_results(jid)
+    assert res["outputs"][2] is None
+    assert res["errors"][2] and "injected fault" in res["errors"][2]
+    for i in range(n):
+        if i != 2:
+            assert res["outputs"][i] == ref[i], f"row {i} diverged"
+    state = eng.jobs.get(jid).stages_state
+    assert state["gen"]["quarantined"] == 0
+    assert state["score"]["quarantined"] == 1
+    log = eng.jobs.get(stage_job_id(jid, "score")).failure_log or []
+    assert any(
+        e["event"] == "row_quarantined" and e["row_id"] == 2 for e in log
+    )
+    _assert_no_dup_no_drop(eng, jid, n)

@@ -31,6 +31,21 @@ def test_elo_tie_groups():
     assert abs(b - c) < 1.0
 
 
+def test_elo_tie_break_is_deterministic_alphabetical():
+    """Equal-strength players sort by name: the server-side elo stage
+    recomputes the table on resume, so the ordering must be a pure
+    function of the rankings — never dict/iteration order."""
+    # z and y are perfectly symmetric; first-appearance order says z
+    rankings = [["z", "y"], ["y", "z"]] * 3
+    df = Rank.elo(rankings)
+    assert list(df["player"]) == ["y", "z"]
+    # three-way tie behind a clear winner: the tied tail is alphabetical
+    df = Rank.elo([["w", ["c", "b", "d"]]] * 4)
+    assert list(df["player"]) == ["w", "b", "c", "d"]
+    # and the full frame is reproducible run to run
+    pd.testing.assert_frame_equal(Rank.elo(rankings), Rank.elo(rankings))
+
+
 def test_elo_json_string_rankings():
     df = Rank.elo(['["a","b"]', '["a","b"]', "not-json"])
     assert df["player"].iloc[0] == "a"

@@ -1,0 +1,485 @@
+"""Server-side stage graphs (engine/stagegraph.py): DAG batch jobs.
+
+Covers the acceptance contract end to end on the live tiny engine:
+
+- submit-time validation: every structural defect is a structured
+  ``InvalidGraph`` with a machine-readable ``reason``, surfaced as
+  HTTP 400 ``INVALID_GRAPH`` through the server and as a typed raise
+  through the SDK — never a 500 traceback or a half-created job;
+- a generate -> score -> rank chain submitted as ONE job is
+  bit-identical at temperature 0 to the client-side three-job
+  sequence, while the per-stage telemetry proves downstream stages
+  admitted rows BEFORE their upstream finished (no full-stage
+  barrier) and the shared system prompt rode the prefix store;
+- row failure domains stay row-level ACROSS stages: a poison row
+  quarantined in stage one propagates as an error placeholder (no LM
+  call downstream), recorded per stage in the parent failure_log;
+- host-side reduce stages (filter / pair / elo) are pure and
+  deterministic, so crash-resume recomputes them bit-identically;
+- whole-DAG pricing: dry_run charges every stage, not just the root.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from sutro_tpu.engine import faults
+from sutro_tpu.engine.stagegraph import (
+    InvalidGraph,
+    StageSpec,
+    _parse_rankings,
+    estimate_stage_rows,
+    graph_cost_bounds,
+    initial_stages_state,
+    parse_graph,
+    run_host_stage_kind,
+    stage_job_id,
+)
+from sutro_tpu.interfaces import JobStatus
+
+
+def _wait_terminal(eng, job_id, timeout=180):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = JobStatus(eng.job_status(job_id))
+        if st.is_terminal() and st != JobStatus.CANCELLING:
+            return st
+        time.sleep(0.05)
+    raise TimeoutError(f"{job_id} not terminal within {timeout}s")
+
+
+def _submit(eng, inputs, stages=None, max_new=16, **kw):
+    payload = {
+        "model": "tiny-dense",
+        "inputs": list(inputs),
+        "sampling_params": {"temperature": 0.0, "max_new_tokens": max_new},
+        "job_priority": 0,
+    }
+    if stages is not None:
+        payload["stages"] = stages
+    payload.update(kw)
+    return eng.submit_batch_inference(payload)
+
+
+# ---------------------------------------------------------------------------
+# parse_graph: structured validation reasons
+# ---------------------------------------------------------------------------
+
+
+def _parse(stages, resolve=None):
+    return parse_graph(stages, default_model="tiny-dense", resolve=resolve)
+
+
+@pytest.mark.parametrize(
+    "stages, reason",
+    [
+        ("not a list", "not_a_list"),
+        ([], "not_a_list"),
+        (
+            [{"name": f"s{i}", "kind": "map",
+              "after": [f"s{i - 1}"] if i else []} for i in range(17)],
+            "too_many_stages",
+        ),
+        (["nope"], "not_a_dict"),
+        # the name becomes a jobstore sub-directory: traversal must die
+        # at validation, not at path-join time
+        ([{"name": "../escape", "kind": "map"}], "bad_name"),
+        ([{"kind": "map"}], "bad_name"),
+        (
+            [{"name": "a", "kind": "map"},
+             {"name": "a", "kind": "map", "after": ["a"]}],
+            "duplicate_name",
+        ),
+        ([{"name": "a", "kind": "reduce"}], "bad_kind"),
+        ([{"name": "a", "kind": "map", "after": 7}], "bad_after"),
+        (
+            [{"name": "a", "kind": "map"}, {"name": "b", "kind": "map"},
+             {"name": "c", "kind": "map", "after": ["a", "b"]}],
+            "multi_parent_unsupported",
+        ),
+        ([{"name": "f", "kind": "filter"}], "missing_parent"),
+        ([{"name": "e", "kind": "elo"}], "missing_parent"),
+        (
+            [{"name": "a", "kind": "map",
+              "prompt_template": "no placeholder"}],
+            "bad_template",
+        ),
+        (
+            [{"name": "a", "kind": "map"},
+             {"name": "f", "kind": "filter", "after": ["a"],
+              "predicate": {"type": "regex"}}],
+            "bad_predicate",
+        ),
+        (
+            [{"name": "a", "kind": "map", "after": ["ghost"]}],
+            "dangling_edge",
+        ),
+        ([{"name": "a", "kind": "map", "after": ["a"]}], "cycle"),
+        (
+            [{"name": "a", "kind": "map", "after": ["b"]},
+             {"name": "b", "kind": "map", "after": ["a"]}],
+            "cycle",
+        ),
+        (
+            [{"name": "a", "kind": "map"}, {"name": "b", "kind": "map"}],
+            "multiple_sinks",
+        ),
+    ],
+)
+def test_parse_graph_structured_reasons(stages, reason):
+    with pytest.raises(InvalidGraph) as e:
+        _parse(stages)
+    assert e.value.reason == reason
+    assert e.value.code == "INVALID_GRAPH"
+    assert e.value.status == 400
+
+
+def test_parse_graph_unknown_model_fails_at_submit():
+    def resolve(model):
+        if model != "tiny-dense":
+            raise ValueError(f"Unknown model {model!r}")
+
+    with pytest.raises(InvalidGraph) as e:
+        _parse(
+            [{"name": "a", "kind": "map", "model": "not-a-model"}],
+            resolve=resolve,
+        )
+    assert e.value.reason == "unknown_model"
+    # the default model fills unset map stages and must resolve too
+    g = _parse([{"name": "a", "kind": "map"}], resolve=resolve)
+    assert g.by_name["a"].model == "tiny-dense"
+
+
+def test_parse_graph_valid_chain_topo_and_estimates():
+    g = _parse(
+        [
+            # submit order deliberately scrambled: topo() must not care
+            {"name": "elo", "kind": "elo", "after": ["rank"]},
+            {"name": "rank", "kind": "map", "after": ["pairs"],
+             "prompt_template": "rank: {input}"},
+            {"name": "gen", "kind": "map"},
+            {"name": "keep", "kind": "filter", "after": ["gen"]},
+            {"name": "pairs", "kind": "pair", "after": ["keep"],
+             "max_pairs": 5},
+        ]
+    )
+    assert [s.name for s in g.topo()] == [
+        "gen", "keep", "pairs", "rank", "elo",
+    ]
+    assert g.sink == "elo"
+    rows = estimate_stage_rows(g, 8)
+    # filter/elo are bounded by their parent; pair is n*(n-1)/2 capped
+    assert rows == {"gen": 8, "keep": 8, "pairs": 5, "rank": 5, "elo": 5}
+    state = initial_stages_state(g, 8)
+    assert state["pairs"] == {
+        "status": "pending", "kind": "pair", "rows_done": 0,
+        "rows_total": 5, "quarantined": 0,
+    }
+    assert stage_job_id("job-1", "gen") == "job-1/stages/gen"
+    # wire round-trip: to_payload re-parses to the same graph
+    g2 = _parse(g.to_payload())
+    assert [s.name for s in g2.topo()] == [s.name for s in g.topo()]
+
+
+def test_graph_cost_bounds_price_downstream_stages():
+    chain = _parse(
+        [
+            {"name": "gen", "kind": "map",
+             "sampling_params": {"max_new_tokens": 16}},
+            {"name": "score", "kind": "map", "after": ["gen"],
+             "prompt_template": "score: {input}",
+             "sampling_params": {"max_new_tokens": 8}},
+        ]
+    )
+    extra_in, extra_new = graph_cost_bounds(chain, 10, 16)
+    # the score stage adds 10 prompts bounded by gen's max_new plus
+    # template overhead, and 10 * 8 output tokens
+    assert extra_in >= 10 * 16
+    assert extra_new == 10 * 8
+    # a single root map at the default cap adds nothing beyond the
+    # plain submit's own bound (the pricing side of the off switch)
+    single = _parse([{"name": "gen", "kind": "map"}])
+    assert graph_cost_bounds(single, 10, 16) == (0, 0)
+
+
+# ---------------------------------------------------------------------------
+# host stage kinds: pure, deterministic reduces
+# ---------------------------------------------------------------------------
+
+
+def _spec(d):
+    d.setdefault("after", ["up"])
+    return StageSpec({"name": d.pop("name", "host"), **d})
+
+
+def test_filter_stage_predicates():
+    rows = [(0, "short"), (1, "a much longer output"), (2, "x ok y")]
+    contains = _spec({"kind": "filter",
+                      "predicate": {"type": "contains", "value": "ok"}})
+    assert run_host_stage_kind(contains, rows) == ["x ok y"]
+    minlen = _spec({"kind": "filter",
+                    "predicate": {"type": "min_length", "value": 7}})
+    assert run_host_stage_kind(minlen, rows) == ["a much longer output"]
+    keep_all = _spec({"kind": "filter"})  # not_error: errors pre-dropped
+    assert run_host_stage_kind(keep_all, rows) == [o for _, o in rows]
+
+
+def test_pair_stage_round_robin_and_cap():
+    rows = [(0, "p"), (1, "q"), (3, "r")]
+    spec = _spec({"kind": "pair"})
+    pairs = [json.loads(p) for p in run_host_stage_kind(spec, rows)]
+    assert pairs == [
+        {"a": "p", "b": "q", "a_row": 0, "b_row": 1},
+        {"a": "p", "b": "r", "a_row": 0, "b_row": 3},
+        {"a": "q", "b": "r", "a_row": 1, "b_row": 3},
+    ]
+    capped = _spec({"kind": "pair", "max_pairs": 2})
+    assert len(run_host_stage_kind(capped, rows)) == 2
+
+
+def test_elo_stage_deterministic_and_tolerant():
+    outputs = [
+        (0, json.dumps({"ranking": ["a", "b"]})),
+        (1, json.dumps(["a", "b"])),       # bare-array form accepted
+        (2, "not json at all"),            # LM noise: skipped, not fatal
+        (3, json.dumps({"ranking": []})),  # empty ranking: skipped
+    ]
+    assert _parse_rankings([o for _, o in outputs]) == [
+        ["a", "b"], ["a", "b"],
+    ]
+    spec = _spec({"kind": "elo"})
+    rows = [json.loads(r) for r in run_host_stage_kind(spec, outputs)]
+    assert [r["player"] for r in rows] == ["a", "b"]
+    assert rows[0]["elo"] > rows[1]["elo"]
+    # resume recomputes host stages: byte-identical on a second run
+    assert run_host_stage_kind(spec, outputs) == run_host_stage_kind(
+        spec, outputs
+    )
+
+
+# ---------------------------------------------------------------------------
+# wire + SDK surfaces: structured 400, never a half-created job
+# ---------------------------------------------------------------------------
+
+_BAD_STAGES = [
+    {"name": "a", "kind": "map", "after": ["ghost"]},
+]
+
+
+def test_http_invalid_graph_is_structured_400(live_engine):
+    eng, url, _home = live_engine
+    before = {j["job_id"] for j in eng.list_jobs()}
+    req = urllib.request.Request(
+        url + "/batch-inference",
+        data=json.dumps(
+            {"model": "tiny-dense", "inputs": ["x"], "stages": _BAD_STAGES}
+        ).encode(),
+        headers={
+            "Content-Type": "application/json",
+            "Authorization": "Bearer test-key",
+        },
+    )
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=60)
+    assert e.value.code == 400
+    body = json.loads(e.value.read().decode())
+    assert body["error"]["code"] == "INVALID_GRAPH"
+    assert body["error"]["reason"] == "dangling_edge"
+    assert "ghost" in body["error"]["message"]
+    # validation ran BEFORE any record existed
+    assert {j["job_id"] for j in eng.list_jobs()} == before
+
+
+def test_sdk_run_graph_invalid_graph_typed_raise(live_engine, monkeypatch):
+    engine, _url, home = live_engine
+    monkeypatch.setenv("SUTRO_HOME", home)
+    from sutro_tpu.sdk import Sutro
+
+    so = Sutro(api_key="test-key")
+    so._engine = engine
+    with pytest.raises(InvalidGraph) as e:
+        so.run_graph(
+            ["x"],
+            stages=[
+                {"name": "a", "kind": "map", "after": ["b"]},
+                {"name": "b", "kind": "map", "after": ["a"]},
+            ],
+            model="tiny-dense",
+            stay_attached=False,
+        )
+    assert e.value.reason == "cycle"
+
+
+# ---------------------------------------------------------------------------
+# acceptance: one DAG job == the client-side job sequence, bit for bit
+# ---------------------------------------------------------------------------
+
+_SP_GEN = "You are a terse poet."
+_SP_SCORE = "You are a strict grader."
+
+
+def test_graph_chain_bit_identical_to_client_sequence(
+    live_engine, monkeypatch
+):
+    """generate -> score -> rank as ONE job: results bit-identical at
+    temperature 0 to three sequential client-side jobs, per-stage spans
+    prove streaming admission (score's first result lands before gen
+    finishes), and the shared system prompt pays prefix-store savings."""
+    eng, _url, _home = live_engine
+    # feed every row as it lands so inter-stage streaming is observable
+    # at this tiny row count (default cadence is 16). n deliberately
+    # NOT a multiple of decode_batch_size=4: admission drains jobs in
+    # seq order, so gen's final short batch leaves free slots that fed
+    # score rows claim while gen is still decoding — making the
+    # no-barrier overlap visible in completion times, not just feeds
+    monkeypatch.setenv("SUTRO_STAGE_FEED_EVERY", "1")
+    n = 10
+    inputs = [f"poem topic {i}" for i in range(n)]
+    jid = _submit(
+        eng, inputs,
+        stages=[
+            {"name": "gen", "kind": "map", "system_prompt": _SP_GEN,
+             "sampling_params": {"max_new_tokens": 16}},
+            {"name": "score", "kind": "map", "after": ["gen"],
+             "system_prompt": _SP_SCORE,
+             "prompt_template": "score this: {input}",
+             "sampling_params": {"max_new_tokens": 8}},
+            {"name": "rank", "kind": "map", "after": ["score"],
+             "prompt_template": "rank: {input}",
+             "sampling_params": {"max_new_tokens": 4}},
+        ],
+    )
+    assert _wait_terminal(eng, jid) == JobStatus.SUCCEEDED
+
+    # --- the client-side equivalent: three jobs, two round-trips ---
+    j1 = _submit(eng, inputs, max_new=16, system_prompt=_SP_GEN)
+    assert _wait_terminal(eng, j1) == JobStatus.SUCCEEDED
+    out1 = eng.job_results(j1)["outputs"]
+    j2 = _submit(
+        eng, [f"score this: {o}" for o in out1], max_new=8,
+        system_prompt=_SP_SCORE,
+    )
+    assert _wait_terminal(eng, j2) == JobStatus.SUCCEEDED
+    out2 = eng.job_results(j2)["outputs"]
+    j3 = _submit(eng, [f"rank: {o}" for o in out2], max_new=4)
+    assert _wait_terminal(eng, j3) == JobStatus.SUCCEEDED
+    out3 = eng.job_results(j3)["outputs"]
+
+    res = eng.job_results(jid)
+    assert res["outputs"] == out3          # the sink IS the job result
+    assert "errors" not in res
+    # intermediate stages are addressable jobs in their own right
+    assert eng.job_results(stage_job_id(jid, "gen"))["outputs"] == out1
+    assert eng.job_results(stage_job_id(jid, "score"))["outputs"] == out2
+
+    # durable per-stage rollup on the parent record
+    state = eng.jobs.get(jid).stages_state
+    assert set(state) == {"gen", "score", "rank"}
+    for name, entry in state.items():
+        assert entry["status"] == "succeeded", name
+        assert entry["rows_done"] == n
+        assert entry["quarantined"] == 0
+
+    from sutro_tpu import telemetry
+
+    spans = telemetry.job(jid).attrs["stages"]
+    # streaming admission observable (acceptance criterion): each
+    # downstream stage produced its FIRST row before its upstream
+    # produced its LAST — no full-stage barrier anywhere in the chain
+    assert spans["score"]["first_result_s"] < spans["gen"]["done_s"]
+    assert spans["rank"]["first_result_s"] < spans["score"]["done_s"]
+    # shared context rode the radix prefix store across rows/stages
+    prefix = telemetry.job(jid).attrs.get("prefix") or {}
+    assert prefix.get("saved_tokens", 0) > 0
+
+
+def test_graph_quarantine_propagates_per_stage(live_engine, monkeypatch):
+    """Row failure domains across stages: a row poisoned in gen is
+    quarantined THERE, skipped (no LM call) downstream with the drop
+    recorded per stage in the parent failure_log, and every other row
+    is bit-identical to the clean run."""
+    eng, _url, _home = live_engine
+    monkeypatch.setenv("SUTRO_STAGE_FEED_EVERY", "1")
+    n = 8
+    inputs = [f"quarantine row {i}" for i in range(n)]
+    stages = [
+        {"name": "gen", "kind": "map",
+         "sampling_params": {"max_new_tokens": 8}},
+        {"name": "score", "kind": "map", "after": ["gen"],
+         "prompt_template": "score this: {input}",
+         "sampling_params": {"max_new_tokens": 4}},
+    ]
+    ref_jid = _submit(eng, inputs, stages=stages)
+    assert _wait_terminal(eng, ref_jid) == JobStatus.SUCCEEDED
+    ref = eng.job_results(ref_jid)["outputs"]
+
+    # poison row 3 inside the gen stage only (job= matches the nested
+    # stage job id, so the score stage and plain jobs are untouched)
+    faults.configure("row.decode:error:rows=3,job=stages/gen")
+    try:
+        jid = _submit(eng, inputs, stages=stages)
+        assert _wait_terminal(eng, jid) == JobStatus.SUCCEEDED
+    finally:
+        faults.clear()
+    res = eng.job_results(jid)
+    assert res["outputs"][3] is None
+    assert res["errors"][3]
+    for i in range(n):
+        if i != 3:
+            assert res["outputs"][i] == ref[i], f"row {i} diverged"
+    state = eng.jobs.get(jid).stages_state
+    assert state["gen"]["quarantined"] == 1
+    assert state["score"]["quarantined"] == 1  # the propagated placeholder
+    log = eng.jobs.get(jid).failure_log or []
+    skips = [e for e in log if e["event"] == "stage_row_skipped"]
+    assert [(e["stage"], e["source_stage"], e["row_id"]) for e in skips] == [
+        ("score", "gen", 3)
+    ]
+
+
+def test_graph_dry_run_prices_whole_dag(live_engine):
+    """dry_run on a DAG charges every stage up front: the estimate is
+    strictly above the same submit without the downstream stage."""
+    eng, _url, _home = live_engine
+    inputs = [f"price row {i}" for i in range(10)]
+    plain = _submit(eng, inputs, dry_run=True)
+    assert _wait_terminal(eng, plain) == JobStatus.SUCCEEDED
+    graph = _submit(
+        eng, inputs, dry_run=True,
+        stages=[
+            {"name": "gen", "kind": "map"},
+            {"name": "score", "kind": "map", "after": ["gen"],
+             "prompt_template": "score this: {input}"},
+        ],
+    )
+    assert _wait_terminal(eng, graph) == JobStatus.SUCCEEDED
+    plain_est = eng.jobs.get(plain).cost_estimate
+    graph_est = eng.jobs.get(graph).cost_estimate
+    assert graph_est > plain_est > 0
+
+
+# ---------------------------------------------------------------------------
+# wire frames: the per-stage NDJSON progress record
+# ---------------------------------------------------------------------------
+
+
+def test_stage_progress_frame_roundtrip():
+    from sutro_tpu.engine.stageframes import (
+        parse_stage_progress,
+        rollup_counts,
+        stage_progress_frame,
+    )
+
+    roll = {
+        "gen": {"status": "running", "kind": "map", "rows_done": 3,
+                "rows_total": 8, "quarantined": 1},
+    }
+    frame = stage_progress_frame(roll)
+    assert frame["update_type"] == "stages"  # old readers skip, not die
+    assert parse_stage_progress(json.loads(json.dumps(frame))) == roll
+    assert parse_stage_progress({"update_type": "progress"}) is None
+    counts = rollup_counts(roll["gen"])
+    assert counts["rows_done"] == 3 and counts["quarantined"] == 1
