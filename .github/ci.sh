@@ -50,6 +50,15 @@ make tier-check
 # degradation to probe-only routing, and the per-request routing-
 # decision host budget (zero telemetry ops when off)
 make fleet-check
+# tier-1 gate: fleet observability plane — cross-replica trace
+# stitching (X-Sutro-Trace propagation, golden Perfetto export, no
+# negative gaps after skew re-anchoring), federated /metrics under the
+# replica label with the _fleet aggregate and exemplar trace ids, the
+# fleet monitor firing AND resolving stock SLO rules under live chaos,
+# protocol skew in both directions, the replay JSONL round-trip, and
+# the --fleet-obs census (zero obs ops and zero federation sends with
+# SUTRO_TELEMETRY=0)
+make fleet-obs-check
 # tier-1 gate: server-side stage graphs — DAG validation (structured
 # INVALID_GRAPH 400), generate->score->rank bit-identity vs the
 # client-side sequence at temp 0, streaming inter-stage admission,

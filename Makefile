@@ -6,9 +6,9 @@ PY ?= python
 
 .PHONY: all native test test-oneshot test-fast compile-check lint lint-baseline \
 	lint-schema chaos telemetry-check monitor-check control-check control-bench \
-	prefix-check tier-check fleet-check graph-check bench bench-e2e bench-fleet \
-	serve-bench bench-trend dryrun chip-validate bench-8b cost golden \
-	host-profile clean
+	prefix-check tier-check fleet-check fleet-obs-check graph-check bench \
+	bench-e2e bench-fleet bench-replay serve-bench bench-trend dryrun \
+	chip-validate bench-8b cost golden host-profile clean
 
 all: native compile-check
 
@@ -156,6 +156,21 @@ fleet-check:
 		-q -m "not slow" -p no:cacheprovider
 	JAX_PLATFORMS=cpu $(PY) benchmarks/profile_host_overhead.py --fleet
 
+# fleet-observability gate (OBSERVABILITY.md "Fleet observability"):
+# cross-replica trace propagation (X-Sutro-Trace forward + adoption,
+# stitched GET /trace/{id} with per-process lanes pinned by golden
+# export), federated /metrics under the replica label with the _fleet
+# aggregate + route-latency exemplars, fleet monitor SLO rules firing
+# AND resolving under live chaos, protocol skew both directions, the
+# replay capture/load round-trip — then the --fleet-obs op census
+# (per-request trace+exemplar cost under the same 2% host-overhead
+# envelope; zero ops and zero federation sends when telemetry off).
+# Tier-1 CI.
+fleet-obs-check:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet_obs.py \
+		-q -m "not slow" -p no:cacheprovider
+	JAX_PLATFORMS=cpu $(PY) benchmarks/profile_host_overhead.py --fleet-obs
+
 # stage-graph gate (README "Stage graphs"): submit-time DAG validation
 # (structured INVALID_GRAPH through API + SDK), generate->score->rank
 # bit-identity vs the client-side job sequence at temp 0, streaming
@@ -176,6 +191,16 @@ graph-check:
 # (~40 s wall) — run on fleet/router changes.
 bench-fleet:
 	JAX_PLATFORMS=cpu $(PY) benchmarks/bench_fleet.py
+
+# trace-replay load harness -> BENCH_REPLAY.json: replay the
+# deterministic session-heavy synthetic workload (same JSONL schema as
+# `sutro replay record`) open-loop against 1- vs 3-replica fleets at
+# SUTRO_REPLAY_SPEEDUP x (default 2); grades p99 TTFT, throughput
+# retention, and routed-prefix hit rate. Grades are warn-only in
+# `make bench-trend`; not tier-1 (~20 s wall) — run on fleet/router or
+# observability changes.
+bench-replay:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/bench_replay.py
 
 # raw decode microbench (one JSON line; driver contract)
 bench:

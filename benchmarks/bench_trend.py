@@ -119,6 +119,18 @@ FLEET_METRICS = (
     (("legs", "batch_1replica", "rows_per_s"), True),
     (("legs", "batch_3replica", "rows_per_s"), True),
 )
+# trace-replay legs (BENCH_REPLAY.json, `make bench-replay`): the
+# recorded-arrival workload replayed through 1- and 3-replica fleets.
+# Warn-only like the fleet legs: the hard obs gates are
+# tests/test_fleet_obs.py + the --fleet-obs op census.
+REPLAY_METRICS = (
+    (("grades", "ttft_p99_1replica_s"), False),
+    (("grades", "ttft_p99_3replica_s"), False),
+    (("grades", "throughput_retention_3v1"), True),
+    (("grades", "routed_prefix_hit_rate"), True),
+    (("legs", "replay_1replica", "rps"), True),
+    (("legs", "replay_3replica", "rps"), True),
+)
 
 
 def _load(path: Path):
@@ -204,6 +216,12 @@ def build_snapshot() -> dict:
             v = _dig(flt, path)
             if v is not None:
                 snap["fleet." + ".".join(path)] = v
+    rpl = _load(REPO / "BENCH_REPLAY.json")
+    if isinstance(rpl, dict):
+        for path, _hb in REPLAY_METRICS:
+            v = _dig(rpl, path)
+            if v is not None:
+                snap["replay." + ".".join(path)] = v
     return snap
 
 
@@ -217,6 +235,9 @@ def _direction(name: str) -> bool:
             return hb
     for path, hb in FLEET_METRICS:
         if name == "fleet." + ".".join(path):
+            return hb
+    for path, hb in REPLAY_METRICS:
+        if name == "replay." + ".".join(path):
             return hb
     return True
 

@@ -1200,6 +1200,209 @@ def run_fleet_census(assert_budget: bool) -> dict:
     return out
 
 
+def run_fleet_obs_census(assert_budget: bool) -> dict:
+    """Fleet observability-plane host cost + zero-op-when-off.
+
+    The obs plane (fleet/obs.py) adds per-REQUEST work to the router's
+    relay path — open a ring trace, record the route/affinity/upstream
+    spans, annotate the pick, observe route latency with an exemplar,
+    close the trace — and per-TICK work off the request path: the
+    cache-bounded federation sweep (one /metrics-snapshot scrape +
+    delta + double ingest per replica) that /metrics and the fleet
+    monitor share. The accounting:
+
+    - tight-loop pricing of the full per-request trace sequence on a
+      live FleetObservability (ring at capacity — eviction priced in);
+      the ratio against the cheapest request the router fronts (idle
+      interactive TTFT) must stay inside the same <=2% envelope as
+      telemetry, and the absolute cost under FLEET_ROUTE_BUDGET_US;
+    - the federation sweep is priced per tick over a 3-replica
+      membership with canned snapshot payloads (no sockets — the wire
+      cost is the replicas' problem, the fold is the router's) and
+      reported amortized over the scrape interval, informational;
+    - zero-op check (asserted): with telemetry disabled, the whole
+      surface — trace_begin (returns None), every span/event/annotate/
+      end on the None id, observe_route, refresh_router_gauges, and
+      federate — fires ZERO census ops and ZERO upstream sends;
+    - positive control: the counted on-leg must fire trace starts,
+      span adds, and exemplar-carrying observations, proving the
+      census watches the paths it claims to.
+    """
+    import sutro_tpu.telemetry as tel
+    import sutro_tpu.telemetry.distributed as tel_distributed
+    import sutro_tpu.telemetry.registry as tel_registry
+    import sutro_tpu.telemetry.spans as tel_spans
+    import sutro_tpu.telemetry.traces as tel_traces
+    from sutro_tpu.fleet import frames as fleet_frames
+    from sutro_tpu.fleet.membership import FleetMembership
+    from sutro_tpu.fleet.obs import FleetObservability
+    from sutro_tpu.fleet.replay import replay_attrs
+
+    n_replicas = 3
+    # canned per-replica snapshot: a representative registry shard
+    # (the fold cost scales with series count, so an empty one would
+    # flatter the budget)
+    tel.set_enabled(True)
+    sreg = tel.MetricsRegistry()
+    sc = sreg.counter("sutro_rows_total", labels=("outcome",))
+    sh = sreg.histogram(
+        "sutro_interactive_ttft_seconds", labels=("source",)
+    )
+    for i in range(64):
+        sc.inc(1.0, "o%d" % (i % 8))
+        sh.observe(0.001 * i, "s%d" % (i % 8))
+    snap_frame = fleet_frames.metrics_snapshot_frame(
+        0.0, sreg.export_snapshot()
+    )
+    sends = {"n": 0}
+
+    def canned_send(method, url, frame=None, timeout=2.0):
+        sends["n"] += 1
+        return dict(snap_frame)
+
+    def no_send(method, url, frame=None, timeout=2.0):
+        raise AssertionError(
+            "telemetry-off obs plane still sent %s %s" % (method, url)
+        )
+
+    m = FleetMembership(
+        ["http://10.0.0.%d:8642" % i for i in range(n_replicas)]
+    )
+    for i in range(n_replicas):
+        m.note_probe_success(
+            "r%d" % i,
+            {
+                "ready": True,
+                "draining": False,
+                "load": {},
+                "fleet_protocol": True,
+                "warm_probe": True,
+                "fleet_obs": True,
+            },
+        )
+    obs = FleetObservability(scrape_interval_s=0.0, send=canned_send)
+    body = {
+        "model": "tiny-dense",
+        "session_id": "bench-sess",
+        "messages": [{"role": "user", "content": "x" * 64}],
+        "stream": True,
+    }
+
+    def request_sequence():
+        """The exact obs calls _relay_interactive makes on a routed,
+        streamed request (fleet/router.py)."""
+        t0 = time.monotonic()
+        tid = obs.trace_begin(
+            "interactive", replay_attrs(body, True, True, 0.0, 128),
+            t0_mono=t0,
+        )
+        obs.span(tid, "affinity_probe", t0, 0.001, {"n_healthy": 3})
+        obs.span(tid, "route_pick", t0, 0.002, {"n_candidates": 3})
+        obs.span(tid, "upstream_connect", t0, 0.003,
+                 {"rid": "r1", "status": 200})
+        obs.annotate(tid, {"replica": "r1",
+                           "replica_url": "http://10.0.0.1:8642"})
+        obs.observe_route(0.004, "interactive", tid)
+        obs.event(tid, "first_byte", {"rid": "r1"})
+        obs.end(tid, "ok")
+
+    # warm the ring to capacity first so the priced path includes
+    # eviction — steady state, not the cheap fill phase
+    for _ in range(300):
+        request_sequence()
+    request_us = _unit_us(request_sequence, n=5000)
+    federate_us = _unit_us(
+        lambda: obs.federate(m), n=500
+    )
+    ratio = 1.0 + request_us / NOMINAL_INTERACTIVE_TTFT_US
+
+    mods = {
+        "registry": tel_registry,
+        "spans": tel_spans,
+        "distributed": tel_distributed,
+        "traces": tel_traces,
+    }
+    counts = {key: 0 for _, _, _, key in _TEL_OPS}
+    counts[_TEL_EXEMPLAR_KEY] = 0
+    was_enabled = tel.enabled()
+    try:
+        # positive control: the counted on-leg must visibly hit the
+        # trace + exemplar paths
+        tel.set_enabled(True)
+        with _Census(mods, counts):
+            request_sequence()
+            on_counts = dict(counts)
+            for key in counts:
+                counts[key] = 0
+            # zero-op + zero-send check: the whole surface, telemetry
+            # off (off_obs built while off, like a SUTRO_TELEMETRY=0
+            # router would)
+            tel.set_enabled(False)
+            off_obs = FleetObservability(
+                scrape_interval_s=0.0, send=no_send
+            )
+            tid = off_obs.trace_begin("interactive", {"k": "v"})
+            assert tid is None, "telemetry-off trace_begin minted an id"
+            off_obs.span(tid, "route_pick", 0.0, 0.001)
+            off_obs.event(tid, "first_byte")
+            off_obs.annotate(tid, {"replica": "r0"})
+            off_obs.observe_route(0.004, "interactive", tid)
+            off_obs.end(tid, "ok")
+            off_obs.refresh_router_gauges(m.snapshot())
+            assert off_obs.federate(m) == 0
+            off_counts = dict(counts)
+    finally:
+        tel.set_enabled(was_enabled)
+    off_ops = sum(off_counts.values())
+
+    out = {
+        "n_replicas": n_replicas,
+        "request_trace_us": round(request_us, 2),
+        "federate_us_per_tick": round(federate_us, 1),
+        "scrapes_sent": sends["n"],
+        "route_budget_us": FLEET_ROUTE_BUDGET_US,
+        "nominal_ttft_us": NOMINAL_INTERACTIVE_TTFT_US,
+        "overhead_ratio": round(ratio, 4),
+        "budget_ratio": TEL_OVERHEAD_MAX,
+        "on_op_counts": {k: v for k, v in on_counts.items() if v},
+        "disabled_ops_fired": off_ops,
+        "ok": bool(
+            request_us <= FLEET_ROUTE_BUDGET_US
+            and ratio <= TEL_OVERHEAD_MAX
+            and off_ops == 0
+            and on_counts["trace_start"] > 0
+            and on_counts["trace_add"] > 0
+            and on_counts[_TEL_EXEMPLAR_KEY] > 0
+        ),
+    }
+    if assert_budget:
+        assert off_ops == 0, (
+            f"telemetry-off obs plane fired census ops: {off_counts}"
+        )
+        assert request_us <= FLEET_ROUTE_BUDGET_US, (
+            f"per-request obs trace costs {request_us:.1f} us > "
+            f"budget {FLEET_ROUTE_BUDGET_US} us"
+        )
+        assert ratio <= TEL_OVERHEAD_MAX, (
+            f"obs plane adds {request_us:.1f} us on a "
+            f"{NOMINAL_INTERACTIVE_TTFT_US:.0f} us nominal request "
+            f"(ratio {ratio:.4f} > {TEL_OVERHEAD_MAX})"
+        )
+        assert on_counts["trace_start"] > 0, (
+            "census positive control: obs request sequence opened no "
+            "trace"
+        )
+        assert on_counts["trace_add"] > 0, (
+            "census positive control: obs request sequence recorded no "
+            "spans"
+        )
+        assert on_counts[_TEL_EXEMPLAR_KEY] > 0, (
+            "census positive control: observe_route carried no "
+            "exemplar trace id"
+        )
+    return out
+
+
 def run_stagegraph_census(assert_budget: bool) -> dict:
     """Stage-graph subsystem host overhead for jobs that DON'T use it.
 
@@ -1595,6 +1798,25 @@ def main() -> None:
         base["fleet"] = fleet
         path.write_text(json.dumps(base, indent=2) + "\n")
         print(json.dumps({"fleet_overhead": fleet}))
+        return
+
+    if "--fleet-obs" in sys.argv:
+        # standalone gate (make fleet-obs-check): per-request trace +
+        # federation fold cost + zero-op-when-off; merge into
+        # HOST_OVERHEAD.json
+        fobs = run_fleet_obs_census(
+            assert_budget="--no-assert" not in sys.argv
+        )
+        path = REPO / "HOST_OVERHEAD.json"
+        base = {}
+        if path.exists():
+            try:
+                base = json.loads(path.read_text())
+            except ValueError:
+                base = {}
+        base["fleet_obs"] = fobs
+        path.write_text(json.dumps(base, indent=2) + "\n")
+        print(json.dumps({"fleet_obs_overhead": fobs}))
         return
 
     if "--stagegraph" in sys.argv:

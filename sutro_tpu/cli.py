@@ -191,6 +191,181 @@ def fleet_status(as_json: bool) -> None:
         click.echo(to_colored_text(
             "counters: " + ", ".join(
                 f"{k}={v}" for k, v in sorted(counters.items())), ))
+    probe_only = doc.get("probe_only_routes")
+    if probe_only is not None:
+        click.echo(to_colored_text(
+            f"probe-only routes (affinity probe disagreed with pick): "
+            f"{probe_only}", ))
+    lat = doc.get("route_latency")
+    if lat:
+        click.echo(to_colored_text(
+            f"route latency: p50={lat.get('p50_s')}s "
+            f"p99={lat.get('p99_s')}s over {lat.get('count')} route(s)", ))
+
+
+@fleet.command("watch")
+@click.option("--interval", default=2.0, show_default=True,
+              help="Seconds between dashboard refreshes")
+@click.option("--once", is_flag=True,
+              help="Render one frame and exit (no screen clearing)")
+@click.option("--json", "as_json", is_flag=True,
+              help="Raw /fleet-monitor document instead of the dashboard")
+def fleet_watch(interval: float, once: bool, as_json: bool) -> None:
+    """Live fleet SLO dashboard over the router's fleet monitor
+    (OBSERVABILITY.md "Fleet observability"): fleet-wide TTFT/route
+    percentiles, failover and routed-prefix-hit rates, replica balance,
+    active alerts with exemplar trace ids, and the fleet doctor
+    verdict. Requires base_url to point at a ``sutro fleet`` router
+    with telemetry + monitor enabled."""
+    sdk = get_sdk()
+    while True:
+        try:
+            doc = sdk.get_fleet_monitor()
+        except KeyError as e:
+            click.echo(to_colored_text(f"✗ {e}", "fail"))
+            raise SystemExit(1)
+        except Exception as e:  # noqa: BLE001 — remote 404/conn errors
+            click.echo(to_colored_text(
+                f"✗ fleet monitor unavailable: {e}", "fail"))
+            raise SystemExit(1)
+        if doc is None:
+            click.echo(to_colored_text(
+                "no fleet router at this base_url (single daemon?)",
+                "fail"))
+            raise SystemExit(1)
+        if as_json:
+            click.echo(json.dumps(doc, indent=2))
+        else:
+            if not once:
+                click.clear()
+            _render_fleet_watch_frame(doc)
+        if once or as_json:
+            return
+        try:
+            time.sleep(max(interval, 0.1))
+        except KeyboardInterrupt:
+            return
+
+
+def _render_fleet_watch_frame(doc: dict) -> None:
+    stats = doc.get("stats") or {}
+    rates = stats.get("rates") or {}
+    gauges = stats.get("gauges") or {}
+    pcts = stats.get("percentiles") or {}
+    click.echo(to_colored_text(
+        f"sutro fleet watch — tick {doc.get('ticks')} · window "
+        f"{stats.get('window_s', 0)}s · interval {doc.get('interval_s')}s"
+        + (" · DEGRADED: " + str(doc["degraded"])
+           if doc.get("degraded") else ""),
+        "callout",
+    ))
+    row = {
+        "healthy": "%d/%d" % (
+            int(gauges.get("n_healthy", 0)),
+            int(gauges.get("n_replicas", 0)),
+        ),
+        "draining": int(gauges.get("n_draining", 0)),
+        "routed/s": rates.get("routed_per_s", 0.0),
+        "failover/s": rates.get("failovers_per_s", 0.0),
+    }
+    hit = rates.get("routed_prefix_hit_rate")
+    if hit is not None:
+        row["prefix hit"] = f"{hit:.0%}"
+    imbalance = gauges.get("replica_imbalance")
+    if imbalance is not None:
+        row["imbalance"] = f"{imbalance:.3g}x"
+    ttft, route = pcts.get("fleet_ttft"), pcts.get("fleet_route")
+    if ttft:
+        row["ttft p50/p99 (s)"] = (
+            f"{ttft['p50_s']:.3g}/{ttft.get('p99_s') or 0:.3g}"
+        )
+    if route:
+        row["route p99 (s)"] = f"{route.get('p99_s') or 0:.3g}"
+    click.echo(tabulate([row], headers="keys",
+                        tablefmt="rounded_outline"))
+    alerts = doc.get("alerts") or {}
+    active = alerts.get("active") or []
+    if active:
+        click.echo(to_colored_text(
+            f"⚠ {len(active)} alert(s) FIRING", "fail"))
+        for a in active:
+            click.echo(
+                f"  {a['name']} [{a['severity']}] {a['metric']} "
+                f"{a['op']} {a['threshold']} (value={a.get('value')})"
+            )
+    else:
+        click.echo(to_colored_text("no alerts firing", "success"))
+    events = (alerts.get("events") or [])[-5:]
+    if events:
+        click.echo("recent transitions:")
+        for ev in events:
+            line = (
+                f"  {ev['state']:>8}  {ev['rule']} "
+                f"(value={ev.get('value')})"
+            )
+            exemplars = ev.get("exemplar_trace_ids") or []
+            if exemplars:
+                line += " traces: " + ",".join(exemplars)
+            click.echo(line)
+    fleet_verdict = (doc.get("verdicts") or {}).get("fleet")
+    if fleet_verdict:
+        click.echo(to_colored_text(
+            f"fleet doctor: {fleet_verdict.get('verdict')}", "callout"))
+        for line in fleet_verdict.get("evidence") or ():
+            click.echo(f"  {line}")
+
+
+@cli.group()
+def replay() -> None:
+    """Trace-replay load harness: capture live traffic, replay it."""
+
+
+@replay.command("record")
+@click.option("-o", "--output", "output", required=True,
+              type=click.Path(dir_okay=False),
+              help="JSONL file to write replay records to")
+def replay_record(output: str) -> None:
+    """Drain the fleet router's trace ring into a replayable JSONL
+    workload (arrival offsets, session ids, request bodies — see
+    OBSERVABILITY.md "Fleet observability" for the record schema).
+    Requires base_url to point at a ``sutro fleet`` router."""
+    from .fleet import replay as replay_mod
+
+    records = get_sdk().get_replay_log()
+    if records is None:
+        click.echo(to_colored_text(
+            "no fleet router at this base_url (single daemon?)", "fail"))
+        sys.exit(1)
+    replay_mod.dump_jsonl(records, output)
+    n_bodies = len([r for r in records if r.get("body")])
+    click.echo(to_colored_text(
+        f"✔ wrote {len(records)} record(s) ({n_bodies} with replayable "
+        f"bodies) to {output}", "success"))
+
+
+@replay.command("run")
+@click.argument("workload", type=click.Path(exists=True, dir_okay=False))
+@click.option("--speedup", default=1.0, show_default=True,
+              help="Replay the arrival process this many times faster")
+@click.option("--timeout", default=300.0, show_default=True,
+              help="Per-request timeout (s)")
+def replay_run(workload: str, speedup: float, timeout: float) -> None:
+    """Replay a recorded JSONL workload against the current base_url,
+    honoring the captured arrival process (open-loop), and report
+    TTFT percentiles + error counts."""
+    from .fleet import replay as replay_mod
+
+    records = replay_mod.load_jsonl(workload)
+    if not records:
+        click.echo(to_colored_text("empty workload", "fail"))
+        sys.exit(1)
+    base = get_sdk().base_url.rstrip("/")
+    click.echo(to_colored_text(
+        f"replaying {len(records)} record(s) at {speedup}x against "
+        f"{base} ...", "callout"))
+    doc = replay_mod.replay(
+        base, records, speedup=speedup, timeout=timeout)
+    click.echo(json.dumps(doc, indent=2))
 
 
 @cli.command()

@@ -5,7 +5,10 @@ checked routing with per-replica circuit breakers (membership.py,
 health.py), SGLang-style warm-prefix affinity (affinity.py), and
 jobstore-backed batch failover with zero lost or duplicated rows
 (router.py). Wire frames between router and replica live in frames.py
-and are registered in the graftlint wire schema.
+and are registered in the graftlint wire schema. The observability
+plane (obs.py) adds cross-process trace stitching, federated metrics,
+and the fleet SLO monitor; replay.py turns the router's trace ring
+into a replayable load harness.
 
 Import surface is lazy on purpose: the router pulls in ``requests``
 and telemetry; replicas import only ``fleet.frames``.
@@ -16,6 +19,8 @@ from __future__ import annotations
 __all__ = [
     "FleetRouter",
     "FleetMembership",
+    "FleetMonitor",
+    "FleetObservability",
     "HealthProber",
     "WarmAffinity",
     "make_fleet_server",
@@ -30,6 +35,10 @@ def __getattr__(name: str):
         from . import router
 
         return getattr(router, name)
+    if name in ("FleetMonitor", "FleetObservability"):
+        from . import obs
+
+        return getattr(obs, name)
     if name == "FleetMembership":
         from .membership import FleetMembership
 

@@ -53,6 +53,9 @@ def fleet_state_frame(
         # feature flags the router gates on (additive: old routers
         # ignore them, old replicas simply don't send them)
         "warm_probe": True,
+        # observability plane: X-Sutro-Trace adoption plus the
+        # /metrics-snapshot and /trace-doc scrape endpoints
+        "fleet_obs": True,
     }
 
 
@@ -82,6 +85,38 @@ def warm_report_frame(warm_tokens: int, prompt_tokens: int) -> Dict[str, Any]:
     }
 
 
+def metrics_snapshot_frame(
+    epoch_unix: float, snapshot: Dict[str, Any]
+) -> Dict[str, Any]:
+    """Replica -> router: the replica's own registry snapshot
+    (``GET /metrics-snapshot``) — ``MetricsRegistry.export_snapshot``
+    output plus the wall clock the router's federation layer needs to
+    re-anchor by skew. The router ships per-scrape *deltas* into its
+    federated registry (``snapshot_delta``), so the frame stays the
+    raw cumulative snapshot."""
+    return {
+        "t": "metrics_snapshot",
+        "v": FLEET_WIRE_V,
+        "epoch_unix": float(epoch_unix),
+        "snapshot": snapshot,
+    }
+
+
+def trace_doc_frame(
+    epoch_unix: float, doc: Dict[str, Any]
+) -> Dict[str, Any]:
+    """Replica -> router: one raw per-request trace document
+    (``GET /trace-doc/{id}``) for cross-process stitching. Carries the
+    replica's wall clock so the router can re-anchor the replica's
+    span offsets onto its own timeline (round-10 skew convention)."""
+    return {
+        "t": "trace_doc",
+        "v": FLEET_WIRE_V,
+        "epoch_unix": float(epoch_unix),
+        "doc": doc,
+    }
+
+
 # -- recv-side tolerant parsers ----------------------------------------
 
 
@@ -106,6 +141,7 @@ def parse_fleet_state(doc: Any) -> Optional[Dict[str, Any]]:
         # knows this replica speaks only the health-probe protocol
         "fleet_protocol": t == "fleet_state",
         "warm_probe": bool(doc.get("warm_probe", False)),
+        "fleet_obs": bool(doc.get("fleet_obs", False)),
     }
 
 
@@ -118,6 +154,40 @@ def parse_warm_report(doc: Any) -> int:
         return max(0, int(doc.get("warm_tokens") or 0))
     except (TypeError, ValueError):
         return 0
+
+
+def parse_metrics_snapshot(doc: Any) -> Optional[Dict[str, Any]]:
+    """Tolerant read of a ``metrics_snapshot`` frame. Returns
+    ``{"epoch_unix": float, "snapshot": dict}`` or None when the
+    document is unusable (an old replica 404s the endpoint — the
+    router just skips federation for it)."""
+    if not isinstance(doc, dict) or doc.get("t") != "metrics_snapshot":
+        return None
+    snap = doc.get("snapshot")
+    if not isinstance(snap, dict):
+        return None
+    try:
+        epoch = float(doc.get("epoch_unix") or 0.0)
+    except (TypeError, ValueError):
+        epoch = 0.0
+    return {"epoch_unix": epoch, "snapshot": snap}
+
+
+def parse_trace_doc(doc: Any) -> Optional[Dict[str, Any]]:
+    """Tolerant read of a ``trace_doc`` frame. Returns
+    ``{"epoch_unix": float, "doc": dict}`` or None — a replica that
+    evicted (or never had) the trace degrades the stitch to
+    router-spans-only, never an error."""
+    if not isinstance(doc, dict) or doc.get("t") != "trace_doc":
+        return None
+    inner = doc.get("doc")
+    if not isinstance(inner, dict):
+        return None
+    try:
+        epoch = float(doc.get("epoch_unix") or 0.0)
+    except (TypeError, ValueError):
+        epoch = 0.0
+    return {"epoch_unix": epoch, "doc": inner}
 
 
 def load_score(load: Dict[str, Any]) -> int:

@@ -62,6 +62,7 @@ class Replica:
         # fleet endpoints — satellite: old replica vs new router)
         self.fleet_protocol = True
         self.warm_probe = True
+        self.fleet_obs = True
         # breaker transition timestamps (monotonic) for flap detection
         self.transitions: List[float] = []
 
@@ -150,6 +151,7 @@ class FleetMembership:
                 r.models = list(state_doc["models"])
             r.fleet_protocol = bool(state_doc.get("fleet_protocol", False))
             r.warm_probe = bool(state_doc.get("warm_probe", False))
+            r.fleet_obs = bool(state_doc.get("fleet_obs", False))
             old = self._transition(r, CLOSED, now)
             if old is not None:
                 fired = (r.rid, old, CLOSED)
@@ -278,5 +280,6 @@ class FleetMembership:
             "models": list(r.models),
             "fleet_protocol": r.fleet_protocol,
             "warm_probe": r.warm_probe,
+            "fleet_obs": r.fleet_obs,
             "consecutive_failures": r.consecutive_failures,
         }

@@ -21,6 +21,16 @@ Behind it:
   Chunk-granular first-result-wins (round 11) means zero rows lost or
   duplicated — resumed work skips every row already flushed.
 
+- **Observability plane** (obs.py): every relayed interactive request
+  gets a router trace (``route_pick`` → ``affinity_probe`` →
+  ``upstream_connect`` → ``first_byte``) whose id travels to the
+  picked replica in the ``X-Sutro-Trace`` header; ``GET /trace/{id}``
+  stitches both halves into one Perfetto timeline. ``GET /metrics``
+  federates every replica's registry snapshot under a ``replica``
+  label next to the router's own series; ``GET /fleet-monitor`` (and
+  ``/stream``) serve the fleet SLO monitor; ``GET /replay-log`` drains
+  the trace ring as a replayable workload (``sutro replay record``).
+
 Fault sites: ``fleet.route`` (router pick — a raising kind fails the
 chosen replica for one request), ``fleet.probe`` (health.py), and
 ``fleet.replica_crash`` (server.py, simulated replica death) drive the
@@ -38,9 +48,11 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from .. import telemetry
 from ..engine import faults
+from ..telemetry.monitor import monitor_enabled
 from .affinity import WarmAffinity
 from .health import HealthProber
 from .membership import OPEN, FleetMembership
+from .obs import FleetMonitor, FleetObservability
 
 logger = logging.getLogger(__name__)
 
@@ -88,6 +100,8 @@ class FleetRouter:
         probe_interval: float = 1.0,
         probe_timeout: float = 2.0,
         stall_timeout: float = STALL_TIMEOUT_S,
+        monitor_interval: Optional[float] = None,
+        monitor_window: Optional[float] = None,
     ):
         self.stall_timeout = float(stall_timeout)
         self.membership = FleetMembership(
@@ -109,6 +123,21 @@ class FleetRouter:
             "failover_stream_error": 0,
             "probe_only_routes": 0,
         }
+        # observability plane: always constructed (every entry point
+        # early-returns when telemetry is off — zero per-request cost);
+        # the scrape cache rides the probe cadence so federation lag
+        # tracks membership lag
+        self.obs = FleetObservability(
+            scrape_interval_s=max(float(probe_interval), 0.05),
+            scrape_timeout=probe_timeout,
+        )
+        self.monitor: Optional[FleetMonitor] = None
+        if telemetry.ENABLED and monitor_enabled():
+            self.monitor = FleetMonitor(
+                self,
+                interval_s=monitor_interval,
+                window_s=monitor_window,
+            )
 
     # -- lifecycle -----------------------------------------------------
 
@@ -118,8 +147,12 @@ class FleetRouter:
             # sees real membership instead of all-unprobed
             self.prober.sweep_once()
         self.prober.start()
+        if self.monitor is not None:
+            self.monitor.start()
 
     def stop(self) -> None:
+        if self.monitor is not None:
+            self.monitor.stop()
         self.prober.stop()
 
     # -- bookkeeping ---------------------------------------------------
@@ -151,6 +184,11 @@ class FleetRouter:
             doc["jobs_tracked"] = len(self._job_owner)
         doc["doctor"] = doctor.diagnose_fleet(doc)
         doc["stall_timeout_s"] = self.stall_timeout
+        # observability surfacing: degraded-protocol routes at top
+        # level (sutro fleet status prints them) + route latency from
+        # the router's own sutro_fleet_route_seconds series
+        doc["probe_only_routes"] = doc["counters"]["probe_only_routes"]
+        doc["route_latency"] = self.obs.route_latency_summary()
         return doc
 
     # -- candidate selection -------------------------------------------
@@ -341,8 +379,21 @@ class FleetHTTPHandler(BaseHTTPRequestHandler):
                 self._json({"fleet": self.router.snapshot()})
             elif head == "metrics":
                 self._metrics()
+            elif head == "fleet-monitor":
+                self._fleet_monitor(rest)
+            elif head == "replay-log":
+                self._replay_log()
             elif head == "stream-job-progress" and rest:
                 self._relay_progress(rest)
+            elif (
+                head == "trace"
+                and rest
+                and self.router.obs.has_trace(rest)
+            ):
+                # a ROUTER trace id: stitch router + replica spans into
+                # one Perfetto-loadable timeline. Engine trace ids fall
+                # through to the job-scoped forward below.
+                self._stitched_trace(rest)
             elif head in _JOB_GET_HEADS and rest:
                 self._forward_job_get(head, rest)
             elif head in _ANY_GET_HEADS:
@@ -395,7 +446,15 @@ class FleetHTTPHandler(BaseHTTPRequestHandler):
         )
 
     def _metrics(self) -> None:
-        data = telemetry.REGISTRY.to_prometheus().encode()
+        """Federated fleet scrape: pull every obs-capable replica's
+        registry snapshot (cache-bounded), fold the deltas in under a
+        ``replica`` label, refresh the router's census gauges, render
+        the federated registry — one scrape shows per-replica TTFT/ITL
+        next to the fleet aggregate and the router's own series."""
+        obs = self.router.obs
+        obs.federate(self.router.membership)
+        obs.refresh_router_gauges(self.router.membership.snapshot())
+        data = obs.registry.to_prometheus().encode()
         self.send_response(200)
         self.send_header(
             "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
@@ -403,6 +462,81 @@ class FleetHTTPHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
+
+    def _stitched_trace(self, trace_id: str) -> None:
+        """Chrome trace-event JSON served RAW (same contract as the
+        engine's /trace/{id}): ``curl .../trace/<id> > t.json`` loads
+        in Perfetto with one process lane group per participant."""
+        from ..telemetry import traceexport
+
+        doc = self.router.obs.stitch_trace(trace_id)
+        if doc is None:
+            self._error(404, f"unknown trace {trace_id}")
+            return
+        self._json(traceexport.stitched_to_chrome(doc))
+
+    def _fleet_monitor(self, rest: Optional[str]) -> None:
+        mon = self.router.monitor
+        if mon is None:
+            self._error(
+                404,
+                "fleet monitor disabled (SUTRO_TELEMETRY=0 or "
+                "SUTRO_MONITOR=0)",
+            )
+            return
+        if rest == "stream":
+            self._stream_fleet_monitor(mon)
+        elif rest is None:
+            self._json({"fleet_monitor": mon.snapshot_doc()})
+        else:
+            self._error(404, f"Unknown endpoint GET /fleet-monitor/{rest}")
+
+    def _stream_fleet_monitor(self, mon: Any) -> None:
+        """NDJSON fleet-monitor stream (chunked), one record per
+        sampler tick — same transfer mechanics and ``?ticks=N`` bound
+        as the engine daemon's /monitor/stream."""
+        max_ticks: Optional[int] = None
+        q = self.path.partition("?")[2]
+        for kv in q.split("&"):
+            k, _, v = kv.partition("=")
+            if k == "ticks" and v.isdigit():
+                max_ticks = int(v)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def send_chunk(obj: Dict[str, Any]) -> None:
+            line = (json.dumps(obj) + "\n").encode()
+            self.wfile.write(f"{len(line):X}\r\n".encode() + line + b"\r\n")
+            self.wfile.flush()
+
+        try:
+            for rec in mon.stream(max_ticks=max_ticks):
+                send_chunk(rec)
+        except (BrokenPipeError, ConnectionResetError):
+            return  # client detached — the monitor keeps sampling
+        except Exception:  # noqa: BLE001 — headers already sent; end
+            # the chunked body cleanly instead of corrupting it
+            logger.warning("fleet monitor stream aborted", exc_info=True)
+        try:
+            send_chunk({"t": "end", "degraded": mon.failed})
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def _replay_log(self) -> None:
+        """The trace ring as replayable records (``sutro replay
+        record`` drains this into a JSONL file)."""
+        from . import replay as replay_mod
+
+        self._json(
+            {
+                "records": replay_mod.records_from_traces(
+                    self.router.obs.traces
+                )
+            }
+        )
 
     # -- forwarding ----------------------------------------------------
 
@@ -414,6 +548,7 @@ class FleetHTTPHandler(BaseHTTPRequestHandler):
         stream: bool = False,
         read_timeout: float = READ_TIMEOUT_S,
         content_type: Optional[str] = None,
+        trace_id: Optional[str] = None,
     ) -> Any:
         import requests
 
@@ -421,6 +556,11 @@ class FleetHTTPHandler(BaseHTTPRequestHandler):
         ct = content_type or self.headers.get("Content-Type")
         if ct and method == "post":
             headers["Content-Type"] = ct
+        if trace_id is not None:
+            # cross-process trace propagation: the replica's gateway
+            # adopts this id instead of minting its own (old replicas
+            # ignore the header — stitch degrades, never breaks)
+            headers["X-Sutro-Trace"] = trace_id
         fn = requests.get if method == "get" else requests.post
         kwargs: Dict[str, Any] = {
             "timeout": (CONNECT_TIMEOUT_S, read_timeout),
@@ -482,6 +622,7 @@ class FleetHTTPHandler(BaseHTTPRequestHandler):
     # -- batch submit + progress relay ---------------------------------
 
     def _relay_batch_submit(self, body: bytes) -> None:
+        t_arrival = time.monotonic()
         last_err: Optional[str] = None
         for r in self.router.candidates_batch()[:MAX_ROUTE_ATTEMPTS]:
             if self.router._route_fault(r["rid"]):
@@ -504,6 +645,9 @@ class FleetHTTPHandler(BaseHTTPRequestHandler):
                     self.router.set_job_owner(job_id, r["rid"])
                     self.router.membership.bump_load(r["rid"])
                     self.router._count("batch_routed")
+                self.router.obs.observe_route(
+                    time.monotonic() - t_arrival, "batch"
+                )
             self._relay_response(resp)
             return
         self._error(
@@ -628,6 +772,7 @@ class FleetHTTPHandler(BaseHTTPRequestHandler):
     # -- interactive relay ---------------------------------------------
 
     def _relay_interactive(self, tail: str, body: bytes) -> None:
+        t_arrival = time.monotonic()
         chat = tail == "chat/completions"
         try:
             doc = json.loads(body) if body else {}
@@ -637,8 +782,29 @@ class FleetHTTPHandler(BaseHTTPRequestHandler):
             )
             return
         wants_stream = bool(doc.get("stream"))
+        obs = self.router.obs
+        from . import replay as replay_mod
+
+        tid = obs.trace_begin(
+            "interactive",
+            replay_mod.replay_attrs(
+                doc, chat, wants_stream, time.time(), len(body)
+            ),
+            t0_mono=t_arrival,
+        )
+        t_probe = time.monotonic()
         cands, scores = self.router.candidates_interactive(doc, chat)
+        t_picked = time.monotonic()
+        obs.span(
+            tid, "affinity_probe", t_probe, t_picked - t_probe,
+            {"n_healthy": len(cands)},
+        )
+        obs.span(
+            tid, "route_pick", t_arrival, t_picked - t_arrival,
+            {"n_candidates": len(cands)},
+        )
         if not cands:
+            obs.end(tid, "error")
             self._openai_error(
                 503, "no healthy replica available", "service_unavailable"
             )
@@ -650,10 +816,15 @@ class FleetHTTPHandler(BaseHTTPRequestHandler):
                 break
             if self.router._route_fault(r["rid"]):
                 last_err = f"route fault injected for {r['rid']}"
+                obs.event(
+                    tid, "retry_failover",
+                    {"rid": r["rid"], "reason": "route fault injected"},
+                )
                 self._note_interactive_retry(tried)
                 tried += 1
                 continue
             tried += 1
+            t_conn = time.monotonic()
             try:
                 resp = self._upstream(
                     "post",
@@ -664,12 +835,25 @@ class FleetHTTPHandler(BaseHTTPRequestHandler):
                     if wants_stream
                     else READ_TIMEOUT_S,
                     content_type="application/json",
+                    trace_id=tid,
                 )
             except OSError as e:
                 # died before ANY response: transparent retry
                 last_err = f"{r['rid']}: {e}"
+                obs.event(
+                    tid, "retry_failover",
+                    {"rid": r["rid"], "reason": f"{type(e).__name__}"},
+                )
                 self._note_interactive_retry(tried - 1)
                 continue
+            obs.span(
+                tid, "upstream_connect", t_conn,
+                time.monotonic() - t_conn,
+                {"rid": r["rid"], "status": resp.status_code},
+            )
+            obs.annotate(
+                tid, {"replica": r["rid"], "replica_url": r["url"]}
+            )
             self.router._count("interactive_routed")
             self.router.membership.bump_load(r["rid"])
             if scores.get(r["rid"], 0) > 0:
@@ -678,11 +862,19 @@ class FleetHTTPHandler(BaseHTTPRequestHandler):
                     telemetry.FLEET_ROUTED_PREFIX_HITS_TOTAL.inc(1.0)
             if not r.get("fleet_protocol"):
                 self.router._count("probe_only_routes")
+            obs.observe_route(
+                time.monotonic() - t_arrival, "interactive", tid
+            )
             if wants_stream and resp.status_code == 200:
-                self._relay_sse(r["rid"], resp)
+                self._relay_sse(r["rid"], resp, tid=tid)
             else:
+                obs.event(tid, "first_byte", {"rid": r["rid"]})
                 self._relay_response(resp)
+                obs.end(
+                    tid, "ok" if resp.status_code == 200 else "error"
+                )
             return
+        obs.end(tid, "error")
         self._openai_error(
             503,
             f"no replica answered after {tried} attempt(s) "
@@ -696,13 +888,16 @@ class FleetHTTPHandler(BaseHTTPRequestHandler):
             if telemetry.ENABLED:
                 telemetry.FLEET_FAILOVERS_TOTAL.inc(1.0, "interactive")
 
-    def _relay_sse(self, rid: str, resp: Any) -> None:
+    def _relay_sse(
+        self, rid: str, resp: Any, tid: Optional[str] = None
+    ) -> None:
         """Relay an upstream SSE stream. The first relayed byte commits
         us to this replica: after it, an upstream death or stall
         becomes a structured error frame + [DONE] within the stall
         timeout — the mid-stream contract is 'never a silent hang',
         not 'hide the failure' (a transparent mid-stream retry would
         replay tokens)."""
+        obs = self.router.obs
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
@@ -714,15 +909,20 @@ class FleetHTTPHandler(BaseHTTPRequestHandler):
             self.wfile.flush()
 
         clean_done = False
+        first = True
         failed: Optional[str] = None
         try:
             for chunk in resp.iter_content(chunk_size=None):
                 if not chunk:
                     continue
+                if first:
+                    first = False
+                    obs.event(tid, "first_byte", {"rid": rid})
                 send(chunk)
                 if b"[DONE]" in chunk:
                     clean_done = True
         except (BrokenPipeError, ConnectionResetError):
+            obs.end(tid, "client_detached")
             return  # our client detached; upstream cancels via its ping
         except OSError as e:
             failed = f"replica connection lost mid-stream: {e}"
@@ -730,6 +930,7 @@ class FleetHTTPHandler(BaseHTTPRequestHandler):
             failed = f"mid-stream relay error: {type(e).__name__}: {e}"
         if not clean_done and failed is None:
             failed = "replica closed the stream without [DONE]"
+        obs.end(tid, "ok" if failed is None else "stream_error")
         if failed is not None:
             self.router._count("failover_stream_error")
             if telemetry.ENABLED:
@@ -778,6 +979,8 @@ def start_fleet_thread(
     probe_interval: float = 0.25,
     probe_timeout: float = 2.0,
     stall_timeout: float = STALL_TIMEOUT_S,
+    monitor_interval: Optional[float] = None,
+    monitor_window: Optional[float] = None,
 ) -> Tuple[FleetRouter, ThreadingHTTPServer, threading.Thread, str]:
     """Start a router + HTTP thread (tests/benchmarks); returns
     (router, server, thread, base_url)."""
@@ -786,6 +989,8 @@ def start_fleet_thread(
         probe_interval=probe_interval,
         probe_timeout=probe_timeout,
         stall_timeout=stall_timeout,
+        monitor_interval=monitor_interval,
+        monitor_window=monitor_window,
     )
     router.start()
     server = make_fleet_server(router, host, port)

@@ -1297,6 +1297,39 @@ class Sutro(EmbeddingTemplates, ClassificationTemplates, EvalTemplates):
         resp.raise_for_status()
         return resp.json().get("fleet")
 
+    def get_fleet_monitor(self) -> Optional[Dict[str, Any]]:
+        """Fleet SLO monitor snapshot from a fleet router
+        (OBSERVABILITY.md "Fleet observability"): fleet-wide windowed
+        stats, rule states, alert events with exemplar trace ids, and
+        the fleet doctor verdict. None when the endpoint doesn't exist
+        (single daemon / local backend); raises ``KeyError`` when the
+        router answers but the monitor is disabled."""
+        if self.backend != "remote":
+            return None
+        resp = self.do_request("get", "fleet-monitor")
+        if resp.status_code == 404:
+            try:
+                detail = resp.json().get("error", "")
+            except ValueError:
+                detail = ""
+            if "disabled" in str(detail):
+                raise KeyError(detail)
+            return None
+        resp.raise_for_status()
+        return resp.json().get("fleet_monitor")
+
+    def get_replay_log(self) -> Optional[List[Dict[str, Any]]]:
+        """Replayable records drained from a fleet router's trace ring
+        (``sutro replay record``). None when the endpoint doesn't
+        exist (single daemon / local backend)."""
+        if self.backend != "remote":
+            return None
+        resp = self.do_request("get", "replay-log")
+        if resp.status_code == 404:
+            return None
+        resp.raise_for_status()
+        return resp.json().get("records")
+
     def clear_job_results_cache(self) -> int:
         """Remove ~/.sutro/job-results (reference sdk.py:1640-1675)."""
         d = self._cache_dir()

@@ -32,6 +32,14 @@ diagnosis of that document; GET /monitor serves the live SLO monitor's
 consolidated document (windowed rates/percentiles, alert state, in-
 flight doctor verdicts) and GET /monitor/stream tails it as NDJSON,
 one record per sampler tick (404 when the monitor is disabled).
+
+Fleet observability (OBSERVABILITY.md "Fleet observability"): GET
+/metrics-snapshot serves the raw registry snapshot the fleet router
+federates under a ``replica`` label; GET /trace-doc/{id} serves one raw
+per-request trace document for the router's cross-process stitcher;
+and an ``X-Sutro-Trace`` request header on /v1/* makes the gateway
+ADOPT the router-assigned trace id instead of minting one (old
+replicas ignore the header — the trace degrades to replica-local).
 """
 
 from __future__ import annotations
@@ -207,6 +215,10 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
                 self._json({"quotas": eng.get_quotas()})
             elif head == "metrics":
                 self._metrics()
+            elif head == "metrics-snapshot":
+                self._metrics_snapshot()
+            elif head == "trace-doc" and rest:
+                self._trace_doc(rest)
             elif head == "job-telemetry" and rest:
                 self._json({"telemetry": eng.job_telemetry(rest)})
             elif head == "job-doctor" and rest:
@@ -420,6 +432,40 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    def _metrics_snapshot(self) -> None:
+        """Raw registry snapshot for fleet-router federation
+        (fleet/frames.py ``metrics_snapshot``): the router ships
+        per-scrape DELTAS of this into its replica-labelled federated
+        registry, so the frame stays the plain cumulative export. An
+        old router never calls this; an old replica 404s it and the
+        router skips federation for that replica."""
+        import time
+
+        from . import telemetry
+        from .fleet import frames as fleet_frames
+
+        self._json(
+            fleet_frames.metrics_snapshot_frame(
+                time.time(), telemetry.REGISTRY.export_snapshot()
+            )
+        )
+
+    def _trace_doc(self, trace_id: str) -> None:
+        """One raw per-request trace document (NOT Chrome-rendered —
+        that's GET /trace/{id}) for the fleet router's cross-process
+        stitcher, with this replica's wall clock for skew
+        re-anchoring. 404 when evicted/unknown: the router degrades
+        the stitch to router-spans-only."""
+        import time
+
+        from . import telemetry
+        from .fleet import frames as fleet_frames
+
+        doc = telemetry.TRACES.doc(trace_id)
+        if doc is None:
+            raise KeyError(trace_id)
+        self._json(fleet_frames.trace_doc_frame(time.time(), doc))
+
     def _stream_progress(self, job_id: str) -> None:
         """NDJSON progress stream (chunked) — reference sdk.py:311-367.
         ``?cursor=N`` suppresses progress records at or below N rows
@@ -556,8 +602,19 @@ class EngineHTTPHandler(BaseHTTPRequestHandler):
         except oai.BadServingRequest as e:
             self._openai_error(400, str(e))
             return
+        # cross-process trace propagation (fleet/router.py front door):
+        # a router-assigned X-Sutro-Trace id is ADOPTED by the gateway
+        # instead of minting tr-<rid>, so the router's GET /trace/{id}
+        # stitches router + replica spans into one timeline. Malformed
+        # or oversized values are ignored (defensive: the header is an
+        # open surface), degrading to a replica-minted id.
+        ext_tid = self.headers.get("X-Sutro-Trace")
+        if ext_tid is not None and not (
+            ext_tid.startswith("tr-") and 3 < len(ext_tid) <= 64
+        ):
+            ext_tid = None
         try:
-            ir = gw.submit(sreq)
+            ir = gw.submit(sreq, trace_id=ext_tid)
         except GatewayRejected as e:
             self._openai_error(
                 e.status,

@@ -157,7 +157,14 @@ class InteractiveGateway:
 
     # -- admission (HTTP handler / SDK thread) -------------------------
 
-    def submit(self, sreq: ServingRequest) -> InteractiveRequest:
+    def submit(
+        self, sreq: ServingRequest, trace_id: Optional[str] = None
+    ) -> InteractiveRequest:
+        """``trace_id`` is an externally-assigned trace id (the fleet
+        router's ``X-Sutro-Trace`` header, via server.py): when given
+        and telemetry is on, the request's trace ADOPTS that id instead
+        of minting ``tr-<rid>`` — the cross-process propagation that
+        lets the router stitch its spans with ours."""
         t_submit = time.monotonic()
         rid = f"ivr-{next(self._counter)}"
         if faults.ACTIVE is not None:
@@ -348,14 +355,16 @@ class InteractiveGateway:
             row_seed=sreq.seed,
             stop_seqs=[s.encode() for s in stop_strs] or None,
         )
-        trace_id = None
-        if telemetry.ENABLED:
+        if not telemetry.ENABLED:
+            trace_id = None
+        else:
             # forensics trace (OBSERVABILITY.md "Forensics"): the id
             # propagates through JobCtx into the scheduler's child
             # spans and through the channel into the server's SSE
             # flush spans; ended by finish(). Handle deliberately not
             # held — the id string IS the cross-function context.
-            trace_id = f"tr-{rid}"
+            if trace_id is None:
+                trace_id = f"tr-{rid}"
             telemetry.TRACES.start_trace(
                 trace_id,
                 "interactive",

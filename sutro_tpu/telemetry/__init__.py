@@ -364,6 +364,15 @@ FLEET_ROUTED_PREFIX_HITS_TOTAL = REGISTRY.counter(
     "Interactive requests routed to a replica reporting > 0 warm "
     "prefix tokens (the SGLang-style cache-aware routing win)",
 )
+FLEET_ROUTE_SECONDS = REGISTRY.histogram(
+    "sutro_fleet_route_seconds",
+    "Router time from request arrival to the routing decision landing "
+    "on a replica (candidate scoring + affinity probe + upstream "
+    "connect, retries included); exemplars carry the router trace id",
+    labels=("kind",),  # interactive | batch
+    unit="seconds",
+    max_series=8,
+)
 
 # Span names the engine emits — OBSERVABILITY.md's span schema section
 # and tests key off this tuple, so additions land in one place.

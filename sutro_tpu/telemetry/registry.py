@@ -235,7 +235,11 @@ class MetricsRegistry:
     #: shards from more distinct workers collapse into "_overflow"
     MAX_WORKERS = 64
 
-    def __init__(self) -> None:
+    def __init__(self, federation_label: str = "worker") -> None:
+        # the trailing label federated series gain: "worker" for the dp
+        # coordinator (the historical default, pinned by dp goldens),
+        # "replica" for the fleet router's federated registry
+        self.federation_label = federation_label
         self._lock = threading.Lock()
         self._metrics: Dict[str, _Metric] = {}
         self._gauges: Dict[Tuple[str, Tuple[str, ...]], float] = {}
@@ -381,7 +385,7 @@ class MetricsRegistry:
                 "help": m.help,
                 "unit": m.unit,
                 "labels": list(m.label_names)
-                + (["worker"] if federated else []),
+                + ([self.federation_label] if federated else []),
                 "series": {},
             }
             if isinstance(m, Gauge):
@@ -580,7 +584,7 @@ class MetricsRegistry:
                     if (
                         ex_series is None
                         and names
-                        and names[-1] == "worker"
+                        and names[-1] == self.federation_label
                         and values[-1:] == ("0",)
                     ):
                         # federated metric: exemplars live on the

@@ -35,12 +35,26 @@ _LANES = (
 
 _PID = 1
 
+# Router-process lanes for stitched fleet traces (fleet/router.py spans)
+# — the front door's waterfall: pick -> probe -> connect -> first byte.
+_ROUTER_LANES = (
+    ("route", ("route_pick", "affinity_probe", "retry_failover")),
+    ("upstream", ("upstream_connect", "first_byte")),
+)
+
 
 def _lane_of(name: str) -> int:
     for i, (_, members) in enumerate(_LANES):
         if name in members:
             return i
     return len(_LANES)
+
+
+def _lane_in(name: str, lanes) -> int:
+    for i, (_, members) in enumerate(lanes):
+        if name in members:
+            return i
+    return len(lanes)
 
 
 def _us(seconds: float) -> int:
@@ -171,6 +185,102 @@ def job_doc_to_chrome(doc: Dict[str, Any]) -> Dict[str, Any]:
         "otherData": {"job_id": job_id},
         "traceEvents": events,
     }
+
+
+def stitched_to_chrome(doc: Dict[str, Any]) -> Dict[str, Any]:
+    """Stitched fleet trace document (fleet/obs.py ``stitch_trace``)
+    -> Chrome trace-event JSON with one *process* lane group per
+    participating process: the router is pid 1 with its own lane
+    family (route/upstream), each replica gets the standard engine
+    waterfall lanes under pid 2+. Every span's start offset is on the
+    ROUTER's clock — the stitcher already re-anchored replica spans by
+    wall-clock skew (round-10 ``ingest_remote`` convention), so the
+    handoff reads left to right across process lanes in Perfetto."""
+    events: List[Dict[str, Any]] = []
+    for pidx, proc in enumerate(doc.get("processes", ())):
+        pid = pidx + 1
+        pdoc = proc.get("doc") or {}
+        t_off = float(proc.get("t_off_s") or 0.0)
+        lanes = _ROUTER_LANES if proc.get("role") == "router" else _LANES
+        events.append(
+            {
+                "ph": "M",
+                "pid": pid,
+                "tid": 0,
+                "name": "process_name",
+                "args": {"name": proc.get("process", f"p{pid}")},
+            }
+        )
+        lanes_used = set()
+        for span in pdoc.get("spans", ()):
+            name = span.get("name", "?")
+            tid = _lane_in(name, lanes)
+            lanes_used.add(tid)
+            ev: Dict[str, Any] = {
+                "ph": "X",
+                "pid": pid,
+                "tid": tid,
+                "name": name,
+                "ts": _us(span.get("t0_s", 0.0) + t_off),
+                "dur": max(_us(span.get("dur_s", 0.0)), 1),
+            }
+            if span.get("attrs"):
+                ev["args"] = dict(span["attrs"])
+            events.append(ev)
+        for i, (lane_name, _) in enumerate(lanes):
+            if i in lanes_used:
+                events.append(
+                    {
+                        "ph": "M",
+                        "pid": pid,
+                        "tid": i,
+                        "name": "thread_name",
+                        "args": {"name": lane_name},
+                    }
+                )
+        if len(lanes) in lanes_used:
+            events.append(
+                {
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": len(lanes),
+                    "name": "thread_name",
+                    "args": {"name": "other"},
+                }
+            )
+    return {
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "trace_id": doc.get("trace_id"),
+            "kind": doc.get("kind", "fleet"),
+            "processes": [
+                p.get("process") for p in doc.get("processes", ())
+            ],
+        },
+        "traceEvents": events,
+    }
+
+
+def stitched_spans(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Flatten a stitched fleet trace document into one merged span
+    list on the router's clock, sorted by start — what the acceptance
+    test walks to assert the cross-process handoff has no negative
+    gaps after skew re-anchoring."""
+    out: List[Dict[str, Any]] = []
+    for proc in doc.get("processes", ()):
+        pdoc = proc.get("doc") or {}
+        t_off = float(proc.get("t_off_s") or 0.0)
+        for span in pdoc.get("spans", ()):
+            out.append(
+                {
+                    "name": span.get("name", "?"),
+                    "t0_s": round(span.get("t0_s", 0.0) + t_off, 6),
+                    "dur_s": span.get("dur_s", 0.0),
+                    "process": proc.get("process"),
+                }
+            )
+    out.sort(key=lambda s: (s["t0_s"], s["name"]))
+    return out
 
 
 def render(chrome_doc: Dict[str, Any]) -> str:

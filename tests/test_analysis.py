@@ -1245,6 +1245,26 @@ def _injected_trace(flag):
     assert "trace-ctx-dropped" in res.stdout
 
 
+def test_injected_unforwarded_fleet_trace_fails_gate(tmp_path):
+    """The fleet sub-pass of trace-ctx-dropped: strip the router's
+    ``trace_id=tid`` forwarding from its upstream relay — the request
+    still works, but the replica half of every cross-process stitch is
+    silently lost, and the gate must catch exactly that."""
+    dst = _copy_tree(tmp_path)
+    rt = dst / "fleet" / "router.py"
+    src = rt.read_text()
+    anchor = "                    trace_id=tid,\n"
+    assert anchor in src
+    rt.write_text(src.replace(anchor, "", 1))
+    res = run_cli(
+        ["sutro_tpu", "--baseline", str(BASELINE)], cwd=tmp_path
+    )
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "trace-ctx-dropped" in res.stdout
+    assert "fleet/router.py" in res.stdout
+    assert "never forwarded" in res.stdout
+
+
 def test_injected_identifier_label_fails_gate(tmp_path):
     dst = _copy_tree(tmp_path)
     js = dst / "engine" / "jobstore.py"
